@@ -1,0 +1,26 @@
+"""cometbft_tpu — a TPU-native Byzantine-fault-tolerant replication framework.
+
+A from-scratch framework with the capability surface of CometBFT
+(Tendermint consensus, ABCI application interface, mempool, block sync,
+state sync, light client, evidence handling, RPC/CLI tooling), designed
+TPU-first: the signature-verification plane — the only embarrassingly
+parallel compute in a BFT node — is a JAX/XLA batch kernel reached through
+the pluggable ``BatchVerifier`` seam (reference: crypto/crypto.go:44),
+so an entire validator set's commit signatures land as one device launch.
+
+Layer map (mirrors SURVEY.md §1):
+  L0 foundation   — utils/, crypto/, types/, config/
+  L1 persistence  — store/, state/ (+ wal/)
+  L2 app iface    — abci/, proxy/
+  L3 comms        — p2p/
+  L4 reactors     — consensus/, mempool/, blocksync/, statesync/, evidence/
+  L5 runtime      — node/
+  L6 APIs         — rpc/, light/
+  L7 CLI          — cmd/
+TPU compute plane — ops/ (kernels), parallel/ (mesh + sharding), models/
+  (jittable end-to-end verification workloads: the "flagship models").
+"""
+
+from cometbft_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,1 @@
+"""Foundation utilities (reference: libs/ — log, service, sync, bytes, time)."""

@@ -1,0 +1,95 @@
+"""Service lifecycle base class.
+
+Every long-lived component embeds this, mirroring the reference's
+``service.BaseService`` (libs/service/service.go): idempotent
+start/stop, a quit event, and overridable on_start/on_stop hooks.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cometbft_tpu.utils.log import Logger, default_logger
+
+
+class ServiceError(RuntimeError):
+    pass
+
+
+class AlreadyStartedError(ServiceError):
+    pass
+
+
+class AlreadyStoppedError(ServiceError):
+    pass
+
+
+class NotStartedError(ServiceError):
+    pass
+
+
+class BaseService:
+    """Idempotent start/stop lifecycle (libs/service/service.go:99).
+
+    Subclasses override :meth:`on_start` / :meth:`on_stop` and may wait on
+    :meth:`quit_event` in background threads.
+    """
+
+    def __init__(self, name: str | None = None, logger: Logger | None = None):
+        self._name = name or type(self).__name__
+        self.logger = logger or default_logger().with_fields(module=self._name)
+        self._mtx = threading.Lock()
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._started:
+                raise AlreadyStartedError(f"{self._name} already started")
+            if self._stopped:
+                raise AlreadyStoppedError(f"{self._name} already stopped")
+            self._started = True
+        self.logger.info("service start")
+        try:
+            self.on_start()
+        except BaseException:
+            with self._mtx:
+                self._started = False
+            raise
+
+    def stop(self) -> None:
+        with self._mtx:
+            if not self._started:
+                raise NotStartedError(f"{self._name} not started")
+            if self._stopped:
+                return  # stop is idempotent once started
+            self._stopped = True
+        self.logger.info("service stop")
+        self._quit.set()
+        self.on_stop()
+
+    def is_running(self) -> bool:
+        with self._mtx:
+            return self._started and not self._stopped
+
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the service is stopped."""
+        return self._quit.wait(timeout)
+
+    # -- overridables --------------------------------------------------
+
+    def on_start(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def on_stop(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def __repr__(self) -> str:
+        state = "running" if self.is_running() else "stopped"
+        return f"<{self._name} {state}>"
