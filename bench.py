@@ -1,0 +1,81 @@
+"""Headline benchmark: Ed25519 batch-verify throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.json north star): 1M verifies/sec on one TPU v5e.
+
+Run with the default environment (TPU via the axon platform); falls
+back to whatever jax.devices() offers (CPU in dev shells).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+BASELINE_SIGS_PER_SEC = 1_000_000
+
+
+def main() -> None:
+    import jax
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops.ed25519_verify import verify_arrays
+
+    dev = jax.devices()[0]
+    log(f"device: {dev}")
+
+    # Full batch on accelerators; small batch on the CPU dev fallback.
+    n = 256 if dev.platform == "cpu" else 4096
+    msglen = 120
+    rng = np.random.RandomState(0)
+    priv = ed.gen_priv_key()
+    pub_b = np.frombuffer(priv.pub_key().bytes(), dtype=np.uint8)
+    msgs = [
+        rng.randint(0, 256, size=msglen, dtype=np.uint8).tobytes()
+        for _ in range(n)
+    ]
+    t0 = time.time()
+    sigs = np.stack(
+        [np.frombuffer(priv.sign(m), dtype=np.uint8) for m in msgs]
+    )
+    pubs = np.tile(pub_b, (n, 1))
+    log(f"signed {n} msgs in {time.time() - t0:.2f}s (host)")
+
+    t0 = time.time()
+    out = verify_arrays(pubs, sigs, msgs)
+    log(f"first launch (compile) {time.time() - t0:.1f}s")
+    assert bool(out.all()), "benchmark signatures must verify"
+
+    # timed runs
+    best = float("inf")
+    for i in range(3):
+        t0 = time.time()
+        out = verify_arrays(pubs, sigs, msgs)
+        dt = time.time() - t0
+        log(f"run {i}: {n} sigs in {dt * 1e3:.1f} ms = {n / dt:,.0f} sigs/s")
+        best = min(best, dt)
+    assert bool(out.all())
+
+    value = n / best
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(value, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(value / BASELINE_SIGS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,78 @@
-"""Package placeholder — populated as layers land."""
+"""Crypto interfaces (reference: crypto/crypto.go:22-52).
+
+The ``BatchVerifier`` protocol is the seam where the TPU execution
+backend plugs in: 2 methods, zero leakage of consensus types — exactly
+the property that lets an entire validator set's signatures land as a
+single device launch (crypto/crypto.go:44, crypto/batch/batch.go:10).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Protocol, runtime_checkable
+
+ADDRESS_SIZE = 20  # tmhash truncated size (crypto/crypto.go:19)
+
+
+class PubKey(abc.ABC):
+    @abc.abstractmethod
+    def address(self) -> bytes:
+        """20-byte address: sha256(pubkey_bytes)[:20] for ed25519."""
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def type(self) -> str:
+        ...
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, PubKey):
+            return NotImplemented
+        return self.type() == other.type() and self.bytes() == other.bytes()
+
+    def __hash__(self) -> int:
+        return hash((self.type(), self.bytes()))
+
+
+class PrivKey(abc.ABC):
+    @abc.abstractmethod
+    def bytes(self) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def sign(self, msg: bytes) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def pub_key(self) -> PubKey:
+        ...
+
+    @abc.abstractmethod
+    def type(self) -> str:
+        ...
+
+
+@runtime_checkable
+class BatchVerifier(Protocol):
+    """The TPU seam (crypto/crypto.go:44-52).
+
+    ``add`` enqueues one (pubkey, msg, sig) tuple; ``verify`` executes the
+    whole batch — on the TPU backend, as one device launch — and returns
+    (all_valid, per_entry_validity).
+    """
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        ...
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        ...
+
+
+class BatchVerificationError(Exception):
+    pass

@@ -1,0 +1,42 @@
+"""Batch-verifier dispatch by key type (reference: crypto/batch/batch.go:10).
+
+``create_batch_verifier`` returns the best available backend for a key
+type: the TPU (JAX/XLA) batch kernel when a device is usable, else the
+CPU fallback. The selection is behind this single seam so every caller
+(VerifyCommit, light client, blocksync replay, consensus addVote) gets
+the device path for free.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from cometbft_tpu.crypto import BatchVerifier, PubKey
+from cometbft_tpu.crypto import ed25519 as _ed
+
+
+def _ed25519_factory() -> BatchVerifier:
+    if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
+        return _ed.CpuBatchVerifier()
+    try:
+        from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier
+
+        return TpuBatchVerifier()
+    except Exception:
+        return _ed.CpuBatchVerifier()
+
+
+REGISTRY: dict[str, Callable[[], BatchVerifier]] = {
+    _ed.KEY_TYPE: _ed25519_factory,
+}
+
+
+def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
+    """(batch.go:10 CreateBatchVerifier) — raises KeyError for key types
+    without a batch implementation; callers fall back to single verify."""
+    return REGISTRY[pub_key.type()]()
+
+
+def supports_batch_verifier(pub_key: PubKey | None) -> bool:
+    return pub_key is not None and pub_key.type() in REGISTRY

@@ -1,0 +1,144 @@
+"""Ed25519 keys with ZIP-215 verification (reference: crypto/ed25519/ed25519.go).
+
+Single-signature verify uses a two-tier strategy:
+  1. Fast path: the host C implementation (``cryptography``/OpenSSL,
+     strict RFC 8032, cofactorless). Any signature it accepts is also
+     accepted under ZIP-215 (cofactored form of the same equation holds,
+     and its stricter decoding is a subset), so an accept is final.
+  2. On reject, fall back to the pure-Python ZIP-215 oracle
+     (cometbft_tpu.crypto.edwards) to admit the ZIP-215-only edge cases
+     (non-canonical A/R encodings, small-order components) — matching the
+     reference's curve25519-voi semantics (crypto/ed25519/ed25519.go:39).
+
+Batch verification is the TPU plane; see cometbft_tpu.ops.ed25519 and the
+dispatch in cometbft_tpu.crypto.batch. The CPU batch verifier here is the
+correctness fallback mirroring BatchVerifier (ed25519.go:190-222).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519 as _ced
+
+from cometbft_tpu.crypto import BatchVerifier, PrivKey, PubKey, tmhash
+from cometbft_tpu.crypto import edwards
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIVATE_KEY_SIZE = 64  # seed || pubkey, matching the reference layout
+SIGNATURE_SIZE = 64
+SEED_SIZE = 32
+
+
+class Ed25519PubKey(PubKey):
+    __slots__ = ("_bytes", "_lib_key")
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._lib_key = None
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        if self._lib_key is None:
+            try:
+                self._lib_key = _ced.Ed25519PublicKey.from_public_bytes(
+                    self._bytes
+                )
+            except Exception:
+                self._lib_key = False
+        if self._lib_key:
+            try:
+                self._lib_key.verify(sig, msg)
+                return True
+            except InvalidSignature:
+                pass
+        # ZIP-215 edge cases (and keys OpenSSL refuses to load).
+        return edwards.verify_zip215(self._bytes, msg, sig)
+
+    def __repr__(self) -> str:
+        return f"PubKeyEd25519{{{self._bytes.hex().upper()}}}"
+
+
+class Ed25519PrivKey(PrivKey):
+    __slots__ = ("_seed", "_lib_key", "_pub")
+
+    def __init__(self, data: bytes):
+        """Accepts a 32-byte seed or the 64-byte seed||pubkey layout."""
+        if len(data) == PRIVATE_KEY_SIZE:
+            data = data[:SEED_SIZE]
+        if len(data) != SEED_SIZE:
+            raise ValueError("ed25519 private key must be 32 or 64 bytes")
+        self._seed = bytes(data)
+        self._lib_key = _ced.Ed25519PrivateKey.from_private_bytes(self._seed)
+        self._pub = Ed25519PubKey(
+            self._lib_key.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+
+    def bytes(self) -> bytes:
+        """64-byte seed || pubkey, the reference's private-key layout."""
+        return self._seed + self._pub.bytes()
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._lib_key.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return self._pub
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> Ed25519PrivKey:
+    return Ed25519PrivKey(os.urandom(SEED_SIZE))
+
+
+def priv_key_from_secret(secret: bytes) -> Ed25519PrivKey:
+    """Deterministic key from a secret (reference GenPrivKeyFromSecret:
+    seed = sha256(secret)) — test/tooling use only."""
+    return Ed25519PrivKey(tmhash.sum256(secret))
+
+
+class CpuBatchVerifier(BatchVerifier):
+    """Sequential host-side batch verifier — the correctness fallback.
+
+    The production batch path is cometbft_tpu.ops.ed25519.TpuBatchVerifier;
+    both must agree bit-for-bit (differential tests).
+    """
+
+    def __init__(self) -> None:
+        self._entries: list[tuple[Ed25519PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(pub_key, Ed25519PubKey):
+            raise TypeError("CpuBatchVerifier requires ed25519 keys")
+        if len(sig) != SIGNATURE_SIZE:
+            raise ValueError("malformed signature size")
+        self._entries.append((pub_key, bytes(msg), bytes(sig)))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if not self._entries:
+            return False, []
+        results = [
+            pk.verify_signature(msg, sig) for pk, msg, sig in self._entries
+        ]
+        return all(results), results

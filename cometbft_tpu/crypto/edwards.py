@@ -1,0 +1,230 @@
+"""Pure-Python edwards25519 arithmetic — the correctness oracle.
+
+This module is the semantic ground truth for the TPU batch-verify kernel
+(cometbft_tpu/ops): the kernel's precomputed tables are generated from it
+and its verify() defines the accept/reject behavior the kernel must match
+bit-for-bit (differential fuzzing in tests/test_ed25519_kernel.py).
+
+Semantics: **ZIP-215** (matching the reference's curve25519-voi-backed
+verifier, crypto/ed25519/ed25519.go:39):
+  1. A (pubkey) and R (sig[0:32]) decode per RFC 8032 §5.1.3 *without*
+     the canonical-y check — encodings with y >= p are accepted, and
+     x=0-with-sign-bit ("-0") is accepted.
+  2. S (sig[32:64]) must be canonical: S < L.
+  3. Accept iff [8][S]B == [8]R + [8][k]A (cofactored equation),
+     k = SHA-512(R || A || M) mod L.
+
+All group ops use extended twisted Edwards coordinates (X:Y:Z:T) with
+a=-1 ("Twisted Edwards Curves Revisited", Hisil et al. 2008).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# Field and group parameters (RFC 8032 §5.1).
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+# Base point B (RFC 8032): y = 4/5, x recovered with even... positive sign.
+_BY = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """x with x^2 = (y^2-1)/(d*y^2+1), lsb matching ``sign``; None if the
+    quotient is not a square. Accepts x=0 with sign=1 (ZIP-215 "-0")."""
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    # candidate root of u/v: x = u*v^3 * (u*v^7)^((p-5)/8)
+    x = (u * pow(v, 3, P) * pow(u * pow(v, 7, P), (P - 5) // 8, P)) % P
+    vxx = (v * x * x) % P
+    if vxx == u % P:
+        pass
+    elif vxx == (-u) % P:
+        x = (x * SQRT_M1) % P
+    else:
+        return None
+    if x & 1 != sign:
+        x = (P - x) % P
+    return x
+
+
+_BX = _recover_x(_BY, 0)
+assert _BX is not None
+
+# Extended coordinates point: (X, Y, Z, T) with x=X/Z, y=Y/Z, T=XY/Z.
+Point = tuple[int, int, int, int]
+
+IDENTITY: Point = (0, 1, 1, 0)
+B_POINT: Point = (_BX, _BY, 1, (_BX * _BY) % P)
+
+
+def pt_add(p: Point, q: Point) -> Point:
+    """Unified addition, add-2008-hwcd-3 (complete for a=-1, k=2d)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = ((y1 - x1) * (y2 - x2)) % P
+    b = ((y1 + x1) * (y2 + x2)) % P
+    c = (2 * t1 * D % P) * t2 % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = (b - a) % P, (dd - c) % P, (dd + c) % P, (b + a) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def pt_double(p: Point) -> Point:
+    """Doubling, dbl-2008-hwcd."""
+    x1, y1, z1, _ = p
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = (a + b) % P
+    e = (h - (x1 + y1) * (x1 + y1)) % P
+    g = (a - b) % P
+    f = (c + g) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return ((P - x) % P, y, z, (P - t) % P)
+
+
+def pt_mul(k: int, p: Point) -> Point:
+    """Scalar multiplication (double-and-add, MSB first)."""
+    q = IDENTITY
+    for i in reversed(range(k.bit_length())):
+        q = pt_double(q)
+        if (k >> i) & 1:
+            q = pt_add(q, p)
+    return q
+
+
+def pt_equal(p: Point, q: Point) -> bool:
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def pt_is_identity(p: Point) -> bool:
+    x, y, z, _ = p
+    return x % P == 0 and (y - z) % P == 0
+
+
+def pt_to_affine(p: Point) -> tuple[int, int]:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    return (x * zi % P, y * zi % P)
+
+
+def encode_point(p: Point) -> bytes:
+    x, y = pt_to_affine(p)
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def decode_point(s: bytes) -> Point | None:
+    """ZIP-215 decoding: non-canonical y accepted (reduced mod p)."""
+    if len(s) != 32:
+        return None
+    enc = int.from_bytes(s, "little")
+    sign = enc >> 255
+    y = (enc & ((1 << 255) - 1)) % P
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, (x * y) % P)
+
+
+def decode_point_rfc8032(s: bytes) -> Point | None:
+    """Strict RFC 8032 decoding (canonical y, reject -0). Kept for tests
+    contrasting ZIP-215 with the strict rules."""
+    if len(s) != 32:
+        return None
+    enc = int.from_bytes(s, "little")
+    sign = enc >> 255
+    y = enc & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    if x == 0 and sign == 1:
+        return None
+    return (x, y, 1, (x * y) % P)
+
+
+# -- Ed25519 sign/verify (oracle) -------------------------------------
+
+
+def _sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+def secret_expand(seed: bytes) -> tuple[int, bytes]:
+    """RFC 8032 §5.1.5: clamped scalar + hash prefix from a 32-byte seed."""
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
+    h = _sha512(seed)
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def public_key(seed: bytes) -> bytes:
+    a, _ = secret_expand(seed)
+    return encode_point(pt_mul(a, B_POINT))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 §5.1.6 deterministic signing."""
+    a, prefix = secret_expand(seed)
+    pub = encode_point(pt_mul(a, B_POINT))
+    r = int.from_bytes(_sha512(prefix + msg), "little") % L
+    r_enc = encode_point(pt_mul(r, B_POINT))
+    k = int.from_bytes(_sha512(r_enc + pub + msg), "little") % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
+def verify_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """The oracle verifier: ZIP-215 semantics, cofactored equation."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    a_pt = decode_point(pub)
+    r_pt = decode_point(sig[:32])
+    if a_pt is None or r_pt is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = int.from_bytes(_sha512(sig[:32] + pub + msg), "little") % L
+    # [8]([S]B - R - [k]A) == identity
+    q = pt_add(pt_mul(s, B_POINT), pt_neg(pt_add(r_pt, pt_mul(k, a_pt))))
+    for _ in range(3):
+        q = pt_double(q)
+    return pt_is_identity(q)
+
+
+# -- Torsion points (for edge-case tests & differential fuzzing) -------
+
+def small_order_points() -> list[bytes]:
+    """Canonical encodings of the 8 small-order (torsion) points.
+
+    Derived by projecting curve points into the torsion subgroup with
+    [L]Q — every point's L-multiple has order dividing the cofactor 8.
+    """
+    for y in range(2, 1000):
+        x = _recover_x(y % P, 0)
+        if x is None:
+            continue
+        tor = pt_mul(L, (x, y % P, 1, x * y % P))
+        # order-8 generator iff [4]tor is not the identity
+        if not pt_is_identity(pt_mul(4, tor)):
+            out, cur = [], IDENTITY
+            for _ in range(8):
+                out.append(encode_point(cur))
+                cur = pt_add(cur, tor)
+            return out
+    raise AssertionError("torsion enumeration failed")

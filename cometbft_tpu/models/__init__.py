@@ -1,1 +1,14 @@
-"""Package placeholder — populated as layers land."""
+"""Flagship verification workloads ("models") — jittable end-to-end
+compositions of the device kernels, mirroring the reference's headline
+benchmark configs (BASELINE.json):
+
+  commit.py — single-commit and batched-commit verification steps
+              (the VerifyCommit hot path, types/validation.go:220).
+"""
+
+from cometbft_tpu.models.commit import (
+    commit_verify_step,
+    example_inputs,
+)
+
+__all__ = ["commit_verify_step", "example_inputs"]

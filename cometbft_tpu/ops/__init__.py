@@ -1,1 +1,23 @@
-"""Package placeholder — populated as layers land."""
+"""TPU compute plane — JAX/XLA kernels.
+
+The only data-parallel compute in a BFT node is signature verification
+(SURVEY.md §2.10); these modules implement it as batched integer-limb
+arithmetic that XLA fuses into large elementwise launches:
+
+  field.py          — GF(2^255-19) limb arithmetic
+  curve.py          — edwards25519 group ops + scalar multiplication
+  sha512.py         — in-device SHA-512 (vote sign-bytes hashing)
+  scalar.py         — arithmetic mod the group order L
+  ed25519_verify.py — the batch-verify kernel + BatchVerifier provider
+
+64-bit integer mode is required (limb products accumulate in i64), so
+importing this package enables jax x64 process-wide before any tracing.
+This is a deliberate global: the framework is standalone node software
+that owns its process. Embedders who must keep 32-bit defaults should
+isolate verification in a worker process (the node runtime never mixes
+these kernels with float ML workloads in-process).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,229 @@
+"""edwards25519 group operations for the batch-verify kernel.
+
+Points are pytrees (X, Y, Z, T) of lazy field elements (ops/field.py),
+extended twisted Edwards coordinates with a=-1 ("Twisted Edwards Curves
+Revisited", Hisil et al. 2008 — unified/complete formulas, so there is
+no per-lane control flow on point identity: every lane of the batch
+executes the same straight-line code, which is what XLA wants).
+
+Scalar multiplication strategy (per verify, Q = [S]B + [h](-A)):
+- [S]B fixed base: a 64x16 comb table of j*16^w*B in precomputed-Niels
+  form ((y+x, y-x, 2dxy), Z=1) generated on host from the pure-Python
+  oracle — 64 mixed adds, zero doublings.
+- [h](-A) variable base: per-lane 16-entry window table (0..15 times
+  -A), then 64 scan steps of 4 doublings + 1 table add.
+
+Lazy-limb growth budget: every coordinate produced here is a mul output
+(limbs < 2^17); formulas chain at most 2 add/subs before the next mul,
+staying far under field.mul's |limb| < 2^24 input requirement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.crypto import edwards as _ref
+from cometbft_tpu.ops import field as F
+
+# -- constants (host-generated from the oracle) ------------------------
+
+D_LIMBS = F.from_int(_ref.D)
+TWO_D_LIMBS = F.from_int(2 * _ref.D % _ref.P)
+SQRT_M1_LIMBS = F.from_int(_ref.SQRT_M1)
+
+WINDOWS = 64  # 4-bit windows over 256-bit scalars
+
+
+def _niels_from_affine(x: int, y: int) -> np.ndarray:
+    """(y+x, y-x, 2dxy) limbs — shape (3, 16)."""
+    return np.stack(
+        [
+            F.from_int((y + x) % _ref.P),
+            F.from_int((y - x) % _ref.P),
+            F.from_int(2 * _ref.D * x * y % _ref.P),
+        ]
+    )
+
+
+def _build_comb_table() -> np.ndarray:
+    """COMB[w][j] = j * 16^w * B as Niels triples; shape (64, 16, 3, 16).
+
+    j=0 is the Niels identity (1, 1, 0), which the mixed add treats as
+    a no-op projectively — so table lookups need no identity branch.
+    """
+    table = np.zeros((WINDOWS, 16, 3, F.NLIMBS), dtype=np.int64)
+    base = _ref.B_POINT
+    for w in range(WINDOWS):
+        acc = _ref.IDENTITY
+        for j in range(16):
+            if j == 0:
+                table[w, j] = np.stack([F.ONE, F.ONE, F.ZERO])
+            else:
+                acc = _ref.pt_add(acc, base)
+                ax, ay = _ref.pt_to_affine(acc)
+                table[w, j] = _niels_from_affine(ax, ay)
+        for _ in range(4):
+            base = _ref.pt_double(base)
+    return table
+
+
+B_COMB = _build_comb_table()  # (64, 16, 3, 16) int64
+
+
+# -- point algebra -----------------------------------------------------
+
+def identity(batch_shape=()) -> tuple:
+    z = jnp.zeros((*batch_shape, F.NLIMBS), dtype=F.DTYPE)
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), (*batch_shape, F.NLIMBS))
+    return (z, one, one, z)
+
+
+def pt_add(p, q):
+    """Unified extended addition (add-2008-hwcd-3, a=-1, k=2d)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
+    b = F.mul(F.add(y1, x1), F.add(y2, x2))
+    c = F.mul(F.mul(t1, jnp.asarray(TWO_D_LIMBS)), t2)
+    dd = F.mul_small(F.mul(z1, z2), 2)
+    e = F.sub(b, a)
+    f = F.sub(dd, c)
+    g = F.add(dd, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def pt_add_niels(p, n):
+    """Mixed add with a precomputed Niels point (y+x, y-x, 2dxy, Z=1)."""
+    x1, y1, z1, t1 = p
+    yplus, yminus, xy2d = n
+    a = F.mul(F.sub(y1, x1), yminus)
+    b = F.mul(F.add(y1, x1), yplus)
+    c = F.mul(t1, xy2d)
+    dd = F.mul_small(z1, 2)
+    e = F.sub(b, a)
+    f = F.sub(dd, c)
+    g = F.add(dd, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def pt_double(p):
+    """Doubling (dbl-2008-hwcd)."""
+    x1, y1, z1, _ = p
+    a = F.square(x1)
+    b = F.square(y1)
+    c = F.mul_small(F.square(z1), 2)
+    h = F.add(a, b)
+    e = F.sub(h, F.square(F.add(x1, y1)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def pt_neg(p):
+    x, y, z, t = p
+    return (F.neg(x), y, z, F.neg(t))
+
+
+def pt_is_identity(p):
+    """X == 0 and Y == Z (projective identity test)."""
+    x, y, z, _ = p
+    return F.is_zero(x) & F.eq(y, z)
+
+
+# -- decompression (ZIP-215) -------------------------------------------
+
+def decompress(enc):
+    """(..., 32) uint8 -> (point, valid_mask).
+
+    ZIP-215 rules (crypto/ed25519/ed25519.go:39 semantics): the 255-bit
+    y is reduced mod p implicitly (non-canonical encodings accepted);
+    rejection only for non-square x^2 candidates; x=0 with sign bit set
+    ("-0") is accepted. Matches crypto/edwards.decode_point.
+    """
+    sign = (enc[..., 31] >> 7).astype(F.DTYPE)
+    y = F.from_bytes_le(enc)
+    y = y.at[..., 15].add(-((sign << 15) << 0))  # clear bit 255
+    yy = F.square(y)
+    u = F.sub(yy, jnp.asarray(F.ONE))
+    v = F.add(F.mul(yy, jnp.asarray(D_LIMBS)), jnp.asarray(F.ONE))
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    vxx = F.mul(v, F.square(x))
+    ok1 = F.eq(vxx, u)
+    ok2 = F.eq(vxx, F.neg(u))
+    x = F.select(ok2, F.mul(x, jnp.asarray(SQRT_M1_LIMBS)), x)
+    valid = ok1 | ok2
+    flip = F.is_odd(x) != (sign == 1)
+    x = F.select(flip, F.neg(x), x)
+    return (x, y, jnp.broadcast_to(jnp.asarray(F.ONE), y.shape), F.mul(x, y)), valid
+
+
+# -- scalar windows ----------------------------------------------------
+
+def nibbles_from_bytes_le(b):
+    """(..., 32) uint8 scalar -> (..., 64) int32 4-bit windows, little-
+    endian (window w has weight 16^w)."""
+    b = b.astype(jnp.int32)
+    lo = b & 0xF
+    hi = b >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(*b.shape[:-1], 64)
+
+
+def comb_mul_base(s_nibbles):
+    """[S]B via the Niels comb: 64 table lookups + mixed adds.
+
+    s_nibbles: (..., 64) int32. Returns an extended point.
+    """
+    batch = s_nibbles.shape[:-1]
+    table = jnp.asarray(B_COMB)  # (64, 16, 3, 16)
+
+    def body(acc, xs):
+        tbl_w, nib = xs  # (16, 3, 16), (...,)
+        entry = tbl_w[nib]  # gather -> (..., 3, 16)
+        n = (entry[..., 0, :], entry[..., 1, :], entry[..., 2, :])
+        return pt_add_niels(acc, n), None
+
+    nibs_t = jnp.moveaxis(s_nibbles, -1, 0)  # (64, ...)
+    acc, _ = lax.scan(body, identity(batch), (table, nibs_t))
+    return acc
+
+
+def window_mul(k_nibbles, p):
+    """[k]P for a per-lane point P: windowed double-and-add.
+
+    Builds the 16-entry multiples table (15 adds), then scans windows
+    MSB-first: acc = 16*acc + T[nib]. k_nibbles: (..., 64) int32.
+    """
+    batch = k_nibbles.shape[:-1]
+    # table[j] = j*P, extended coords; stack along a new axis -3.
+    entries = [identity(batch), p]
+    for _ in range(14):
+        entries.append(pt_add(entries[-1], p))
+    table = tuple(
+        jnp.stack([e[c] for e in entries], axis=-2) for c in range(4)
+    )  # each (..., 16 entries, 16 limbs)
+
+    def body(acc, nib):
+        for _ in range(4):
+            acc = pt_double(acc)
+        idx = nib[..., None, None].astype(jnp.int32)
+        entry = tuple(
+            jnp.take_along_axis(table[c], idx, axis=-2)[..., 0, :]
+            for c in range(4)
+        )
+        return pt_add(acc, entry), None
+
+    nibs_t = jnp.moveaxis(k_nibbles, -1, 0)[::-1]  # (64, ...) MSB first
+    acc, _ = lax.scan(body, identity(batch), nibs_t)
+    return acc
+
+
+def mul8(p):
+    """[8]P — the cofactor clearing in the ZIP-215 equation."""
+    return pt_double(pt_double(pt_double(p)))

@@ -1,0 +1,182 @@
+"""The Ed25519 batch-verify kernel — the TPU execution backend.
+
+This is the device seam the reference exposes as crypto.BatchVerifier
+(crypto/crypto.go:44, crypto/ed25519/ed25519.go:190): callers enqueue
+(pubkey, msg, sig) tuples and one launch returns per-signature validity
+for the whole batch. Everything happens in-device: point decompression,
+SHA-512 of R||A||M, digest reduction mod L, the comb/windowed double
+scalar multiplication, and the cofactored ZIP-215 acceptance equation
+
+    [8]([S]B + [k](-A) - R) == identity.
+
+Per-signature results come back as a bool vector — no bisection search
+for the first bad index is needed (cf. types/validation.go:310, which
+has to re-verify on batch failure because the RLC trick only yields a
+single bit; data-parallel verification gives the per-vote bits for
+free).
+
+Batch shaping: inputs are padded to (power-of-two batch, message-length
+bucket) so the jit cache stays small and shapes stay static for XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import BatchVerifier, PubKey
+from cometbft_tpu.crypto import ed25519 as _ed
+from cometbft_tpu.ops import curve as C
+from cometbft_tpu.ops import scalar as SC
+from cometbft_tpu.ops import sha512 as SH
+
+# Message-length buckets (bytes). Vote sign-bytes are ~120 bytes; the
+# largest bucket covers arbitrary app-level uses.
+_BUCKETS = (128, 256, 512, 1024, 4096)
+_MIN_BATCH = 8
+
+
+def build_padded_input(r_enc, a_enc, msg, msglen, nblocks: int):
+    """Assemble SHA-512 input R || A || M with FIPS 180-4 padding, fully
+    vectorized (per-lane dynamic message length, static bucket width).
+
+    SHA padding is minimal per message: each lane's 0x80 marker and
+    16-byte big-endian bit length land at the end of *its own* final
+    block, not the bucket's. Returns (buf, nblocks_lane)."""
+    width = nblocks * 128
+    batch = msg.shape[:-1]
+    content = jnp.concatenate(
+        [r_enc.astype(jnp.int64), a_enc.astype(jnp.int64), msg.astype(jnp.int64)],
+        axis=-1,
+    )
+    pad = [(0, 0)] * len(batch) + [(0, width - content.shape[-1])]
+    content = jnp.pad(content, pad)
+    total = (64 + msglen).astype(jnp.int64)[..., None]  # (..., 1)
+    nblocks_lane = (total + 17 + 127) // 128            # ceil((total+17)/128)
+    lane_width = nblocks_lane * 128
+    idx = jnp.arange(width, dtype=jnp.int64)
+    buf = jnp.where(idx < total, content, 0)
+    buf = jnp.where(idx == total, 0x80, buf)
+    bitlen = total * 8
+    pos_from_end = lane_width - 1 - idx
+    lenbyte = (bitlen >> jnp.minimum(8 * pos_from_end, 56)) & 0xFF
+    buf = jnp.where((pos_from_end >= 0) & (pos_from_end < 8), lenbyte, buf)
+    return buf.astype(jnp.uint8), nblocks_lane[..., 0]
+
+
+def verify_kernel(pub, sig, msg, msglen, nblocks: int):
+    """(..., 32) u8, (..., 64) u8, (..., M) u8, (...,) i32 -> (...,) bool.
+
+    Semantics are bit-identical to crypto.edwards.verify_zip215 (the
+    pure-Python oracle); differential fuzz in tests/test_ops_kernel.py.
+    """
+    r_enc = sig[..., :32]
+    s_bytes = sig[..., 32:]
+    a_pt, a_ok = C.decompress(pub)
+    r_pt, r_ok = C.decompress(r_enc)
+    s_ok = SC.bytes_lt_l(s_bytes)
+
+    buf, nblocks_lane = build_padded_input(r_enc, pub, msg, msglen, nblocks)
+    digest = SH.sha512_padded(buf, nblocks, nblocks_lane)
+    k_nib = SC.limbs_to_nibbles(SC.reduce_digest(digest))
+    s_nib = C.nibbles_from_bytes_le(s_bytes)
+
+    p1 = C.comb_mul_base(s_nib)                    # [S]B
+    p2 = C.window_mul(k_nib, C.pt_neg(a_pt))       # [k](-A)
+    q = C.pt_add(C.pt_add(p1, p2), C.pt_neg(r_pt))
+    eq_ok = C.pt_is_identity(C.mul8(q))
+    return eq_ok & a_ok & r_ok & s_ok
+
+
+_kernel_cache: dict[tuple[int, int], object] = {}
+
+
+def _compiled(batch: int, bucket: int):
+    key = (batch, bucket)
+    fn = _kernel_cache.get(key)
+    if fn is None:
+        nblocks = (64 + bucket + 17 + 127) // 128
+        fn = jax.jit(
+            lambda p, s, m, ln: verify_kernel(p, s, m, ln, nblocks)
+        )
+        _kernel_cache[key] = fn
+    return fn
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
+
+
+def verify_arrays(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
+    """Host entry: numpy (n,32), (n,64), list of n messages -> bool[n].
+
+    Pads to (pow2 batch, length bucket) and runs one device launch.
+    """
+    n = len(msgs)
+    maxlen = max((len(m) for m in msgs), default=0)
+    bucket = next((b for b in _BUCKETS if b >= maxlen), None)
+    if bucket is None:
+        raise ValueError(f"message too large for device path: {maxlen}")
+    batch = max(_next_pow2(n), _MIN_BATCH)
+
+    msg_arr = np.zeros((batch, bucket), dtype=np.uint8)
+    msglen = np.zeros((batch,), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        msg_arr[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        msglen[i] = len(m)
+    pub_arr = np.zeros((batch, 32), dtype=np.uint8)
+    sig_arr = np.zeros((batch, 64), dtype=np.uint8)
+    pub_arr[:n] = pub
+    sig_arr[:n] = sig
+
+    fn = _compiled(batch, bucket)
+    out = fn(
+        jnp.asarray(pub_arr),
+        jnp.asarray(sig_arr),
+        jnp.asarray(msg_arr),
+        jnp.asarray(msglen),
+    )
+    return np.asarray(out)[:n]
+
+
+class TpuBatchVerifier(BatchVerifier):
+    """BatchVerifier provider backed by the device kernel
+    (the reference's crypto/ed25519/ed25519.go:190 BatchVerifier slot).
+    """
+
+    def __init__(self) -> None:
+        self._pubs: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type() != _ed.KEY_TYPE:
+            raise TypeError("TpuBatchVerifier requires ed25519 keys")
+        if len(sig) != _ed.SIGNATURE_SIZE:
+            raise ValueError("malformed signature size")
+        self._pubs.append(pub_key.bytes())
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(sig))
+
+    def __len__(self) -> int:
+        return len(self._pubs)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        n = len(self._pubs)
+        if n == 0:
+            return False, []
+        if max(len(m) for m in self._msgs) > _BUCKETS[-1]:
+            # Messages beyond the largest device bucket: honor the
+            # BatchVerifier contract via the host fallback instead of
+            # raising mid-verify.
+            cpu = _ed.CpuBatchVerifier()
+            for p, m, s in zip(self._pubs, self._msgs, self._sigs):
+                cpu.add(_ed.Ed25519PubKey(p), m, s)
+            return cpu.verify()
+        pub = np.frombuffer(b"".join(self._pubs), dtype=np.uint8).reshape(n, 32)
+        sig = np.frombuffer(b"".join(self._sigs), dtype=np.uint8).reshape(n, 64)
+        out = verify_arrays(pub, sig, self._msgs)
+        results = [bool(v) for v in out]
+        return all(results), results

@@ -1,0 +1,269 @@
+"""GF(2^255-19) arithmetic on 16x16-bit limbs — the kernel's number system.
+
+Design notes (TPU-first):
+- A field element is an int64 array of shape (..., 16): little-endian
+  limbs, nominally 16 bits each but stored *lazily* — limbs may be any
+  signed value with |limb| < 2^26 (the "loose" invariant). All ops
+  broadcast over leading batch dims, so one traced program verifies an
+  entire validator set.
+- add/sub are single vector adds with NO carry work. Carries are only
+  resolved inside mul (where products must not overflow i64) and at
+  canonical boundaries (encode/compare). This keeps the op count per
+  group operation small enough that XLA emits short, fusable
+  vector code — no per-limb scalar slicing anywhere on the hot path.
+- Carry resolution is *vectorized relaxation*: every limb computes its
+  carry simultaneously; carries shift up one limb per iteration (the
+  2^256 wraparound folds in as x38, since 2^256 ≡ 38 mod p). Three
+  iterations shrink any mul column set to limbs < 2^22; sequential
+  per-limb propagation exists only in the rarely-used canonical path.
+- Overflow budget: mul inputs require |limb| < 2^26. Columns then
+  bound by 16*2^52, and the x38 fold keeps everything < 2^62 in i64.
+  mul outputs have limbs < 2^22, and each add/sub grows the bound by
+  one bit — so up to 4 chained add/subs between muls are safe. The
+  curve formulas (ops/curve.py) never chain more than 3.
+
+The semantic ground truth is cometbft_tpu.crypto.edwards (pure-Python
+big-int oracle); tests differential-fuzz every op against it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.crypto.edwards import P
+
+NLIMBS = 16
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+
+DTYPE = jnp.int64
+
+# Relaxation wrap factors: carry out of limb 15 re-enters at limb 0 with
+# weight 2^256 ≡ 38 (mod p).
+_WRAP = np.ones(NLIMBS, dtype=np.int64)
+_WRAP[0] = 38
+
+
+# -- host-side conversions (tests, table generation) -------------------
+
+def from_int(x: int) -> np.ndarray:
+    """Python int -> limb array (host helper)."""
+    if x < 0 or x >= 1 << 256:
+        raise ValueError("field element out of range")
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int64
+    )
+
+
+def to_int(limbs) -> int:
+    """Limb array -> python int (host helper; accepts lazy/signed limbs)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+
+
+def batch_from_ints(xs: list[int]) -> np.ndarray:
+    return np.stack([from_int(x) for x in xs])
+
+
+P_LIMBS = from_int(P)
+ZERO = from_int(0)
+ONE = from_int(1)
+
+
+# -- carry machinery ---------------------------------------------------
+
+def relax(c, iters: int = 4):
+    """Vectorized carry relaxation: all limbs release their carry at
+    once; carries travel one limb per iteration, the top carry folding
+    into limb 0 as x38. Signed-safe (arithmetic shift = floor division).
+
+    Convergence: each iteration shifts carry magnitude down 16 bits but
+    the x38 wrap adds ~5.3 bits back at limb 0. Four iterations take any
+    |column| < 2^58 down to limbs < 2^17.
+    """
+    for _ in range(iters):
+        carry = c >> LIMB_BITS
+        lo = c - (carry << LIMB_BITS)
+        c = lo + jnp.roll(carry, 1, axis=-1) * _WRAP
+    return c
+
+
+def add(a, b):
+    """Lazy add: no carries (grows the limb bound by one bit)."""
+    return a + b
+
+
+def sub(a, b):
+    """Lazy subtract: no carries (limbs may go negative)."""
+    return a - b
+
+
+def neg(a):
+    return -a
+
+
+def mul(a, b):
+    """Field multiply: skewed outer product -> 31 columns -> x38 fold ->
+    4 relaxation rounds. Inputs must satisfy |limb| < 2^24 (mul outputs
+    have limbs < 2^17, so up to ~6 chained add/subs stay in budget)."""
+    o = a[..., :, None] * b[..., None, :]  # (..., 16, 16)
+    # Skew trick: pad rows to width 32, flatten, drop the tail, and
+    # re-view as (16, 31) — row i lands shifted right by i, so a plain
+    # sum over rows yields the 31 schoolbook columns.
+    batch = o.shape[:-2]
+    o = jnp.pad(o, [(0, 0)] * len(batch) + [(0, 0), (0, NLIMBS)])
+    o = o.reshape(*batch, 2 * NLIMBS * NLIMBS)[..., : 31 * NLIMBS]
+    cols = o.reshape(*batch, NLIMBS, 31).sum(axis=-2)  # (..., 31)
+    low = cols[..., :NLIMBS]
+    high = cols[..., NLIMBS:]
+    low = low + 38 * jnp.pad(high, [(0, 0)] * len(batch) + [(0, 1)])
+    return relax(low)
+
+
+def square(a):
+    return mul(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small host constant (|k| <= 2^15); lazy (one bit
+    of growth per doubling of k — callers budget accordingly)."""
+    return a * k
+
+
+# -- canonical form, comparisons ---------------------------------------
+
+def _propagate_seq(c):
+    """Exact sequential carry pass (canonical boundaries only): limbs to
+    [0, 2^16), returning (limbs, signed_carry_out) with weight 2^256."""
+    out = []
+    carry = jnp.zeros_like(c[..., 0])
+    for i in range(NLIMBS):
+        t = c[..., i] + carry
+        out.append(t & MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(out, axis=-1), carry
+
+
+def _narrow(a):
+    """Lazy limbs -> limbs in [0, 2^16) with the value in [0, 2^256)."""
+    limbs, carry = _propagate_seq(relax(a, iters=2))
+    limbs = limbs.at[..., 0].add(38 * carry)
+    limbs, carry = _propagate_seq(limbs)
+    limbs = limbs.at[..., 0].add(38 * carry)
+    limbs, _ = _propagate_seq(limbs)
+    return limbs
+
+
+def _cond_sub_p(limbs):
+    """Subtract p when limbs >= p; inputs/outputs in narrow form."""
+    diff, borrow = _propagate_seq(limbs - P_LIMBS)
+    ge = borrow >= 0
+    return jnp.where(ge[..., None], diff, limbs)
+
+
+def reduce_full(a):
+    """Lazy form -> canonical [0, p)."""
+    return _cond_sub_p(_cond_sub_p(_narrow(a)))
+
+
+def eq(a, b):
+    """Canonical equality of lazy elements."""
+    return jnp.all(reduce_full(sub(a, b)) == 0, axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(reduce_full(a) == 0, axis=-1)
+
+
+def is_odd(a):
+    """Low bit of the canonical value."""
+    return (reduce_full(a)[..., 0] & 1).astype(jnp.bool_)
+
+
+def select(mask, a, b):
+    """Per-lane select: mask shape (...,), a/b shape (..., 16)."""
+    return jnp.where(mask[..., None], a, b)
+
+
+# -- byte conversions (device side) ------------------------------------
+
+def from_bytes_le(b):
+    """(..., 32) uint8 -> narrow limbs (value < 2^256, unreduced)."""
+    b = b.astype(DTYPE)
+    return b[..., 0::2] + (b[..., 1::2] << 8)
+
+
+def to_bytes_le(a):
+    """Canonical little-endian 32 bytes."""
+    r = reduce_full(a)
+    lo = (r & 0xFF).astype(jnp.uint8)
+    hi = ((r >> 8) & 0xFF).astype(jnp.uint8)
+    return jnp.stack([lo, hi], axis=-1).reshape(*r.shape[:-1], 32)
+
+
+# -- exponentiation chains ---------------------------------------------
+
+def _pow2k(a, k: int):
+    """k successive squarings as a fori_loop — one square body per call
+    site in the traced graph, regardless of k (compile time)."""
+    if k <= 2:
+        for _ in range(k):
+            a = square(a)
+        return a
+    return lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def pow22523(z):
+    """z^((p-5)/8), the square-root chain core (ref10-style addition
+    chain: 254 squarings, 11 multiplies)."""
+    t0 = square(z)                      # z^2
+    t1 = _pow2k(square(t0), 1)          # z^8
+    t1 = mul(z, t1)                     # z^9
+    t0 = mul(t0, t1)                    # z^11
+    t0 = square(t0)                     # z^22
+    t0 = mul(t1, t0)                    # z^31 = z^(2^5-1)
+    t1 = _pow2k(t0, 5)                  # z^(2^10-2^5)
+    t0 = mul(t1, t0)                    # z^(2^10-1)
+    t1 = _pow2k(t0, 10)
+    t1 = mul(t1, t0)                    # z^(2^20-1)
+    t2 = _pow2k(t1, 20)
+    t1 = mul(t2, t1)                    # z^(2^40-1)
+    t1 = _pow2k(t1, 10)
+    t0 = mul(t1, t0)                    # z^(2^50-1)
+    t1 = _pow2k(t0, 50)
+    t1 = mul(t1, t0)                    # z^(2^100-1)
+    t2 = _pow2k(t1, 100)
+    t1 = mul(t2, t1)                    # z^(2^200-1)
+    t1 = _pow2k(t1, 50)
+    t0 = mul(t1, t0)                    # z^(2^250-1)
+    t0 = _pow2k(t0, 2)                  # z^(2^252-4)
+    return mul(t0, z)                   # z^(2^252-3) = z^((p-5)/8)
+
+
+def invert(z):
+    """z^(p-2) = z^(2^255-21) (ref10-style chain)."""
+    t0 = square(z)                      # z^2
+    t1 = _pow2k(square(t0), 1)          # z^8
+    t1 = mul(z, t1)                     # z^9
+    t0 = mul(t0, t1)                    # z^11
+    t2 = square(t0)                     # z^22
+    t1 = mul(t1, t2)                    # z^31
+    t2 = _pow2k(t1, 5)
+    t1 = mul(t2, t1)                    # z^(2^10-1)
+    t2 = _pow2k(t1, 10)
+    t2 = mul(t2, t1)                    # z^(2^20-1)
+    t3 = _pow2k(t2, 20)
+    t2 = mul(t3, t2)                    # z^(2^40-1)
+    t2 = _pow2k(t2, 10)
+    t1 = mul(t2, t1)                    # z^(2^50-1)
+    t2 = _pow2k(t1, 50)
+    t2 = mul(t2, t1)                    # z^(2^100-1)
+    t3 = _pow2k(t2, 100)
+    t2 = mul(t3, t2)                    # z^(2^200-1)
+    t2 = _pow2k(t2, 50)
+    t1 = mul(t2, t1)                    # z^(2^250-1)
+    t1 = _pow2k(t1, 5)                  # z^(2^255-32)
+    return mul(t1, t0)                  # z^(2^255-21) = z^(p-2)

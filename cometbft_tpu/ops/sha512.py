@@ -1,0 +1,149 @@
+"""In-device SHA-512 — hashing vote sign-bytes inside the verify kernel.
+
+Ed25519 verification needs k = SHA-512(R || A || M) per signature; doing
+it on-device keeps the whole batch in one launch with zero host round
+trips. 64-bit words use jnp.uint64 (emulated as u32 pairs on TPU; the
+hash is a rounding error next to the curve arithmetic).
+
+Round constants and IVs are derived on host from first principles
+(fractional parts of cube/square roots of the first primes, FIPS 180-4)
+rather than transcribed — tests cross-check digests against hashlib.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _primes(n: int) -> list[int]:
+    out, c = [], 2
+    while len(out) < n:
+        if all(c % q for q in out if q * q <= c):
+            out.append(c)
+        c += 1
+    return out
+
+
+def _icbrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 2) // 3 + 1)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            return x
+        x = y
+
+
+def _isqrt(n: int) -> int:
+    import math
+
+    return math.isqrt(n)
+
+
+# K[t] = frac(cbrt(prime_t)) * 2^64 ; IV[i] = frac(sqrt(prime_i)) * 2^64
+_K = np.array(
+    [_icbrt(p << 192) & ((1 << 64) - 1) for p in _primes(80)], dtype=np.uint64
+)
+_IV = np.array(
+    [_isqrt(p << 128) & ((1 << 64) - 1) for p in _primes(8)], dtype=np.uint64
+)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint64(n)) | (x << np.uint64(64 - n))
+
+
+def _schedule(words):
+    """(..., 16) u64 block words -> (80, ...) expanded schedule."""
+
+    def body(win, _):
+        s0 = _rotr(win[..., 1], 1) ^ _rotr(win[..., 1], 8) ^ (
+            win[..., 1] >> np.uint64(7)
+        )
+        s1 = _rotr(win[..., 14], 19) ^ _rotr(win[..., 14], 61) ^ (
+            win[..., 14] >> np.uint64(6)
+        )
+        new = win[..., 0] + s0 + win[..., 9] + s1
+        win = jnp.roll(win, -1, axis=-1).at[..., 15].set(new)
+        return win, new
+
+    _, extra = lax.scan(body, words, None, length=64)
+    return jnp.concatenate([jnp.moveaxis(words, -1, 0), extra], axis=0)
+
+
+def _compress(state, words):
+    """One SHA-512 block: state (..., 8) u64, words (..., 16) u64."""
+    w = _schedule(words)
+
+    def round_body(carry, xs):
+        a, b, c, d, e, f, g, h = carry
+        w_t, k_t = xs
+        ch = (e & f) ^ (~e & g)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        big0 = _rotr(a, 28) ^ _rotr(a, 34) ^ _rotr(a, 39)
+        big1 = _rotr(e, 14) ^ _rotr(e, 18) ^ _rotr(e, 41)
+        t1 = h + big1 + ch + k_t + w_t
+        t2 = big0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    out, _ = lax.scan(round_body, init, (w, jnp.asarray(_K)))
+    return state + jnp.stack(out, axis=-1)
+
+
+def bytes_to_words(buf):
+    """(..., n*8) uint8 big-endian -> (..., n) uint64."""
+    b = buf.astype(jnp.uint64)
+    b = b.reshape(*buf.shape[:-1], buf.shape[-1] // 8, 8)
+    shifts = jnp.asarray(
+        np.arange(56, -8, -8, dtype=np.uint64), dtype=jnp.uint64
+    )
+    return (b << shifts).sum(axis=-1, dtype=jnp.uint64)
+
+
+def words_to_bytes(words):
+    """(..., n) uint64 -> (..., n*8) uint8 big-endian."""
+    shifts = jnp.asarray(
+        np.arange(56, -8, -8, dtype=np.uint64), dtype=jnp.uint64
+    )
+    b = (words[..., None] >> shifts) & jnp.uint64(0xFF)
+    return b.astype(jnp.uint8).reshape(*words.shape[:-1], words.shape[-1] * 8)
+
+
+def sha512_padded(buf, nblocks: int, nblocks_lane=None):
+    """Digest of a pre-padded buffer: (..., nblocks*128) uint8 -> (..., 64).
+
+    The caller supplies full padding (0x80 marker + big-endian bit
+    length); see ed25519_verify.build_padded_input. SHA padding is
+    *minimal* per message, so lanes may use fewer blocks than the static
+    bucket maximum: ``nblocks_lane`` (..., int) selects how many blocks
+    each lane actually absorbs (trailing blocks are computed then
+    discarded — branch-free SPMD).
+    """
+    words = bytes_to_words(buf).reshape(*buf.shape[:-1], nblocks, 16)
+    state = jnp.broadcast_to(
+        jnp.asarray(_IV), (*buf.shape[:-1], 8)
+    ).astype(jnp.uint64)
+    for i in range(nblocks):
+        new = _compress(state, words[..., i, :])
+        if nblocks_lane is None:
+            state = new
+        else:
+            state = jnp.where((i < nblocks_lane)[..., None], new, state)
+    return words_to_bytes(state)
+
+
+def pad_message(msg_bytes: bytes) -> tuple[np.ndarray, int]:
+    """Host-side reference padding (tests): returns (padded, nblocks)."""
+    n = len(msg_bytes)
+    total = n + 1 + 16
+    nblocks = (total + 127) // 128
+    buf = np.zeros(nblocks * 128, dtype=np.uint8)
+    buf[:n] = np.frombuffer(msg_bytes, dtype=np.uint8)
+    buf[n] = 0x80
+    bitlen = n * 8
+    for j in range(16):
+        buf[-1 - j] = (bitlen >> (8 * j)) & 0xFF
+    return buf, nblocks

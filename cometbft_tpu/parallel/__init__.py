@@ -1,1 +1,26 @@
-"""Package placeholder — populated as layers land."""
+"""Multi-chip scaling plane: device meshes + sharded verification.
+
+The reference scales signature verification to one CPU's SIMD lanes
+(curve25519-voi batch verify); this framework scales it across a TPU
+pod slice with jax.sharding — signatures are embarrassingly parallel,
+so shardings place batch shards on every chip and XLA inserts zero
+collectives for the verify itself (communication materializes only at
+the final boolean reduction if the caller asks for a scalar verdict).
+
+Mesh convention (2-D, ``("blocks", "sigs")``):
+- ``blocks`` — coarse axis: independent verification units (headers in
+  light-client sync, blocks in blocksync replay) — the "data parallel"
+  axis of this domain.
+- ``sigs`` — fine axis: signatures within one unit (a validator set's
+  commit) — the "model parallel" axis; a 10k-validator commit shards
+  its votes across chips on this axis.
+"""
+
+from cometbft_tpu.parallel.mesh import (
+    all_valid,
+    make_mesh,
+    shard_batch,
+    sharded_verify_fn,
+)
+
+__all__ = ["all_valid", "make_mesh", "shard_batch", "sharded_verify_fn"]

@@ -1,19 +1,27 @@
 """Test configuration.
 
-Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
-exercised without TPU hardware (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip). Env vars must be set
-before jax is imported anywhere.
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
+are exercised without TPU hardware (the driver separately dry-run-
+compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+This environment injects a TPU plugin via sitecustomize which imports
+jax at interpreter startup with JAX_PLATFORMS=axon — so env vars alone
+are too late here; we must also force the platform through the config
+API before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import random
 

@@ -1,0 +1,205 @@
+"""Tests for the host crypto plane: ed25519 (ZIP-215), merkle, hashes."""
+
+import hashlib
+import os
+
+import pytest
+
+from cometbft_tpu.crypto import batch, ed25519, merkle, tmhash
+from cometbft_tpu.crypto import edwards
+
+
+class TestEdwardsOracle:
+    def test_rfc8032_test_vector_empty_msg(self):
+        # RFC 8032 §7.1 TEST 1
+        seed = bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+        )
+        pub = bytes.fromhex(
+            "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+        )
+        sig = bytes.fromhex(
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+        )
+        assert edwards.public_key(seed) == pub
+        assert edwards.sign(seed, b"") == sig
+        assert edwards.verify_zip215(pub, b"", sig)
+
+    def test_rfc8032_test_vector_msg(self):
+        # RFC 8032 §7.1 TEST 3
+        seed = bytes.fromhex(
+            "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+        )
+        pub = bytes.fromhex(
+            "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+        )
+        msg = bytes.fromhex("af82")
+        sig = bytes.fromhex(
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+            "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+        )
+        assert edwards.sign(seed, msg) == sig
+        assert edwards.verify_zip215(pub, msg, sig)
+
+    def test_noncanonical_y_accepted_zip215_only(self):
+        # identity point y=1; non-canonical encoding y = p + 1
+        noncanon = (edwards.P + 1).to_bytes(32, "little")
+        assert edwards.decode_point(noncanon) is not None
+        assert edwards.decode_point_rfc8032(noncanon) is None
+
+    def test_minus_zero_accepted_zip215_only(self):
+        # y=1 (identity) with the sign bit set: x = -0
+        enc = bytearray((1).to_bytes(32, "little"))
+        enc[31] |= 0x80
+        assert edwards.decode_point(bytes(enc)) is not None
+        assert edwards.decode_point_rfc8032(bytes(enc)) is None
+
+    def test_non_square_rejected(self):
+        # y=2: (y^2-1)/(dy^2+1) is not a square for curve25519's d
+        found_invalid = False
+        for y in range(2, 30):
+            if edwards._recover_x(y, 0) is None:
+                found_invalid = True
+                enc = y.to_bytes(32, "little")
+                assert edwards.decode_point(enc) is None
+                break
+        assert found_invalid
+
+    def test_small_order_pubkey_signature(self):
+        """ZIP-215 accepts signatures under small-order public keys when the
+        cofactored equation holds — e.g. A = identity, R = identity, S = 0."""
+        ident = edwards.encode_point(edwards.IDENTITY)
+        sig = ident + (0).to_bytes(32, "little")
+        assert edwards.verify_zip215(ident, b"any message", sig)
+
+    def test_s_must_be_canonical(self):
+        ident = edwards.encode_point(edwards.IDENTITY)
+        sig = ident + edwards.L.to_bytes(32, "little")  # S == L rejected
+        assert not edwards.verify_zip215(ident, b"m", sig)
+
+    def test_torsion_points_have_small_order(self):
+        pts = edwards.small_order_points()
+        assert len(pts) == 8
+        for enc in pts:
+            pt = edwards.decode_point(enc)
+            assert pt is not None
+            assert edwards.pt_is_identity(edwards.pt_mul(8, pt))
+
+
+class TestEd25519Keys:
+    def test_sign_verify_roundtrip(self):
+        priv = ed25519.gen_priv_key()
+        msg = b"vote sign bytes"
+        sig = priv.sign(msg)
+        assert priv.pub_key().verify_signature(msg, sig)
+        assert not priv.pub_key().verify_signature(msg + b"!", sig)
+        assert not priv.pub_key().verify_signature(msg, sig[:-1])
+
+    def test_privkey_layout_64_bytes(self):
+        priv = ed25519.gen_priv_key()
+        raw = priv.bytes()
+        assert len(raw) == 64
+        assert raw[32:] == priv.pub_key().bytes()
+        # reconstruct from 64-byte layout
+        again = ed25519.Ed25519PrivKey(raw)
+        assert again.pub_key() == priv.pub_key()
+
+    def test_address_is_truncated_sha256(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+        assert len(pub.address()) == 20
+
+    def test_deterministic_from_secret(self):
+        a = ed25519.priv_key_from_secret(b"secret")
+        b = ed25519.priv_key_from_secret(b"secret")
+        assert a.bytes() == b.bytes()
+
+    def test_zip215_edge_accepted_by_pubkey_verify(self):
+        """The two-tier verify must admit ZIP-215-only signatures that
+        OpenSSL rejects (small-order A, S=0, R=A)."""
+        ident = edwards.encode_point(edwards.IDENTITY)
+        pub = ed25519.Ed25519PubKey(ident)
+        sig = ident + (0).to_bytes(32, "little")
+        assert pub.verify_signature(b"m", sig)
+
+    def test_cpu_batch_verifier(self):
+        bv = ed25519.CpuBatchVerifier()
+        privs = [ed25519.gen_priv_key() for _ in range(4)]
+        msgs = [os.urandom(40) for _ in range(4)]
+        for priv, msg in zip(privs, msgs):
+            bv.add(priv.pub_key(), msg, priv.sign(msg))
+        ok, results = bv.verify()
+        assert ok and results == [True] * 4
+
+    def test_cpu_batch_verifier_reports_bad_index(self):
+        bv = ed25519.CpuBatchVerifier()
+        privs = [ed25519.gen_priv_key() for _ in range(3)]
+        for i, priv in enumerate(privs):
+            msg = bytes([i]) * 32
+            sig = priv.sign(msg)
+            if i == 1:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+            bv.add(priv.pub_key(), msg, sig)
+        ok, results = bv.verify()
+        assert not ok and results == [True, False, True]
+
+    def test_empty_batch_fails(self):
+        ok, results = ed25519.CpuBatchVerifier().verify()
+        assert not ok and results == []
+
+    def test_batch_dispatch(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_DISABLE_DEVICE_VERIFY", "1")
+        priv = ed25519.gen_priv_key()
+        bv = batch.create_batch_verifier(priv.pub_key())
+        assert isinstance(bv, ed25519.CpuBatchVerifier)
+        assert batch.supports_batch_verifier(priv.pub_key())
+
+
+class TestMerkle:
+    def test_empty_tree(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+    def test_single_leaf(self):
+        assert merkle.hash_from_byte_slices([b"x"]) == hashlib.sha256(
+            b"\x00x"
+        ).digest()
+
+    def test_rfc6962_structure(self):
+        # root(a,b,c) = inner(inner(leaf a, leaf b), leaf c)
+        la, lb, lc = (merkle.leaf_hash(x) for x in (b"a", b"b", b"c"))
+        expect = merkle.inner_hash(merkle.inner_hash(la, lb), lc)
+        assert merkle.hash_from_byte_slices([b"a", b"b", b"c"]) == expect
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33])
+    def test_proofs_verify(self, n):
+        items = [bytes([i]) * 3 for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            assert proof.verify(root, items[i])
+            assert not proof.verify(root, items[i] + b"!")
+            assert not proof.verify(b"\x00" * 32, items[i])
+
+    def test_proof_wrong_index_fails(self):
+        items = [b"a", b"b", b"c", b"d"]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert not proofs[0].verify(root, items[1])
+
+    def test_proof_bounds(self):
+        proof = merkle.Proof(total=1, index=0, leaf_hash=merkle.leaf_hash(b"x"), aunts=[])
+        assert proof.verify(merkle.hash_from_byte_slices([b"x"]), b"x")
+        bad = merkle.Proof(total=0, index=0, leaf_hash=b"", aunts=[])
+        assert not bad.verify(b"", b"x")
+        toomany = merkle.Proof(
+            total=2, index=0, leaf_hash=merkle.leaf_hash(b"x"), aunts=[b"\x00" * 32] * 101
+        )
+        assert not toomany.verify(b"\x00" * 32, b"x")
+
+
+class TestTmhash:
+    def test_sizes(self):
+        assert len(tmhash.sum256(b"a")) == 32
+        assert len(tmhash.sum_truncated(b"a")) == 20
+        assert tmhash.sum_truncated(b"a") == tmhash.sum256(b"a")[:20]

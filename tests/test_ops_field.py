@@ -1,0 +1,96 @@
+"""Differential tests: GF(2^255-19) limb arithmetic vs python big ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto.edwards import P
+from cometbft_tpu.ops import field as F
+
+
+@pytest.fixture(scope="module")
+def cases():
+    rng = random.Random(1)
+    xs = [rng.randrange(0, 2**256) for _ in range(32)]
+    ys = [rng.randrange(0, 2**256) for _ in range(32)]
+    xs[:6] = [0, 1, P - 1, P, 2 * P - 1, 2**256 - 1]
+    ys[:6] = [0, 2**256 - 1, P, 1, P - 1, 2**256 - 1]
+    return (
+        xs,
+        ys,
+        jnp.array(F.batch_from_ints(xs)),
+        jnp.array(F.batch_from_ints(ys)),
+    )
+
+
+class TestFieldOps:
+    def test_add_sub_mul(self, cases):
+        xs, ys, A, B = cases
+        addv = jax.jit(F.add)(A, B)
+        subv = jax.jit(F.sub)(A, B)
+        mulv = jax.jit(F.mul)(A, B)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert F.to_int(addv[i]) % P == (x + y) % P
+            assert F.to_int(subv[i]) % P == (x - y) % P
+            assert F.to_int(mulv[i]) % P == (x * y) % P
+            # mul restores the lazy-limb budget
+            assert all(abs(int(v)) < 1 << 17 for v in np.asarray(mulv[i]))
+
+    def test_lazy_chain_stays_correct(self, cases):
+        """Chained carry-free add/subs between muls (the growth budget)."""
+        xs, ys, A, B = cases
+
+        def chain(a, b):
+            t = F.mul(a, b)
+            for _ in range(5):
+                t = F.add(t, F.sub(t, b))
+            return F.mul(t, t)
+
+        cv = jax.jit(chain)(A, B)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            t = (x * y) % P
+            for _ in range(5):
+                t = (t + (t - y)) % P
+            assert F.to_int(cv[i]) % P == (t * t) % P
+
+    def test_reduce_full_and_neg(self, cases):
+        xs, _, A, _ = cases
+        rf = jax.jit(F.reduce_full)(A)
+        ng = jax.jit(lambda a: F.reduce_full(F.neg(a)))(A)
+        for i, x in enumerate(xs):
+            assert F.to_int(rf[i]) == x % P
+            assert F.to_int(ng[i]) == (-x) % P
+
+    def test_exponentiation_chains(self, cases):
+        xs, _, A, _ = cases
+        inv = jax.jit(F.invert)(A)
+        p22 = jax.jit(F.pow22523)(A)
+        for i, x in enumerate(xs):
+            want_inv = pow(x, P - 2, P)
+            assert F.to_int(inv[i]) % P == want_inv
+            assert F.to_int(p22[i]) % P == pow(x % P, (P - 5) // 8, P)
+
+    def test_eq_is_zero_nonunique_repr(self):
+        assert bool(F.eq(jnp.array(F.from_int(P)), jnp.array(F.from_int(0))))
+        assert bool(F.is_zero(jnp.array(F.from_int(P))))
+        assert bool(F.is_zero(jnp.array(F.from_int(2 * P))))
+        assert not bool(F.is_zero(jnp.array(F.from_int(1))))
+
+    def test_byte_roundtrips(self):
+        v = 0x1234567890ABCDEF << 128 | 977
+        tb = F.to_bytes_le(jnp.array(F.from_int(v)))
+        assert int.from_bytes(bytes(np.asarray(tb)), "little") == v % P
+        fb = F.from_bytes_le(
+            jnp.array(np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8))
+        )
+        assert F.to_int(fb) == v
+
+    def test_from_int_bounds(self):
+        with pytest.raises(ValueError):
+            F.from_int(-1)
+        with pytest.raises(ValueError):
+            F.from_int(1 << 256)

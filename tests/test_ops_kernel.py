@@ -1,0 +1,210 @@
+"""Differential tests for the device kernel stack: curve ops, SHA-512,
+scalar reduction, and the assembled ed25519 batch verifier vs the
+pure-Python ZIP-215 oracle (crypto/edwards.py).
+
+The oracle-vs-kernel agreement here is the consensus-safety property:
+the TPU path must never disagree with the reference semantics
+(crypto/ed25519/ed25519.go:39 curve25519-voi ZIP-215).
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import edwards as E
+from cometbft_tpu.ops import curve as C
+from cometbft_tpu.ops import field as F
+from cometbft_tpu.ops import scalar as SC
+from cometbft_tpu.ops import sha512 as SH
+from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier
+
+
+def to_dev(pt):
+    x, y = E.pt_to_affine(pt)
+    return tuple(jnp.asarray(F.from_int(v)) for v in (x, y, 1, x * y % E.P))
+
+
+def affine_eq(dev_pt, ref_pt):
+    x, y, z, _ = (F.to_int(np.asarray(c)) % E.P for c in dev_pt)
+    zi = pow(z, E.P - 2, E.P)
+    rx, ry = E.pt_to_affine(ref_pt)
+    return (x * zi % E.P) == rx and (y * zi % E.P) == ry
+
+
+class TestCurve:
+    def test_add_double_vs_oracle(self, rng):
+        for _ in range(3):
+            p = E.pt_mul(rng.randrange(1, E.L), E.B_POINT)
+            q = E.pt_mul(rng.randrange(1, E.L), E.B_POINT)
+            assert affine_eq(jax.jit(C.pt_add)(to_dev(p), to_dev(q)), E.pt_add(p, q))
+            assert affine_eq(jax.jit(C.pt_double)(to_dev(p)), E.pt_double(p))
+
+    def test_decompress_zip215(self, rng):
+        encs, expect = [], []
+        pts = [E.pt_mul(rng.randrange(1, E.L), E.B_POINT) for _ in range(4)]
+        for p in pts:
+            encs.append(E.encode_point(p))
+            expect.append(True)
+        encs.append((E.P + 1).to_bytes(32, "little"))  # non-canonical y
+        expect.append(True)
+        minus_zero = bytearray((1).to_bytes(32, "little"))
+        minus_zero[31] |= 0x80
+        encs.append(bytes(minus_zero))  # "-0"
+        expect.append(True)
+        bad = next(
+            y.to_bytes(32, "little")
+            for y in range(2, 100)
+            if E._recover_x(y, 0) is None
+        )
+        encs.append(bad)  # non-square
+        expect.append(False)
+        arr = jnp.asarray(
+            np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(len(encs), 32)
+        )
+        pt_dev, valid = jax.jit(C.decompress)(arr)
+        assert [bool(v) for v in np.asarray(valid)] == expect
+        for i, p in enumerate(pts):
+            assert affine_eq(tuple(c[i] for c in pt_dev), p)
+        for i in (4, 5):  # ZIP-215 cases agree with the oracle decoder
+            ref = E.decode_point(encs[i])
+            assert affine_eq(tuple(c[i] for c in pt_dev), ref)
+
+    def test_scalar_mults_vs_oracle(self, rng):
+        scalars = [rng.randrange(0, E.L) for _ in range(4)]
+        sb = jnp.asarray(
+            np.stack(
+                [
+                    np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
+                    for s in scalars
+                ]
+            )
+        )
+        comb = jax.jit(lambda b: C.comb_mul_base(C.nibbles_from_bytes_le(b)))(sb)
+        pts = [E.pt_mul(rng.randrange(1, E.L), E.B_POINT) for _ in range(4)]
+        p4 = tuple(jnp.stack([to_dev(p)[c] for p in pts]) for c in range(4))
+        win = jax.jit(lambda b, p: C.window_mul(C.nibbles_from_bytes_le(b), p))(
+            sb, p4
+        )
+        for i, s in enumerate(scalars):
+            assert affine_eq(tuple(c[i] for c in comb), E.pt_mul(s, E.B_POINT))
+            assert affine_eq(tuple(c[i] for c in win), E.pt_mul(s, pts[i]))
+
+    def test_identity_and_mul8(self):
+        assert bool(np.asarray(C.pt_is_identity(C.identity(()))))
+        torsion = E.decode_point(E.small_order_points()[3])
+        assert bool(
+            np.asarray(C.pt_is_identity(jax.jit(C.mul8)(to_dev(torsion))))
+        )
+
+
+class TestSha512:
+    @pytest.mark.parametrize(
+        "msg", [b"", b"abc", b"a" * 111, b"a" * 112, b"x" * 250]
+    )
+    def test_vs_hashlib(self, msg):
+        buf, nblk = SH.pad_message(msg)
+        got = np.asarray(
+            jax.jit(SH.sha512_padded, static_argnums=1)(jnp.asarray(buf), nblk)
+        )
+        assert bytes(got) == hashlib.sha512(msg).digest()
+
+
+class TestScalarModL:
+    def test_reduce_digest(self):
+        rng = random.Random(3)
+        vals = [rng.randrange(0, 2**512) for _ in range(64)]
+        vals[:6] = [0, 1, E.L - 1, E.L, E.L + 1, 2**512 - 1]
+        digests = np.stack(
+            [
+                np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8)
+                for v in vals
+            ]
+        )
+        red = np.asarray(jax.jit(SC.reduce_digest)(jnp.asarray(digests)))
+        nib = np.asarray(SC.limbs_to_nibbles(jnp.asarray(red)))
+        for i, v in enumerate(vals):
+            got = sum(int(red[i][j]) << (16 * j) for j in range(16))
+            assert got == v % E.L
+            assert sum(int(nib[i][j]) << (4 * j) for j in range(64)) == v % E.L
+
+    def test_bytes_lt_l(self):
+        vals = [0, 1, E.L - 1, E.L, E.L + 1, 2**256 - 1]
+        sb = np.stack(
+            [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals]
+        )
+        lt = np.asarray(jax.jit(SC.bytes_lt_l)(jnp.asarray(sb)))
+        assert [bool(v) for v in lt] == [v < E.L for v in vals]
+
+
+class TestBatchVerifyKernel:
+    def test_crafted_cases(self):
+        bv = TpuBatchVerifier()
+        expected = []
+        privs = [ed.gen_priv_key() for _ in range(6)]
+        for i, priv in enumerate(privs):
+            m = bytes([i]) * (10 + i * 23)
+            sig = priv.sign(m)
+            ok = True
+            if i == 2:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+                ok = False
+            if i == 4:
+                m = m + b"!"
+                ok = False
+            bv.add(priv.pub_key(), m, sig)
+            expected.append(ok)
+        # ZIP-215 edge: identity pubkey, R=identity, S=0 verifies
+        ident = E.encode_point(E.IDENTITY)
+        bv.add(ed.Ed25519PubKey(ident), b"edge", ident + bytes(32))
+        expected.append(True)
+        # S >= L rejected
+        bv.add(
+            privs[0].pub_key(),
+            b"m",
+            E.encode_point(E.B_POINT) + E.L.to_bytes(32, "little"),
+        )
+        expected.append(False)
+        ok, results = bv.verify()
+        assert results == expected
+        assert ok == all(expected)
+
+    def test_differential_fuzz_vs_oracle(self, rng):
+        bv = TpuBatchVerifier()
+        oracle = []
+        for _ in range(24):
+            priv = ed.gen_priv_key()
+            m = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 150)))
+            sig = bytearray(priv.sign(m))
+            pub = bytearray(priv.pub_key().bytes())
+            r = rng.random()
+            if r < 0.3:
+                sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            elif r < 0.45:
+                pub[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            elif r < 0.55:
+                m = m + b"x"
+            bv.add(ed.Ed25519PubKey(bytes(pub)), m, bytes(sig))
+            oracle.append(E.verify_zip215(bytes(pub), m, bytes(sig)))
+        _, results = bv.verify()
+        assert results == oracle
+
+    def test_empty_batch(self):
+        ok, results = TpuBatchVerifier().verify()
+        assert not ok and results == []
+
+    def test_cpu_and_tpu_verifiers_agree(self):
+        priv = ed.gen_priv_key()
+        m = b"agreement"
+        sig = priv.sign(m)
+        for cls in (ed.CpuBatchVerifier, TpuBatchVerifier):
+            bv = cls()
+            bv.add(priv.pub_key(), m, sig)
+            bv.add(priv.pub_key(), m + b"?", sig)
+            ok, res = bv.verify()
+            assert not ok and res == [True, False]
