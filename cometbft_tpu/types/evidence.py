@@ -1,0 +1,89 @@
+"""Byzantine-fault evidence types (types/evidence.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+
+class EvidenceError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class DuplicateVoteEvidence:
+    """Equivocation: two votes by one validator for the same
+    (height, round, type) but different blocks (types/evidence.go:44)."""
+
+    vote_a: Vote
+    vote_b: Vote
+    total_voting_power: int = 0
+    validator_power: int = 0
+    timestamp_ns: int = 0
+
+    @property
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def hash(self) -> bytes:
+        from cometbft_tpu.types import codec
+
+        return tmhash.sum256(codec.encode_evidence(self))
+
+    @classmethod
+    def from_votes(
+        cls, vote_a: Vote, vote_b: Vote, block_time_ns: int, val_set: ValidatorSet
+    ) -> "DuplicateVoteEvidence":
+        """Canonical ordering: vote_a is the lexicographically smaller
+        block id (types/evidence.go NewDuplicateVoteEvidence)."""
+        if vote_a is None or vote_b is None:
+            raise EvidenceError("missing vote")
+        _, val = val_set.get_by_address(vote_a.validator_address)
+        if val is None:
+            raise EvidenceError("validator not in set")
+        if vote_b.block_id.key() < vote_a.block_id.key():
+            vote_a, vote_b = vote_b, vote_a
+        return cls(
+            vote_a=vote_a,
+            vote_b=vote_b,
+            total_voting_power=val_set.total_voting_power(),
+            validator_power=val.voting_power,
+            timestamp_ns=block_time_ns,
+        )
+
+    def validate_basic(self) -> None:
+        if self.vote_a is None or self.vote_b is None:
+            raise EvidenceError("missing vote")
+        if self.vote_a.block_id.key() >= self.vote_b.block_id.key():
+            raise EvidenceError("duplicate votes in wrong order")
+
+
+@dataclass(frozen=True)
+class LightClientAttackEvidence:
+    """A conflicting light block signed by a subset of validators
+    (types/evidence.go:176). The conflicting block is carried as its
+    header-level data; full verification lives in evidence/verify."""
+
+    conflicting_header_hash: bytes
+    conflicting_commit: object  # Commit
+    common_height: int
+    byzantine_validators: tuple[bytes, ...] = ()  # addresses
+    total_voting_power: int = 0
+    timestamp_ns: int = 0
+
+    @property
+    def height(self) -> int:
+        return self.common_height
+
+    def hash(self) -> bytes:
+        from cometbft_tpu.types import codec
+        from cometbft_tpu.utils.protoio import ProtoWriter
+
+        w = ProtoWriter()
+        w.bytes_(1, self.conflicting_header_hash)
+        w.varint(2, self.common_height & 0xFFFFFFFFFFFFFFFF)
+        w.message(3, codec.encode_commit(self.conflicting_commit))
+        return tmhash.sum256(w.finish())

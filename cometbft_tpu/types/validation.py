@@ -1,0 +1,237 @@
+"""Commit verification — the north-star hot path (types/validation.go).
+
+All block/light-client/evidence verification funnels here, and from
+here into the BatchVerifier seam, i.e. onto the TPU:
+
+  VerifyCommit              every applied block (state/validation.go:94)
+  VerifyCommitLight         blocksync replay (internal/blocksync/reactor.go:550)
+  VerifyCommitLightTrusting light client (light/verifier.go:56)
+
+Design difference from the reference: its batch path gets only a single
+ok/fail bit from the RLC batch equation and must re-verify sequentially
+to find the offender (types/validation.go:310); the data-parallel device
+kernel returns per-signature validity, so the invalid index is free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.types.block import BlockID, Commit
+from cometbft_tpu.types.validator import ValidatorSet
+
+
+class CommitError(Exception):
+    pass
+
+
+class InvalidCommitHeight(CommitError):
+    pass
+
+
+class InvalidCommitSignatures(CommitError):
+    pass
+
+
+class NotEnoughVotingPower(CommitError):
+    pass
+
+
+@dataclass
+class _Entry:
+    idx: int
+    val_idx: int
+    power: int
+    counts: bool  # counts toward the tallied (for-block) power
+
+
+def _check_dims(vals: ValidatorSet, commit: Commit, height: int, block_id: BlockID):
+    if vals is None or commit is None:
+        raise CommitError("nil validator set or commit")
+    if height != commit.height:
+        raise InvalidCommitHeight(
+            f"commit height {commit.height}, expected {height}"
+        )
+    if block_id != commit.block_id:
+        raise InvalidCommitSignatures(
+            f"commit for wrong block id {commit.block_id}"
+        )
+
+
+def _should_batch_verify(commit: Commit, entries: list[_Entry], vals) -> bool:
+    """(validation.go:15) >= 2 sigs, all batch-capable, same key type."""
+    if len(entries) < 2:
+        return False
+    key_types = {
+        vals.get_by_index(e.val_idx).pub_key.type() for e in entries
+    }
+    if len(key_types) != 1:
+        return False
+    pk = vals.get_by_index(entries[0].val_idx).pub_key
+    return crypto_batch.supports_batch_verifier(pk)
+
+
+def _verify(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    count_sig,
+    count_all: bool,
+    lookup_by_address: bool,
+) -> None:
+    """Shared engine for the three verification modes
+    (validation.go:160 verifyBasicValsAndCommit + verifyCommitBatch).
+
+    count_sig(cs) decides which signatures are cryptographically checked;
+    tallied power only ever counts BlockIDFlagCommit votes. count_all
+    keeps verifying past the threshold (VerifyCommit) or stops early
+    (the Light variants).
+    """
+    if not lookup_by_address and len(vals) != commit.size():
+        raise InvalidCommitSignatures(
+            f"validator set size {len(vals)} != commit size {commit.size()}"
+        )
+
+    entries: list[_Entry] = []
+    tallied = 0
+    counted_power = 0
+    seen_addrs: set[bytes] = set()
+    for idx, cs in enumerate(commit.signatures):
+        if not count_sig(cs):
+            continue
+        if lookup_by_address:
+            val_idx, val = vals.get_by_address(cs.validator_address)
+            if val_idx < 0:
+                continue
+            if cs.validator_address in seen_addrs:
+                raise InvalidCommitSignatures(
+                    "double vote by validator in trusting verification"
+                )
+            seen_addrs.add(cs.validator_address)
+        else:
+            val_idx, val = idx, vals.get_by_index(idx)
+            if val is None:
+                raise InvalidCommitSignatures(f"no validator at index {idx}")
+            if val.address != cs.validator_address:
+                raise InvalidCommitSignatures(
+                    f"signature {idx} address mismatch"
+                )
+        entries.append(
+            _Entry(idx, val_idx, val.voting_power, cs.is_commit())
+        )
+        if cs.is_commit():
+            counted_power += val.voting_power
+        # early-break path: stop collecting once the counted power
+        # passes the threshold (validation.go:290)
+        if not count_all and counted_power > voting_power_needed:
+            break
+
+    # crypto pass — one device launch for the whole commit
+    verifier = None
+    if _should_batch_verify(commit, entries, vals):
+        verifier = crypto_batch.create_batch_verifier(
+            vals.get_by_index(entries[0].val_idx).pub_key
+        )
+    if verifier is not None:
+        for e in entries:
+            verifier.add(
+                vals.get_by_index(e.val_idx).pub_key,
+                commit.vote_sign_bytes(chain_id, e.idx),
+                commit.signatures[e.idx].signature,
+            )
+        ok, results = verifier.verify()
+        if not ok:
+            bad = next(i for i, r in enumerate(results) if not r)
+            raise InvalidCommitSignatures(
+                f"wrong signature (#{entries[bad].idx})"
+            )
+    else:
+        for e in entries:
+            pk = vals.get_by_index(e.val_idx).pub_key
+            if not pk.verify_signature(
+                commit.vote_sign_bytes(chain_id, e.idx),
+                commit.signatures[e.idx].signature,
+            ):
+                raise InvalidCommitSignatures(f"wrong signature (#{e.idx})")
+
+    for e in entries:
+        if e.counts:
+            tallied += e.power
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPower(
+            f"tallied {tallied} <= needed {voting_power_needed}"
+        )
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """Full verification: every signature (commit and nil votes) checked,
+    +2/3 of total power must have signed the block (validation.go:28)."""
+    _check_dims(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        needed,
+        count_sig=lambda cs: not cs.is_absent(),
+        count_all=True,
+        lookup_by_address=False,
+    )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """Verify only until +2/3 is reached; nil votes skipped
+    (validation.go:63)."""
+    _check_dims(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        needed,
+        count_sig=lambda cs: cs.is_commit(),
+        count_all=False,
+        lookup_by_address=False,
+    )
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction = Fraction(1, 3),
+) -> None:
+    """Light-client trusting verification: signatures matched by address
+    against the *trusted* set; needs > trust_level of its power
+    (validation.go:129)."""
+    if trust_level.denominator == 0:
+        raise ValueError("trust level has zero denominator")
+    if not (0 < trust_level <= 1):
+        raise ValueError(f"trust level must be in (0, 1], got {trust_level}")
+    needed = (
+        vals.total_voting_power() * trust_level.numerator
+    ) // trust_level.denominator
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        needed,
+        count_sig=lambda cs: cs.is_commit(),
+        count_all=False,
+        lookup_by_address=True,
+    )

@@ -1,0 +1,88 @@
+"""Shared test factories (reference analog: internal/test/ factories +
+consensus validatorStub, internal/consensus/common_test.go:84)."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.types import (
+    PRECOMMIT_TYPE,
+    BlockID,
+    Commit,
+    PartSetHeader,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+
+CHAIN_ID = "test-chain"
+
+
+def make_keys(n: int) -> list[ed.Ed25519PrivKey]:
+    return [ed.priv_key_from_secret(b"val%d" % i) for i in range(n)]
+
+
+def make_val_set(
+    n: int = 4, powers: list[int] | None = None
+) -> tuple[ValidatorSet, list[ed.Ed25519PrivKey]]:
+    keys = make_keys(n)
+    powers = powers or [10] * n
+    vals = ValidatorSet(
+        [Validator(k.pub_key(), p) for k, p in zip(keys, powers)]
+    )
+    # order keys to match the set's canonical order
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    return vals, ordered
+
+
+def make_block_id(seed: bytes = b"blk") -> BlockID:
+    import hashlib
+
+    h = hashlib.sha256(seed).digest()
+    return BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+
+
+def signed_vote(
+    priv: ed.Ed25519PrivKey,
+    val_idx: int,
+    block_id: BlockID,
+    height: int = 1,
+    round_: int = 0,
+    vote_type: int = PRECOMMIT_TYPE,
+    time_ns: int = 1_700_000_000_000_000_000,
+    chain_id: str = CHAIN_ID,
+) -> Vote:
+    vote = Vote(
+        type=vote_type,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=time_ns,
+        validator_address=priv.pub_key().address(),
+        validator_index=val_idx,
+    )
+    sig = priv.sign(vote.sign_bytes(chain_id))
+    return replace(vote, signature=sig)
+
+
+def make_commit(
+    vals: ValidatorSet,
+    keys: list[ed.Ed25519PrivKey],
+    block_id: BlockID,
+    height: int = 1,
+    round_: int = 0,
+    chain_id: str = CHAIN_ID,
+) -> Commit:
+    vote_set = VoteSet(chain_id, height, round_, PRECOMMIT_TYPE, vals)
+    for i, key in enumerate(keys):
+        vote_set.add_vote(
+            signed_vote(
+                key, i, block_id, height=height, round_=round_, chain_id=chain_id
+            )
+        )
+    return vote_set.make_commit()
