@@ -1,0 +1,546 @@
+"""ABCI 2.x application interface — request/response types and the
+``Application`` protocol (reference: abci/types/application.go:11-41).
+
+Twelve methods across four logical connections:
+  query     — Info, Query, Echo
+  mempool   — CheckTx
+  consensus — InitChain, PrepareProposal, ProcessProposal,
+              FinalizeBlock, ExtendVote, VerifyVoteExtension, Commit
+  snapshot  — ListSnapshots, OfferSnapshot, LoadSnapshotChunk,
+              ApplySnapshotChunk
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import IntEnum
+
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+CODE_TYPE_OK = 0
+
+# CheckTx types (abci/types/types.proto CheckTxType)
+CHECK_TX_TYPE_CHECK = 0
+CHECK_TX_TYPE_RECHECK = 1
+
+
+class ProposalStatus(IntEnum):
+    """ProcessProposal verdict (ResponseProcessProposal.ProposalStatus)."""
+
+    UNKNOWN = 0
+    ACCEPT = 1
+    REJECT = 2
+
+
+class VerifyStatus(IntEnum):
+    """VerifyVoteExtension verdict."""
+
+    UNKNOWN = 0
+    ACCEPT = 1
+    REJECT = 2
+
+
+class OfferSnapshotResult(IntEnum):
+    """OfferSnapshot verdict (ResponseOfferSnapshot.Result)."""
+
+    UNKNOWN = 0
+    ACCEPT = 1
+    ABORT = 2
+    REJECT = 3
+    REJECT_FORMAT = 4
+    REJECT_SENDER = 5
+
+
+class ApplySnapshotChunkResult(IntEnum):
+    UNKNOWN = 0
+    ACCEPT = 1
+    ABORT = 2
+    RETRY = 3
+    RETRY_SNAPSHOT = 4
+    REJECT_SNAPSHOT = 5
+
+
+@dataclass(frozen=True)
+class EventAttribute:
+    key: str
+    value: str
+    index: bool = True
+
+
+@dataclass(frozen=True)
+class Event:
+    """Indexable event emitted by the app (abci/types Event)."""
+
+    type: str
+    attributes: tuple[EventAttribute, ...] = ()
+
+
+@dataclass(frozen=True)
+class ValidatorUpdate:
+    """(pubkey, power) delta from the app (abci ValidatorUpdate)."""
+
+    pub_key_type: str
+    pub_key_bytes: bytes
+    power: int
+
+
+@dataclass(frozen=True)
+class ExecTxResult:
+    """Result of executing one tx in FinalizeBlock (abci ExecTxResult)."""
+
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: tuple[Event, ...] = ()
+    codespace: str = ""
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def deterministic_encode(self) -> bytes:
+        """Encoding of the deterministic subset only (code, data, gas),
+        used for Header.last_results_hash (reference:
+        types/results.go deterministicExecTxResult)."""
+        w = ProtoWriter()
+        w.varint(1, self.code)
+        w.bytes_(2, self.data)
+        w.varint(5, self.gas_wanted & 0xFFFFFFFFFFFFFFFF)
+        w.varint(6, self.gas_used & 0xFFFFFFFFFFFFFFFF)
+        return w.finish()
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.varint(1, self.code)
+        w.bytes_(2, self.data)
+        w.string(3, self.log)
+        w.string(4, self.info)
+        w.varint(5, self.gas_wanted & 0xFFFFFFFFFFFFFFFF)
+        w.varint(6, self.gas_used & 0xFFFFFFFFFFFFFFFF)
+        for ev in self.events:
+            e = ProtoWriter()
+            e.string(1, ev.type)
+            for attr in ev.attributes:
+                a = ProtoWriter()
+                a.string(1, attr.key)
+                a.string(2, attr.value)
+                a.varint(3, 1 if attr.index else 0)
+                e.message(2, a.finish())
+            w.message(7, e.finish())
+        w.string(8, self.codespace)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ExecTxResult":
+        f = ProtoReader(data).to_dict()
+        events = []
+        for raw in f.get(7, []):
+            ef = ProtoReader(raw).to_dict()
+            attrs = []
+            for araw in ef.get(2, []):
+                af = ProtoReader(araw).to_dict()
+                attrs.append(
+                    EventAttribute(
+                        key=bytes(af.get(1, [b""])[0]).decode(),
+                        value=bytes(af.get(2, [b""])[0]).decode(),
+                        index=bool(af.get(3, [0])[0]),
+                    )
+                )
+            events.append(
+                Event(
+                    type=bytes(ef.get(1, [b""])[0]).decode(),
+                    attributes=tuple(attrs),
+                )
+            )
+        from cometbft_tpu.types.codec import s64
+
+        return cls(
+            code=int(f.get(1, [0])[0]),
+            data=bytes(f.get(2, [b""])[0]),
+            log=bytes(f.get(3, [b""])[0]).decode(),
+            info=bytes(f.get(4, [b""])[0]).decode(),
+            gas_wanted=s64(f.get(5, [0])[0]),
+            gas_used=s64(f.get(6, [0])[0]),
+            events=tuple(events),
+            codespace=bytes(f.get(8, [b""])[0]).decode(),
+        )
+
+
+def results_hash(results: list[ExecTxResult]) -> bytes:
+    """Merkle root over deterministic tx-result encodings — the value of
+    Header.last_results_hash (types/results.go TxResults.Hash)."""
+    from cometbft_tpu.crypto import merkle
+
+    return merkle.hash_from_byte_slices(
+        [r.deterministic_encode() for r in results]
+    )
+
+
+# -- requests/responses ------------------------------------------------
+
+@dataclass(frozen=True)
+class InfoRequest:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+    abci_version: str = ""
+
+
+@dataclass(frozen=True)
+class InfoResponse:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass(frozen=True)
+class QueryRequest:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass(frozen=True)
+class QueryResponse:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof_ops: tuple = ()
+    height: int = 0
+    codespace: str = ""
+
+
+@dataclass(frozen=True)
+class CheckTxRequest:
+    tx: bytes
+    type: int = CHECK_TX_TYPE_CHECK
+
+
+@dataclass(frozen=True)
+class CheckTxResponse:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    codespace: str = ""
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass(frozen=True)
+class InitChainRequest:
+    time_ns: int = 0
+    chain_id: str = ""
+    consensus_params: object | None = None
+    validators: tuple[ValidatorUpdate, ...] = ()
+    app_state_bytes: bytes = b""
+    initial_height: int = 1
+
+
+@dataclass(frozen=True)
+class InitChainResponse:
+    consensus_params: object | None = None
+    validators: tuple[ValidatorUpdate, ...] = ()
+    app_hash: bytes = b""
+
+
+@dataclass(frozen=True)
+class PrepareProposalRequest:
+    max_tx_bytes: int = 0
+    txs: tuple[bytes, ...] = ()
+    local_last_commit: object | None = None
+    misbehavior: tuple = ()
+    height: int = 0
+    time_ns: int = 0
+    next_validators_hash: bytes = b""
+    proposer_address: bytes = b""
+
+
+@dataclass(frozen=True)
+class PrepareProposalResponse:
+    txs: tuple[bytes, ...] = ()
+
+
+@dataclass(frozen=True)
+class ProcessProposalRequest:
+    txs: tuple[bytes, ...] = ()
+    proposed_last_commit: object | None = None
+    misbehavior: tuple = ()
+    hash: bytes = b""
+    height: int = 0
+    time_ns: int = 0
+    next_validators_hash: bytes = b""
+    proposer_address: bytes = b""
+
+
+@dataclass(frozen=True)
+class ProcessProposalResponse:
+    status: ProposalStatus = ProposalStatus.UNKNOWN
+
+    @property
+    def is_accepted(self) -> bool:
+        return self.status == ProposalStatus.ACCEPT
+
+
+@dataclass(frozen=True)
+class ExtendVoteRequest:
+    hash: bytes = b""
+    height: int = 0
+    round: int = 0
+    time_ns: int = 0
+    txs: tuple[bytes, ...] = ()
+    proposed_last_commit: object | None = None
+    misbehavior: tuple = ()
+    next_validators_hash: bytes = b""
+    proposer_address: bytes = b""
+
+
+@dataclass(frozen=True)
+class ExtendVoteResponse:
+    vote_extension: bytes = b""
+
+
+@dataclass(frozen=True)
+class VerifyVoteExtensionRequest:
+    hash: bytes = b""
+    validator_address: bytes = b""
+    height: int = 0
+    vote_extension: bytes = b""
+
+
+@dataclass(frozen=True)
+class VerifyVoteExtensionResponse:
+    status: VerifyStatus = VerifyStatus.UNKNOWN
+
+    @property
+    def is_accepted(self) -> bool:
+        return self.status == VerifyStatus.ACCEPT
+
+
+@dataclass(frozen=True)
+class CommitInfo:
+    """Last-commit votes forwarded to the app (abci CommitInfo)."""
+
+    round: int = 0
+    votes: tuple["VoteInfo", ...] = ()
+
+
+@dataclass(frozen=True)
+class VoteInfo:
+    validator_address: bytes
+    validator_power: int
+    block_id_flag: int
+
+
+@dataclass(frozen=True)
+class Misbehavior:
+    """Evidence forwarded to the app (abci Misbehavior)."""
+
+    type: int  # 1 duplicate vote, 2 light client attack
+    validator_address: bytes
+    validator_power: int
+    height: int
+    time_ns: int
+    total_voting_power: int
+
+
+MISBEHAVIOR_DUPLICATE_VOTE = 1
+MISBEHAVIOR_LIGHT_CLIENT_ATTACK = 2
+
+
+@dataclass(frozen=True)
+class FinalizeBlockRequest:
+    txs: tuple[bytes, ...] = ()
+    decided_last_commit: CommitInfo = field(default_factory=CommitInfo)
+    misbehavior: tuple[Misbehavior, ...] = ()
+    hash: bytes = b""
+    height: int = 0
+    time_ns: int = 0
+    next_validators_hash: bytes = b""
+    proposer_address: bytes = b""
+    syncing_to_height: int = 0
+
+
+@dataclass(frozen=True)
+class FinalizeBlockResponse:
+    events: tuple[Event, ...] = ()
+    tx_results: tuple[ExecTxResult, ...] = ()
+    validator_updates: tuple[ValidatorUpdate, ...] = ()
+    consensus_param_updates: object | None = None
+    app_hash: bytes = b""
+
+    def encode(self) -> bytes:
+        """Persistent encoding for the state store (ABCIResponses)."""
+        w = ProtoWriter()
+        for r in self.tx_results:
+            w.message(2, r.encode())
+        for vu in self.validator_updates:
+            v = ProtoWriter()
+            v.string(1, vu.pub_key_type)
+            v.bytes_(2, vu.pub_key_bytes)
+            v.varint(3, vu.power)
+            w.message(3, v.finish())
+        w.bytes_(5, self.app_hash)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "FinalizeBlockResponse":
+        f = ProtoReader(data).to_dict()
+        return cls(
+            tx_results=tuple(
+                ExecTxResult.decode(raw) for raw in f.get(2, [])
+            ),
+            validator_updates=tuple(
+                ValidatorUpdate(
+                    pub_key_type=bytes(
+                        ProtoReader(raw).to_dict().get(1, [b""])[0]
+                    ).decode(),
+                    pub_key_bytes=bytes(
+                        ProtoReader(raw).to_dict().get(2, [b""])[0]
+                    ),
+                    power=int(ProtoReader(raw).to_dict().get(3, [0])[0]),
+                )
+                for raw in f.get(3, [])
+            ),
+            app_hash=bytes(f.get(5, [b""])[0]),
+        )
+
+
+@dataclass(frozen=True)
+class CommitResponse:
+    retain_height: int = 0
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+@dataclass(frozen=True)
+class ListSnapshotsResponse:
+    snapshots: tuple[Snapshot, ...] = ()
+
+
+@dataclass(frozen=True)
+class OfferSnapshotRequest:
+    snapshot: Snapshot | None = None
+    app_hash: bytes = b""
+
+
+@dataclass(frozen=True)
+class OfferSnapshotResponse:
+    result: OfferSnapshotResult = OfferSnapshotResult.UNKNOWN
+
+
+@dataclass(frozen=True)
+class LoadSnapshotChunkRequest:
+    height: int = 0
+    format: int = 0
+    chunk: int = 0
+
+
+@dataclass(frozen=True)
+class LoadSnapshotChunkResponse:
+    chunk: bytes = b""
+
+
+@dataclass(frozen=True)
+class ApplySnapshotChunkRequest:
+    index: int = 0
+    chunk: bytes = b""
+    sender: str = ""
+
+
+@dataclass(frozen=True)
+class ApplySnapshotChunkResponse:
+    result: ApplySnapshotChunkResult = ApplySnapshotChunkResult.UNKNOWN
+    refetch_chunks: tuple[int, ...] = ()
+    reject_senders: tuple[str, ...] = ()
+
+
+class Application:
+    """Base application: every method has a sane no-op default, so apps
+    override only what they need (abci/types/application.go BaseApplication).
+    """
+
+    def info(self, req: InfoRequest) -> InfoResponse:
+        return InfoResponse()
+
+    def query(self, req: QueryRequest) -> QueryResponse:
+        return QueryResponse()
+
+    def check_tx(self, req: CheckTxRequest) -> CheckTxResponse:
+        return CheckTxResponse()
+
+    def init_chain(self, req: InitChainRequest) -> InitChainResponse:
+        return InitChainResponse()
+
+    def prepare_proposal(
+        self, req: PrepareProposalRequest
+    ) -> PrepareProposalResponse:
+        # Default: include txs up to the byte limit (reference default).
+        total, txs = 0, []
+        for tx in req.txs:
+            if req.max_tx_bytes > 0 and total + len(tx) > req.max_tx_bytes:
+                break
+            total += len(tx)
+            txs.append(tx)
+        return PrepareProposalResponse(txs=tuple(txs))
+
+    def process_proposal(
+        self, req: ProcessProposalRequest
+    ) -> ProcessProposalResponse:
+        return ProcessProposalResponse(status=ProposalStatus.ACCEPT)
+
+    def extend_vote(self, req: ExtendVoteRequest) -> ExtendVoteResponse:
+        return ExtendVoteResponse()
+
+    def verify_vote_extension(
+        self, req: VerifyVoteExtensionRequest
+    ) -> VerifyVoteExtensionResponse:
+        return VerifyVoteExtensionResponse(status=VerifyStatus.ACCEPT)
+
+    def finalize_block(
+        self, req: FinalizeBlockRequest
+    ) -> FinalizeBlockResponse:
+        return FinalizeBlockResponse(
+            tx_results=tuple(ExecTxResult() for _ in req.txs)
+        )
+
+    def commit(self) -> CommitResponse:
+        return CommitResponse()
+
+    def list_snapshots(self) -> ListSnapshotsResponse:
+        return ListSnapshotsResponse()
+
+    def offer_snapshot(self, req: OfferSnapshotRequest) -> OfferSnapshotResponse:
+        return OfferSnapshotResponse()
+
+    def load_snapshot_chunk(
+        self, req: LoadSnapshotChunkRequest
+    ) -> LoadSnapshotChunkResponse:
+        return LoadSnapshotChunkResponse()
+
+    def apply_snapshot_chunk(
+        self, req: ApplySnapshotChunkRequest
+    ) -> ApplySnapshotChunkResponse:
+        return ApplySnapshotChunkResponse(
+            result=ApplySnapshotChunkResult.ACCEPT
+        )

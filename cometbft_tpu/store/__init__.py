@@ -1,1 +1,198 @@
-"""Package placeholder — populated as layers land."""
+"""Block store — part-based persistent block storage
+(reference: store/store.go:46, store/db_key_layout.go).
+
+Blocks are saved as their gossip part-sets plus a BlockMeta per height,
+the canonical commit for height H (inside block H+1's storage path in
+the reference; here keyed directly), and the "seen commit" (the +2/3
+precommits this node itself observed, which may differ in round).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.block import Block, Commit
+from cometbft_tpu.types.block_meta import BlockMeta
+from cometbft_tpu.types.part_set import Part, PartSet
+from cometbft_tpu.utils.db import DB
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+# Key layout (store/db_key_layout.go v1): prefix + big-endian height so
+# range iteration walks heights in order.
+_META = b"H:"
+_PART = b"P:"
+_COMMIT = b"C:"
+_SEEN_COMMIT = b"SC:"
+_EXT_COMMIT = b"EC:"
+_HASH = b"BH:"
+_STATE_KEY = b"blockStore"
+
+
+def _hkey(prefix: bytes, height: int) -> bytes:
+    return prefix + height.to_bytes(8, "big")
+
+
+def _pkey(height: int, index: int) -> bytes:
+    return _PART + height.to_bytes(8, "big") + index.to_bytes(4, "big")
+
+
+class BlockStoreError(Exception):
+    pass
+
+
+class BlockStore:
+    """Contiguous range [base, height] of blocks (store/store.go:37-46)."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.RLock()
+        self._base, self._height = self._load_state()
+
+    # -- range ---------------------------------------------------------
+
+    def _load_state(self) -> tuple[int, int]:
+        raw = self._db.get(_STATE_KEY)
+        if raw is None:
+            return 0, 0
+        f = ProtoReader(raw).to_dict()
+        return int(f.get(1, [0])[0]), int(f.get(2, [0])[0])
+
+    def _save_state_ops(self) -> tuple[bytes, bytes]:
+        w = ProtoWriter()
+        w.varint(1, self._base)
+        w.varint(2, self._height)
+        return _STATE_KEY, w.finish()
+
+    def base(self) -> int:
+        with self._mtx:
+            return self._base
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    def size(self) -> int:
+        with self._mtx:
+            return 0 if self._height == 0 else self._height - self._base + 1
+
+    # -- loads ---------------------------------------------------------
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self._db.get(_hkey(_META, height))
+        return BlockMeta.decode(raw) if raw is not None else None
+
+    def load_block(self, height: int) -> Block | None:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        buf = bytearray()
+        for i in range(meta.block_id.part_set_header.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                raise BlockStoreError(
+                    f"missing part {i} of block {height}"
+                )
+            buf += part.bytes
+        return codec.decode_block(bytes(buf))
+
+    def load_block_by_hash(self, block_hash: bytes) -> Block | None:
+        raw = self._db.get(_HASH + block_hash)
+        if raw is None:
+            return None
+        return self.load_block(int.from_bytes(raw, "big"))
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        raw = self._db.get(_pkey(height, index))
+        return codec.decode_part(raw) if raw is not None else None
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit for block at ``height`` (carried in the
+        child block's LastCommit, store/store.go LoadBlockCommit)."""
+        raw = self._db.get(_hkey(_COMMIT, height))
+        return codec.decode_commit(raw) if raw is not None else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(_hkey(_SEEN_COMMIT, height))
+        return codec.decode_commit(raw) if raw is not None else None
+
+    # -- saves ---------------------------------------------------------
+
+    def save_block(
+        self, block: Block, part_set: PartSet, seen_commit: Commit
+    ) -> None:
+        """Atomically persist block parts + meta + commits
+        (store/store.go SaveBlock)."""
+        if block is None or not part_set.is_complete():
+            raise BlockStoreError("cannot save incomplete block")
+        height = block.header.height
+        with self._mtx:
+            expected = self._height + 1 if self._height > 0 else height
+            if height != expected:
+                raise BlockStoreError(
+                    f"cannot save block {height}, expected {expected}"
+                )
+            meta = BlockMeta.from_parts(block, part_set)
+            ops: list[tuple[bytes, bytes | None]] = [
+                (_hkey(_META, height), meta.encode()),
+                (_HASH + block.hash(), height.to_bytes(8, "big")),
+                (_hkey(_SEEN_COMMIT, height), codec.encode_commit(seen_commit)),
+            ]
+            for i in range(part_set.header.total):
+                part = part_set.get_part(i)
+                ops.append((_pkey(height, i), codec.encode_part(part)))
+            if block.last_commit is not None:
+                ops.append(
+                    (
+                        _hkey(_COMMIT, height - 1),
+                        codec.encode_commit(block.last_commit),
+                    )
+                )
+            prev_base, prev_height = self._base, self._height
+            self._height = height
+            if self._base == 0:
+                self._base = height
+            ops.append(self._save_state_ops())
+            try:
+                self._db.write_batch(ops)
+            except BaseException:
+                self._base, self._height = prev_base, prev_height
+                raise
+
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        self._db.set(_hkey(_SEEN_COMMIT, height), codec.encode_commit(commit))
+
+    # -- pruning -------------------------------------------------------
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Remove blocks below ``retain_height``; returns count pruned
+        (store/store.go PruneBlocks)."""
+        with self._mtx:
+            if retain_height <= self._base:
+                return 0
+            if retain_height > self._height:
+                raise BlockStoreError(
+                    f"cannot prune beyond height {self._height}"
+                )
+            pruned = 0
+            ops: list[tuple[bytes, bytes | None]] = []
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is None:
+                    continue
+                ops.append((_hkey(_META, h), None))
+                ops.append((_HASH + meta.block_id.hash, None))
+                ops.append((_hkey(_COMMIT, h), None))
+                ops.append((_hkey(_SEEN_COMMIT, h), None))
+                for i in range(meta.block_id.part_set_header.total):
+                    ops.append((_pkey(h, i), None))
+                pruned += 1
+            prev_base = self._base
+            self._base = retain_height
+            ops.append(self._save_state_ops())
+            try:
+                self._db.write_batch(ops)
+            except BaseException:
+                self._base = prev_base
+                raise
+            return pruned

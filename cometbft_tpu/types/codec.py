@@ -235,3 +235,46 @@ def decode_block(data: bytes) -> Block:
         evidence=evidence,
         last_commit=last_commit,
     )
+
+
+# -- parts + proofs ----------------------------------------------------
+
+def encode_proof(p) -> bytes:
+    w = ProtoWriter()
+    w.varint(1, p.total)
+    w.varint(2, p.index)
+    w.bytes_(3, p.leaf_hash)
+    for aunt in p.aunts:
+        w.bytes_(4, aunt)
+    return w.finish()
+
+
+def decode_proof(data: bytes):
+    from cometbft_tpu.crypto.merkle import Proof
+
+    f = ProtoReader(data).to_dict()
+    return Proof(
+        total=int(f.get(1, [0])[0]),
+        index=int(f.get(2, [0])[0]),
+        leaf_hash=bytes(f.get(3, [b""])[0]),
+        aunts=[bytes(a) for a in f.get(4, [])],
+    )
+
+
+def encode_part(p) -> bytes:
+    w = ProtoWriter()
+    w.varint(1, p.index)
+    w.bytes_(2, p.bytes)
+    w.message(3, encode_proof(p.proof))
+    return w.finish()
+
+
+def decode_part(data: bytes):
+    from cometbft_tpu.types.part_set import Part
+
+    f = ProtoReader(data).to_dict()
+    return Part(
+        index=int(f.get(1, [0])[0]),
+        bytes=bytes(f.get(2, [b""])[0]),
+        proof=decode_proof(f[3][0]),
+    )

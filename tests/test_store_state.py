@@ -1,0 +1,295 @@
+"""Persistence layer: KV db, block store, state store (reference test
+analogs: store/store_test.go, state/store_test.go)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from cometbft_tpu import state as sm
+from cometbft_tpu.abci.types import ExecTxResult, FinalizeBlockResponse
+from cometbft_tpu.store import BlockStore, BlockStoreError
+from cometbft_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    Data,
+    GenesisDoc,
+    GenesisValidator,
+    Header,
+)
+from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
+from cometbft_tpu.utils.db import MemDB, SQLiteDB, open_db, prefix_end
+
+from tests.helpers import CHAIN_ID, make_commit, make_val_set
+
+
+# -- db ----------------------------------------------------------------
+
+def db_backends(tmp_path):
+    return [MemDB(), SQLiteDB(str(tmp_path / "t.db"))]
+
+
+def test_db_roundtrip(tmp_path):
+    for db in db_backends(tmp_path):
+        assert db.get(b"a") is None
+        db.set(b"a", b"1")
+        db.set(b"b", b"2")
+        assert db.get(b"a") == b"1"
+        assert db.has(b"b")
+        db.delete(b"a")
+        assert db.get(b"a") is None
+        db.close()
+
+
+def test_db_iteration_order(tmp_path):
+    for db in db_backends(tmp_path):
+        keys = [b"a", b"ab", b"b\x00", b"b", b"\xff", b"B"]
+        for i, k in enumerate(keys):
+            db.set(k, bytes([i]))
+        got = [k for k, _ in db.iterator()]
+        assert got == sorted(keys)
+        rev = [k for k, _ in db.reverse_iterator()]
+        assert rev == sorted(keys, reverse=True)
+        # range [b, c)
+        rng = [k for k, _ in db.iterator(b"b", b"c")]
+        assert rng == [b"b", b"b\x00"]
+        db.close()
+
+
+def test_db_batch_and_prefix(tmp_path):
+    for db in db_backends(tmp_path):
+        db.write_batch([(b"p/1", b"x"), (b"p/2", b"y"), (b"q/1", b"z")])
+        assert [k for k, _ in db.prefix_iterator(b"p/")] == [b"p/1", b"p/2"]
+        db.write_batch([(b"p/1", None), (b"p/3", b"w")])
+        assert db.get(b"p/1") is None
+        assert db.get(b"p/3") == b"w"
+        db.close()
+
+
+def test_prefix_end():
+    assert prefix_end(b"a") == b"b"
+    assert prefix_end(b"a\xff") == b"b"
+    assert prefix_end(b"\xff") is None
+    assert prefix_end(b"") is None
+
+
+def test_sqlite_persistence(tmp_path):
+    path = str(tmp_path / "p.db")
+    db = SQLiteDB(path)
+    db.set(b"k", b"v")
+    db.close()
+    db2 = SQLiteDB(path)
+    assert db2.get(b"k") == b"v"
+    db2.close()
+
+
+def test_open_db(tmp_path):
+    assert isinstance(open_db("x"), MemDB)
+    db = open_db("x", "sqlite", str(tmp_path))
+    assert isinstance(db, SQLiteDB)
+    assert os.path.exists(tmp_path / "x.db")
+    db.close()
+
+
+# -- block store -------------------------------------------------------
+
+def make_chain_block(vals, keys, height, last_block_id, last_commit):
+    header = Header(
+        chain_id=CHAIN_ID,
+        height=height,
+        time_ns=1_700_000_000_000_000_000 + height,
+        last_block_id=last_block_id,
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        proposer_address=vals.get_proposer().address,
+    )
+    block = Block(
+        header=header,
+        data=Data(txs=(b"tx-%d" % height,)),
+        last_commit=last_commit,
+    )
+    return block.with_hashes()
+
+
+def build_chain(n=3):
+    vals, keys = make_val_set(4)
+    blocks, parts, commits = [], [], []
+    last_block_id = BlockID()
+    last_commit = Commit()
+    for h in range(1, n + 1):
+        block = make_chain_block(vals, keys, h, last_block_id, last_commit)
+        ps = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+        block_id = BlockID(hash=block.hash(), part_set_header=ps.header)
+        commit = make_commit(vals, keys, block_id, height=h)
+        blocks.append(block)
+        parts.append(ps)
+        commits.append(commit)
+        last_block_id, last_commit = block_id, commit
+    return blocks, parts, commits
+
+
+def test_block_store_save_load():
+    bs = BlockStore(MemDB())
+    assert bs.height() == 0 and bs.base() == 0 and bs.size() == 0
+    blocks, parts, commits = build_chain(3)
+    for b, ps, c in zip(blocks, parts, commits):
+        bs.save_block(b, ps, c)
+    assert bs.height() == 3 and bs.base() == 1 and bs.size() == 3
+
+    got = bs.load_block(2)
+    assert got.hash() == blocks[1].hash()
+    assert got.data.txs == (b"tx-2",)
+    assert got.last_commit.block_id.hash == blocks[0].hash()
+
+    meta = bs.load_block_meta(2)
+    assert meta.block_id.hash == blocks[1].hash()
+    assert meta.num_txs == 1
+
+    # canonical commit for height 1 came from block 2's last_commit
+    c1 = bs.load_block_commit(1)
+    assert c1.height == 1 and c1.block_id.hash == blocks[0].hash()
+    sc = bs.load_seen_commit(3)
+    assert sc.height == 3
+
+    byhash = bs.load_block_by_hash(blocks[0].hash())
+    assert byhash.header.height == 1
+    assert bs.load_block(99) is None
+    assert bs.load_block_by_hash(b"\x00" * 32) is None
+
+
+def test_block_store_part_roundtrip():
+    bs = BlockStore(MemDB())
+    blocks, parts, commits = build_chain(1)
+    bs.save_block(blocks[0], parts[0], commits[0])
+    part = bs.load_block_part(1, 0)
+    assert part.bytes == parts[0].get_part(0).bytes
+    assert part.proof.verify(
+        parts[0].header.hash, part.bytes
+    ), "stored part must carry a valid merkle proof"
+
+
+def test_block_store_nonmonotonic_save_rejected():
+    bs = BlockStore(MemDB())
+    blocks, parts, commits = build_chain(3)
+    bs.save_block(blocks[0], parts[0], commits[0])
+    with pytest.raises(BlockStoreError):
+        bs.save_block(blocks[2], parts[2], commits[2])
+
+
+def test_block_store_prune():
+    bs = BlockStore(MemDB())
+    blocks, parts, commits = build_chain(3)
+    for b, ps, c in zip(blocks, parts, commits):
+        bs.save_block(b, ps, c)
+    assert bs.prune_blocks(3) == 2
+    assert bs.base() == 3 and bs.height() == 3
+    assert bs.load_block(1) is None
+    assert bs.load_block(3) is not None
+    with pytest.raises(BlockStoreError):
+        bs.prune_blocks(99)
+
+
+def test_block_store_reopen(tmp_path):
+    db = SQLiteDB(str(tmp_path / "blocks.db"))
+    bs = BlockStore(db)
+    blocks, parts, commits = build_chain(2)
+    for b, ps, c in zip(blocks, parts, commits):
+        bs.save_block(b, ps, c)
+    db.close()
+    db2 = SQLiteDB(str(tmp_path / "blocks.db"))
+    bs2 = BlockStore(db2)
+    assert bs2.height() == 2
+    assert bs2.load_block(2).hash() == blocks[1].hash()
+    db2.close()
+
+
+# -- state -------------------------------------------------------------
+
+def make_genesis(n=4):
+    vals, keys = make_val_set(n)
+    return (
+        GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=tuple(
+                GenesisValidator(v.pub_key, v.voting_power)
+                for v in vals.validators
+            ),
+        ),
+        keys,
+    )
+
+
+def test_state_from_genesis():
+    gen, _ = make_genesis()
+    st = sm.State.from_genesis(gen)
+    assert st.chain_id == CHAIN_ID
+    assert st.last_block_height == 0
+    assert len(st.validators) == 4
+    assert len(st.last_validators) == 0
+    assert st.next_validators.get_proposer() is not None
+
+
+def test_state_roundtrip():
+    gen, _ = make_genesis()
+    st = sm.State.from_genesis(gen)
+    st2 = sm.decode_state(sm.encode_state(st))
+    assert st2.chain_id == st.chain_id
+    assert st2.last_block_height == st.last_block_height
+    assert st2.validators.hash() == st.validators.hash()
+    assert st2.next_validators.hash() == st.next_validators.hash()
+    assert (
+        st2.next_validators.get_proposer().address
+        == st.next_validators.get_proposer().address
+    )
+    assert st2.consensus_params == st.consensus_params
+    assert st2.app_hash == st.app_hash
+
+
+def test_state_store_save_load():
+    gen, _ = make_genesis()
+    st = sm.State.from_genesis(gen)
+    store = sm.Store(MemDB())
+    assert store.load() is None
+    store.save(st)
+    loaded = store.load()
+    assert loaded.validators.hash() == st.validators.hash()
+    vals_at_initial = store.load_validators(1)
+    assert vals_at_initial.hash() == st.validators.hash()
+    vals_next = store.load_validators(2)
+    assert vals_next.hash() == st.next_validators.hash()
+    params = store.load_consensus_params(1)
+    assert params == st.consensus_params
+
+
+def test_state_store_finalize_response_roundtrip():
+    store = sm.Store(MemDB())
+    resp = FinalizeBlockResponse(
+        tx_results=(
+            ExecTxResult(code=0, data=b"ok", gas_wanted=5, gas_used=3),
+            ExecTxResult(code=7, log="bad tx"),
+        ),
+        app_hash=b"\xaa" * 32,
+    )
+    store.save_finalize_block_response(5, resp)
+    got = store.load_finalize_block_response(5)
+    assert got.app_hash == resp.app_hash
+    assert got.tx_results[0].data == b"ok"
+    assert got.tx_results[1].code == 7
+    assert got.tx_results[1].log == "bad tx"
+    assert store.load_finalize_block_response(6) is None
+
+
+def test_load_state_from_db_or_genesis():
+    gen, _ = make_genesis()
+    store = sm.Store(MemDB())
+    st = sm.load_state_from_db_or_genesis(store, gen)
+    assert st.last_block_height == 0
+    store.save(st)
+    st2 = sm.load_state_from_db_or_genesis(store, gen)
+    assert st2.validators.hash() == st.validators.hash()
+    bad_gen = GenesisDoc(chain_id="other-chain", validators=gen.validators)
+    with pytest.raises(sm.StateError):
+        sm.load_state_from_db_or_genesis(store, bad_gen)
