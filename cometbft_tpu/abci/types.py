@@ -75,6 +75,33 @@ class Event:
     attributes: tuple[EventAttribute, ...] = ()
 
 
+def encode_event(ev: Event) -> bytes:
+    e = ProtoWriter()
+    e.string(1, ev.type)
+    for attr in ev.attributes:
+        a = ProtoWriter()
+        a.string(1, attr.key)
+        a.string(2, attr.value)
+        a.varint(3, 1 if attr.index else 0)
+        e.message(2, a.finish())
+    return e.finish()
+
+
+def decode_event(raw: bytes) -> Event:
+    ef = ProtoReader(raw).to_dict()
+    attrs = []
+    for araw in ef.get(2, []):
+        af = ProtoReader(araw).to_dict()
+        attrs.append(
+            EventAttribute(
+                key=bytes(af.get(1, [b""])[0]).decode(),
+                value=bytes(af.get(2, [b""])[0]).decode(),
+                index=bool(af.get(3, [0])[0]),
+            )
+        )
+    return Event(type=bytes(ef.get(1, [b""])[0]).decode(), attributes=tuple(attrs))
+
+
 @dataclass(frozen=True)
 class ValidatorUpdate:
     """(pubkey, power) delta from the app (abci ValidatorUpdate)."""
@@ -121,40 +148,14 @@ class ExecTxResult:
         w.varint(5, self.gas_wanted & 0xFFFFFFFFFFFFFFFF)
         w.varint(6, self.gas_used & 0xFFFFFFFFFFFFFFFF)
         for ev in self.events:
-            e = ProtoWriter()
-            e.string(1, ev.type)
-            for attr in ev.attributes:
-                a = ProtoWriter()
-                a.string(1, attr.key)
-                a.string(2, attr.value)
-                a.varint(3, 1 if attr.index else 0)
-                e.message(2, a.finish())
-            w.message(7, e.finish())
+            w.message(7, encode_event(ev))
         w.string(8, self.codespace)
         return w.finish()
 
     @classmethod
     def decode(cls, data: bytes) -> "ExecTxResult":
         f = ProtoReader(data).to_dict()
-        events = []
-        for raw in f.get(7, []):
-            ef = ProtoReader(raw).to_dict()
-            attrs = []
-            for araw in ef.get(2, []):
-                af = ProtoReader(araw).to_dict()
-                attrs.append(
-                    EventAttribute(
-                        key=bytes(af.get(1, [b""])[0]).decode(),
-                        value=bytes(af.get(2, [b""])[0]).decode(),
-                        index=bool(af.get(3, [0])[0]),
-                    )
-                )
-            events.append(
-                Event(
-                    type=bytes(ef.get(1, [b""])[0]).decode(),
-                    attributes=tuple(attrs),
-                )
-            )
+        events = [decode_event(raw) for raw in f.get(7, [])]
         from cometbft_tpu.types.codec import s64
 
         return cls(
@@ -383,8 +384,12 @@ class FinalizeBlockResponse:
     app_hash: bytes = b""
 
     def encode(self) -> bytes:
-        """Persistent encoding for the state store (ABCIResponses)."""
+        """Persistent encoding for the state store (ABCIResponses).
+        Covers every field — block events and param updates included —
+        so crash-replay and block_results RPC see what the app returned."""
         w = ProtoWriter()
+        for ev in self.events:
+            w.message(1, encode_event(ev))
         for r in self.tx_results:
             w.message(2, r.encode())
         for vu in self.validator_updates:
@@ -393,28 +398,48 @@ class FinalizeBlockResponse:
             v.bytes_(2, vu.pub_key_bytes)
             v.varint(3, vu.power)
             w.message(3, v.finish())
+        if self.consensus_param_updates is not None:
+            import json
+
+            w.bytes_(
+                4,
+                json.dumps(
+                    self.consensus_param_updates.to_json_dict(),
+                    sort_keys=True,
+                ).encode(),
+            )
         w.bytes_(5, self.app_hash)
         return w.finish()
 
     @classmethod
     def decode(cls, data: bytes) -> "FinalizeBlockResponse":
         f = ProtoReader(data).to_dict()
+        updates = []
+        for raw in f.get(3, []):
+            uf = ProtoReader(raw).to_dict()
+            updates.append(
+                ValidatorUpdate(
+                    pub_key_type=bytes(uf.get(1, [b""])[0]).decode(),
+                    pub_key_bytes=bytes(uf.get(2, [b""])[0]),
+                    power=int(uf.get(3, [0])[0]),
+                )
+            )
+        param_updates = None
+        if 4 in f:
+            import json
+
+            from cometbft_tpu.types.params import ConsensusParams
+
+            param_updates = ConsensusParams.from_json_dict(
+                json.loads(bytes(f[4][0]).decode())
+            )
         return cls(
+            events=tuple(decode_event(raw) for raw in f.get(1, [])),
             tx_results=tuple(
                 ExecTxResult.decode(raw) for raw in f.get(2, [])
             ),
-            validator_updates=tuple(
-                ValidatorUpdate(
-                    pub_key_type=bytes(
-                        ProtoReader(raw).to_dict().get(1, [b""])[0]
-                    ).decode(),
-                    pub_key_bytes=bytes(
-                        ProtoReader(raw).to_dict().get(2, [b""])[0]
-                    ),
-                    power=int(ProtoReader(raw).to_dict().get(3, [0])[0]),
-                )
-                for raw in f.get(3, [])
-            ),
+            validator_updates=tuple(updates),
+            consensus_param_updates=param_updates,
             app_hash=bytes(f.get(5, [b""])[0]),
         )
 

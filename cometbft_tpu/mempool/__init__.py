@@ -1,1 +1,368 @@
-"""Package placeholder — populated as layers land."""
+"""Mempool — pending transactions awaiting block inclusion
+(reference: mempool/mempool.go:26, mempool/clist_mempool.go:29).
+
+FIFO tx list with an LRU dedup cache in front of app CheckTx.  The
+consensus engine reaps txs for proposals, locks the mempool across
+commit, then calls update() with the committed block's txs; remaining
+txs are re-checked against the new app state (recheck).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable
+
+from cometbft_tpu.abci.types import (
+    CHECK_TX_TYPE_CHECK,
+    CHECK_TX_TYPE_RECHECK,
+    CheckTxRequest,
+    CheckTxResponse,
+)
+from cometbft_tpu.types.block import tx_hash
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    """Duplicate submission (mempool/errors.go ErrTxInCache)."""
+
+
+class TxTooLargeError(MempoolError):
+    pass
+
+
+class MempoolFullError(MempoolError):
+    pass
+
+
+@dataclass
+class _MempoolTx:
+    tx: bytes
+    height: int  # height at which the tx entered the mempool
+    gas_wanted: int
+    sender: str = ""
+
+
+class TxCache:
+    """Fixed-size LRU of recently seen tx hashes (mempool/cache.go)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._mtx = threading.Lock()
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        key = tx_hash(tx)
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._mtx:
+            self._map.pop(tx_hash(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        with self._mtx:
+            return tx_hash(tx) in self._map
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+
+class NopTxCache(TxCache):
+    def __init__(self):
+        super().__init__(1)
+
+    def push(self, tx: bytes) -> bool:
+        return True
+
+    def has(self, tx: bytes) -> bool:
+        return False
+
+
+PreCheckFunc = Callable[[bytes], None]  # raises to reject
+PostCheckFunc = Callable[[bytes, CheckTxResponse], None]
+
+
+def pre_check_max_bytes(max_bytes: int) -> PreCheckFunc:
+    """(mempool/mempool.go PreCheckMaxBytes)"""
+
+    def check(tx: bytes) -> None:
+        if len(tx) > max_bytes:
+            raise TxTooLargeError(
+                f"tx size {len(tx)} exceeds max {max_bytes}"
+            )
+
+    return check
+
+
+def post_check_max_gas(max_gas: int) -> PostCheckFunc:
+    """(mempool/mempool.go PostCheckMaxGas)"""
+
+    def check(tx: bytes, res: CheckTxResponse) -> None:
+        if max_gas >= 0 and res.gas_wanted > max_gas:
+            raise MempoolError(
+                f"gas wanted {res.gas_wanted} exceeds block max {max_gas}"
+            )
+
+    return check
+
+
+class CListMempool:
+    """The production mempool (mempool/clist_mempool.go:29)."""
+
+    def __init__(
+        self,
+        proxy_app_conn,
+        height: int = 0,
+        size: int = 5000,
+        max_tx_bytes: int = 1048576,
+        max_txs_bytes: int = 1073741824,
+        cache_size: int = 10000,
+        keep_invalid_txs_in_cache: bool = False,
+        recheck: bool = True,
+    ):
+        self._proxy = proxy_app_conn
+        self._height = height
+        self._size_limit = size
+        self._max_tx_bytes = max_tx_bytes
+        self._max_txs_bytes = max_txs_bytes
+        self._keep_invalid = keep_invalid_txs_in_cache
+        self._recheck_enabled = recheck
+        self.cache = TxCache(cache_size) if cache_size > 0 else NopTxCache()
+
+        self._mtx = threading.RLock()  # the consensus Lock()/Unlock()
+        self._txs: OrderedDict[bytes, _MempoolTx] = OrderedDict()
+        self._txs_bytes = 0
+        self._notified_available = False
+        self._tx_available = threading.Event()
+        self.pre_check: PreCheckFunc | None = None
+        self.post_check: PostCheckFunc | None = None
+
+    # -- introspection -------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._txs)
+
+    def size_bytes(self) -> int:
+        with self._mtx:
+            return self._txs_bytes
+
+    def is_full(self, tx_len: int) -> bool:
+        with self._mtx:
+            return (
+                len(self._txs) >= self._size_limit
+                or self._txs_bytes + tx_len > self._max_txs_bytes
+            )
+
+    def contains(self, tx: bytes) -> bool:
+        with self._mtx:
+            return tx_hash(tx) in self._txs
+
+    # -- CheckTx path --------------------------------------------------
+
+    def check_tx(self, tx: bytes, sender: str = "") -> CheckTxResponse:
+        """Validate tx via the app and add it
+        (clist_mempool.go:269 CheckTx)."""
+        if len(tx) > self._max_tx_bytes:
+            raise TxTooLargeError(
+                f"tx size {len(tx)} exceeds max {self._max_tx_bytes}"
+            )
+        if self.pre_check is not None:
+            self.pre_check(tx)
+        if self.is_full(len(tx)):
+            raise MempoolFullError(
+                f"mempool is full: {self.size()} txs"
+            )
+        if not self.cache.push(tx):
+            raise TxInCacheError("tx already in cache")
+        try:
+            res = self._proxy.check_tx(
+                CheckTxRequest(tx=tx, type=CHECK_TX_TYPE_CHECK)
+            )
+        except BaseException:
+            self.cache.remove(tx)
+            raise
+        self._handle_check_result(tx, res, sender)
+        return res
+
+    def _handle_check_result(
+        self, tx: bytes, res: CheckTxResponse, sender: str
+    ) -> None:
+        """(clist_mempool.go:328 handleCheckTxResponse)"""
+        post_err = None
+        if self.post_check is not None:
+            try:
+                self.post_check(tx, res)
+            except MempoolError as e:
+                post_err = e
+        if res.code != 0 or post_err is not None:
+            if not self._keep_invalid:
+                self.cache.remove(tx)
+            if post_err is not None:
+                raise post_err
+            return
+        with self._mtx:
+            if self.is_full(len(tx)):
+                self.cache.remove(tx)
+                raise MempoolFullError("mempool is full")
+            key = tx_hash(tx)
+            if key in self._txs:
+                return
+            self._txs[key] = _MempoolTx(
+                tx=tx,
+                height=self._height,
+                gas_wanted=res.gas_wanted,
+                sender=sender,
+            )
+            self._txs_bytes += len(tx)
+            self._notify_available()
+
+    def _notify_available(self) -> None:
+        if not self._notified_available and len(self._txs) > 0:
+            self._notified_available = True
+            self._tx_available.set()
+
+    def txs_available(self) -> threading.Event:
+        """Fires once per height when txs exist (TxsAvailable)."""
+        return self._tx_available
+
+    # -- reap ----------------------------------------------------------
+
+    def reap_max_bytes_max_gas(
+        self, max_bytes: int, max_gas: int
+    ) -> list[bytes]:
+        """FIFO txs within the block's byte/gas budget
+        (clist_mempool.go ReapMaxBytesMaxGas)."""
+        with self._mtx:
+            out: list[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for mt in self._txs.values():
+                if max_bytes > -1 and total_bytes + len(mt.tx) > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + mt.gas_wanted > max_gas:
+                    break
+                out.append(mt.tx)
+                total_bytes += len(mt.tx)
+                total_gas += mt.gas_wanted
+            return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        with self._mtx:
+            txs = [mt.tx for mt in self._txs.values()]
+            return txs if n < 0 else txs[:n]
+
+    # -- consensus integration -----------------------------------------
+
+    def lock(self) -> None:
+        """Held across FinalizeBlock→Commit (state/execution.go:405)."""
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list,
+        new_pre_check: PreCheckFunc | None = None,
+        new_post_check: PostCheckFunc | None = None,
+    ) -> None:
+        """Remove committed txs + recheck the rest.  Caller must hold
+        the lock (clist_mempool.go:Update contract)."""
+        self._height = height
+        self._notified_available = False
+        self._tx_available.clear()
+        if new_pre_check is not None:
+            self.pre_check = new_pre_check
+        if new_post_check is not None:
+            self.post_check = new_post_check
+        for i, tx in enumerate(txs):
+            result_ok = (
+                tx_results[i].code == 0 if i < len(tx_results) else False
+            )
+            if result_ok:
+                self.cache.push(tx)  # keep committed txs in cache
+            elif not self._keep_invalid:
+                self.cache.remove(tx)
+            mt = self._txs.pop(tx_hash(tx), None)
+            if mt is not None:
+                self._txs_bytes -= len(mt.tx)
+        if self._recheck_enabled and self._txs:
+            self._recheck_txs()
+        if self._txs:
+            self._notify_available()
+
+    def _recheck_txs(self) -> None:
+        """Re-run CheckTx on everything left after a block
+        (clist_mempool.go recheckTxs)."""
+        for key in list(self._txs.keys()):
+            mt = self._txs.get(key)
+            if mt is None:
+                continue
+            res = self._proxy.check_tx(
+                CheckTxRequest(tx=mt.tx, type=CHECK_TX_TYPE_RECHECK)
+            )
+            if res.code != 0:
+                self._txs.pop(key, None)
+                self._txs_bytes -= len(mt.tx)
+                if not self._keep_invalid:
+                    self.cache.remove(mt.tx)
+
+    def flush(self) -> None:
+        with self._mtx:
+            self._txs.clear()
+            self._txs_bytes = 0
+            self.cache.reset()
+
+
+class NopMempool:
+    """Disabled mempool (mempool/nop_mempool.go) for apps that disseminate
+    txs themselves."""
+
+    def check_tx(self, tx: bytes, sender: str = "") -> CheckTxResponse:
+        raise MempoolError("mempool is disabled")
+
+    def size(self) -> int:
+        return 0
+
+    def size_bytes(self) -> int:
+        return 0
+
+    def contains(self, tx: bytes) -> bool:
+        return False
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas) -> list[bytes]:
+        return []
+
+    def reap_max_txs(self, n) -> list[bytes]:
+        return []
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def update(self, *a, **kw) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def txs_available(self) -> threading.Event:
+        return threading.Event()

@@ -1,1 +1,156 @@
-"""Package placeholder — populated as layers land."""
+"""Proxy — four typed application connections over one app
+(reference: proxy/multi_app_conn.go:42-58, proxy/app_conn.go).
+
+The reference multiplexes the ABCI app behind four logical connections
+(consensus, mempool, query, snapshot) so a slow CheckTx can never block
+FinalizeBlock.  In-process that property comes from the locking
+discipline: the default creator shares one reentrant lock (the
+reference's local client), while the unsync creator leaves
+synchronization to the application (the reference's unsync-local
+client, used by apps that do their own locking).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from cometbft_tpu.abci.types import Application
+from cometbft_tpu.utils.service import BaseService
+
+
+class AbciClientError(Exception):
+    pass
+
+
+class _LocalClient:
+    """Synchronous in-process ABCI client (abci/client/local_client.go).
+
+    Every call round-trips to the app under ``lock`` (a no-op lock for
+    unsync mode).  Methods mirror the Application surface 1:1.
+    """
+
+    def __init__(self, app: Application, lock, shared_error: list):
+        self._app = app
+        self._lock = lock
+        # One-slot error latch shared by all four connections: a fatal
+        # app error on any connection poisons the whole proxy, since the
+        # app's state is unknown (multiAppConn StopForError semantics).
+        self._shared_error = shared_error
+
+    def _call(self, fn: Callable, *args):
+        with self._lock:
+            if self._shared_error:
+                raise AbciClientError(
+                    f"abci client is dead: {self._shared_error[0]}"
+                ) from self._shared_error[0]
+            try:
+                return fn(*args)
+            except BaseException as exc:
+                self._shared_error.append(exc)
+                raise
+
+    def error(self) -> BaseException | None:
+        return self._shared_error[0] if self._shared_error else None
+
+    # query connection
+    def info(self, req):
+        return self._call(self._app.info, req)
+
+    def query(self, req):
+        return self._call(self._app.query, req)
+
+    # mempool connection
+    def check_tx(self, req):
+        return self._call(self._app.check_tx, req)
+
+    def flush(self) -> None:
+        """No queue to drain in-process (socket client parity no-op)."""
+
+    # consensus connection
+    def init_chain(self, req):
+        return self._call(self._app.init_chain, req)
+
+    def prepare_proposal(self, req):
+        return self._call(self._app.prepare_proposal, req)
+
+    def process_proposal(self, req):
+        return self._call(self._app.process_proposal, req)
+
+    def finalize_block(self, req):
+        return self._call(self._app.finalize_block, req)
+
+    def extend_vote(self, req):
+        return self._call(self._app.extend_vote, req)
+
+    def verify_vote_extension(self, req):
+        return self._call(self._app.verify_vote_extension, req)
+
+    def commit(self):
+        return self._call(self._app.commit)
+
+    # snapshot connection
+    def list_snapshots(self):
+        return self._call(self._app.list_snapshots)
+
+    def offer_snapshot(self, req):
+        return self._call(self._app.offer_snapshot, req)
+
+    def load_snapshot_chunk(self, req):
+        return self._call(self._app.load_snapshot_chunk, req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._call(self._app.apply_snapshot_chunk, req)
+
+
+class _NopLock:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClientCreator:
+    """Builds one client per logical connection (proxy/client.go)."""
+
+    def __init__(self, app: Application, sync: bool = True):
+        self._app = app
+        self._lock = threading.RLock() if sync else _NopLock()
+        self._shared_error: list = []
+
+    def new_client(self) -> _LocalClient:
+        return _LocalClient(self._app, self._lock, self._shared_error)
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    """Shared-mutex local client (proxy/client.go NewLocalClientCreator)."""
+    return ClientCreator(app, sync=True)
+
+
+def unsync_local_client_creator(app: Application) -> ClientCreator:
+    """App-managed locking (NewUnsyncLocalClientCreator) — lets CheckTx
+    run concurrently with FinalizeBlock, the 4-connection point."""
+    return ClientCreator(app, sync=False)
+
+
+class AppConns(BaseService):
+    """The four typed connections (proxy/multi_app_conn.go:42)."""
+
+    def __init__(self, creator: ClientCreator):
+        super().__init__(name="proxyApp")
+        self._creator = creator
+        self.consensus = creator.new_client()
+        self.mempool = creator.new_client()
+        self.query = creator.new_client()
+        self.snapshot = creator.new_client()
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+
+def new_app_conns(creator: ClientCreator) -> AppConns:
+    return AppConns(creator)

@@ -305,13 +305,13 @@ class Store:
     def prune(self, retain_height: int) -> None:
         """Delete historical validators/params/responses below
         ``retain_height`` (state/pruner.go)."""
-        from cometbft_tpu.utils.db import prefix_end
-
         for prefix in (_VALS, _PARAMS, _ABCI_RESP):
-            ops = []
-            end = _hkey(prefix, retain_height)
-            for k, _ in self._db.iterator(prefix, min(end, prefix_end(prefix))):
-                ops.append((k, None))
+            ops = [
+                (k, None)
+                for k, _ in self._db.iterator(
+                    prefix, _hkey(prefix, retain_height)
+                )
+            ]
             if ops:
                 self._db.write_batch(ops)
 

@@ -155,7 +155,12 @@ class SQLiteDB(DB):
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0)
+            # check_same_thread=False so close() can tear down every
+            # connection regardless of which thread created it; each
+            # thread still uses its own connection for isolation.
+            conn = sqlite3.connect(
+                self._path, timeout=30.0, check_same_thread=False
+            )
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn

@@ -1,0 +1,215 @@
+"""ABCI layer: kvstore app, proxy connections (reference analogs:
+abci/example/kvstore/kvstore_test.go, proxy tests)."""
+
+from __future__ import annotations
+
+import base64
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.types import (
+    ApplySnapshotChunkRequest,
+    ApplySnapshotChunkResult,
+    CheckTxRequest,
+    ExecTxResult,
+    FinalizeBlockRequest,
+    InfoRequest,
+    InitChainRequest,
+    LoadSnapshotChunkRequest,
+    OfferSnapshotRequest,
+    OfferSnapshotResult,
+    ProcessProposalRequest,
+    ProposalStatus,
+    QueryRequest,
+    ValidatorUpdate,
+    results_hash,
+)
+from cometbft_tpu.proxy import (
+    AppConns,
+    local_client_creator,
+    unsync_local_client_creator,
+)
+from cometbft_tpu.utils.db import MemDB
+
+
+def finalize(app, height, *txs):
+    return app.finalize_block(
+        FinalizeBlockRequest(txs=tuple(txs), height=height)
+    )
+
+
+def test_kvstore_basic_flow():
+    app = KVStoreApp()
+    assert app.info(InfoRequest()).last_block_height == 0
+    resp = finalize(app, 1, b"name=satoshi", b"lang=go")
+    assert all(r.is_ok for r in resp.tx_results)
+    assert resp.app_hash != b""
+    app.commit()
+    q = app.query(QueryRequest(data=b"name"))
+    assert q.value == b"satoshi"
+    assert app.query(QueryRequest(data=b"missing")).value == b""
+    assert app.info(InfoRequest()).last_block_height == 1
+
+
+def test_kvstore_app_hash_deterministic():
+    a, b = KVStoreApp(), KVStoreApp()
+    for app in (a, b):
+        finalize(app, 1, b"x=1", b"y=2")
+    assert a.app_hash == b.app_hash
+    finalize(a, 2, b"z=3")
+    assert a.app_hash != b.app_hash
+
+
+def test_kvstore_check_tx():
+    app = KVStoreApp()
+    assert app.check_tx(CheckTxRequest(tx=b"k=v")).is_ok
+    assert not app.check_tx(CheckTxRequest(tx=b"no-equals")).is_ok
+    assert not app.check_tx(CheckTxRequest(tx=b"\xff\xfe")).is_ok
+    pub64 = base64.b64encode(b"\x01" * 32).decode()
+    assert app.check_tx(
+        CheckTxRequest(tx=f"val:{pub64}!10".encode())
+    ).is_ok
+    assert not app.check_tx(CheckTxRequest(tx=b"val:junk")).is_ok
+
+
+def test_kvstore_validator_updates():
+    app = KVStoreApp()
+    pub = b"\x02" * 32
+    pub64 = base64.b64encode(pub).decode()
+    app.init_chain(
+        InitChainRequest(
+            validators=(
+                ValidatorUpdate("ed25519", b"\x01" * 32, 10),
+            )
+        )
+    )
+    resp = finalize(app, 1, f"val:{pub64}!7".encode())
+    assert resp.validator_updates == (
+        ValidatorUpdate("ed25519", pub, 7),
+    )
+    resp = finalize(app, 2, f"val:{pub64}!0".encode())
+    assert resp.validator_updates[0].power == 0
+
+
+def test_kvstore_process_proposal():
+    app = KVStoreApp()
+    ok = app.process_proposal(ProcessProposalRequest(txs=(b"a=b",)))
+    assert ok.status == ProposalStatus.ACCEPT
+    bad = app.process_proposal(ProcessProposalRequest(txs=(b"nope",)))
+    assert bad.status == ProposalStatus.REJECT
+
+
+def test_kvstore_persistence():
+    db = MemDB()
+    app = KVStoreApp(db=db)
+    finalize(app, 1, b"k=v")
+    app.commit()
+    app2 = KVStoreApp(db=db)
+    assert app2.height == 1
+    assert app2.get("k") == "v"
+    assert app2.app_hash == app.app_hash
+    assert app2.info(InfoRequest()).last_block_app_hash == app.app_hash
+
+
+def test_kvstore_snapshots_roundtrip():
+    src = KVStoreApp(snapshot_interval=2)
+    for h in range(1, 5):
+        finalize(src, h, b"k%d=v%d" % (h, h))
+        src.commit()
+    snaps = src.list_snapshots().snapshots
+    assert snaps, "snapshot should exist at interval heights"
+    snap = snaps[-1]
+    assert snap.height == 4
+
+    dst = KVStoreApp()
+    offer = dst.offer_snapshot(OfferSnapshotRequest(snapshot=snap))
+    assert offer.result == OfferSnapshotResult.ACCEPT
+    for i in range(snap.chunks):
+        chunk = src.load_snapshot_chunk(
+            LoadSnapshotChunkRequest(height=snap.height, format=1, chunk=i)
+        ).chunk
+        r = dst.apply_snapshot_chunk(ApplySnapshotChunkRequest(index=i, chunk=chunk))
+        assert r.result == ApplySnapshotChunkResult.ACCEPT
+    assert dst.height == 4
+    assert dst.get("k4") == "v4"
+    assert dst.app_hash == src.app_hash
+
+
+def test_kvstore_snapshot_bad_hash_rejected():
+    src = KVStoreApp(snapshot_interval=1)
+    finalize(src, 1, b"a=b")
+    src.commit()
+    snap = src.list_snapshots().snapshots[-1]
+    dst = KVStoreApp()
+    dst.offer_snapshot(OfferSnapshotRequest(snapshot=snap))
+    r = dst.apply_snapshot_chunk(
+        ApplySnapshotChunkRequest(index=0, chunk=b"corrupted")
+    )
+    assert r.result == ApplySnapshotChunkResult.REJECT_SNAPSHOT
+
+
+def test_results_hash_deterministic():
+    rs = [ExecTxResult(code=0, data=b"a"), ExecTxResult(code=1)]
+    assert results_hash(rs) == results_hash(list(rs))
+    assert results_hash(rs) != results_hash(rs[:1])
+
+
+def test_proxy_app_conns():
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    conns.consensus.finalize_block(
+        FinalizeBlockRequest(txs=(b"a=1",), height=1)
+    )
+    conns.consensus.commit()
+    assert conns.query.query(QueryRequest(data=b"a")).value == b"1"
+    assert conns.mempool.check_tx(CheckTxRequest(tx=b"b=2")).is_ok
+    assert conns.snapshot.list_snapshots().snapshots == ()
+    conns.stop()
+
+
+def test_proxy_error_latching():
+    class BoomApp(KVStoreApp):
+        def query(self, req):
+            raise RuntimeError("boom")
+
+    conns = AppConns(unsync_local_client_creator(BoomApp()))
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        conns.query.query(QueryRequest(data=b"x"))
+    from cometbft_tpu.proxy import AbciClientError
+
+    with pytest.raises(AbciClientError):
+        conns.query.query(QueryRequest(data=b"x"))
+    # a fatal app error poisons ALL four connections: the app's state
+    # is unknown, so CheckTx must not keep validating against it
+    with pytest.raises(AbciClientError):
+        conns.mempool.check_tx(CheckTxRequest(tx=b"a=b"))
+    assert conns.mempool.error() is not None
+
+
+def test_finalize_response_full_roundtrip():
+    from cometbft_tpu.abci.types import (
+        Event,
+        EventAttribute,
+        FinalizeBlockResponse,
+    )
+    from cometbft_tpu.types.params import BlockParams, ConsensusParams
+
+    resp = FinalizeBlockResponse(
+        events=(
+            Event("block_event", (EventAttribute("k", "v"),)),
+        ),
+        tx_results=(ExecTxResult(code=0, data=b"d"),),
+        validator_updates=(ValidatorUpdate("ed25519", b"\x03" * 32, 9),),
+        consensus_param_updates=ConsensusParams(
+            block=BlockParams(max_bytes=2048)
+        ),
+        app_hash=b"\x01" * 32,
+    )
+    got = FinalizeBlockResponse.decode(resp.encode())
+    assert got.events == resp.events
+    assert got.tx_results == resp.tx_results
+    assert got.validator_updates == resp.validator_updates
+    assert got.consensus_param_updates.block.max_bytes == 2048
+    assert got.app_hash == resp.app_hash

@@ -1,0 +1,333 @@
+"""EventBus/pubsub, mempool, privval (reference analogs:
+libs/pubsub/pubsub_test.go, mempool/clist_mempool_test.go,
+privval/file_test.go)."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.types import ExecTxResult
+from cometbft_tpu.mempool import (
+    CListMempool,
+    MempoolFullError,
+    TxInCacheError,
+    TxTooLargeError,
+    pre_check_max_bytes,
+)
+from cometbft_tpu.privval import DoubleSignError, FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.types import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+from cometbft_tpu.types.event_bus import (
+    EVENT_QUERY_NEW_BLOCK,
+    EventBus,
+    EventDataTx,
+)
+from cometbft_tpu.utils.pubsub import (
+    PubSubError,
+    Query,
+    QueryError,
+    Server,
+)
+
+from tests.helpers import make_block_id
+
+
+# -- query DSL ---------------------------------------------------------
+
+def test_query_parse_and_match():
+    q = Query.parse("tm.event='NewBlock'")
+    assert q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"]})
+    assert not q.matches({})
+
+
+def test_query_and_numeric():
+    q = Query.parse("tm.event='Tx' AND tx.height > 5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+    q2 = Query.parse("tx.height <= 10")
+    assert q2.matches({"tx.height": ["10"]})
+    assert not q2.matches({"tx.height": ["11"]})
+
+
+def test_query_contains_exists():
+    q = Query.parse("app.key CONTAINS 'sat'")
+    assert q.matches({"app.key": ["satoshi"]})
+    assert not q.matches({"app.key": ["nakamoto"]})
+    q2 = Query.parse("app.key EXISTS")
+    assert q2.matches({"app.key": ["x"]})
+    assert not q2.matches({"other": ["x"]})
+
+
+def test_query_parse_errors():
+    for bad in ["", "AND", "a.b ~ 2", "x = ", "x > 'str'", "a='1' b='2'"]:
+        with pytest.raises(QueryError):
+            Query.parse(bad)
+
+
+# -- pubsub server -----------------------------------------------------
+
+def test_pubsub_basic():
+    s = Server()
+    sub = s.subscribe("c1", "tm.event='A'")
+    s.publish("hello", {"tm.event": ["A"]})
+    s.publish("nope", {"tm.event": ["B"]})
+    msg = sub.next(timeout=1)
+    assert msg.data == "hello"
+    assert sub.try_next() is None
+
+
+def test_pubsub_duplicate_and_unsubscribe():
+    s = Server()
+    s.subscribe("c1", "tm.event='A'")
+    with pytest.raises(PubSubError):
+        s.subscribe("c1", "tm.event='A'")
+    s.unsubscribe("c1", "tm.event='A'")
+    with pytest.raises(PubSubError):
+        s.unsubscribe("c1", "tm.event='A'")
+
+
+def test_pubsub_slow_subscriber_canceled():
+    s = Server(capacity=2)
+    sub = s.subscribe("slow", "tm.event='A'")
+    for _ in range(3):
+        s.publish("x", {"tm.event": ["A"]})
+    assert sub.canceled
+    assert s.num_client_subscriptions("slow") == 0
+
+
+# -- event bus ---------------------------------------------------------
+
+def test_event_bus_tx_events():
+    bus = EventBus()
+    bus.start()
+    sub = bus.subscribe("test", "tm.event='Tx' AND app.key='name'")
+    app = KVStoreApp()
+    from cometbft_tpu.abci.types import FinalizeBlockRequest
+
+    resp = app.finalize_block(
+        FinalizeBlockRequest(txs=(b"name=satoshi",), height=1)
+    )
+    bus.publish_tx(
+        EventDataTx(
+            height=1, index=0, tx=b"name=satoshi", result=resp.tx_results[0]
+        )
+    )
+    msg = sub.next(timeout=1)
+    assert msg.data.height == 1
+    assert msg.events["app.key"] == ["name"]
+    # non-indexed attrs must not be queryable keys in indexers, but the
+    # event bus forwards all attributes (reference behavior).
+    bus.stop()
+
+
+def test_event_bus_new_block_query():
+    bus = EventBus()
+    bus.start()
+    sub = bus.subscribe("test", EVENT_QUERY_NEW_BLOCK)
+
+    class _FakeBlockHeader:
+        height = 7
+
+    class _FakeBlock:
+        header = _FakeBlockHeader()
+
+    from cometbft_tpu.types.event_bus import EventDataNewBlock
+
+    bus.publish_new_block(
+        EventDataNewBlock(block=_FakeBlock(), block_id=None)
+    )
+    msg = sub.next(timeout=1)
+    assert msg.events["block.height"] == ["7"]
+    bus.stop()
+
+
+# -- mempool -----------------------------------------------------------
+
+def make_mempool(**kw):
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    return CListMempool(conns.mempool, **kw), app
+
+
+def test_mempool_check_and_reap():
+    mp, _ = make_mempool()
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    assert mp.size() == 2
+    assert mp.size_bytes() == 6
+    txs = mp.reap_max_bytes_max_gas(-1, -1)
+    assert txs == [b"a=1", b"b=2"]  # FIFO
+    assert mp.reap_max_txs(1) == [b"a=1"]
+    assert mp.reap_max_bytes_max_gas(3, -1) == [b"a=1"]
+    # gas: each kvstore tx wants 1 gas
+    assert mp.reap_max_bytes_max_gas(-1, 1) == [b"a=1"]
+
+
+def test_mempool_duplicate_rejected():
+    mp, _ = make_mempool()
+    mp.check_tx(b"a=1")
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")
+    assert mp.size() == 1
+
+
+def test_mempool_invalid_tx_not_added():
+    mp, _ = make_mempool()
+    res = mp.check_tx(b"not-a-kv-tx")
+    assert res.code != 0
+    assert mp.size() == 0
+    # invalid tx evicted from cache -> can be resubmitted
+    res2 = mp.check_tx(b"not-a-kv-tx")
+    assert res2.code != 0
+
+
+def test_mempool_update_removes_committed():
+    mp, _ = make_mempool()
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    mp.lock()
+    mp.update(1, [b"a=1"], [ExecTxResult(code=0)])
+    mp.unlock()
+    assert mp.size() == 1
+    assert mp.reap_max_txs(-1) == [b"b=2"]
+    # committed tx stays in cache: replay rejected
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")
+
+
+def test_mempool_full():
+    mp, _ = make_mempool(size=1)
+    mp.check_tx(b"a=1")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"b=2")
+
+
+def test_mempool_tx_too_large_and_precheck():
+    mp, _ = make_mempool(max_tx_bytes=4)
+    with pytest.raises(TxTooLargeError):
+        mp.check_tx(b"abcdef=1")
+    mp.pre_check = pre_check_max_bytes(2)
+    with pytest.raises(TxTooLargeError):
+        mp.check_tx(b"a=1")
+
+
+def test_mempool_txs_available():
+    mp, _ = make_mempool()
+    ev = mp.txs_available()
+    assert not ev.is_set()
+    mp.check_tx(b"a=1")
+    assert ev.is_set()
+    mp.lock()
+    mp.update(1, [b"a=1"], [ExecTxResult(code=0)])
+    mp.unlock()
+    assert not ev.is_set()
+
+
+# -- privval -----------------------------------------------------------
+
+CHAIN = "test-chain"
+
+
+def make_vote(pv, height=1, round_=0, vote_type=PREVOTE_TYPE, block_id=None):
+    return Vote(
+        type=vote_type,
+        height=height,
+        round=round_,
+        block_id=block_id if block_id is not None else make_block_id(),
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=pv.address,
+        validator_index=0,
+    )
+
+
+def test_filepv_sign_and_verify():
+    pv = FilePV.generate()
+    vote = make_vote(pv)
+    signed = pv.sign_vote(CHAIN, vote)
+    assert pv.pub_key.verify_signature(
+        vote.sign_bytes(CHAIN), signed.signature
+    )
+
+
+def test_filepv_double_sign_protection():
+    pv = FilePV.generate()
+    vote = make_vote(pv)
+    pv.sign_vote(CHAIN, vote)
+    # Same HRS, different block: refuse.
+    other = replace(vote, block_id=make_block_id(b"other"))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, other)
+    # Height regression: refuse.
+    pv.sign_vote(CHAIN, make_vote(pv, height=2))
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(CHAIN, make_vote(pv, height=1))
+
+
+def test_filepv_resign_same_vote_new_timestamp():
+    pv = FilePV.generate()
+    vote = make_vote(pv)
+    s1 = pv.sign_vote(CHAIN, vote)
+    later = replace(vote, timestamp_ns=vote.timestamp_ns + 5_000_000_000)
+    s2 = pv.sign_vote(CHAIN, later)
+    assert s2.signature == s1.signature
+    # The originally signed timestamp must be restored so the reused
+    # signature still verifies against the returned vote's sign bytes.
+    assert s2.timestamp_ns == vote.timestamp_ns
+    assert pv.pub_key.verify_signature(s2.sign_bytes(CHAIN), s2.signature)
+
+
+def test_filepv_step_ordering():
+    pv = FilePV.generate()
+    bid = make_block_id()
+    pv.sign_vote(CHAIN, make_vote(pv, vote_type=PREVOTE_TYPE, block_id=bid))
+    pv.sign_vote(CHAIN, make_vote(pv, vote_type=PRECOMMIT_TYPE, block_id=bid))
+    # step regression precommit -> prevote at same h/r
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(
+            CHAIN, make_vote(pv, vote_type=PREVOTE_TYPE, block_id=bid)
+        )
+
+
+def test_filepv_persistence(tmp_path):
+    key_path = str(tmp_path / "priv_key.json")
+    state_path = str(tmp_path / "priv_state.json")
+    pv = FilePV.load_or_generate(key_path, state_path)
+    vote = make_vote(pv)
+    pv.sign_vote(CHAIN, vote)
+    # Reload: same key, and the last-sign state survives -> conflicting
+    # vote at same HRS still refused after a "crash".
+    pv2 = FilePV.load(key_path, state_path)
+    assert pv2.address == pv.address
+    assert pv2.height == 1
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(
+            CHAIN, make_vote(pv2, block_id=make_block_id(b"other"))
+        )
+    # identical request returns cached signature
+    again = pv2.sign_vote(CHAIN, vote)
+    assert again.signature == pv.signature
+
+
+def test_filepv_sign_proposal(tmp_path):
+    from cometbft_tpu.types import Proposal
+
+    pv = FilePV.generate()
+    prop = Proposal(
+        height=1,
+        round=0,
+        pol_round=-1,
+        block_id=make_block_id(),
+        timestamp_ns=1_700_000_000_000_000_000,
+    )
+    signed = pv.sign_proposal(CHAIN, prop)
+    assert pv.pub_key.verify_signature(
+        prop.sign_bytes(CHAIN), signed.signature
+    )
+    # proposal then prevote at same h/r is allowed (step order)
+    pv.sign_vote(CHAIN, make_vote(pv))
+    with pytest.raises(DoubleSignError):
+        pv.sign_proposal(CHAIN, replace(prop, block_id=make_block_id(b"x")))
