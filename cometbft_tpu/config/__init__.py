@@ -1,1 +1,448 @@
-"""Package placeholder — populated as layers land."""
+"""Node configuration (reference: config/config.go:93, config/toml.go).
+
+One ``Config`` tree with per-subsystem sections, round-tripped through
+TOML (stdlib ``tomllib`` for reads, a small writer for saves), plus the
+filesystem layout helpers that the reference's ``cometbft init`` relies
+on.  Durations are nanosecond ints to match the rest of the codebase's
+``time_ns`` convention; the TOML form uses the reference's
+human-friendly "300ms"/"10s" strings.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+from dataclasses import dataclass, field, fields
+
+_NS = {
+    "ns": 1,
+    "us": 10**3,
+    "ms": 10**6,
+    "s": 10**9,
+    "m": 60 * 10**9,
+    "h": 3600 * 10**9,
+}
+
+
+class ConfigError(Exception):
+    pass
+
+
+def parse_duration_ns(s: str | int) -> int:
+    """Parse Go-style duration strings ("1.5s", "500ms", "1m30s")."""
+    if isinstance(s, int):
+        return s
+    total, pos = 0, 0
+    s = s.strip()
+    if s in ("0", ""):
+        return 0
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ns|us|ms|s|m|h)", s):
+        if m.start() != pos:
+            raise ConfigError(f"invalid duration {s!r}")
+        total += int(float(m.group(1)) * _NS[m.group(2)])
+        pos = m.end()
+    if pos != len(s):
+        raise ConfigError(f"invalid duration {s!r}")
+    return total
+
+
+def format_duration_ns(ns: int) -> str:
+    for unit in ("h", "m", "s", "ms", "us"):
+        if ns and ns % _NS[unit] == 0:
+            return f"{ns // _NS[unit]}{unit}"
+    return f"{ns}ns"
+
+
+@dataclass
+class BaseConfig:
+    """Top-level options (config/config.go BaseConfig)."""
+
+    chain_id: str = ""
+    home: str = ""
+    proxy_app: str = "kvstore"
+    moniker: str = "node"
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "plain"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    abci: str = "builtin"  # builtin | socket | grpc
+    filter_peers: bool = False
+
+
+@dataclass
+class RPCConfig:
+    """(config/config.go RPCConfig)"""
+
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: tuple[str, ...] = ()
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ns: int = 10 * 10**9
+    max_request_batch_size: int = 10
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+    def is_pprof_enabled(self) -> bool:
+        return bool(self.pprof_laddr)
+
+
+@dataclass
+class GRPCConfig:
+    laddr: str = ""
+    version_service_enabled: bool = True
+    block_service_enabled: bool = True
+    block_results_service_enabled: bool = True
+    privileged_laddr: str = ""
+    pruning_service_enabled: bool = False
+
+
+@dataclass
+class P2PConfig:
+    """(config/config.go P2PConfig)"""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    flush_throttle_timeout_ns: int = 10 * 10**6
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ns: int = 20 * 10**9
+    dial_timeout_ns: int = 3 * 10**9
+
+
+@dataclass
+class MempoolConfig:
+    """(config/config.go MempoolConfig)"""
+
+    type: str = "flood"  # flood | nop
+    recheck: bool = True
+    recheck_timeout_ns: int = 10**9
+    broadcast: bool = True
+    size: int = 5000
+    max_txs_bytes: int = 1073741824
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: tuple[str, ...] = ()
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 168 * 3600 * 10**9
+    discovery_time_ns: int = 15 * 10**9
+    temp_dir: str = ""
+    chunk_request_timeout_ns: int = 10 * 10**9
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class BlockSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts that bound throughput (config/config.go:1233-1237)."""
+
+    wal_file: str = "data/cs.wal/wal"
+    timeout_propose_ns: int = 3 * 10**9
+    timeout_propose_delta_ns: int = 500 * 10**6
+    timeout_prevote_ns: int = 10**9
+    timeout_prevote_delta_ns: int = 500 * 10**6
+    timeout_precommit_ns: int = 10**9
+    timeout_precommit_delta_ns: int = 500 * 10**6
+    timeout_commit_ns: int = 10**9
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    peer_gossip_sleep_duration_ns: int = 100 * 10**6
+    peer_query_maj23_sleep_duration_ns: int = 2 * 10**9
+    double_sign_check_height: int = 0
+
+    def propose_timeout_ns(self, round_: int) -> int:
+        return self.timeout_propose_ns + self.timeout_propose_delta_ns * round_
+
+    def prevote_timeout_ns(self, round_: int) -> int:
+        return self.timeout_prevote_ns + self.timeout_prevote_delta_ns * round_
+
+    def precommit_timeout_ns(self, round_: int) -> int:
+        return (
+            self.timeout_precommit_ns + self.timeout_precommit_delta_ns * round_
+        )
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+    pruning_interval_ns: int = 10 * 10**9
+    compact: bool = False
+    compaction_interval: int = 1000
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+    psql_conn: str = ""
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "cometbft"
+
+
+_SECTIONS: dict[str, type] = {
+    "rpc": RPCConfig,
+    "grpc": GRPCConfig,
+    "p2p": P2PConfig,
+    "mempool": MempoolConfig,
+    "statesync": StateSyncConfig,
+    "blocksync": BlockSyncConfig,
+    "consensus": ConsensusConfig,
+    "storage": StorageConfig,
+    "tx_index": TxIndexConfig,
+    "instrumentation": InstrumentationConfig,
+}
+
+
+@dataclass
+class Config:
+    """The full tree (config/config.go:93)."""
+
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    grpc: GRPCConfig = field(default_factory=GRPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+    # -- filesystem layout ---------------------------------------------
+
+    def _abs(self, rel: str) -> str:
+        if os.path.isabs(rel):
+            return rel
+        return os.path.join(self.base.home, rel)
+
+    @property
+    def genesis_path(self) -> str:
+        return self._abs(self.base.genesis_file)
+
+    @property
+    def priv_validator_key_path(self) -> str:
+        return self._abs(self.base.priv_validator_key_file)
+
+    @property
+    def priv_validator_state_path(self) -> str:
+        return self._abs(self.base.priv_validator_state_file)
+
+    @property
+    def node_key_path(self) -> str:
+        return self._abs(self.base.node_key_file)
+
+    @property
+    def db_dir(self) -> str:
+        return self._abs(self.base.db_dir)
+
+    @property
+    def wal_path(self) -> str:
+        return self._abs(self.consensus.wal_file)
+
+    @property
+    def addr_book_path(self) -> str:
+        return self._abs(self.p2p.addr_book_file)
+
+    def ensure_dirs(self) -> None:
+        """(config/toml.go EnsureRoot)"""
+        for d in (
+            self.base.home,
+            os.path.join(self.base.home, "config"),
+            os.path.join(self.base.home, "data"),
+            os.path.dirname(self.wal_path),
+        ):
+            if d:
+                os.makedirs(d, exist_ok=True)
+
+    # -- validation -----------------------------------------------------
+
+    def validate_basic(self) -> None:
+        """(config/config.go:156 ValidateBasic)"""
+        if self.base.abci not in ("builtin", "socket", "grpc"):
+            raise ConfigError(f"unknown abci mode {self.base.abci!r}")
+        if self.base.log_format not in ("plain", "json"):
+            raise ConfigError("log_format must be plain or json")
+        if self.mempool.type not in ("flood", "nop"):
+            raise ConfigError(f"unknown mempool type {self.mempool.type!r}")
+        if self.mempool.size < 0 or self.mempool.cache_size < 0:
+            raise ConfigError("mempool sizes cannot be negative")
+        if self.p2p.max_num_inbound_peers < 0:
+            raise ConfigError("max_num_inbound_peers cannot be negative")
+        if self.p2p.max_num_outbound_peers < 0:
+            raise ConfigError("max_num_outbound_peers cannot be negative")
+        if self.p2p.send_rate < 0 or self.p2p.recv_rate < 0:
+            raise ConfigError("p2p rates cannot be negative")
+        if self.rpc.max_open_connections < 0:
+            raise ConfigError("rpc max_open_connections cannot be negative")
+        for name in (
+            "timeout_propose_ns",
+            "timeout_prevote_ns",
+            "timeout_precommit_ns",
+            "timeout_commit_ns",
+        ):
+            if getattr(self.consensus, name) < 0:
+                raise ConfigError(f"consensus {name} cannot be negative")
+        if self.statesync.enable:
+            if len(self.statesync.rpc_servers) < 2:
+                raise ConfigError("statesync requires >= 2 rpc_servers")
+            if self.statesync.trust_height <= 0:
+                raise ConfigError("statesync requires trust_height > 0")
+        if self.tx_index.indexer not in ("kv", "null", "psql"):
+            raise ConfigError(f"unknown indexer {self.tx_index.indexer!r}")
+
+    # -- TOML round trip ------------------------------------------------
+
+    def to_toml(self) -> str:
+        out = [_section_toml(None, self.base)]
+        for name in _SECTIONS:
+            out.append(_section_toml(name, getattr(self, name)))
+        return "\n".join(out)
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Config":
+        data = tomllib.loads(text)
+        cfg = cls()
+        cfg.base = _section_from_dict(BaseConfig, data)
+        for name, typ in _SECTIONS.items():
+            if name in data:
+                setattr(cfg, name, _section_from_dict(typ, data[name]))
+        return cfg
+
+    def save(self, path: str | None = None) -> None:
+        path = path or os.path.join(self.base.home, "config", "config.toml")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+
+    @classmethod
+    def load(cls, home: str) -> "Config":
+        path = os.path.join(home, "config", "config.toml")
+        with open(path, "rb") as f:
+            cfg = cls.from_toml(f.read().decode())
+        cfg.base.home = home
+        return cfg
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (tuple, list)):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise ConfigError(f"cannot encode {type(v)} in TOML")
+
+
+def _section_toml(name: str | None, section) -> str:
+    lines = [f"[{name}]"] if name else []
+    for f in fields(section):
+        key, v = f.name, getattr(section, f.name)
+        if key == "home":
+            continue  # home is implied by file location
+        if key.endswith("_ns"):
+            key, v = key[:-3], format_duration_ns(v)
+        lines.append(f"{key} = {_toml_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def _section_from_dict(typ: type, data: dict):
+    kwargs = {}
+    for f in fields(typ):
+        key = f.name[:-3] if f.name.endswith("_ns") else f.name
+        if key not in data:
+            continue
+        v = data[key]
+        if f.name.endswith("_ns"):
+            v = parse_duration_ns(v)
+        elif isinstance(v, list):
+            v = tuple(v)
+        kwargs[f.name] = v
+    return typ(**kwargs)
+
+
+def default_config(home: str = "") -> Config:
+    cfg = Config()
+    cfg.base.home = home
+    return cfg
+
+
+def test_config(home: str = "") -> Config:
+    """Fast timeouts for tests (config/config.go TestConfig)."""
+    cfg = default_config(home)
+    cfg.base.db_backend = "memdb"
+    cfg.consensus = ConsensusConfig(
+        timeout_propose_ns=80 * 10**6,
+        timeout_propose_delta_ns=1 * 10**6,
+        timeout_prevote_ns=20 * 10**6,
+        timeout_prevote_delta_ns=1 * 10**6,
+        timeout_precommit_ns=20 * 10**6,
+        timeout_precommit_delta_ns=1 * 10**6,
+        timeout_commit_ns=20 * 10**6,
+        peer_gossip_sleep_duration_ns=5 * 10**6,
+        peer_query_maj23_sleep_duration_ns=250 * 10**6,
+    )
+    cfg.mempool.recheck_timeout_ns = 10 * 10**6
+    return cfg
+
+
+__all__ = [
+    "BaseConfig",
+    "BlockSyncConfig",
+    "Config",
+    "ConfigError",
+    "ConsensusConfig",
+    "GRPCConfig",
+    "InstrumentationConfig",
+    "MempoolConfig",
+    "P2PConfig",
+    "RPCConfig",
+    "StateSyncConfig",
+    "StorageConfig",
+    "TxIndexConfig",
+    "default_config",
+    "format_duration_ns",
+    "parse_duration_ns",
+    "test_config",
+]

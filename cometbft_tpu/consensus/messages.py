@@ -1,0 +1,247 @@
+"""Consensus wire messages (reference: internal/consensus/msgs.go,
+proto/cometbft/consensus/v2/types.proto).
+
+One tagged union covering the state-machine inputs (Proposal,
+BlockPart, Vote) and the gossip control messages (NewRoundStep,
+NewValidBlock, ProposalPOL, HasVote, VoteSetMaj23, VoteSetBits).  The
+same encoding serves the WAL and the p2p channels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.block import BlockID
+from cometbft_tpu.types.part_set import Part
+from cometbft_tpu.types.vote import Proposal, Vote
+from cometbft_tpu.utils.bit_array import BitArray
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter, _unzigzag
+
+
+class MessageError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class NewRoundStepMessage:
+    """Peer's current HRS (reactor.go NewRoundStepMessage)."""
+
+    height: int
+    round: int
+    step: int
+    seconds_since_start_time: int = 0
+    last_commit_round: int = -1
+
+
+@dataclass(frozen=True)
+class NewValidBlockMessage:
+    """Peer observed a POL-valid block (reactor.go NewValidBlockMessage)."""
+
+    height: int
+    round: int
+    block_part_set_header: object  # PartSetHeader
+    block_parts: BitArray
+    is_commit: bool = False
+
+
+@dataclass(frozen=True)
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass(frozen=True)
+class ProposalPOLMessage:
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+
+@dataclass(frozen=True)
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass(frozen=True)
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass(frozen=True)
+class HasVoteMessage:
+    height: int
+    round: int
+    type: int
+    index: int
+
+
+@dataclass(frozen=True)
+class VoteSetMaj23Message:
+    height: int
+    round: int
+    type: int
+    block_id: BlockID
+
+
+@dataclass(frozen=True)
+class VoteSetBitsMessage:
+    height: int
+    round: int
+    type: int
+    block_id: BlockID
+    votes: BitArray
+
+
+# -- wire codec ---------------------------------------------------------
+
+_TAG_NEW_ROUND_STEP = 1
+_TAG_NEW_VALID_BLOCK = 2
+_TAG_PROPOSAL = 3
+_TAG_PROPOSAL_POL = 4
+_TAG_BLOCK_PART = 5
+_TAG_VOTE = 6
+_TAG_HAS_VOTE = 7
+_TAG_VOTE_SET_MAJ23 = 8
+_TAG_VOTE_SET_BITS = 9
+
+
+def _enc_bit_array(ba: BitArray) -> bytes:
+    w = ProtoWriter()
+    w.varint(1, ba.size)
+    w.bytes_(2, ba.to_bytes())
+    return w.finish()
+
+
+def _dec_bit_array(data: bytes) -> BitArray:
+    f = ProtoReader(data).to_dict()
+    bits = int(f.get(1, [0])[0])
+    return BitArray.from_bytes(bits, bytes(f.get(2, [b""])[0]))
+
+
+def encode_message(msg) -> bytes:
+    w = ProtoWriter()
+    if isinstance(msg, NewRoundStepMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.varint(3, msg.step)
+        m.varint(4, msg.seconds_since_start_time)
+        m.svarint(5, msg.last_commit_round)
+        w.message(_TAG_NEW_ROUND_STEP, m.finish())
+    elif isinstance(msg, NewValidBlockMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.message(3, msg.block_part_set_header.encode())
+        m.message(4, _enc_bit_array(msg.block_parts))
+        m.bool_(5, msg.is_commit)
+        w.message(_TAG_NEW_VALID_BLOCK, m.finish())
+    elif isinstance(msg, ProposalMessage):
+        w.message(_TAG_PROPOSAL, msg.proposal.encode())
+    elif isinstance(msg, ProposalPOLMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.proposal_pol_round)
+        m.message(3, _enc_bit_array(msg.proposal_pol))
+        w.message(_TAG_PROPOSAL_POL, m.finish())
+    elif isinstance(msg, BlockPartMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.message(3, codec.encode_part(msg.part))
+        w.message(_TAG_BLOCK_PART, m.finish())
+    elif isinstance(msg, VoteMessage):
+        w.message(_TAG_VOTE, msg.vote.encode())
+    elif isinstance(msg, HasVoteMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.varint(3, msg.type)
+        m.svarint(4, msg.index)
+        w.message(_TAG_HAS_VOTE, m.finish())
+    elif isinstance(msg, VoteSetMaj23Message):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.varint(3, msg.type)
+        m.message(4, msg.block_id.encode())
+        w.message(_TAG_VOTE_SET_MAJ23, m.finish())
+    elif isinstance(msg, VoteSetBitsMessage):
+        m = ProtoWriter()
+        m.varint(1, msg.height)
+        m.svarint(2, msg.round)
+        m.varint(3, msg.type)
+        m.message(4, msg.block_id.encode())
+        m.message(5, _enc_bit_array(msg.votes))
+        w.message(_TAG_VOTE_SET_BITS, m.finish())
+    else:
+        raise MessageError(f"cannot encode {type(msg).__name__}")
+    return w.finish()
+
+
+def decode_message(data: bytes):
+    f = ProtoReader(data).to_dict()
+    if len(f) != 1:
+        raise MessageError("consensus message must have exactly one body")
+    tag = next(iter(f))
+    body = bytes(f[tag][0])
+    m = ProtoReader(body).to_dict() if tag != _TAG_PROPOSAL else None
+    if tag == _TAG_NEW_ROUND_STEP:
+        return NewRoundStepMessage(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            step=int(m.get(3, [0])[0]),
+            seconds_since_start_time=int(m.get(4, [0])[0]),
+            last_commit_round=_unzigzag(int(m.get(5, [0])[0])),
+        )
+    if tag == _TAG_NEW_VALID_BLOCK:
+        return NewValidBlockMessage(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            block_part_set_header=codec.decode_part_set_header(
+                bytes(m[3][0])
+            ),
+            block_parts=_dec_bit_array(bytes(m[4][0])),
+            is_commit=bool(m.get(5, [0])[0]),
+        )
+    if tag == _TAG_PROPOSAL:
+        return ProposalMessage(proposal=Proposal.decode(body))
+    if tag == _TAG_PROPOSAL_POL:
+        return ProposalPOLMessage(
+            height=int(m.get(1, [0])[0]),
+            proposal_pol_round=_unzigzag(int(m.get(2, [0])[0])),
+            proposal_pol=_dec_bit_array(bytes(m[3][0])),
+        )
+    if tag == _TAG_BLOCK_PART:
+        return BlockPartMessage(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            part=codec.decode_part(bytes(m[3][0])),
+        )
+    if tag == _TAG_VOTE:
+        return VoteMessage(vote=Vote.decode(body))
+    if tag == _TAG_HAS_VOTE:
+        return HasVoteMessage(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            type=int(m.get(3, [0])[0]),
+            index=_unzigzag(int(m.get(4, [0])[0])),
+        )
+    if tag == _TAG_VOTE_SET_MAJ23:
+        return VoteSetMaj23Message(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            type=int(m.get(3, [0])[0]),
+            block_id=codec.decode_block_id(bytes(m[4][0])),
+        )
+    if tag == _TAG_VOTE_SET_BITS:
+        return VoteSetBitsMessage(
+            height=int(m.get(1, [0])[0]),
+            round=_unzigzag(int(m.get(2, [0])[0])),
+            type=int(m.get(3, [0])[0]),
+            block_id=codec.decode_block_id(bytes(m[4][0])),
+            votes=_dec_bit_array(bytes(m[5][0])),
+        )
+    raise MessageError(f"unknown consensus message tag {tag}")

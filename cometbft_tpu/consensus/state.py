@@ -1,0 +1,1070 @@
+"""The consensus state machine — Tendermint BFT over one height/round
+ladder (reference: internal/consensus/state.go:72).
+
+Single-writer core (SURVEY.md §2.10): ALL state transitions happen in
+one thread (``_receive_routine``), fed by a FIFO input queue carrying
+peer messages, our own internal messages, and fired timeouts.  Every
+input is WAL-logged before processing — fsynced for our own messages —
+so a crash replays to exactly the same state (wal.go contract).
+
+The hot path: every precommit entering ``try_add_vote`` is signature-
+verified via VoteSet (ed25519 → TPU batch plane), and every decided
+block re-verifies the previous commit inside ``BlockExecutor.apply_block``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, replace
+
+from cometbft_tpu.config import ConsensusConfig
+from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
+from cometbft_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+    decode_message,
+    encode_message,
+)
+from cometbft_tpu.consensus.ticker import (
+    STEP_COMMIT,
+    STEP_NAMES,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    TimeoutInfo,
+    TimeoutTicker,
+)
+from cometbft_tpu.abci.types import ExtendVoteRequest, VerifyVoteExtensionRequest
+from cometbft_tpu.state import State
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.types.block import Block, BlockID, Commit
+from cometbft_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from cometbft_tpu.types.event_bus import (
+    EventBus,
+    EventDataCompleteProposal,
+    EventDataNewRound,
+    EventDataRoundState,
+    EventDataVote,
+)
+from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.types.vote import Proposal, Vote
+from cometbft_tpu.types.vote_set import ConflictingVoteError, VoteSet
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.wal import KIND_MSG_INFO, KIND_TIMEOUT, NopWAL, WALRecord
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+
+class ConsensusError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class MsgInfo:
+    """(state.go msgInfo)"""
+
+    msg: object
+    peer_id: str = ""  # "" = internal (our own proposal/parts/votes)
+
+
+def encode_msg_info(mi: MsgInfo) -> bytes:
+    w = ProtoWriter()
+    w.string(1, mi.peer_id)
+    w.bytes_(2, encode_message(mi.msg))
+    return w.finish()
+
+
+def decode_msg_info(data: bytes) -> MsgInfo:
+    f = ProtoReader(data).to_dict()
+    return MsgInfo(
+        msg=decode_message(bytes(f[2][0])),
+        peer_id=bytes(f.get(1, [b""])[0]).decode(),
+    )
+
+
+def encode_timeout_info(ti: TimeoutInfo) -> bytes:
+    w = ProtoWriter()
+    w.varint(1, ti.duration_ns)
+    w.varint(2, ti.height)
+    w.svarint(3, ti.round)
+    w.varint(4, ti.step)
+    return w.finish()
+
+
+def decode_timeout_info(data: bytes) -> TimeoutInfo:
+    from cometbft_tpu.utils.protoio import _unzigzag
+
+    f = ProtoReader(data).to_dict()
+    return TimeoutInfo(
+        duration_ns=int(f.get(1, [0])[0]),
+        height=int(f.get(2, [0])[0]),
+        round=_unzigzag(int(f.get(3, [0])[0])),
+        step=int(f.get(4, [0])[0]),
+    )
+
+
+class ConsensusState(BaseService):
+    """(internal/consensus/state.go:72 State)"""
+
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store,
+        priv_validator=None,
+        event_bus: EventBus | None = None,
+        wal=None,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="consensus",
+            logger=logger or default_logger().with_fields(module="consensus"),
+        )
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.event_bus = event_bus
+        self.wal = wal if wal is not None else NopWAL()
+
+        # round state (round_state.go RoundState) — guarded by _rs_mtx for
+        # readers (gossip, RPC); written only by the receive routine.
+        self._rs_mtx = threading.RLock()
+        self.height = 0
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.start_time_ns = 0
+        self.commit_time_ns = 0
+        self.validators: ValidatorSet | None = None
+        self.proposal: Proposal | None = None
+        self.proposal_block: Block | None = None
+        self.proposal_block_parts: PartSet | None = None
+        self.locked_round = -1
+        self.locked_block: Block | None = None
+        self.locked_block_parts: PartSet | None = None
+        self.valid_round = -1
+        self.valid_block: Block | None = None
+        self.valid_block_parts: PartSet | None = None
+        self.votes: HeightVoteSet | None = None
+        self.commit_round = -1
+        self.last_commit: VoteSet | None = None
+        self.last_validators: ValidatorSet | None = None
+        self.triggered_timeout_precommit = False
+
+        self.state = state  # committed chain state
+
+        self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._ticker = TimeoutTicker(self._tock)
+        self._thread: threading.Thread | None = None
+        self._replay_mode = False
+        self._replay_msg_time_ns = 0
+        self._proposal_recv_time_ns = 0
+
+        # listeners for new-step notification (reactor broadcast hook)
+        self.on_new_step = None
+
+        self._update_to_state(state)
+
+    # -- public input API (reactor entry points) -------------------------
+
+    def send_peer_msg(self, msg, peer_id: str) -> None:
+        """Queue a peer message (reactor.go Receive → peerMsgQueue)."""
+        self._queue.put(("msg", MsgInfo(msg, peer_id)))
+
+    def _send_internal(self, msg) -> None:
+        """(state.go sendInternalMessage) — must never block the receive
+        routine NOR drop our own messages.  A full queue (e.g. a
+        max-size proposal split into >1000 parts) falls back to a
+        blocking put from a helper thread, mirroring the reference's
+        go-routine fallback."""
+        item = ("msg", MsgInfo(msg, ""))
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            threading.Thread(
+                target=self._queue.put, args=(item,), daemon=True
+            ).start()
+
+    def _tock(self, ti: TimeoutInfo) -> None:
+        self._queue.put(("timeout", ti))
+
+    def set_proposal_and_block(
+        self, proposal: Proposal, parts: PartSet
+    ) -> None:
+        """Inject a full proposal (privileged/test path, state.go
+        SetProposalAndBlock)."""
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(parts.header.total):
+            self._send_internal(
+                BlockPartMessage(proposal.height, proposal.round, parts.get_part(i))
+            )
+
+    # -- round state snapshot --------------------------------------------
+
+    def round_state(self) -> dict:
+        """Snapshot for gossip/RPC (round_state.go RoundState)."""
+        with self._rs_mtx:
+            return {
+                "height": self.height,
+                "round": self.round,
+                "step": self.step,
+                "step_name": STEP_NAMES[self.step],
+                "start_time_ns": self.start_time_ns,
+                "proposal": self.proposal,
+                "proposal_block": self.proposal_block,
+                "proposal_block_parts": self.proposal_block_parts,
+                "locked_round": self.locked_round,
+                "locked_block": self.locked_block,
+                "valid_round": self.valid_round,
+                "valid_block": self.valid_block,
+                "votes": self.votes,
+                "commit_round": self.commit_round,
+                "last_commit": self.last_commit,
+                "validators": self.validators,
+                "last_validators": self.last_validators,
+            }
+
+    # -- lifecycle -------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._ticker.start()
+        self._catchup_replay()
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="cs-receive", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round_0()
+
+    def on_stop(self) -> None:
+        self._queue.put(("quit", None))
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._ticker.stop()
+        if hasattr(self.wal, "stop") and getattr(
+            self.wal, "is_running", lambda: False
+        )():
+            self.wal.stop()
+
+    # -- WAL replay (replay.go:95 catchupReplay) -------------------------
+
+    def _catchup_replay(self) -> None:
+        records = self.wal.search_for_end_height(self.height - 1)
+        if records is None:
+            # No anchor for the in-flight height (fresh WAL, or the node
+            # jumped heights via handshake/statesync): write it now so a
+            # crash mid-height can replay (wal.go OnStart writes
+            # EndHeightMessage{0} to an empty WAL for the same reason).
+            self.wal.write_end_height(self.height - 1)
+            return
+        self._replay_mode = True
+        try:
+            for rec in records:
+                self._apply_wal_record(rec)
+        finally:
+            self._replay_mode = False
+        self.logger.info("replayed wal", height=self.height, n=len(records))
+
+    def _apply_wal_record(self, rec: WALRecord) -> None:
+        self._replay_msg_time_ns = rec.time_ns
+        if rec.kind == KIND_MSG_INFO:
+            mi = decode_msg_info(rec.data)
+            self._handle_msg(mi)
+        elif rec.kind == KIND_TIMEOUT:
+            ti = decode_timeout_info(rec.data)
+            self._handle_timeout(ti)
+
+    # -- the single-writer core (state.go:795 receiveRoutine) ------------
+
+    def _receive_routine(self) -> None:
+        while True:
+            try:
+                kind, payload = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if self.quit_event().is_set():
+                    return
+                continue
+            if kind == "quit":
+                return
+            try:
+                if kind == "msg":
+                    # WAL BEFORE processing; fsync for our own messages
+                    data = encode_msg_info(payload)
+                    if payload.peer_id == "":
+                        self.wal.write_sync(KIND_MSG_INFO, data)
+                    else:
+                        self.wal.write(KIND_MSG_INFO, data)
+                    self._handle_msg(payload)
+                elif kind == "timeout":
+                    self.wal.write(KIND_TIMEOUT, encode_timeout_info(payload))
+                    self._handle_timeout(payload)
+            except Exception as exc:  # noqa: BLE001 — the loop must survive
+                self.logger.error(
+                    "error processing consensus input",
+                    err=repr(exc),
+                    kind=kind,
+                )
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        msg, peer_id = mi.msg, mi.peer_id
+        with self._rs_mtx:
+            if isinstance(msg, ProposalMessage):
+                self._set_proposal(msg.proposal)
+            elif isinstance(msg, BlockPartMessage):
+                added = self._add_proposal_block_part(msg, peer_id)
+                if added and self.proposal_block_parts.is_complete():
+                    self._handle_complete_proposal(msg.height)
+            elif isinstance(msg, VoteMessage):
+                self._try_add_vote(msg.vote, peer_id)
+            else:
+                self.logger.debug(
+                    "ignoring message", type=type(msg).__name__
+                )
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        with self._rs_mtx:
+            if ti.height != self.height or ti.round < self.round or (
+                ti.round == self.round and ti.step < self.step
+            ):
+                return  # stale
+            if ti.step == STEP_NEW_HEIGHT:
+                self._enter_new_round(ti.height, 0)
+            elif ti.step == STEP_NEW_ROUND:
+                self._enter_propose(ti.height, 0)
+            elif ti.step == STEP_PROPOSE:
+                self.event_bus and self.event_bus.publish_timeout_propose(
+                    self._rs_event()
+                )
+                self._enter_prevote(ti.height, ti.round)
+            elif ti.step == STEP_PREVOTE_WAIT:
+                self.event_bus and self.event_bus.publish_timeout_wait(
+                    self._rs_event()
+                )
+                self._enter_precommit(ti.height, ti.round)
+            elif ti.step == STEP_PRECOMMIT_WAIT:
+                self.event_bus and self.event_bus.publish_timeout_wait(
+                    self._rs_event()
+                )
+                self._enter_precommit(ti.height, ti.round)
+                self._enter_new_round(ti.height, ti.round + 1)
+
+    # -- state setup -----------------------------------------------------
+
+    def _update_to_state(self, state: State) -> None:
+        """(state.go:652 updateToState)"""
+        if self.commit_round > -1 and 0 < self.height != state.last_block_height:
+            raise ConsensusError(
+                f"updateToState at height {self.height} != "
+                f"committed {state.last_block_height}"
+            )
+        height = (
+            state.initial_height
+            if state.last_block_height == 0
+            else state.last_block_height + 1
+        )
+        validators = state.validators
+
+        if state.last_block_height > 0 and self.commit_round > -1 and self.votes:
+            # promote this height's precommits to last_commit
+            precommits = self.votes.precommits(self.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise ConsensusError("wanted +2/3 precommits for last commit")
+            last_commit = precommits
+        elif state.last_block_height == 0:
+            last_commit = None
+        else:
+            last_commit = self.last_commit if self.height == height else None
+
+        self.height = height
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        if self.commit_time_ns == 0:
+            self.start_time_ns = now_ns() + self.config.timeout_commit_ns
+        else:
+            self.start_time_ns = (
+                self.commit_time_ns + self.config.timeout_commit_ns
+            )
+        self.validators = validators
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_parts = None
+        self._proposal_recv_time_ns = 0
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.valid_block_parts = None
+        self.votes = HeightVoteSet(
+            state.chain_id,
+            height,
+            validators,
+            extensions_enabled=state.consensus_params.vote_extensions_enabled(
+                height
+            ),
+        )
+        self.commit_round = -1
+        self.last_commit = last_commit
+        self.last_validators = state.last_validators
+        self.triggered_timeout_precommit = False
+        self.state = state
+
+    def _schedule_round_0(self) -> None:
+        sleep = max(self.start_time_ns - now_ns(), 0)
+        self._ticker.schedule(
+            TimeoutInfo(sleep, self.height, 0, STEP_NEW_HEIGHT)
+        )
+
+    def _new_step(self) -> None:
+        if self.event_bus is not None and not self._replay_mode:
+            self.event_bus.publish_new_round_step(self._rs_event())
+        if self.on_new_step is not None:
+            self.on_new_step(self.round_state())
+
+    def _rs_event(self) -> EventDataRoundState:
+        return EventDataRoundState(
+            height=self.height, round=self.round, step=STEP_NAMES[self.step]
+        )
+
+    # -- transitions -----------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """(state.go:1063)"""
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.step != STEP_NEW_HEIGHT
+        ):
+            return
+        self.logger.debug("enter new round", height=height, round=round_)
+        if round_ > self.round:
+            # proposer rotation advances with the round (state.go:1087)
+            self.validators = self.validators.copy().increment_proposer_priority(
+                round_ - self.round
+            )
+        self.round = round_
+        self.step = STEP_NEW_ROUND
+        if round_ != 0:
+            # round 0 keeps the proposal received during NewHeight wait
+            self.proposal = None
+            self.proposal_block = None
+            self.proposal_block_parts = None
+            self._proposal_recv_time_ns = 0
+        self.votes.set_round(round_)
+        self.triggered_timeout_precommit = False
+        if self.event_bus is not None and not self._replay_mode:
+            self.event_bus.publish_new_round(
+                EventDataNewRound(
+                    height=height,
+                    round=round_,
+                    step=STEP_NAMES[self.step],
+                    proposer_address=self.validators.get_proposer().address,
+                )
+            )
+        self._enter_propose(height, round_)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """(state.go:1152)"""
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.step >= STEP_PROPOSE
+        ):
+            return
+        self.round = round_
+        self.step = STEP_PROPOSE
+        self._new_step()
+        self._ticker.schedule(
+            TimeoutInfo(
+                self.config.propose_timeout_ns(round_),
+                height,
+                round_,
+                STEP_PROPOSE,
+            )
+        )
+        if self._is_proposer():
+            self._decide_proposal(height, round_)
+        # If the proposal is already complete (gossip beat us here):
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _is_proposer(self) -> bool:
+        if self.priv_validator is None:
+            return False
+        return (
+            self.validators.get_proposer().address
+            == self.priv_validator.address
+        )
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """(state.go:1226 defaultDecideProposal)"""
+        if self.valid_block is not None:
+            block, parts = self.valid_block, self.valid_block_parts
+        else:
+            last_commit = None
+            if height > self.state.initial_height:
+                if self.last_commit is not None:
+                    last_commit = self.last_commit.make_commit()
+                else:
+                    last_commit = self.block_store.load_seen_commit(height - 1)
+                if last_commit is None:
+                    self.logger.error(
+                        "cannot propose without last commit", height=height
+                    )
+                    return
+            block = self.block_exec.create_proposal_block(
+                height,
+                self.state,
+                last_commit,
+                self.priv_validator.address,
+            )
+            parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+
+        block_id = BlockID(hash=block.hash(), part_set_header=parts.header)
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=self.valid_round,
+            block_id=block_id,
+            timestamp_ns=block.header.time_ns
+            if not self.state.consensus_params.pbts_enabled(height)
+            else now_ns(),
+        )
+        try:
+            proposal = self.priv_validator.sign_proposal(
+                self.state.chain_id, proposal
+            )
+        except Exception as exc:  # double-sign protection may refuse
+            self.logger.error("failed signing proposal", err=repr(exc))
+            return
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(parts.header.total):
+            self._send_internal(
+                BlockPartMessage(height, round_, parts.get_part(i))
+            )
+        self.logger.info(
+            "signed proposal", height=height, round=round_,
+            hash=block.hash().hex()[:12],
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        """(state.go isProposalComplete)"""
+        if self.proposal is None or self.proposal_block is None:
+            return False
+        if self.proposal.pol_round < 0:
+            return True
+        prevotes = self.votes.prevotes(self.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    # -- proposal handling ------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """(state.go:2048 defaultSetProposal)"""
+        if self.proposal is not None:
+            return
+        if proposal.height != self.height or proposal.round != self.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ConsensusError("invalid proposal POL round")
+        proposer = self.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ConsensusError("invalid proposal signature")
+        self.proposal = proposal
+        # PBTS timeliness is judged at RECEIVE time, not prevote time
+        # (types/vote.go IsTimely contract); during WAL replay the
+        # original receive timestamp comes from the record.
+        self._proposal_recv_time_ns = (
+            self._replay_msg_time_ns if self._replay_mode else now_ns()
+        )
+        if self.proposal_block_parts is None:
+            self.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header
+            )
+        self.logger.info(
+            "received proposal",
+            height=proposal.height,
+            round=proposal.round,
+            hash=proposal.block_id.hash.hex()[:12],
+        )
+
+    def _add_proposal_block_part(
+        self, msg: BlockPartMessage, peer_id: str
+    ) -> bool:
+        """(state.go:2123 addProposalBlockPart)"""
+        if msg.height != self.height:
+            return False
+        if self.proposal_block_parts is None:
+            return False  # no proposal yet: can't verify against a header
+        added = self.proposal_block_parts.add_part(msg.part)
+        if added and self.proposal_block_parts.is_complete():
+            from cometbft_tpu.types import codec
+
+            self.proposal_block = codec.decode_block(
+                self.proposal_block_parts.assemble()
+            )
+            if (
+                self.proposal is not None
+                and self.proposal_block.hash() != self.proposal.block_id.hash
+            ):
+                self.proposal_block = None
+                raise ConsensusError("proposal block hash mismatch")
+            if self.event_bus is not None and not self._replay_mode:
+                self.event_bus.publish_complete_proposal(
+                    EventDataCompleteProposal(
+                        height=self.height,
+                        round=self.round,
+                        step=STEP_NAMES[self.step],
+                        block_id=self.proposal.block_id
+                        if self.proposal
+                        else None,
+                    )
+                )
+        return added
+
+    def _handle_complete_proposal(self, height: int) -> None:
+        """(state.go handleCompleteProposal)"""
+        prevotes = self.votes.prevotes(self.round)
+        maj23 = prevotes.two_thirds_majority() if prevotes else None
+        if (
+            maj23 is not None
+            and not maj23.is_nil()
+            and self.valid_round < self.round
+        ):
+            if self.proposal_block.hash() == maj23.hash:
+                self.valid_round = self.round
+                self.valid_block = self.proposal_block
+                self.valid_block_parts = self.proposal_block_parts
+        if self.step <= STEP_PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(height, self.round)
+            if maj23 is not None and not maj23.is_nil():
+                self._enter_precommit(height, self.round)
+        elif self.step == STEP_COMMIT:
+            self._try_finalize_commit(height)
+
+    # -- prevote ---------------------------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """(state.go:1345)"""
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.step >= STEP_PREVOTE
+        ):
+            return
+        self.round = round_
+        self.step = STEP_PREVOTE
+        self._new_step()
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """(state.go:1387 defaultDoPrevote)"""
+        if self.locked_block is not None:
+            self._sign_add_vote(PREVOTE_TYPE, self.locked_block)
+            return
+        if self.proposal_block is None or self.proposal is None:
+            self._sign_add_vote(PREVOTE_TYPE, None)
+            return
+        if self.state.consensus_params.pbts_enabled(height):
+            if not self._proposal_is_timely():
+                self.logger.info(
+                    "prevote nil: proposal not timely", height=height
+                )
+                self._sign_add_vote(PREVOTE_TYPE, None)
+                return
+        try:
+            self.block_exec.validate_block(self.state, self.proposal_block)
+            accepted = self.block_exec.process_proposal(
+                self.proposal_block, self.state
+            )
+        except Exception as exc:  # invalid block
+            self.logger.info("prevote nil: invalid block", err=repr(exc))
+            accepted = False
+        self._sign_add_vote(
+            PREVOTE_TYPE, self.proposal_block if accepted else None
+        )
+
+    def _proposal_is_timely(self) -> bool:
+        """PBTS timeliness (types/vote.go IsTimely), measured against the
+        proposal's receive time so scheduling delay between receive and
+        prevote cannot flip the verdict."""
+        sp = self.state.consensus_params.synchrony
+        t = self.proposal.timestamp_ns
+        recv = self._proposal_recv_time_ns or now_ns()
+        lhs = t - sp.precision_ns
+        rhs = t + sp.precision_ns + sp.message_delay_ns
+        return lhs <= recv <= rhs
+
+    # -- precommit -------------------------------------------------------
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.step >= STEP_PREVOTE_WAIT
+        ):
+            return
+        self.round = round_
+        self.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._ticker.schedule(
+            TimeoutInfo(
+                self.config.prevote_timeout_ns(round_),
+                height,
+                round_,
+                STEP_PREVOTE_WAIT,
+            )
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """(state.go:1609)"""
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.step >= STEP_PRECOMMIT
+        ):
+            return
+        self.round = round_
+        self.step = STEP_PRECOMMIT
+        self._new_step()
+        prevotes = self.votes.prevotes(round_)
+        maj23 = prevotes.two_thirds_majority() if prevotes else None
+        if maj23 is None:
+            # no polka: precommit nil
+            self._sign_add_vote(PRECOMMIT_TYPE, None)
+            return
+        if self.event_bus is not None and not self._replay_mode:
+            self.event_bus.publish_polka(self._rs_event())
+        pol_round, _ = self.votes.pol_info()
+        if pol_round < round_:
+            raise ConsensusError("polka round inconsistency")
+        if maj23.is_nil():
+            # +2/3 prevoted nil: unlock and precommit nil (state.go:1674)
+            self.locked_round = -1
+            self.locked_block = None
+            self.locked_block_parts = None
+            self._sign_add_vote(PRECOMMIT_TYPE, None)
+            return
+        if (
+            self.locked_block is not None
+            and self.locked_block.hash() == maj23.hash
+        ):
+            # re-lock on same block
+            self.locked_round = round_
+            self._sign_add_vote(PRECOMMIT_TYPE, self.locked_block)
+            return
+        if (
+            self.proposal_block is not None
+            and self.proposal_block.hash() == maj23.hash
+        ):
+            # lock on the polka block
+            try:
+                self.block_exec.validate_block(self.state, self.proposal_block)
+            except Exception as exc:
+                raise ConsensusError(
+                    f"+2/3 prevoted an invalid block: {exc}"
+                ) from exc
+            self.locked_round = round_
+            self.locked_block = self.proposal_block
+            self.locked_block_parts = self.proposal_block_parts
+            self._sign_add_vote(PRECOMMIT_TYPE, self.proposal_block)
+            return
+        # Polka for a block we don't have: unlock, fetch it via gossip
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        if self.proposal_block_parts is None or not (
+            self.proposal_block_parts.has_header(maj23.part_set_header)
+        ):
+            self.proposal_block = None
+            self.proposal_block_parts = PartSet(maj23.part_set_header)
+        self._sign_add_vote(PRECOMMIT_TYPE, None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        if self.height != height or round_ < self.round or (
+            self.round == round_ and self.triggered_timeout_precommit
+        ):
+            return
+        self.triggered_timeout_precommit = True
+        self._ticker.schedule(
+            TimeoutInfo(
+                self.config.precommit_timeout_ns(round_),
+                height,
+                round_,
+                STEP_PRECOMMIT_WAIT,
+            )
+        )
+
+    # -- commit ----------------------------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """(state.go:1743)"""
+        if self.height != height or self.step >= STEP_COMMIT:
+            return
+        self.commit_round = commit_round
+        self.commit_time_ns = now_ns()
+        self.step = STEP_COMMIT
+        self._new_step()
+        precommits = self.votes.precommits(commit_round)
+        maj23 = precommits.two_thirds_majority()
+        if maj23 is None or maj23.is_nil():
+            raise ConsensusError("enterCommit without +2/3 for a block")
+        # lock → proposal promotion so finalize uses the decided block
+        if self.locked_block is not None and self.locked_block.hash() == maj23.hash:
+            self.proposal_block = self.locked_block
+            self.proposal_block_parts = self.locked_block_parts
+        if (
+            self.proposal_block is None
+            or self.proposal_block.hash() != maj23.hash
+        ):
+            if self.proposal_block_parts is None or not (
+                self.proposal_block_parts.has_header(maj23.part_set_header)
+            ):
+                self.proposal_block = None
+                self.proposal_block_parts = PartSet(maj23.part_set_header)
+                return  # wait for parts via gossip
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """(state.go:1806)"""
+        if self.height != height:
+            return
+        precommits = self.votes.precommits(self.commit_round)
+        maj23 = precommits.two_thirds_majority() if precommits else None
+        if maj23 is None or maj23.is_nil():
+            return
+        if (
+            self.proposal_block is None
+            or self.proposal_block.hash() != maj23.hash
+        ):
+            return  # don't have the block yet
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """(state.go:1834) SaveBlock → WAL EndHeight → ApplyBlock →
+        next height."""
+        if self.step != STEP_COMMIT:
+            return
+        precommits = self.votes.precommits(self.commit_round)
+        block_id = precommits.two_thirds_majority()
+        block, parts = self.proposal_block, self.proposal_block_parts
+        if not parts.has_header(block_id.part_set_header):
+            raise ConsensusError("commit partset header mismatch")
+
+        if self.block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        # Height boundary: the block is durably stored; a crash after this
+        # replays from handshake, not the WAL (wal.go EndHeightMessage).
+        self.wal.write_end_height(height)
+
+        new_state = self.block_exec.apply_block(
+            self.state,
+            BlockID(hash=block.hash(), part_set_header=parts.header),
+            block,
+        )
+        self.logger.info(
+            "committed block",
+            height=height,
+            hash=(block.hash() or b"").hex()[:12],
+            num_txs=len(block.data.txs),
+        )
+        self._update_to_state(new_state)
+        self._schedule_round_0()
+
+    # -- votes -----------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
+        """(state.go:2243 tryAddVote)"""
+        try:
+            self._add_vote(vote, peer_id)
+        except ConflictingVoteError as conflict:
+            if self.priv_validator is not None and (
+                vote.validator_address == self.priv_validator.address
+            ):
+                self.logger.error(
+                    "found conflicting vote from ourselves",
+                    height=vote.height,
+                    round=vote.round,
+                )
+                return
+            self.block_exec.ev_pool.report_conflicting_votes(
+                conflict.vote_a, conflict.vote_b
+            )
+        except Exception as exc:  # noqa: BLE001
+            self.logger.debug("failed adding vote", err=repr(exc))
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """(state.go:2294 addVote)"""
+        # Precommit for the previous height (LastCommit catchup)
+        if (
+            vote.height + 1 == self.height
+            and vote.type == PRECOMMIT_TYPE
+            and self.step == STEP_NEW_HEIGHT
+            and self.last_commit is not None
+        ):
+            added = self.last_commit.add_vote(vote)
+            if added and self.event_bus is not None and not self._replay_mode:
+                self.event_bus.publish_vote(EventDataVote(vote=vote))
+            return added
+        if vote.height != self.height:
+            return False
+
+        # Vote-extension verification for current-height precommits
+        if (
+            vote.type == PRECOMMIT_TYPE
+            and not vote.is_nil()
+            and self.state.consensus_params.vote_extensions_enabled(
+                self.height
+            )
+            and self.priv_validator is not None
+            and vote.validator_address != self.priv_validator.address
+        ):
+            resp = self.block_exec.proxy_app.verify_vote_extension(
+                VerifyVoteExtensionRequest(
+                    hash=vote.block_id.hash,
+                    validator_address=vote.validator_address,
+                    height=vote.height,
+                    vote_extension=vote.extension,
+                )
+            )
+            if not resp.is_accepted:
+                raise ConsensusError("vote extension rejected by app")
+
+        added = self.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_bus is not None and not self._replay_mode:
+            self.event_bus.publish_vote(EventDataVote(vote=vote))
+
+        if vote.type == PREVOTE_TYPE:
+            self._on_prevote_added(vote)
+        else:
+            self._on_precommit_added(vote)
+        return True
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        prevotes = self.votes.prevotes(vote.round)
+        maj23 = prevotes.two_thirds_majority()
+        if maj23 is not None:
+            # Unlock if a newer polka contradicts our lock (state.go:2372)
+            if (
+                self.locked_block is not None
+                and self.locked_round < vote.round <= self.round
+                and self.locked_block.hash() != maj23.hash
+            ):
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_parts = None
+            # Track the most recent valid block (state.go:2392)
+            if not maj23.is_nil() and self.valid_round < vote.round <= self.round:
+                if (
+                    self.proposal_block is not None
+                    and self.proposal_block.hash() == maj23.hash
+                ):
+                    self.valid_round = vote.round
+                    self.valid_block = self.proposal_block
+                    self.valid_block_parts = self.proposal_block_parts
+                elif self.proposal_block_parts is None or not (
+                    self.proposal_block_parts.has_header(
+                        maj23.part_set_header
+                    )
+                ):
+                    # polka for a block we don't have: start fetching it
+                    self.proposal_block = None
+                    self.proposal_block_parts = PartSet(
+                        maj23.part_set_header
+                    )
+
+        if self.round < vote.round and prevotes.has_two_thirds_any():
+            self._enter_new_round(self.height, vote.round)
+        elif self.round == vote.round and self.step >= STEP_PREVOTE:
+            if maj23 is not None and (
+                self._is_proposal_complete() or maj23.is_nil()
+            ):
+                self._enter_precommit(self.height, vote.round)
+            elif prevotes.has_two_thirds_any() and self.step == STEP_PREVOTE:
+                self._enter_prevote_wait(self.height, vote.round)
+        elif (
+            self.proposal is not None
+            and 0 <= self.proposal.pol_round == vote.round
+        ):
+            if self._is_proposal_complete():
+                self._enter_prevote(self.height, self.round)
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        precommits = self.votes.precommits(vote.round)
+        maj23 = precommits.two_thirds_majority()
+        if maj23 is not None:
+            self._enter_new_round(self.height, vote.round)
+            self._enter_precommit(self.height, vote.round)
+            if not maj23.is_nil():
+                self._enter_commit(self.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self._enter_new_round(self.height, 0)
+            else:
+                self._enter_precommit_wait(self.height, vote.round)
+        elif self.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(self.height, vote.round)
+            self._enter_precommit_wait(self.height, vote.round)
+
+    def _sign_vote(self, vote_type: int, block: Block | None) -> Vote | None:
+        if self.priv_validator is None:
+            return None
+        addr = self.priv_validator.address
+        idx, _ = self.validators.get_by_address(addr)
+        if idx < 0:
+            return None  # not a validator this height
+        if block is None:
+            block_id = BlockID()
+        else:
+            parts = (
+                self.proposal_block_parts
+                if self.proposal_block is block
+                else (
+                    self.locked_block_parts
+                    if self.locked_block is block
+                    else None
+                )
+            )
+            if parts is None:
+                parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+            block_id = BlockID(
+                hash=block.hash(), part_set_header=parts.header
+            )
+        vote = Vote(
+            type=vote_type,
+            height=self.height,
+            round=self.round,
+            block_id=block_id,
+            timestamp_ns=max(now_ns(), self.state.last_block_time_ns + 1),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        ext_enabled = self.state.consensus_params.vote_extensions_enabled(
+            self.height
+        )
+        if ext_enabled and vote_type == PRECOMMIT_TYPE and block is not None:
+            resp = self.block_exec.proxy_app.extend_vote(
+                ExtendVoteRequest(
+                    hash=block_id.hash,
+                    height=self.height,
+                    round=self.round,
+                )
+            )
+            vote = replace(vote, extension=resp.vote_extension)
+        try:
+            return self.priv_validator.sign_vote(
+                self.state.chain_id,
+                vote,
+                with_extension=ext_enabled and vote_type == PRECOMMIT_TYPE,
+            )
+        except Exception as exc:
+            self.logger.error("failed signing vote", err=repr(exc))
+            return None
+
+    def _sign_add_vote(self, vote_type: int, block: Block | None) -> None:
+        vote = self._sign_vote(vote_type, block)
+        if vote is not None:
+            self._send_internal(VoteMessage(vote))

@@ -1,1 +1,219 @@
-"""Package placeholder — populated as layers land."""
+"""Node — the composition root (reference: node/node.go:280-645).
+
+Wires DBs → state → proxy app → event bus → privval → handshake/replay
+→ mempool → block executor → WAL → consensus, in the reference's
+startup order.  The p2p switch, sync reactors, and RPC server attach
+here as those planes land (node/node.go:320-569).
+"""
+
+from __future__ import annotations
+
+import os
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.types import Application
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus import ConsensusState, Handshaker
+from cometbft_tpu.mempool import (
+    CListMempool,
+    NopMempool,
+    post_check_max_gas,
+    pre_check_max_bytes,
+)
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.state import (
+    Store as StateStore,
+    load_state_from_db_or_genesis,
+)
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.event_bus import EventBus
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.utils.db import open_db
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.wal import WAL, NopWAL
+
+
+class NodeError(Exception):
+    pass
+
+
+def init_files(config: Config, chain_id: str = "") -> GenesisDoc:
+    """``cometbft init`` — write privval key/state and a
+    single-validator genesis (cmd/cometbft/commands/init.go)."""
+    config.ensure_dirs()
+    pv = FilePV.load_or_generate(
+        config.priv_validator_key_path, config.priv_validator_state_path
+    )
+    pv.save()
+    gen_path = config.genesis_path
+    if os.path.exists(gen_path):
+        return GenesisDoc.from_file(gen_path)
+    gen = GenesisDoc(
+        chain_id=chain_id or f"test-chain-{os.urandom(3).hex()}",
+        genesis_time_ns=now_ns(),
+        validators=(GenesisValidator(pv.pub_key, 10),),
+    )
+    gen.save_as(gen_path)
+    config.save()
+    return gen
+
+
+def default_app(config: Config) -> Application:
+    """Resolve config.base.proxy_app to a builtin app (node/setup.go
+    DefaultNewNode's kvstore shortcut)."""
+    name = config.base.proxy_app
+    if name == "kvstore":
+        return KVStoreApp()
+    if name == "noop":
+        return Application()
+    raise NodeError(f"unknown builtin app {name!r}")
+
+
+class Node(BaseService):
+    """(node/node.go Node)"""
+
+    def __init__(
+        self,
+        config: Config,
+        app: Application | None = None,
+        genesis: GenesisDoc | None = None,
+        priv_validator: FilePV | None = None,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="node",
+            logger=logger or default_logger().with_fields(module="node"),
+        )
+        config.validate_basic()
+        self.config = config
+
+        # 1. stores (node/node.go:320 initDBs)
+        backend = config.base.db_backend
+        db_dir = config.db_dir
+        self.block_store_db = open_db("blockstore", backend, db_dir)
+        self.state_db = open_db("state", backend, db_dir)
+        self.block_store = BlockStore(self.block_store_db)
+        self.state_store = StateStore(self.state_db)
+
+        # 2. genesis + state (node.go:329)
+        if genesis is None:
+            genesis = GenesisDoc.from_file(config.genesis_path)
+        self.genesis = genesis
+        state = load_state_from_db_or_genesis(self.state_store, genesis)
+
+        # 3. proxy app (setup.go:172)
+        self.app = app if app is not None else default_app(config)
+        self.proxy_app = AppConns(local_client_creator(self.app))
+
+        # 4. event bus (setup.go:181)
+        self.event_bus = EventBus()
+
+        # 5. privval (setup.go:698)
+        if priv_validator is None and os.path.exists(
+            config.priv_validator_key_path
+        ):
+            priv_validator = FilePV.load(
+                config.priv_validator_key_path,
+                config.priv_validator_state_path,
+            )
+        self.priv_validator = priv_validator
+
+        # 6. handshake happens at start (doHandshake, setup.go:222)
+        self._pre_handshake_state = state
+        self.state = state
+
+        # 7. mempool (setup.go:277)
+        if config.mempool.type == "nop":
+            self.mempool = NopMempool()
+        else:
+            self.mempool = CListMempool(
+                self.proxy_app.mempool,
+                height=state.last_block_height,
+                size=config.mempool.size,
+                max_tx_bytes=config.mempool.max_tx_bytes,
+                max_txs_bytes=config.mempool.max_txs_bytes,
+                cache_size=config.mempool.cache_size,
+                keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
+                recheck=config.mempool.recheck,
+            )
+
+        # 8. block executor (node.go:447)
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            self.mempool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+            logger=self.logger.with_fields(module="executor"),
+        )
+
+        # 9. WAL + consensus (setup.go:369).  memdb nodes are ephemeral
+        # (tests): give them a no-op WAL.
+        if config.base.db_backend == "memdb":
+            self.wal = NopWAL()
+        else:
+            self.wal = WAL(config.wal_path)
+        self.consensus = ConsensusState(
+            config.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            priv_validator=self.priv_validator,
+            event_bus=self.event_bus,
+            wal=self.wal,
+            logger=self.logger.with_fields(module="consensus"),
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def on_start(self) -> None:
+        """(node/node.go:580 OnStart)"""
+        self.proxy_app.start()
+        self.event_bus.start()
+
+        # crash recovery: three-way height reconciliation (setup.go:222)
+        hs = Handshaker(
+            self.state_store,
+            self._pre_handshake_state,
+            self.block_store,
+            self.genesis,
+            logger=self.logger.with_fields(module="handshake"),
+        )
+        self.state = hs.handshake(self.proxy_app)
+        self.consensus.state = self.state
+        self.consensus._update_to_state(self.state)
+
+        if isinstance(self.mempool, CListMempool):
+            max_bytes = self.state.consensus_params.block.max_bytes
+            self.mempool.pre_check = pre_check_max_bytes(
+                max_bytes if max_bytes > 0 else 104857600
+            )
+            self.mempool.post_check = post_check_max_gas(
+                self.state.consensus_params.block.max_gas
+            )
+
+        if isinstance(self.wal, WAL):
+            self.wal.start()
+        self.consensus.start()
+
+    def on_stop(self) -> None:
+        for svc in (self.consensus, self.event_bus, self.proxy_app):
+            try:
+                if svc.is_running():
+                    svc.stop()
+            except Exception as exc:  # noqa: BLE001 — best-effort teardown
+                self.logger.error("error stopping service", err=repr(exc))
+        self.block_store_db.close()
+        self.state_db.close()
+
+    # -- convenience -----------------------------------------------------
+
+    def height(self) -> int:
+        return self.block_store.height()
+
+
+__all__ = ["Node", "NodeError", "default_app", "init_files"]

@@ -141,12 +141,26 @@ def verify_arrays(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     return np.asarray(out)[:n]
 
 
+#: Below this batch size the host verifier is faster than a device
+#: launch (fixed dispatch cost + one-time XLA compile per shape); the
+#: device path wins from dozens of signatures up to the 10k-validator
+#: north star. Overridable for benchmarking via CMT_TPU_DEVICE_MIN_BATCH.
+DEVICE_MIN_BATCH = 64
+
+
 class TpuBatchVerifier(BatchVerifier):
     """BatchVerifier provider backed by the device kernel
     (the reference's crypto/ed25519/ed25519.go:190 BatchVerifier slot).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, device_min_batch: int | None = None) -> None:
+        import os
+
+        if device_min_batch is None:
+            device_min_batch = int(
+                os.environ.get("CMT_TPU_DEVICE_MIN_BATCH", DEVICE_MIN_BATCH)
+            )
+        self._device_min_batch = device_min_batch
         self._pubs: list[bytes] = []
         self._msgs: list[bytes] = []
         self._sigs: list[bytes] = []
@@ -167,7 +181,7 @@ class TpuBatchVerifier(BatchVerifier):
         n = len(self._pubs)
         if n == 0:
             return False, []
-        if max(len(m) for m in self._msgs) > _BUCKETS[-1]:
+        if n < self._device_min_batch or max(len(m) for m in self._msgs) > _BUCKETS[-1]:
             # Messages beyond the largest device bucket: honor the
             # BatchVerifier contract via the host fallback instead of
             # raising mid-verify.

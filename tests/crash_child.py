@@ -1,0 +1,54 @@
+"""Subprocess target for the crash-matrix test (reference analog:
+internal/consensus/replay_test.go + internal/fail).
+
+Runs a real node over sqlite stores with a PERSISTENT kvstore app; with
+FAIL_TEST_INDEX set, one of BlockExecutor.apply_block's fail points
+hard-exits mid-persistence, simulating kill -9 at that exact point.
+
+Usage: python -m tests.crash_child <home> <target_height>
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.config import test_config
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.node import Node
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.utils.db import SQLiteDB
+
+
+def main() -> None:
+    home, target = sys.argv[1], int(sys.argv[2])
+    cfg = test_config(home)
+    cfg.base.db_backend = "sqlite"
+    cfg.ensure_dirs()
+    priv = FilePV(
+        ed.priv_key_from_secret(b"crash-v0"),
+        cfg.priv_validator_key_path,
+        cfg.priv_validator_state_path,
+    )
+    priv.save()
+    gen = GenesisDoc(
+        chain_id="crash-chain",
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=(GenesisValidator(priv.pub_key, 10),),
+    )
+    app = KVStoreApp(SQLiteDB(os.path.join(home, "data", "app.db")))
+    node = Node(cfg, app=app, genesis=gen, priv_validator=priv)
+    node.start()
+    node.mempool.check_tx(b"crash=test")
+    deadline = time.time() + 60
+    while node.height() < target and time.time() < deadline:
+        time.sleep(0.02)
+    node.stop()
+    sys.exit(0 if node.height() >= target else 3)
+
+
+if __name__ == "__main__":
+    main()

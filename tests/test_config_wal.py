@@ -1,0 +1,190 @@
+"""Config round-trip + WAL/autofile tests (reference analogs:
+config/config_test.go, internal/consensus/wal_test.go,
+internal/autofile/group_test.go)."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.config import (
+    Config,
+    ConfigError,
+    default_config,
+    format_duration_ns,
+    parse_duration_ns,
+    test_config as make_test_config,
+)
+from cometbft_tpu.wal import (
+    KIND_END_HEIGHT,
+    KIND_MSG_INFO,
+    WAL,
+    WALRecord,
+    decode_records,
+    encode_record,
+)
+from cometbft_tpu.wal.autofile import Group
+
+
+class TestDurations:
+    def test_parse(self):
+        assert parse_duration_ns("3s") == 3 * 10**9
+        assert parse_duration_ns("500ms") == 500 * 10**6
+        assert parse_duration_ns("1m30s") == 90 * 10**9
+        assert parse_duration_ns("1.5s") == 1_500_000_000
+        assert parse_duration_ns("0") == 0
+
+    def test_parse_invalid(self):
+        with pytest.raises(ConfigError):
+            parse_duration_ns("3 parsecs")
+        with pytest.raises(ConfigError):
+            parse_duration_ns("s3")
+
+    def test_format_roundtrip(self):
+        for ns in (0, 1, 10**6, 3 * 10**9, 90 * 10**9, 505_000_000):
+            assert parse_duration_ns(format_duration_ns(ns)) == ns
+
+
+class TestConfig:
+    def test_defaults_valid(self):
+        default_config().validate_basic()
+        make_test_config().validate_basic()
+
+    def test_toml_roundtrip(self):
+        cfg = default_config()
+        cfg.base.moniker = "alice"
+        cfg.consensus.timeout_propose_ns = 7 * 10**9
+        cfg.p2p.persistent_peers = "id@1.2.3.4:26656"
+        cfg.statesync.rpc_servers = ("a:26657", "b:26657")
+        rt = Config.from_toml(cfg.to_toml())
+        assert rt.base.moniker == "alice"
+        assert rt.consensus.timeout_propose_ns == 7 * 10**9
+        assert rt.p2p.persistent_peers == "id@1.2.3.4:26656"
+        assert rt.statesync.rpc_servers == ("a:26657", "b:26657")
+
+    def test_save_load(self, tmp_path):
+        cfg = default_config(str(tmp_path))
+        cfg.base.moniker = "bob"
+        cfg.ensure_dirs()
+        cfg.save()
+        loaded = Config.load(str(tmp_path))
+        assert loaded.base.moniker == "bob"
+        assert loaded.base.home == str(tmp_path)
+
+    def test_validation_rejects(self):
+        cfg = default_config()
+        cfg.base.abci = "carrier-pigeon"
+        with pytest.raises(ConfigError):
+            cfg.validate_basic()
+        cfg = default_config()
+        cfg.statesync.enable = True
+        with pytest.raises(ConfigError):
+            cfg.validate_basic()
+
+    def test_paths(self, tmp_path):
+        cfg = default_config(str(tmp_path))
+        assert cfg.wal_path.startswith(str(tmp_path))
+        assert cfg.genesis_path.endswith("genesis.json")
+
+    def test_timeout_escalation(self):
+        c = default_config().consensus
+        assert c.propose_timeout_ns(0) == 3 * 10**9
+        assert c.propose_timeout_ns(2) == 4 * 10**9
+
+
+class TestAutofile:
+    def test_write_read(self, tmp_path):
+        g = Group(str(tmp_path / "wal"))
+        g.write(b"hello ")
+        g.write(b"world")
+        assert g.read_all() == b"hello world"
+        g.close()
+
+    def test_rotation(self, tmp_path):
+        g = Group(str(tmp_path / "wal"), head_size_limit=10)
+        g.write(b"0123456789AB")
+        assert g.maybe_rotate()
+        g.write(b"tail")
+        assert g.read_all() == b"0123456789ABtail"
+        assert os.path.exists(str(tmp_path / "wal.000"))
+        g.close()
+        # reopen picks up rotated chunks
+        g2 = Group(str(tmp_path / "wal"), head_size_limit=10)
+        assert g2.read_all() == b"0123456789ABtail"
+        g2.close()
+
+    def test_total_size_pruning(self, tmp_path):
+        g = Group(
+            str(tmp_path / "wal"), head_size_limit=8, total_size_limit=20
+        )
+        for i in range(6):
+            g.write(b"%08d" % i)
+            g.maybe_rotate()
+        data = g.read_all()
+        assert len(data) <= 24  # oldest chunks pruned
+        assert data.endswith(b"00000005")
+        g.close()
+
+
+class TestWALCodec:
+    def test_record_roundtrip(self):
+        rec = WALRecord(time_ns=123456789, kind=KIND_MSG_INFO, data=b"payload")
+        out = decode_records(encode_record(rec))
+        assert out == [rec]
+
+    def test_torn_tail_tolerated(self):
+        good = encode_record(WALRecord(1, KIND_MSG_INFO, b"a"))
+        torn = encode_record(WALRecord(2, KIND_MSG_INFO, b"b"))[:-3]
+        out = decode_records(good + torn)
+        assert len(out) == 1 and out[0].data == b"a"
+
+    def test_mid_stream_corruption_raises(self):
+        from cometbft_tpu.wal import WALCorruptionError
+
+        a = bytearray(encode_record(WALRecord(1, KIND_MSG_INFO, b"abcdef")))
+        b = encode_record(WALRecord(2, KIND_MSG_INFO, b"b"))
+        a[10] ^= 0xFF  # corrupt payload of first record
+        with pytest.raises(WALCorruptionError):
+            decode_records(bytes(a) + b)
+
+
+class TestWAL:
+    def test_write_search_end_height(self, tmp_path):
+        wal = WAL(str(tmp_path / "cs.wal" / "wal"))
+        wal.start()
+        wal.write(KIND_MSG_INFO, b"h1-msg1")
+        wal.write_sync(KIND_MSG_INFO, b"h1-msg2")
+        wal.write_end_height(1)
+        wal.write(KIND_MSG_INFO, b"h2-msg1")
+        wal.write(KIND_MSG_INFO, b"h2-msg2")
+
+        tail = wal.search_for_end_height(1)
+        assert [r.data for r in tail] == [b"h2-msg1", b"h2-msg2"]
+        assert wal.search_for_end_height(99) is None
+        wal.stop()
+
+    def test_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "cs.wal" / "wal")
+        wal = WAL(path)
+        wal.start()
+        wal.write_end_height(5)
+        wal.write(KIND_MSG_INFO, b"inflight")
+        wal.stop()
+
+        wal2 = WAL(path)
+        wal2.start()
+        tail = wal2.search_for_end_height(5)
+        assert [r.data for r in tail] == [b"inflight"]
+        wal2.stop()
+
+    def test_end_height_records(self, tmp_path):
+        wal = WAL(str(tmp_path / "wal"))
+        wal.start()
+        for h in range(1, 4):
+            wal.write_end_height(h)
+        recs = wal.records()
+        assert [r.end_height for r in recs if r.kind == KIND_END_HEIGHT] == [
+            1,
+            2,
+            3,
+        ]
+        wal.stop()

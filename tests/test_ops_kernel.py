@@ -144,7 +144,7 @@ class TestScalarModL:
 
 class TestBatchVerifyKernel:
     def test_crafted_cases(self):
-        bv = TpuBatchVerifier()
+        bv = TpuBatchVerifier(device_min_batch=0)
         expected = []
         privs = [ed.gen_priv_key() for _ in range(6)]
         for i, priv in enumerate(privs):
@@ -175,7 +175,7 @@ class TestBatchVerifyKernel:
         assert ok == all(expected)
 
     def test_differential_fuzz_vs_oracle(self, rng):
-        bv = TpuBatchVerifier()
+        bv = TpuBatchVerifier(device_min_batch=0)
         oracle = []
         for _ in range(24):
             priv = ed.gen_priv_key()
@@ -195,7 +195,7 @@ class TestBatchVerifyKernel:
         assert results == oracle
 
     def test_empty_batch(self):
-        ok, results = TpuBatchVerifier().verify()
+        ok, results = TpuBatchVerifier(device_min_batch=0).verify()
         assert not ok and results == []
 
     def test_cpu_and_tpu_verifiers_agree(self):
@@ -203,7 +203,7 @@ class TestBatchVerifyKernel:
         m = b"agreement"
         sig = priv.sign(m)
         for cls in (ed.CpuBatchVerifier, TpuBatchVerifier):
-            bv = cls()
+            bv = cls() if cls is ed.CpuBatchVerifier else cls(device_min_batch=0)
             bv.add(priv.pub_key(), m, sig)
             bv.add(priv.pub_key(), m + b"?", sig)
             ok, res = bv.verify()
