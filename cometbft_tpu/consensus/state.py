@@ -918,8 +918,13 @@ class ConsensusState(BaseService):
             and self.state.consensus_params.vote_extensions_enabled(
                 self.height
             )
-            and self.priv_validator is not None
-            and vote.validator_address != self.priv_validator.address
+            # verify every validator's extension except our own — on a
+            # non-validator node (no priv_validator) that means ALL of
+            # them (state.go addVote: myAddr is empty for observers)
+            and (
+                self.priv_validator is None
+                or vote.validator_address != self.priv_validator.address
+            )
         ):
             resp = self.block_exec.proxy_app.verify_vote_extension(
                 VerifyVoteExtensionRequest(

@@ -1,1 +1,41 @@
-"""Package placeholder — populated as layers land."""
+"""p2p plane — the distributed communication backend (reference: p2p/).
+
+Stack, bottom-up (SURVEY.md §5 "Distributed communication backend"):
+TCP → SecretConnection (X25519 + ChaCha20-Poly1305 authenticated
+encryption) → MConnection (priority channel multiplexing, flow
+control) → Switch (reactor fan-out, peer lifecycle) → PEX/addrbook.
+"""
+
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn import (
+    ChannelDescriptor,
+    MConnConfig,
+    MConnection,
+    SecretConnection,
+)
+from cometbft_tpu.p2p.key import NodeKey, pub_key_to_id
+from cometbft_tpu.p2p.netaddr import NetAddress, parse_peer_list
+from cometbft_tpu.p2p.node_info import NodeInfo, ProtocolVersion
+from cometbft_tpu.p2p.peer import Peer, PeerSet
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport, RejectedError
+
+__all__ = [
+    "ChannelDescriptor",
+    "Envelope",
+    "MConnConfig",
+    "MConnection",
+    "MultiplexTransport",
+    "NetAddress",
+    "NodeInfo",
+    "NodeKey",
+    "Peer",
+    "PeerSet",
+    "ProtocolVersion",
+    "Reactor",
+    "RejectedError",
+    "SecretConnection",
+    "Switch",
+    "parse_peer_list",
+    "pub_key_to_id",
+]

@@ -1,0 +1,380 @@
+"""Multiplexed connection (reference: p2p/conn/connection.go:80 MConnection).
+
+One send thread + one recv thread per connection.  Outbound messages
+are chunked into packets (max 1024-byte payload) and queued per
+channel; the send thread drains channels by priority — picking the
+channel with the lowest recently-sent/priority ratio, exactly the
+reference's ``selectChannelToGossipOn`` discipline
+(connection.go:549 sendPacketMsg).  Ping/pong keepalive, a 10 ms flush
+throttle, and flowrate send/recv limits (connection.go:27-48) round out
+the capability set.
+
+Wire format: length-prefixed protobuf ``Packet`` envelopes
+(proto/cometbft/p2p/v1/conn.proto) — oneof ping/pong/msg{channel, eof,
+data}.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from cometbft_tpu.utils.flowrate import Monitor
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.protoio import (
+    ProtoReader,
+    ProtoWriter,
+    encode_uvarint,
+    read_uvarint_from,
+)
+from cometbft_tpu.utils.service import BaseService
+
+MAX_PACKET_PAYLOAD = 1024          # connection.go defaultMaxPacketMsgPayloadSize
+FLUSH_THROTTLE = 0.010             # connection.go:43 flushThrottle 10ms
+PING_INTERVAL = 10.0               # connection.go pingTimeout (shortened default 60s→10s keepalive cadence)
+PONG_TIMEOUT = 45.0                # connection.go:46 defaultPongTimeout
+SEND_RATE = 5_120_000              # config/config.go SendRate 5.12 MB/s
+RECV_RATE = 5_120_000
+MAX_PACKET_OVERHEAD = 256          # framing + proto tag slack over max payload
+
+
+class MConnError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class ChannelDescriptor:
+    """(connection.go:612 ChannelDescriptor)"""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 64
+    recv_message_capacity: int = 22020096  # 21MB (consensus max msg)
+
+
+@dataclass
+class MConnConfig:
+    """(connection.go:117 MConnConfig)"""
+
+    send_rate: int = SEND_RATE
+    recv_rate: int = RECV_RATE
+    max_packet_msg_payload_size: int = MAX_PACKET_PAYLOAD
+    flush_throttle: float = FLUSH_THROTTLE
+    ping_interval: float = PING_INTERVAL
+    pong_timeout: float = PONG_TIMEOUT
+
+
+# -- packet wire format -------------------------------------------------
+
+_F_PING, _F_PONG, _F_MSG = 1, 2, 3
+
+
+def encode_packet_ping() -> bytes:
+    w = ProtoWriter()
+    w.message(_F_PING, b"")
+    return w.finish()
+
+
+def encode_packet_pong() -> bytes:
+    w = ProtoWriter()
+    w.message(_F_PONG, b"")
+    return w.finish()
+
+
+def encode_packet_msg(channel_id: int, eof: bool, data: bytes) -> bytes:
+    m = ProtoWriter()
+    m.varint(1, channel_id)
+    m.bool_(2, eof)
+    m.bytes_(3, data)
+    w = ProtoWriter()
+    w.message(_F_MSG, m.finish())
+    return w.finish()
+
+
+def decode_packet(data: bytes):
+    """Returns ('ping',), ('pong',) or ('msg', channel_id, eof, payload)."""
+    f = ProtoReader(data).to_dict()
+    if _F_PING in f:
+        return ("ping",)
+    if _F_PONG in f:
+        return ("pong",)
+    if _F_MSG in f:
+        m = ProtoReader(bytes(f[_F_MSG][0])).to_dict()
+        return (
+            "msg",
+            int(m.get(1, [0])[0]),
+            bool(m.get(2, [0])[0]),
+            bytes(m.get(3, [b""])[0]),
+        )
+    raise MConnError("unknown packet")
+
+
+class _Channel:
+    """(connection.go:640 channel) — send queue + recv reassembly."""
+
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: queue.Queue[bytes] = queue.Queue(
+            desc.send_queue_capacity
+        )
+        self.sending: bytes | None = None  # message currently being chunked
+        self.sent_pos = 0
+        self.recently_sent = 0  # decayed by send routine
+        self.recving = bytearray()
+
+    def is_send_pending(self) -> bool:
+        return self.sending is not None or not self.send_queue.empty()
+
+    def next_packet(self, max_payload: int) -> tuple[bool, bytes]:
+        """Pop the next chunk of the in-flight message -> (eof, data)."""
+        if self.sending is None:
+            self.sending = self.send_queue.get_nowait()
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos : self.sent_pos + max_payload]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.sent_pos = 0
+        return eof, chunk
+
+
+class MConnection(BaseService):
+    """(connection.go:80 MConnection)
+
+    ``conn`` needs write(bytes)/read_exact(n)/close().  ``on_receive``
+    is called from the recv thread as ``on_receive(ch_id, msg_bytes)``;
+    ``on_error`` is called once when the connection dies.
+    """
+
+    def __init__(
+        self,
+        conn,
+        channels: list[ChannelDescriptor],
+        on_receive,
+        on_error=None,
+        config: MConnConfig | None = None,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="mconn", logger=logger or default_logger().with_fields(module="mconn")
+        )
+        self.conn = conn
+        self.config = config or MConnConfig()
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self.channels: dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channels
+        }
+        self._send_signal = threading.Event()
+        self._last_pong = time.monotonic()
+        self._send_monitor = Monitor()
+        self._recv_monitor = Monitor()
+        self._send_thread: threading.Thread | None = None
+        self._recv_thread: threading.Thread | None = None
+        self._ping_thread: threading.Thread | None = None
+        self._errored = threading.Event()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._send_thread = threading.Thread(
+            target=self._send_routine, name="mconn-send", daemon=True
+        )
+        self._recv_thread = threading.Thread(
+            target=self._recv_routine, name="mconn-recv", daemon=True
+        )
+        self._ping_thread = threading.Thread(
+            target=self._ping_routine, name="mconn-ping", daemon=True
+        )
+        self._send_thread.start()
+        self._recv_thread.start()
+        self._ping_thread.start()
+
+    def on_stop(self) -> None:
+        self._send_monitor.done()
+        self._recv_monitor.done()
+        self._send_signal.set()
+        self.conn.close()
+
+    def _stop_for_error(self, err: Exception) -> None:
+        if self._errored.is_set():
+            return
+        self._errored.set()
+        self.logger.debug("connection error", err=repr(err))
+        try:
+            if self.is_running():
+                self.stop()
+        except Exception:
+            pass
+        if self.on_error is not None:
+            self.on_error(err)
+
+    # -- sending (connection.go:320 Send) -------------------------------
+
+    def send(self, ch_id: int, msg: bytes, timeout: float | None = 10.0) -> bool:
+        """Queue ``msg`` on channel; blocks up to ``timeout`` if full."""
+        ch = self.channels.get(ch_id)
+        if ch is None:
+            raise MConnError(f"unknown channel {ch_id:#x}")
+        if not self.is_running():
+            return False
+        try:
+            ch.send_queue.put(msg, timeout=timeout)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        """Non-blocking send (connection.go:356 TrySend)."""
+        ch = self.channels.get(ch_id)
+        if ch is None:
+            raise MConnError(f"unknown channel {ch_id:#x}")
+        if not self.is_running():
+            return False
+        try:
+            ch.send_queue.put_nowait(msg)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def _select_channel(self) -> _Channel | None:
+        """Lowest recently-sent/priority ratio wins (connection.go:549)."""
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / ch.desc.priority
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    def _send_routine(self) -> None:
+        cfg = self.config
+        buf = bytearray()
+        last_flush = time.monotonic()
+        try:
+            while not self._quit.is_set():
+                ch = self._select_channel()
+                if ch is None:
+                    # flush whatever is buffered, then wait for work
+                    if buf:
+                        self._flush(buf)
+                        buf.clear()
+                    fired = self._send_signal.wait(timeout=0.05)
+                    if fired:
+                        self._send_signal.clear()
+                    self._decay_recently_sent()
+                    continue
+                eof, chunk = ch.next_packet(cfg.max_packet_msg_payload_size)
+                pkt = encode_packet_msg(ch.desc.id, eof, chunk)
+                framed = encode_uvarint(len(pkt)) + pkt
+                buf += framed
+                ch.recently_sent += len(framed)
+                self._send_monitor.limit(len(framed), cfg.send_rate)
+                self._send_monitor.update(len(framed))
+                now = time.monotonic()
+                # flush on throttle expiry or when buffer is large
+                if now - last_flush >= cfg.flush_throttle or len(buf) >= 65536:
+                    self._flush(buf)
+                    buf.clear()
+                    last_flush = now
+        except Exception as exc:  # noqa: BLE001 — any I/O error kills the conn
+            self._stop_for_error(exc)
+
+    def _flush(self, buf: bytearray) -> None:
+        if buf:
+            self.conn.write(bytes(buf))
+
+    def _decay_recently_sent(self) -> None:
+        for ch in self.channels.values():
+            ch.recently_sent = int(ch.recently_sent * 0.8)
+
+    def send_ping(self) -> None:
+        pkt = encode_packet_ping()
+        self.conn.write(encode_uvarint(len(pkt)) + pkt)
+
+    def _send_pong(self) -> None:
+        pkt = encode_packet_pong()
+        self.conn.write(encode_uvarint(len(pkt)) + pkt)
+
+    def _ping_routine(self) -> None:
+        cfg = self.config
+        while not self._quit.wait(cfg.ping_interval):
+            try:
+                self.send_ping()
+            except Exception as exc:  # noqa: BLE001
+                self._stop_for_error(exc)
+                return
+            if time.monotonic() - self._last_pong > cfg.pong_timeout:
+                self._stop_for_error(MConnError("pong timeout"))
+                return
+
+    # -- receiving (connection.go:590 recvRoutine) ----------------------
+
+    def _recv_routine(self) -> None:
+        cfg = self.config
+        max_len = cfg.max_packet_msg_payload_size + MAX_PACKET_OVERHEAD
+        try:
+            while not self._quit.is_set():
+                try:
+                    length = read_uvarint_from(
+                        self.conn.read_exact, max_value=max_len
+                    )
+                except ValueError as exc:
+                    raise MConnError(f"packet length: {exc}") from exc
+                data = self.conn.read_exact(length)
+                self._recv_monitor.limit(length, cfg.recv_rate)
+                self._recv_monitor.update(length)
+                pkt = decode_packet(data)
+                if pkt[0] == "ping":
+                    self._send_pong()
+                elif pkt[0] == "pong":
+                    self._last_pong = time.monotonic()
+                else:
+                    _, ch_id, eof, payload = pkt
+                    ch = self.channels.get(ch_id)
+                    if ch is None:
+                        raise MConnError(f"peer sent unknown channel {ch_id:#x}")
+                    ch.recving += payload
+                    if len(ch.recving) > ch.desc.recv_message_capacity:
+                        raise MConnError(
+                            f"recv msg exceeds capacity on {ch_id:#x}"
+                        )
+                    if eof:
+                        msg = bytes(ch.recving)
+                        ch.recving.clear()
+                        self.on_receive(ch_id, msg)
+        except Exception as exc:  # noqa: BLE001
+            self._stop_for_error(exc)
+
+    # -- introspection --------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "send": self._send_monitor.status(),
+            "recv": self._recv_monitor.status(),
+            "channels": [
+                {
+                    "id": ch.desc.id,
+                    "priority": ch.desc.priority,
+                    "recently_sent": ch.recently_sent,
+                    "send_queue_size": ch.send_queue.qsize(),
+                }
+                for ch in self.channels.values()
+            ],
+        }
+
+
+__all__ = [
+    "MConnection",
+    "MConnConfig",
+    "MConnError",
+    "ChannelDescriptor",
+    "encode_packet_msg",
+    "decode_packet",
+]

@@ -1,0 +1,218 @@
+"""Authenticated encrypted connection (reference: p2p/conn/secret_connection.go).
+
+Same capability as the reference's Station-to-Station construction
+(secret_connection.go:33-50): an ephemeral X25519 Diffie-Hellman
+exchange establishes forward-secret symmetric keys; each side then
+signs the handshake transcript with its long-lived ed25519 node key to
+authenticate; all subsequent traffic flows in fixed-size
+ChaCha20-Poly1305-sealed frames so ciphertext length leaks nothing
+beyond throughput.
+
+Design differences from the reference (new wire format, same
+guarantees): key derivation is HKDF-SHA256 over the DH secret bound to
+both ephemeral pubkeys (the reference uses a Merlin transcript —
+secret_connection.go:88-151); the challenge each side signs is the HKDF
+transcript hash.  Frames are 1024 data bytes + 4-byte length, sealed
+with a 12-byte little-endian counter nonce exactly like the reference
+(secret_connection.go:45-50, ``totalFrameSize``/``aeadNonceSize``).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.exceptions import InvalidTag
+
+from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+
+DATA_LEN_SIZE = 4          # secret_connection.go:40 dataLenSize
+DATA_MAX_SIZE = 1024       # secret_connection.go:41 dataMaxSize
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE  # 1028
+TAG_SIZE = 16              # poly1305 tag
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE
+NONCE_SIZE = 12
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+class AuthError(SecretConnectionError):
+    pass
+
+
+def _hkdf(secret: bytes, info: bytes, length: int = 96) -> bytes:
+    """HKDF-SHA256 (RFC 5869); replaces the reference's Merlin
+    transcript KDF (secret_connection.go:88)."""
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    return HKDF(
+        algorithm=SHA256(), length=length, salt=None, info=info
+    ).derive(secret)
+
+
+class _Nonce:
+    """96-bit little-endian counter nonce (secret_connection.go:47)."""
+
+    def __init__(self) -> None:
+        self._counter = 0
+
+    def next(self) -> bytes:
+        n = self._counter
+        self._counter += 1
+        if n >= 1 << 64:
+            raise SecretConnectionError("nonce counter overflow")
+        return b"\x00\x00\x00\x00" + struct.pack("<Q", n)
+
+
+class SecretConnection:
+    """(secret_connection.go:60 SecretConnection)
+
+    Wraps a socket-like object exposing ``sendall``/``recv``/``close``.
+    ``remote_pubkey`` is the peer's authenticated ed25519 node key.
+    """
+
+    def __init__(self, sock, priv_key: Ed25519PrivKey):
+        self._sock = sock
+        self._send_mtx = threading.Lock()
+        self._recv_mtx = threading.Lock()
+        self._recv_buf = b""
+        self.remote_pubkey: Ed25519PubKey | None = None
+
+        # -- handshake (secret_connection.go:88 MakeSecretConnection) --
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+        self._sock.sendall(eph_pub)
+        their_eph = self._read_exact(32)
+
+        # sort to give both sides the same transcript (secret_connection.go:104)
+        lo, hi = sorted((eph_pub, their_eph))
+        we_are_lo = eph_pub == lo
+        dh = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        if dh == b"\x00" * 32:
+            raise SecretConnectionError("zero shared secret (low-order point)")
+
+        material = _hkdf(dh, b"COMETBFT_TPU_SECRET_CONNECTION" + lo + hi, 96)
+        # lo-side sends with key[0:32], hi-side with key[32:64]
+        # (mirrors recvSecret/sendSecret split, secret_connection.go:120)
+        if we_are_lo:
+            send_key, recv_key = material[0:32], material[32:64]
+        else:
+            send_key, recv_key = material[32:64], material[0:32]
+        challenge = material[64:96]
+
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+
+        # -- authenticate (secret_connection.go:151 shareAuthSignature) --
+        pub = priv_key.pub_key()
+        sig = priv_key.sign(challenge)
+        self.write(pub.bytes() + sig)
+        try:
+            auth = self.read_exact(96)  # buffers any coalesced overrun back
+        except SecretConnectionError as exc:
+            raise AuthError("peer closed during auth handshake") from exc
+        their_pub = Ed25519PubKey(auth[:32])
+        their_sig = auth[32:96]
+        if not their_pub.verify_signature(challenge, their_sig):
+            raise AuthError("peer failed challenge signature")
+        self.remote_pubkey = their_pub
+
+    # -- framed I/O (secret_connection.go:210 Write / :250 Read) --------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise SecretConnectionError("connection closed")
+            buf += chunk
+        return buf
+
+    def write(self, data: bytes) -> int:
+        """Seal ``data`` into as many frames as needed."""
+        total = len(data)
+        with self._send_mtx:
+            off = 0
+            while True:
+                chunk = data[off : off + DATA_MAX_SIZE]
+                frame = struct.pack("<I", len(chunk)) + chunk
+                frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+                sealed = self._send_aead.encrypt(
+                    self._send_nonce.next(), frame, None
+                )
+                self._sock.sendall(sealed)
+                off += len(chunk)
+                if off >= total:
+                    break
+        return total
+
+    def read(self) -> bytes:
+        """Return the data of the next frame ('' on EOF)."""
+        with self._recv_mtx:
+            if self._recv_buf:
+                out, self._recv_buf = self._recv_buf, b""
+                return out
+            try:
+                sealed = self._read_exact(SEALED_FRAME_SIZE)
+            except SecretConnectionError:
+                return b""
+            try:
+                frame = self._recv_aead.decrypt(
+                    self._recv_nonce.next(), sealed, None
+                )
+            except InvalidTag as exc:
+                raise SecretConnectionError("frame auth failed") from exc
+            (length,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
+            if length > DATA_MAX_SIZE:
+                raise SecretConnectionError("invalid frame length")
+            return frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+
+    def read_exact(self, n: int) -> bytes:
+        """Read exactly n plaintext bytes (buffers frame remainders)."""
+        out = b""
+        while len(out) < n:
+            chunk = self.read()
+            if not chunk:
+                raise SecretConnectionError("connection closed")
+            out += chunk
+        with self._recv_mtx:
+            out, extra = out[:n], out[n:]
+            if extra:
+                self._recv_buf = extra + self._recv_buf
+        return out
+
+    def close(self) -> None:
+        # shutdown before close: close() alone defers the FIN while another
+        # thread sits blocked in recv() (the in-flight syscall pins the fd),
+        # so the remote would never see EOF.  shutdown tears the stream down
+        # immediately and unblocks both sides' readers.
+        import socket as _socket
+
+        try:
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+__all__ = [
+    "SecretConnection",
+    "SecretConnectionError",
+    "AuthError",
+    "DATA_MAX_SIZE",
+    "TOTAL_FRAME_SIZE",
+    "SEALED_FRAME_SIZE",
+]

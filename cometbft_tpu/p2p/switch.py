@@ -1,0 +1,292 @@
+"""Switch — the reactor multiplexer and peer lifecycle manager
+(reference: p2p/switch.go:72).
+
+Owns the transport, the peer set, and all reactors.  Every upgraded
+connection becomes a Peer whose inbound messages are dispatched by
+channel id to the owning reactor (switch.go:269 Broadcast fan-out,
+switch.go:322 StopPeerForError, reconnect-with-backoff for persistent
+peers switch.go:389).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.peer import Peer, PeerSet
+from cometbft_tpu.p2p.transport import MultiplexTransport, RejectedError
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+
+RECONNECT_ATTEMPTS = 20          # switch.go reconnectAttempts
+RECONNECT_BASE_INTERVAL = 0.5    # (shortened from 5s for test cadence; prod sets via config)
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch(BaseService):
+    """(p2p/switch.go:72 Switch)"""
+
+    def __init__(
+        self,
+        transport: MultiplexTransport,
+        mconn_config: MConnConfig | None = None,
+        max_inbound: int = 40,
+        max_outbound: int = 10,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="switch",
+            logger=logger or default_logger().with_fields(module="switch"),
+        )
+        self.transport = transport
+        self.mconn_config = mconn_config or MConnConfig()
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self.peers = PeerSet()
+        self.reactors: dict[str, Reactor] = {}
+        self._channels: list[ChannelDescriptor] = []
+        self._reactor_by_channel: dict[int, Reactor] = {}
+        self._dialing: set[str] = set()
+        self._reconnecting: set[str] = set()
+        self._persistent_addrs: dict[str, NetAddress] = {}
+        self._mtx = threading.Lock()
+        self.addr_book = None  # set by node wiring when PEX is enabled
+
+    # -- reactor registration (switch.go:134 AddReactor) ----------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self._reactor_by_channel:
+                raise SwitchError(
+                    f"channel {desc.id:#x} claimed by two reactors"
+                )
+            self._channels.append(desc)
+            self._reactor_by_channel[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Reactor | None:
+        return self.reactors.get(name)
+
+    def node_info(self):
+        return self.transport.node_info
+
+    # -- lifecycle ------------------------------------------------------
+
+    def on_start(self) -> None:
+        if not self.transport.is_running():
+            self.transport.start()
+        for reactor in self.reactors.values():
+            reactor.start()
+        threading.Thread(
+            target=self._accept_routine, name="switch-accept", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        for peer in self.peers.copy():
+            self.stop_peer_gracefully(peer)
+        for reactor in self.reactors.values():
+            if reactor.is_running():
+                reactor.stop()
+        if self.transport.is_running():
+            self.transport.stop()
+
+    # -- inbound (switch.go:817 acceptRoutine) --------------------------
+
+    def _accept_routine(self) -> None:
+        while not self._quit.is_set():
+            accepted = self.transport.accept(timeout=0.2)
+            if accepted is None:
+                continue
+            conn, ni, addr = accepted
+            inbound = sum(1 for p in self.peers.copy() if not p.outbound)
+            if inbound >= self.max_inbound:
+                self.logger.debug("rejecting inbound: at capacity")
+                conn.close()
+                continue
+            # one bad peer admission must not kill the accept loop
+            # (switch.go acceptRoutine recovers and keeps accepting)
+            try:
+                self._add_peer_conn(conn, ni, addr, outbound=False)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(
+                    "failed to add inbound peer",
+                    peer=ni.node_id[:10], err=repr(exc),
+                )
+                conn.close()
+
+    # -- dialing (switch.go:500 DialPeersAsync) -------------------------
+
+    def dial_peers_async(self, addrs: list[NetAddress],
+                         persistent: bool = False) -> None:
+        for addr in addrs:
+            if persistent and addr.id:
+                with self._mtx:
+                    self._persistent_addrs[addr.id] = addr
+            threading.Thread(
+                target=self.dial_peer_with_address,
+                args=(addr, persistent),
+                daemon=True,
+            ).start()
+
+    def dial_peer_with_address(self, addr: NetAddress,
+                               persistent: bool = False,
+                               _from_reconnect: bool = False) -> bool:
+        """(switch.go:614 DialPeerWithAddress)"""
+        if addr.id:
+            with self._mtx:
+                if addr.id in self._dialing or self.peers.has(addr.id):
+                    return False
+                self._dialing.add(addr.id)
+        try:
+            conn, ni = self.transport.dial(addr)
+        except Exception as exc:  # noqa: BLE001 — dial failures feed reconnect
+            self.logger.debug("dial failed", addr=str(addr), err=repr(exc))
+            if persistent and not _from_reconnect:
+                self._schedule_reconnect(addr)
+            return False
+        finally:
+            if addr.id:
+                with self._mtx:
+                    self._dialing.discard(addr.id)
+        return self._add_peer_conn(conn, ni, addr, outbound=True,
+                                   persistent=persistent)
+
+    def is_dialing_or_connected(self, node_id: str) -> bool:
+        with self._mtx:
+            return node_id in self._dialing or self.peers.has(node_id)
+
+    def _schedule_reconnect(self, addr: NetAddress) -> None:
+        """(switch.go:389 reconnectToPeer) — exponential backoff + jitter.
+        One attempt chain owns ``addr.id`` for its whole lifetime; dial
+        failures inside the chain do NOT spawn new chains, so the
+        backoff actually grows and the attempt cap holds."""
+        if not addr.id:
+            return
+        with self._mtx:
+            if addr.id in self._reconnecting:
+                return
+            self._reconnecting.add(addr.id)
+
+        def attempt() -> None:
+            try:
+                for i in range(RECONNECT_ATTEMPTS):
+                    if self._quit.is_set():
+                        return
+                    wait = RECONNECT_BASE_INTERVAL * (1.5 ** min(i, 10))
+                    time.sleep(wait * (0.8 + 0.4 * random.random()))
+                    if self.peers.has(addr.id):
+                        return
+                    if self.dial_peer_with_address(
+                        addr, persistent=True, _from_reconnect=True
+                    ):
+                        return
+                self.logger.info(
+                    "giving up reconnecting", peer=addr.id[:10]
+                )
+            finally:
+                with self._mtx:
+                    self._reconnecting.discard(addr.id)
+
+        threading.Thread(target=attempt, daemon=True).start()
+
+    # -- peer lifecycle (switch.go:727 addPeer) -------------------------
+
+    def _add_peer_conn(self, conn, ni, addr: NetAddress,
+                       outbound: bool, persistent: bool = False) -> bool:
+        with self._mtx:
+            persistent = persistent or ni.node_id in self._persistent_addrs
+        peer = Peer(
+            conn,
+            ni,
+            self._channels,
+            on_receive=self._dispatch,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent,
+            socket_addr=addr,
+            mconn_config=self.mconn_config,
+            logger=self.logger.with_fields(peer=ni.node_id[:8]),
+        )
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        try:
+            self.peers.add(peer)
+        except KeyError:
+            self.logger.debug("duplicate peer", peer=ni.node_id[:10])
+            conn.close()
+            return False
+        peer.start()
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+        self.logger.info(
+            "added peer", peer=ni.node_id[:10],
+            direction="out" if outbound else "in",
+        )
+        return True
+
+    def _dispatch(self, peer: Peer, ch_id: int, msg: bytes) -> None:
+        reactor = self._reactor_by_channel.get(ch_id)
+        if reactor is None:
+            self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
+            return
+        reactor.receive(Envelope(channel_id=ch_id, src=peer, message=msg))
+
+    def _on_peer_error(self, peer: Peer, err) -> None:
+        self.stop_peer_for_error(peer, err)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """(switch.go:322 StopPeerForError)"""
+        if not self.peers.has(peer.id):
+            return
+        self.logger.info("stopping peer for error", peer=peer.id[:10],
+                         err=str(reason))
+        self._stop_and_remove_peer(peer, reason)
+        if peer.is_persistent():
+            addr = peer.socket_addr
+            with self._mtx:
+                addr = self._persistent_addrs.get(peer.id, addr)
+            if addr is not None:
+                self._schedule_reconnect(addr)
+
+    def stop_peer_gracefully(self, peer: Peer) -> None:
+        self._stop_and_remove_peer(peer, None)
+
+    def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
+        if not self.peers.remove(peer):
+            return
+        try:
+            if peer.is_running():
+                peer.stop()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        for reactor in self.reactors.values():
+            reactor.remove_peer(peer, reason)
+
+    # -- fan-out (switch.go:269 Broadcast) ------------------------------
+
+    def broadcast(self, ch_id: int, msg: bytes) -> None:
+        """Fire-and-forget to every peer via the per-channel send
+        queues — a full queue drops rather than blocks, matching the
+        reference's async Broadcast semantics."""
+        for peer in self.peers.copy():
+            peer.try_send(ch_id, msg)
+
+    def num_peers(self) -> dict:
+        peers = self.peers.copy()
+        return {
+            "outbound": sum(1 for p in peers if p.outbound),
+            "inbound": sum(1 for p in peers if not p.outbound),
+            "dialing": len(self._dialing),
+        }
+
+
+__all__ = ["Switch", "SwitchError"]

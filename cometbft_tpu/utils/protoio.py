@@ -49,6 +49,24 @@ def decode_uvarint(buf: bytes, offset: int = 0) -> tuple[int, int]:
         shift += 7
 
 
+def read_uvarint_from(read_exact, max_value: int = 1 << 63) -> int:
+    """Decode a uvarint from a stream via ``read_exact(1)`` calls,
+    rejecting values above ``max_value`` before any allocation happens.
+    Shared by the p2p transport and MConnection packet reader so
+    length-cap enforcement lives in one place."""
+    result, shift = 0, 0
+    while True:
+        b = read_exact(1)[0]
+        if shift > 63:
+            raise ValueError("uvarint overflows 64 bits")
+        result |= (b & 0x7F) << shift
+        if result > max_value:
+            raise ValueError(f"uvarint {result} exceeds cap {max_value}")
+        if not (b & 0x80):
+            return result
+        shift += 7
+
+
 def _zigzag(n: int) -> int:
     return (n << 1) ^ (n >> 63) if n < 0 else n << 1
 
