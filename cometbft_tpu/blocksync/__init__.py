@@ -1,1 +1,12 @@
-"""Package placeholder — populated as layers land."""
+"""Blocksync plane — pipelined fast catch-up (reference:
+internal/blocksync/)."""
+
+from cometbft_tpu.blocksync.pool import BlockPool, REQUEST_WINDOW
+from cometbft_tpu.blocksync.reactor import BLOCKSYNC_CHANNEL, BlocksyncReactor
+
+__all__ = [
+    "BLOCKSYNC_CHANNEL",
+    "BlockPool",
+    "BlocksyncReactor",
+    "REQUEST_WINDOW",
+]

@@ -1,0 +1,331 @@
+"""Blocksync reactor — fast chain catch-up (reference:
+internal/blocksync/reactor.go:55, channel 0x40 at reactor.go:20).
+
+Serves blocks from the store to lagging peers and, when started in
+sync mode, drives the BlockPool: request blocks pipelined 400 ahead,
+validate each block H with block H+1's LastCommit
+(``verify_commit_light`` — the TPU batch plane; reactor.go:550), apply
+through the shared BlockExecutor, and hand off to consensus once
+caught up (reactor.go SwitchToConsensus).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.state import State
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.block import BlockID
+from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+from cometbft_tpu.types.validation import verify_commit_light
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+BLOCKSYNC_CHANNEL = 0x40
+
+_MAX_MSG_BYTES = 10485760 + 1024  # a max-size block + framing slack
+
+STATUS_UPDATE_INTERVAL = 10.0     # reactor.go statusUpdateIntervalSeconds
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+POOL_TICK = 0.02
+
+
+# -- wire messages (proto/cometbft/blocksync/v1/types.proto) ------------
+
+_F_BLOCK_REQUEST = 1
+_F_NO_BLOCK_RESPONSE = 2
+_F_BLOCK_RESPONSE = 3
+_F_STATUS_REQUEST = 4
+_F_STATUS_RESPONSE = 5
+
+
+def encode_block_request(height: int) -> bytes:
+    m = ProtoWriter()
+    m.varint(1, height)
+    w = ProtoWriter()
+    w.message(_F_BLOCK_REQUEST, m.finish())
+    return w.finish()
+
+
+def encode_no_block_response(height: int) -> bytes:
+    m = ProtoWriter()
+    m.varint(1, height)
+    w = ProtoWriter()
+    w.message(_F_NO_BLOCK_RESPONSE, m.finish())
+    return w.finish()
+
+
+def encode_block_response(block) -> bytes:
+    m = ProtoWriter()
+    m.message(1, codec.encode_block(block))
+    w = ProtoWriter()
+    w.message(_F_BLOCK_RESPONSE, m.finish())
+    return w.finish()
+
+
+def encode_status_request() -> bytes:
+    w = ProtoWriter()
+    w.message(_F_STATUS_REQUEST, b"")
+    return w.finish()
+
+
+def encode_status_response(height: int, base: int) -> bytes:
+    m = ProtoWriter()
+    m.varint(1, height)
+    m.varint(2, base)
+    w = ProtoWriter()
+    w.message(_F_STATUS_RESPONSE, m.finish())
+    return w.finish()
+
+
+def decode_bs_message(data: bytes):
+    f = ProtoReader(data).to_dict()
+    if _F_BLOCK_REQUEST in f:
+        m = ProtoReader(bytes(f[_F_BLOCK_REQUEST][0])).to_dict()
+        return ("block_request", int(m.get(1, [0])[0]))
+    if _F_NO_BLOCK_RESPONSE in f:
+        m = ProtoReader(bytes(f[_F_NO_BLOCK_RESPONSE][0])).to_dict()
+        return ("no_block", int(m.get(1, [0])[0]))
+    if _F_BLOCK_RESPONSE in f:
+        m = ProtoReader(bytes(f[_F_BLOCK_RESPONSE][0])).to_dict()
+        return ("block", codec.decode_block(bytes(m[1][0])))
+    if _F_STATUS_REQUEST in f:
+        return ("status_request",)
+    if _F_STATUS_RESPONSE in f:
+        m = ProtoReader(bytes(f[_F_STATUS_RESPONSE][0])).to_dict()
+        return ("status", int(m.get(1, [0])[0]), int(m.get(2, [0])[0]))
+    raise ValueError("unknown blocksync message")
+
+
+class BlocksyncReactor(Reactor):
+    """(internal/blocksync/reactor.go:55 Reactor)"""
+
+    def __init__(
+        self,
+        state: State,
+        block_exec,
+        block_store,
+        block_sync: bool,
+        consensus_reactor=None,  # for SwitchToConsensus
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="blocksync",
+            logger=logger or default_logger().with_fields(module="blocksync"),
+        )
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.block_sync = threading.Event()
+        if block_sync:
+            self.block_sync.set()
+        self.consensus_reactor = consensus_reactor
+        start_height = block_store.height() + 1
+        if start_height == 1 and state.initial_height > 1:
+            start_height = state.initial_height
+        self.pool = BlockPool(
+            start_height,
+            send_request=self._send_block_request,
+            send_error=self._on_pool_error,
+            logger=self.logger,
+        )
+        self._caught_up_since: float | None = None
+
+    def is_syncing(self) -> bool:
+        return self.block_sync.is_set()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=BLOCKSYNC_CHANNEL,
+                priority=5,
+                send_queue_capacity=1000,
+                recv_message_capacity=_MAX_MSG_BYTES,
+            )
+        ]
+
+    # -- lifecycle ------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.block_sync.is_set():
+            threading.Thread(
+                target=self._pool_routine, name="blocksync-pool", daemon=True
+            ).start()
+
+    def start_sync(self, state: State) -> None:
+        """Enter sync mode post-statesync (reactor.go SwitchToBlockSync)."""
+        self.state = state
+        self.pool.height = state.last_block_height + 1
+        self.block_sync.set()
+        threading.Thread(
+            target=self._pool_routine, name="blocksync-pool", daemon=True
+        ).start()
+
+    # -- peer lifecycle --------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        peer.send(
+            BLOCKSYNC_CHANNEL,
+            encode_status_response(
+                self.block_store.height(), self.block_store.base()
+            ),
+        )
+
+    def remove_peer(self, peer, reason=None) -> None:
+        self.pool.remove_peer(peer.id)
+
+    # -- receive ---------------------------------------------------------
+
+    def receive(self, env: Envelope) -> None:
+        try:
+            msg = decode_bs_message(env.message)
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error("malformed blocksync msg", err=repr(exc))
+            if self.switch is not None:
+                self.switch.stop_peer_for_error(env.src, exc)
+            return
+        kind = msg[0]
+        if kind == "block_request":
+            self._respond_to_block_request(env.src, msg[1])
+        elif kind == "block":
+            block = msg[1]
+            self.pool.add_block(env.src.id, block, len(env.message))
+        elif kind == "no_block":
+            self.pool.no_block(env.src.id, msg[1])
+        elif kind == "status_request":
+            env.src.try_send(
+                BLOCKSYNC_CHANNEL,
+                encode_status_response(
+                    self.block_store.height(), self.block_store.base()
+                ),
+            )
+        elif kind == "status":
+            _, height, base = msg
+            self.pool.set_peer_range(env.src.id, base, height)
+
+    def _respond_to_block_request(self, peer, height: int) -> None:
+        block = self.block_store.load_block(height)
+        if block is None:
+            peer.try_send(BLOCKSYNC_CHANNEL, encode_no_block_response(height))
+            return
+        peer.send(BLOCKSYNC_CHANNEL, encode_block_response(block))
+
+    # -- pool callbacks ---------------------------------------------------
+
+    def _send_block_request(self, peer_id: str, height: int) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is None:
+            self.pool.remove_peer(peer_id)
+            return
+        peer.try_send(BLOCKSYNC_CHANNEL, encode_block_request(height))
+
+    def _on_pool_error(self, peer_id: str, reason) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+
+    # -- the sync loop (reactor.go:374 poolRoutine) -----------------------
+
+    def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        while not self._quit.is_set() and self.block_sync.is_set():
+            now = time.monotonic()
+            try:
+                if now - last_status > STATUS_UPDATE_INTERVAL:
+                    last_status = now
+                    if self.switch is not None:
+                        self.switch.broadcast(
+                            BLOCKSYNC_CHANNEL, encode_status_request()
+                        )
+                self.pool.make_next_requests()
+                made_progress = self._try_sync_step()
+                if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                    last_switch_check = now
+                    if self._maybe_switch_to_consensus():
+                        return
+                if not made_progress:
+                    self._quit.wait(POOL_TICK)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error("pool routine error", err=repr(exc))
+                self._quit.wait(POOL_TICK)
+
+    def _try_sync_step(self) -> bool:
+        """Validate + apply the next block pair (reactor.go:536)."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = PartSet.from_bytes(
+            codec.encode_block(first), BLOCK_PART_SIZE_BYTES
+        )
+        first_id = BlockID(
+            hash=first.hash(), part_set_header=first_parts.header
+        )
+        try:
+            # block H verified with H+1's LastCommit — the batch-verify
+            # hot path (reactor.go:550 VerifyCommitLight)
+            verify_commit_light(
+                self.state.chain_id,
+                self.state.validators,
+                first_id,
+                first.header.height,
+                second.last_commit,
+            )
+            if second.last_commit.block_id.hash != first.hash():
+                raise ValueError("second block's LastCommit is for a different block")
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error(
+                "invalid block during sync",
+                height=first.header.height, err=repr(exc),
+            )
+            peer1 = self.pool.redo_request(first.header.height)
+            peer2 = self.pool.redo_request(first.header.height + 1)
+            for pid in (peer1, peer2):
+                if pid:
+                    self._on_pool_error(pid, "sent invalid block")
+            return False
+        if self.block_store.height() < first.header.height:
+            self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state = self.block_exec.apply_block(
+            self.state, first_id, first,
+            syncing_to_height=self.pool.max_peer_height(),
+        )
+        self.pool.pop_request()
+        return True
+
+    def _maybe_switch_to_consensus(self) -> bool:
+        """(reactor.go poolRoutine switch check)"""
+        if not self.pool.is_caught_up():
+            self._caught_up_since = None
+            return False
+        if self._caught_up_since is None:
+            self._caught_up_since = time.monotonic()
+            return False
+        if time.monotonic() - self._caught_up_since < 0.5:
+            return False
+        self.logger.info(
+            "caught up — switching to consensus",
+            height=self.pool.height,
+            blocks_synced=self.pool.blocks_synced,
+        )
+        self.block_sync.clear()
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(self.state)
+        return True
+
+
+__all__ = [
+    "BlocksyncReactor",
+    "BLOCKSYNC_CHANNEL",
+    "decode_bs_message",
+    "encode_status_response",
+]

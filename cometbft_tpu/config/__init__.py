@@ -73,6 +73,9 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     abci: str = "builtin"  # builtin | socket | grpc
     filter_peers: bool = False
+    # start in blocksync mode: catch up from peers before joining
+    # consensus (config/config.go BlockSyncMode)
+    block_sync: bool = False
 
 
 @dataclass
@@ -424,6 +427,7 @@ def test_config(home: str = "") -> Config:
         peer_query_maj23_sleep_duration_ns=250 * 10**6,
     )
     cfg.mempool.recheck_timeout_ns = 10 * 10**6
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral port per test node
     return cfg
 
 

@@ -162,6 +162,7 @@ class ConsensusState(BaseService):
 
         self.state = state  # committed chain state
 
+        self._early_parts: list = []  # catch-up parts pre-commit-header
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
         self._ticker = TimeoutTicker(self._tock)
         self._thread: threading.Thread | None = None
@@ -254,6 +255,14 @@ class ConsensusState(BaseService):
         )():
             self.wal.stop()
 
+    def update_state_and_start(self, state: State) -> None:
+        """Adopt a post-sync state and begin consensus — the blocksync →
+        consensus handoff (reactor.go SwitchToConsensus)."""
+        self.state = state
+        self._update_to_state(state)
+        if not self.is_running():
+            self.start()
+
     # -- WAL replay (replay.go:95 catchupReplay) -------------------------
 
     def _catchup_replay(self) -> None:
@@ -318,6 +327,13 @@ class ConsensusState(BaseService):
         with self._rs_mtx:
             if isinstance(msg, ProposalMessage):
                 self._set_proposal(msg.proposal)
+                # stashed early parts may have completed the proposal
+                if (
+                    self.proposal_block_parts is not None
+                    and self.proposal_block_parts.is_complete()
+                    and self.proposal_block is not None
+                ):
+                    self._handle_complete_proposal(msg.proposal.height)
             elif isinstance(msg, BlockPartMessage):
                 added = self._add_proposal_block_part(msg, peer_id)
                 if added and self.proposal_block_parts.is_complete():
@@ -365,6 +381,7 @@ class ConsensusState(BaseService):
                 f"updateToState at height {self.height} != "
                 f"committed {state.last_block_height}"
             )
+        self._early_parts.clear()  # stashed parts are per-height
         height = (
             state.initial_height
             if state.last_block_height == 0
@@ -588,6 +605,18 @@ class ConsensusState(BaseService):
             self.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header
             )
+            # parts that raced ahead of this proposal message
+            early, self._early_parts = self._early_parts, []
+            for part in early:
+                try:
+                    self._add_proposal_block_part(
+                        BlockPartMessage(
+                            height=self.height, round=self.round, part=part
+                        ),
+                        "",
+                    )
+                except Exception:  # noqa: BLE001 — bad proofs skipped
+                    continue
         self.logger.info(
             "received proposal",
             height=proposal.height,
@@ -602,7 +631,13 @@ class ConsensusState(BaseService):
         if msg.height != self.height:
             return False
         if self.proposal_block_parts is None:
-            return False  # no proposal yet: can't verify against a header
+            # No header to verify against yet.  During catch-up, parts
+            # can outrun the precommits that establish the commit header
+            # (enterCommit below); stash a bounded number so one gossip
+            # pass suffices instead of waiting a full round reset.
+            if len(self._early_parts) < 256:
+                self._early_parts.append(msg.part)
+            return False
         added = self.proposal_block_parts.add_part(msg.part)
         if added and self.proposal_block_parts.is_complete():
             from cometbft_tpu.types import codec
@@ -822,8 +857,26 @@ class ConsensusState(BaseService):
                 self.proposal_block_parts.has_header(maj23.part_set_header)
             ):
                 self.proposal_block = None
+                # drop a conflicting proposal too: the network decided a
+                # different block (equivocating proposer); keeping it
+                # would make the hash check reject the decided block
+                self.proposal = None
                 self.proposal_block_parts = PartSet(maj23.part_set_header)
-                return  # wait for parts via gossip
+                # drain parts that arrived before the commit header was
+                # known (proof-checked against the header by add_part)
+                early, self._early_parts = self._early_parts, []
+                for part in early:
+                    try:
+                        self._add_proposal_block_part(
+                            BlockPartMessage(
+                                height=height, round=commit_round, part=part
+                            ),
+                            "",
+                        )
+                    except Exception:  # noqa: BLE001 — stashed parts are
+                        continue  # unvalidated; bad proofs just get skipped
+                if self.proposal_block is None:
+                    return  # wait for parts via gossip
         self._try_finalize_commit(height)
 
     def _try_finalize_commit(self, height: int) -> None:

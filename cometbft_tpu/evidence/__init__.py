@@ -1,1 +1,17 @@
-"""Package placeholder — populated as layers land."""
+"""Evidence plane — byzantine-fault detection (reference:
+internal/evidence/)."""
+
+from cometbft_tpu.evidence.pool import (
+    EvidenceAlreadyCommittedError,
+    EvidenceInvalidError,
+    Pool,
+)
+from cometbft_tpu.evidence.reactor import EVIDENCE_CHANNEL, EvidenceReactor
+
+__all__ = [
+    "EVIDENCE_CHANNEL",
+    "EvidenceAlreadyCommittedError",
+    "EvidenceInvalidError",
+    "EvidenceReactor",
+    "Pool",
+]

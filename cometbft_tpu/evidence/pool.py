@@ -1,0 +1,327 @@
+"""Evidence pool — detection and lifecycle of byzantine-fault proof
+(reference: internal/evidence/pool.go:24).
+
+Consensus reports conflicting votes here (pool.go:308
+ReportConflictingVotes); peers gossip verified evidence in; the block
+proposer reaps pending evidence into blocks (PendingEvidence); once
+committed, evidence is marked and pruned when it expires
+(pool.go Update).  Verification (verify.go:19) checks the proof
+against historical state: validator membership, signature validity,
+and the max-age window.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cometbft_tpu.state import State
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+    LightClientAttackEvidence,
+)
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils.db import DB
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.time import now_ns
+
+_PREFIX_PENDING = b"evp/"
+_PREFIX_COMMITTED = b"evc/"
+
+
+class EvidenceInvalidError(EvidenceError):
+    """Provably bad evidence — the sender is byzantine or buggy."""
+
+
+class EvidenceExpiredError(EvidenceError):
+    """Evidence outside the age window, or referencing state we no
+    longer hold — benign (clock/pruning skew), NOT punishable."""
+
+
+class EvidenceAlreadyCommittedError(EvidenceError):
+    pass
+
+
+def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
+    return prefix + height.to_bytes(8, "big") + ev_hash
+
+
+class Pool:
+    """(internal/evidence/pool.go:24 Pool)"""
+
+    def __init__(
+        self,
+        db: DB,
+        state_store,
+        block_store,
+        logger: Logger | None = None,
+    ):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger or default_logger().with_fields(module="evidence")
+        self._mtx = threading.Lock()
+        # conflicting vote pairs reported by consensus, turned into
+        # evidence at the next Update when block time/val set are known
+        self._consensus_buffer: list[tuple[Vote, Vote]] = []
+        self._new_evidence_cond = threading.Condition(self._mtx)
+        self._pending_bytes: int | None = None  # cache
+
+    # -- state accessors ------------------------------------------------
+
+    def _current_state(self) -> State:
+        return self.state_store.load()
+
+    # -- verification (internal/evidence/verify.go:19) -------------------
+
+    def verify(self, ev) -> None:
+        """Full verification against historical state; raises on failure."""
+        state = self._current_state()
+        height, ev_time = state.last_block_height, None
+
+        if isinstance(ev, DuplicateVoteEvidence):
+            ev_time = self._verify_duplicate_vote(ev, state)
+        elif isinstance(ev, LightClientAttackEvidence):
+            ev_time = self._verify_light_client_attack(ev, state)
+        else:
+            raise EvidenceInvalidError(f"unknown evidence type {type(ev)}")
+
+        # age window (verify.go:36-60)
+        params = state.consensus_params.evidence
+        age_blocks = height - ev.height
+        age_ns = state.last_block_time_ns - ev_time
+        if (
+            age_blocks > params.max_age_num_blocks
+            and age_ns > params.max_age_duration_ns
+        ):
+            raise EvidenceExpiredError(
+                f"evidence from height {ev.height} is too old "
+                f"({age_blocks} blocks, {age_ns / 1e9:.0f}s)"
+            )
+
+    def _verify_duplicate_vote(
+        self, ev: DuplicateVoteEvidence, state: State
+    ) -> int:
+        """(verify.go:164 VerifyDuplicateVote) — returns evidence time."""
+        a, b = ev.vote_a, ev.vote_b
+        if a.height != b.height or a.round != b.round or a.type != b.type:
+            raise EvidenceInvalidError("votes have different H/R/S")
+        if a.validator_address != b.validator_address:
+            raise EvidenceInvalidError("votes from different validators")
+        if a.block_id.key() == b.block_id.key():
+            raise EvidenceInvalidError("votes for the same block")
+        if a.type not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+            raise EvidenceInvalidError("invalid vote type")
+        ev.validate_basic()
+
+        try:
+            val_set = self.state_store.load_validators(ev.height)
+        except Exception as exc:  # noqa: BLE001 — pruned/missing state
+            raise EvidenceExpiredError(
+                f"no validator set for height {ev.height}: {exc}"
+            ) from exc
+        _, val = val_set.get_by_address(a.validator_address)
+        if val is None:
+            raise EvidenceInvalidError(
+                "validator not in set at evidence height"
+            )
+        if ev.validator_power != val.voting_power:
+            raise EvidenceInvalidError("validator power mismatch")
+        if ev.total_voting_power != val_set.total_voting_power():
+            raise EvidenceInvalidError("total voting power mismatch")
+
+        chain_id = state.chain_id
+        for vote in (a, b):
+            if not val.pub_key.verify_signature(
+                vote.sign_bytes(chain_id), vote.signature
+            ):
+                raise EvidenceInvalidError("invalid vote signature")
+        # evidence time = block time at that height (pool.go:308)
+        meta = self.block_store.load_block_meta(ev.height)
+        return meta.header.time_ns if meta is not None else ev.timestamp_ns
+
+    def _verify_light_client_attack(
+        self, ev: LightClientAttackEvidence, state: State
+    ) -> int:
+        """(verify.go:110 VerifyLightClientAttack) — structural checks:
+        common height exists, conflicting commit has +1/3 of the common
+        val set's power signed over the conflicting header."""
+        if ev.common_height <= 0:
+            raise EvidenceInvalidError("non-positive common height")
+        if ev.common_height > state.last_block_height:
+            raise EvidenceInvalidError("common height in the future")
+        commit = ev.conflicting_commit
+        if commit is None or not commit.signatures:
+            raise EvidenceInvalidError("missing conflicting commit")
+        try:
+            val_set = self.state_store.load_validators(ev.common_height)
+        except Exception as exc:  # noqa: BLE001 — pruned/missing state
+            raise EvidenceExpiredError(
+                f"no validator set for height {ev.common_height}: {exc}"
+            ) from exc
+        if ev.total_voting_power != val_set.total_voting_power():
+            raise EvidenceInvalidError("total voting power mismatch")
+        # at least one byzantine validator must be in the common set
+        for addr in ev.byzantine_validators:
+            _, val = val_set.get_by_address(addr)
+            if val is None:
+                raise EvidenceInvalidError(
+                    "byzantine validator not in common set"
+                )
+        meta = self.block_store.load_block_meta(ev.common_height)
+        return meta.header.time_ns if meta is not None else ev.timestamp_ns
+
+    # -- ingestion -------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """Verify + persist pending evidence (pool.go:137 AddEvidence).
+        Idempotent for known evidence."""
+        with self._mtx:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return
+        self.verify(ev)
+        with self._mtx:
+            self._add_pending_locked(ev)
+            self._new_evidence_cond.notify_all()
+        self.logger.info(
+            "verified new evidence", height=ev.height,
+            hash=ev.hash().hex()[:12],
+        )
+
+    def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
+        """(pool.go:308 ReportConflictingVotes) — buffered until Update
+        provides the block time + validator set."""
+        with self._mtx:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    # -- block production / validation -----------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        """(pool.go:96 PendingEvidence)"""
+        out, size = [], 0
+        with self._mtx:
+            for _, raw in self.db.prefix_iterator(_PREFIX_PENDING):
+                ev = codec.decode_evidence(bytes(raw))
+                ev_size = len(raw)
+                if max_bytes >= 0 and size + ev_size > max_bytes:
+                    break
+                out.append(ev)
+                size += ev_size
+        return out, size
+
+    def check_evidence(self, ev_list) -> None:
+        """Validate all evidence in a proposed block (pool.go:184
+        CheckEvidence): no duplicates within the block, nothing already
+        committed, everything verifiable."""
+        seen = set()
+        for ev in ev_list:
+            h = ev.hash()
+            if h in seen:
+                raise EvidenceInvalidError("duplicate evidence in block")
+            seen.add(h)
+            with self._mtx:
+                if self._is_committed(ev):
+                    raise EvidenceAlreadyCommittedError(
+                        "evidence already committed"
+                    )
+                pending = self._is_pending(ev)
+            if not pending:
+                self.verify(ev)
+
+    # -- post-commit update ----------------------------------------------
+
+    def update(self, state: State, ev_list) -> None:
+        """(pool.go:110 Update) — mark committed, materialize reported
+        conflicts, prune expired."""
+        with self._mtx:
+            for ev in ev_list:
+                self._mark_committed_locked(ev)
+        self._process_consensus_buffer(state)
+        self._prune_expired(state)
+
+    def _process_consensus_buffer(self, state: State) -> None:
+        """(pool.go:271 processConsensusBuffer)"""
+        with self._mtx:
+            buf, self._consensus_buffer = self._consensus_buffer, []
+        for vote_a, vote_b in buf:
+            try:
+                val_set = self.state_store.load_validators(vote_a.height)
+                ev = DuplicateVoteEvidence.from_votes(
+                    vote_a, vote_b, state.last_block_time_ns, val_set
+                )
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error("failed to make evidence", err=repr(exc))
+                continue
+            with self._mtx:
+                if self._is_pending(ev) or self._is_committed(ev):
+                    continue
+                self._add_pending_locked(ev)
+                self._new_evidence_cond.notify_all()
+            self.logger.info(
+                "duplicate vote evidence created",
+                height=ev.height,
+                validator=ev.vote_a.validator_address.hex()[:12],
+            )
+
+    def _prune_expired(self, state: State) -> None:
+        params = state.consensus_params.evidence
+        height = state.last_block_height
+        now = state.last_block_time_ns or now_ns()
+        drop = []
+        with self._mtx:
+            for key, raw in self.db.prefix_iterator(_PREFIX_PENDING):
+                ev = codec.decode_evidence(bytes(raw))
+                if (
+                    height - ev.height > params.max_age_num_blocks
+                    and now - ev.timestamp_ns > params.max_age_duration_ns
+                ):
+                    drop.append(key)
+            # committed markers only matter within the age window — once
+            # expired evidence can no longer enter a block, drop them too
+            for key, _ in self.db.prefix_iterator(_PREFIX_COMMITTED):
+                ev_height = int.from_bytes(
+                    key[len(_PREFIX_COMMITTED):len(_PREFIX_COMMITTED) + 8],
+                    "big",
+                )
+                if height - ev_height > params.max_age_num_blocks:
+                    drop.append(key)
+            for key in drop:
+                self.db.delete(key)
+
+    # -- storage helpers -------------------------------------------------
+
+    def _add_pending_locked(self, ev) -> None:
+        self.db.set(
+            _key(_PREFIX_PENDING, ev.height, ev.hash()),
+            codec.encode_evidence(ev),
+        )
+
+    def _is_pending(self, ev) -> bool:
+        return self.db.has(_key(_PREFIX_PENDING, ev.height, ev.hash()))
+
+    def _is_committed(self, ev) -> bool:
+        return self.db.has(_key(_PREFIX_COMMITTED, ev.height, ev.hash()))
+
+    def _mark_committed_locked(self, ev) -> None:
+        self.db.delete(_key(_PREFIX_PENDING, ev.height, ev.hash()))
+        self.db.set(_key(_PREFIX_COMMITTED, ev.height, ev.hash()), b"\x01")
+
+    # -- reactor support -------------------------------------------------
+
+    def wait_for_evidence(self, timeout: float) -> bool:
+        with self._mtx:
+            return self._new_evidence_cond.wait(timeout)
+
+    def size(self) -> int:
+        with self._mtx:
+            return sum(1 for _ in self.db.prefix_iterator(_PREFIX_PENDING))
+
+
+__all__ = [
+    "Pool",
+    "EvidenceExpiredError",
+    "EvidenceInvalidError",
+    "EvidenceAlreadyCommittedError",
+]

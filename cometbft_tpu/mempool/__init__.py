@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable
 
 from cometbft_tpu.abci.types import (
@@ -44,7 +44,8 @@ class _MempoolTx:
     tx: bytes
     height: int  # height at which the tx entered the mempool
     gas_wanted: int
-    sender: str = ""
+    seq: int = 0  # monotonic arrival order, drives reactor broadcast
+    senders: set = field(default_factory=set)  # peers we got it from
 
 
 class TxCache:
@@ -145,6 +146,8 @@ class CListMempool:
         self._mtx = threading.RLock()  # the consensus Lock()/Unlock()
         self._txs: OrderedDict[bytes, _MempoolTx] = OrderedDict()
         self._txs_bytes = 0
+        self._seq = 0  # next arrival sequence number
+        self._new_tx_cond = threading.Condition(self._mtx)
         self._notified_available = False
         self._tx_available = threading.Event()
         self.pre_check: PreCheckFunc | None = None
@@ -187,6 +190,14 @@ class CListMempool:
                 f"mempool is full: {self.size()} txs"
             )
         if not self.cache.push(tx):
+            # record the sender even on the duplicate path so the
+            # broadcast routine never echoes the tx back to them
+            # (clist_mempool.go CheckTx ErrTxInCache branch)
+            if sender:
+                with self._mtx:
+                    mt = self._txs.get(tx_hash(tx))
+                    if mt is not None:
+                        mt.senders.add(sender)
             raise TxInCacheError("tx already in cache")
         try:
             res = self._proxy.check_tx(
@@ -220,15 +231,20 @@ class CListMempool:
                 raise MempoolFullError("mempool is full")
             key = tx_hash(tx)
             if key in self._txs:
+                if sender:
+                    self._txs[key].senders.add(sender)
                 return
+            self._seq += 1
             self._txs[key] = _MempoolTx(
                 tx=tx,
                 height=self._height,
                 gas_wanted=res.gas_wanted,
-                sender=sender,
+                seq=self._seq,
+                senders={sender} if sender else set(),
             )
             self._txs_bytes += len(tx)
             self._notify_available()
+            self._new_tx_cond.notify_all()
 
     def _notify_available(self) -> None:
         if not self._notified_available and len(self._txs) > 0:
@@ -264,6 +280,39 @@ class CListMempool:
         with self._mtx:
             txs = [mt.tx for mt in self._txs.values()]
             return txs if n < 0 else txs[:n]
+
+    # -- reactor iteration (clist_mempool.go TxsWaitChan/TxsFront) ------
+
+    def txs_after(
+        self, seq: int, exclude_sender: str = "", max_txs: int = 64
+    ) -> list[tuple[int, bytes]]:
+        """Txs that arrived after ``seq``, skipping ones received from
+        ``exclude_sender`` (their seq is still consumed so the cursor
+        advances past them)."""
+        with self._mtx:
+            out: list[tuple[int, bytes]] = []
+            for mt in self._txs.values():
+                if mt.seq <= seq:
+                    continue
+                if len(out) >= max_txs:
+                    break
+                if exclude_sender and exclude_sender in mt.senders:
+                    out.append((mt.seq, b""))
+                    continue
+                out.append((mt.seq, mt.tx))
+            return out
+
+    def current_seq(self) -> int:
+        """Latest arrival sequence number handed out."""
+        with self._mtx:
+            return self._seq
+
+    def wait_for_txs_after(self, seq: int, timeout: float) -> bool:
+        """Block until a tx with seq > ``seq`` may exist."""
+        with self._mtx:
+            if self._seq > seq:
+                return True
+            return self._new_tx_cond.wait(timeout)
 
     # -- consensus integration -----------------------------------------
 
@@ -366,3 +415,15 @@ class NopMempool:
 
     def txs_available(self) -> threading.Event:
         return threading.Event()
+
+    def current_seq(self) -> int:
+        return 0
+
+    def txs_after(self, seq, exclude_sender="", max_txs=64):
+        return []
+
+    def wait_for_txs_after(self, seq, timeout):
+        import time as _t
+
+        _t.sleep(timeout)
+        return False

@@ -14,6 +14,19 @@ from cometbft_tpu.abci.kvstore import KVStoreApp
 from cometbft_tpu.abci.types import Application
 from cometbft_tpu.config import Config
 from cometbft_tpu.consensus import ConsensusState, Handshaker
+from cometbft_tpu.blocksync import BlocksyncReactor
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.evidence import EvidenceReactor, Pool as EvidencePool
+from cometbft_tpu.mempool.reactor import MempoolReactor
+from cometbft_tpu.p2p import (
+    MConnConfig,
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    Switch,
+    parse_peer_list,
+)
 from cometbft_tpu.mempool import (
     CListMempool,
     NopMempool,
@@ -141,17 +154,27 @@ class Node(BaseService):
                 recheck=config.mempool.recheck,
             )
 
-        # 8. block executor (node.go:447)
+        # 8. evidence pool (setup.go:329 createEvidenceReactor)
+        self.evidence_db = open_db("evidence", backend, db_dir)
+        self.evidence_pool = EvidencePool(
+            self.evidence_db,
+            self.state_store,
+            self.block_store,
+            logger=self.logger.with_fields(module="evidence"),
+        )
+
+        # 9. block executor (node.go:447)
         self.block_exec = BlockExecutor(
             self.state_store,
             self.proxy_app.consensus,
             self.mempool,
             block_store=self.block_store,
             event_bus=self.event_bus,
+            evidence_pool=self.evidence_pool,
             logger=self.logger.with_fields(module="executor"),
         )
 
-        # 9. WAL + consensus (setup.go:369).  memdb nodes are ephemeral
+        # 10. WAL + consensus (setup.go:369).  memdb nodes are ephemeral
         # (tests): give them a no-op WAL.
         if config.base.db_backend == "memdb":
             self.wal = NopWAL()
@@ -167,6 +190,70 @@ class Node(BaseService):
             wal=self.wal,
             logger=self.logger.with_fields(module="consensus"),
         )
+
+        # 11. p2p: reactors → transport → switch (setup.go:404-473)
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus,
+            wait_sync=config.base.block_sync,
+            logger=self.logger.with_fields(module="consensus-reactor"),
+        )
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.block_exec,
+            self.block_store,
+            block_sync=config.base.block_sync,
+            consensus_reactor=self.consensus_reactor,
+            logger=self.logger.with_fields(module="blocksync"),
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool,
+            broadcast=config.mempool.broadcast
+            and config.mempool.type != "nop",
+            logger=self.logger.with_fields(module="mempool-reactor"),
+        )
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool,
+            logger=self.logger.with_fields(module="evidence-reactor"),
+        )
+        reactors = {
+            "BLOCKSYNC": self.blocksync_reactor,
+            "CONSENSUS": self.consensus_reactor,
+            "MEMPOOL": self.mempool_reactor,
+            "EVIDENCE": self.evidence_reactor,
+        }
+        self.node_key = NodeKey.load_or_generate(config.node_key_path)
+        channels = bytes(
+            d.id for r in reactors.values() for d in r.get_channels()
+        )
+        self._p2p_laddr = NetAddress.parse(config.p2p.laddr)
+        node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            listen_addr=config.p2p.laddr,
+            network=genesis.chain_id,
+            channels=channels,
+            moniker=config.base.moniker,
+        )
+        self.transport = MultiplexTransport(
+            node_info,
+            self.node_key,
+            handshake_timeout=config.p2p.handshake_timeout_ns / 1e9,
+            dial_timeout=config.p2p.dial_timeout_ns / 1e9,
+            logger=self.logger.with_fields(module="transport"),
+        )
+        self.switch = Switch(
+            self.transport,
+            mconn_config=MConnConfig(
+                send_rate=config.p2p.send_rate,
+                recv_rate=config.p2p.recv_rate,
+                max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+                flush_throttle=config.p2p.flush_throttle_timeout_ns / 1e9,
+            ),
+            max_inbound=config.p2p.max_num_inbound_peers,
+            max_outbound=config.p2p.max_num_outbound_peers,
+            logger=self.logger.with_fields(module="switch"),
+        )
+        for name, reactor in reactors.items():
+            self.switch.add_reactor(name, reactor)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -186,6 +273,13 @@ class Node(BaseService):
         self.state = hs.handshake(self.proxy_app)
         self.consensus.state = self.state
         self.consensus._update_to_state(self.state)
+        # blocksync validates against the post-handshake state (its
+        # app_hash reflects InitChain / replayed blocks)
+        self.blocksync_reactor.state = self.state
+        self.blocksync_reactor.pool.height = max(
+            self.blocksync_reactor.pool.height,
+            self.state.last_block_height + 1,
+        )
 
         if isinstance(self.mempool, CListMempool):
             max_bytes = self.state.consensus_params.block.max_bytes
@@ -198,10 +292,32 @@ class Node(BaseService):
 
         if isinstance(self.wal, WAL):
             self.wal.start()
-        self.consensus.start()
+
+        # p2p (node.go:613-626): listen, start switch (which starts the
+        # reactors; the consensus reactor starts the consensus state),
+        # then dial persistent peers.
+        self.transport.listen(self._p2p_laddr)
+        actual = self.transport.listen_addr
+        self.transport.node_info = NodeInfo(
+            node_id=self.transport.node_info.node_id,
+            listen_addr=f"tcp://{actual.host}:{actual.port}",
+            network=self.transport.node_info.network,
+            channels=self.transport.node_info.channels,
+            moniker=self.transport.node_info.moniker,
+        )
+        self.switch.start()
+        peers = parse_peer_list(self.config.p2p.persistent_peers)
+        if peers:
+            self.switch.dial_peers_async(peers, persistent=True)
 
     def on_stop(self) -> None:
-        for svc in (self.consensus, self.event_bus, self.proxy_app):
+        services = (
+            self.switch,
+            self.consensus,
+            self.event_bus,
+            self.proxy_app,
+        )
+        for svc in services:
             try:
                 if svc.is_running():
                     svc.stop()
@@ -209,6 +325,7 @@ class Node(BaseService):
                 self.logger.error("error stopping service", err=repr(exc))
         self.block_store_db.close()
         self.state_db.close()
+        self.evidence_db.close()
 
     # -- convenience -----------------------------------------------------
 

@@ -65,7 +65,8 @@ class NetAddress:
             port = int(port_s)
         except ValueError as exc:
             raise AddressError(f"invalid port in {addr!r}") from exc
-        if not 0 < port < 65536:
+        # port 0 = "bind an ephemeral port" for listen addresses
+        if not 0 <= port < 65536:
             raise AddressError(f"port out of range in {addr!r}")
         return cls(id=node_id, host=host or "127.0.0.1", port=port)
 

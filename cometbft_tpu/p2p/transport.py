@@ -179,10 +179,24 @@ class MultiplexTransport(BaseService):
 
     def on_stop(self) -> None:
         if self._listener is not None:
+            # shutdown before close: close() alone leaves a thread
+            # blocked in accept() holding the fd, leaking the thread
+            # and the port
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
                 pass
+        # drain queued-but-unclaimed inbound connections
+        while True:
+            try:
+                conn, _, _ = self._accept_queue.get_nowait()
+                conn.close()
+            except queue.Empty:
+                break
 
 
 __all__ = ["MultiplexTransport", "TransportError", "RejectedError"]

@@ -1,0 +1,116 @@
+"""Blocksync tests: pool scheduling and end-to-end fast sync
+(reference: internal/blocksync/pool_test.go, reactor_test.go)."""
+
+from __future__ import annotations
+
+import time
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p.netaddr import NetAddress
+from tests.test_reactors import (
+    connect_star,
+    make_localnet,
+    wait_all_height,
+)
+
+
+class TestBlockPool:
+    def test_requests_fill_window_and_complete(self):
+        sent = []
+        pool = BlockPool(
+            1,
+            send_request=lambda p, h: sent.append((p, h)),
+            send_error=lambda p, r: None,
+        )
+        pool.set_peer_range("peerA", 1, 5)
+        pool.set_peer_range("peerB", 1, 5)
+        pool.make_next_requests()
+        assert sorted(h for _, h in sent) == [1, 2, 3, 4, 5]
+
+    def test_add_block_requires_matching_peer(self):
+        from tests.helpers import make_val_set
+
+        pool = BlockPool(1, lambda p, h: None, lambda p, r: None)
+        pool.set_peer_range("peerA", 1, 3)
+        pool.make_next_requests()
+
+        class FakeBlock:
+            class header:
+                height = 1
+
+        assert not pool.add_block("stranger", FakeBlock(), 100)
+
+    def test_timeout_reassigns(self, monkeypatch):
+        import cometbft_tpu.blocksync.pool as pool_mod
+
+        sent = []
+        errors = []
+        pool = BlockPool(
+            1,
+            send_request=lambda p, h: sent.append((p, h)),
+            send_error=lambda p, r: errors.append(p),
+        )
+        monkeypatch.setattr(pool_mod, "REQUEST_TIMEOUT", 0.01)
+        pool.set_peer_range("slow", 1, 2)
+        pool.make_next_requests()
+        assert sent and all(p == "slow" for p, _ in sent)
+        time.sleep(0.05)
+        pool.set_peer_range("fast", 1, 2)
+        pool.make_next_requests()
+        assert errors == ["slow"]
+        assert any(p == "fast" for p, _ in sent)
+
+    def test_caught_up(self):
+        pool = BlockPool(5, lambda p, h: None, lambda p, r: None)
+        assert not pool.is_caught_up()  # no peers
+        pool.set_peer_range("a", 1, 4)
+        assert pool.is_caught_up()  # we're past every peer
+        pool.set_peer_range("b", 1, 9)
+        assert not pool.is_caught_up()
+
+
+class TestBlocksyncE2E:
+    def test_fresh_node_fast_syncs(self, tmp_path):
+        """Validators build a chain; a fresh observer in block_sync mode
+        catches up via 0x40 and then switches to consensus."""
+        nodes, privs, gen = make_localnet(tmp_path, 4)
+        cfg = make_test_config(str(tmp_path / "syncer"))
+        cfg.base.block_sync = True
+        cfg.ensure_dirs()
+        syncer = Node(cfg, app=KVStoreApp(), genesis=gen, priv_validator=None)
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 5)
+            syncer.start()
+            addr = nodes[0].transport.listen_addr
+            syncer.switch.dial_peer_with_address(
+                NetAddress(id=addr.id, host=addr.host, port=addr.port),
+                persistent=True,
+            )
+            wait_all_height([syncer], 5, timeout=30)
+            # same chain
+            assert (
+                syncer.block_store.load_block_meta(4).block_id.hash
+                == nodes[0].block_store.load_block_meta(4).block_id.hash
+            )
+            # eventually switches to consensus and keeps following live
+            deadline = time.monotonic() + 20
+            while (
+                time.monotonic() < deadline
+                and syncer.blocksync_reactor.is_syncing()
+            ):
+                time.sleep(0.05)
+            assert not syncer.blocksync_reactor.is_syncing()
+            target = nodes[0].height() + 2
+            wait_all_height([syncer], target, timeout=30)
+        finally:
+            for n in [*nodes, syncer]:
+                try:
+                    n.stop()
+                except Exception:
+                    pass

@@ -1,0 +1,264 @@
+"""Reactor-plane tests: evidence pool, mempool gossip, and the
+multi-validator localnet over real TCP p2p.
+
+Mirrors the reference's in-process consensus reactor tests
+(internal/consensus/reactor_test.go) and evidence pool tests
+(internal/evidence/pool_test.go).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.types import QueryRequest
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tests.helpers import make_block_id, signed_vote
+
+GENESIS_TIME = 1_700_000_000_000_000_000
+CHAIN = "reactor-test-chain"
+
+
+def make_localnet(tmp_path, n: int, connect: str = "star"):
+    """n validator nodes sharing one genesis, each with its own home."""
+    privs = [
+        FilePV(ed.priv_key_from_secret(b"net-val%d" % i)) for i in range(n)
+    ]
+    gen = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time_ns=GENESIS_TIME,
+        validators=tuple(GenesisValidator(pv.pub_key, 10) for pv in privs),
+    )
+    nodes = []
+    for i, pv in enumerate(privs):
+        cfg = make_test_config(str(tmp_path / f"node{i}"))
+        cfg.ensure_dirs()
+        pv._key_path = cfg.priv_validator_key_path
+        pv._state_path = cfg.priv_validator_state_path
+        pv.save()
+        node = Node(cfg, app=KVStoreApp(), genesis=gen, priv_validator=pv)
+        nodes.append(node)
+    return nodes, privs, gen
+
+
+def connect_star(nodes, timeout=10.0):
+    hub = nodes[0]
+    for node in nodes[1:]:
+        addr = hub.transport.listen_addr
+        node.switch.dial_peer_with_address(
+            NetAddress(id=addr.id, host=addr.host, port=addr.port),
+            persistent=True,
+        )
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if hub.switch.peers.size() == len(nodes) - 1 and all(
+            n.switch.peers.size() >= 1 for n in nodes[1:]
+        ):
+            return
+        time.sleep(0.02)
+    raise TimeoutError("localnet failed to connect")
+
+
+def wait_all_height(nodes, h, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(n.height() >= h for n in nodes):
+            return
+        time.sleep(0.05)
+    heights = [n.height() for n in nodes]
+    raise TimeoutError(f"heights {heights}, wanted all >= {h}")
+
+
+class TestLocalnet:
+    def test_four_validators_progress_over_tcp(self, tmp_path):
+        nodes, _, _ = make_localnet(tmp_path, 4)
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 3)
+            # every node converged on the same block hashes
+            h2 = {n.block_store.load_block_meta(2).block_id.hash
+                  for n in nodes}
+            assert len(h2) == 1
+            # commits carry +2/3 signatures
+            commit = nodes[0].block_store.load_block_commit(2)
+            present = sum(1 for cs in commit.signatures if cs.signature)
+            assert present >= 3
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_tx_gossip_and_execution(self, tmp_path):
+        nodes, _, _ = make_localnet(tmp_path, 4)
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 1)
+            # submit a tx to a NON-proposing node: it must flood to the
+            # proposer via the mempool reactor and land in a block
+            nodes[3].mempool.check_tx(b"gossip-key=gossip-val")
+            deadline = time.monotonic() + 30
+            found = False
+            while time.monotonic() < deadline and not found:
+                for n in nodes:
+                    resp = n.app.query(QueryRequest(data=b"gossip-key"))
+                    if resp.value == b"gossip-val":
+                        found = True
+                        break
+                time.sleep(0.05)
+            assert found, "gossiped tx never executed"
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_late_joiner_catches_up(self, tmp_path):
+        """A 5th node (same genesis, validator set of 4) joins late and
+        catches up via consensus-reactor catchup gossip."""
+        nodes, privs, gen = make_localnet(tmp_path, 4)
+        cfg = make_test_config(str(tmp_path / "late"))
+        cfg.ensure_dirs()
+        late = Node(cfg, app=KVStoreApp(), genesis=gen, priv_validator=None)
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 3)
+            late.start()
+            addr = nodes[0].transport.listen_addr
+            late.switch.dial_peer_with_address(
+                NetAddress(id=addr.id, host=addr.host, port=addr.port),
+                persistent=True,
+            )
+            wait_all_height([late], 3, timeout=30)
+            assert (
+                late.block_store.load_block_meta(2).block_id.hash
+                == nodes[0].block_store.load_block_meta(2).block_id.hash
+            )
+        finally:
+            for n in [*nodes, late]:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+
+class TestEvidencePool:
+    def _produced_node(self, tmp_path):
+        nodes, privs, gen = make_localnet(tmp_path, 4)
+        for n in nodes:
+            n.start()
+        connect_star(nodes)
+        wait_all_height(nodes, 2)
+        return nodes, privs
+
+    def test_duplicate_vote_evidence_lifecycle(self, tmp_path):
+        nodes, privs = self._produced_node(tmp_path)
+        try:
+            node = nodes[0]
+            state = node.state_store.load()
+            val_set = node.state_store.load_validators(1)
+            # find the validator index for privs[1] in the canonical set
+            addr = privs[1].pub_key.address()
+            idx, val = val_set.get_by_address(addr)
+            assert val is not None
+            va = signed_vote(privs[1]._priv_key, idx, make_block_id(b"a"),
+                             height=1, chain_id=CHAIN)
+            vb = signed_vote(privs[1]._priv_key, idx, make_block_id(b"b"),
+                             height=1, chain_id=CHAIN)
+            ev = DuplicateVoteEvidence.from_votes(
+                va, vb, state.last_block_time_ns, val_set
+            )
+            pool = node.evidence_pool
+            pool.add_evidence(ev)
+            pending, size = pool.pending_evidence(-1)
+            assert len(pending) == 1 and size > 0
+            assert pending[0].hash() == ev.hash()
+            # check_evidence accepts it; after commit it is rejected
+            pool.check_evidence([ev])
+            pool.update(state, [ev])
+            pending, _ = pool.pending_evidence(-1)
+            assert pending == []
+            with pytest.raises(Exception):
+                pool.check_evidence([ev])
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_invalid_evidence_rejected(self, tmp_path):
+        nodes, privs = self._produced_node(tmp_path)
+        try:
+            node = nodes[0]
+            state = node.state_store.load()
+            val_set = node.state_store.load_validators(1)
+            outsider = ed.priv_key_from_secret(b"outsider")
+            va = signed_vote(outsider, 0, make_block_id(b"a"), height=1,
+                             chain_id=CHAIN)
+            vb = signed_vote(outsider, 0, make_block_id(b"b"), height=1,
+                             chain_id=CHAIN)
+            ev = DuplicateVoteEvidence(
+                vote_a=min(va, vb, key=lambda v: v.block_id.key()),
+                vote_b=max(va, vb, key=lambda v: v.block_id.key()),
+                total_voting_power=val_set.total_voting_power(),
+                validator_power=10,
+                timestamp_ns=state.last_block_time_ns,
+            )
+            with pytest.raises(Exception):
+                node.evidence_pool.add_evidence(ev)
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_evidence_gossip_between_nodes(self, tmp_path):
+        nodes, privs = self._produced_node(tmp_path)
+        try:
+            node = nodes[0]
+            state = node.state_store.load()
+            val_set = node.state_store.load_validators(1)
+            addr = privs[2].pub_key.address()
+            idx, _ = val_set.get_by_address(addr)
+            va = signed_vote(privs[2]._priv_key, idx, make_block_id(b"x"),
+                             height=1, chain_id=CHAIN)
+            vb = signed_vote(privs[2]._priv_key, idx, make_block_id(b"y"),
+                             height=1, chain_id=CHAIN)
+            ev = DuplicateVoteEvidence.from_votes(
+                va, vb, state.last_block_time_ns, val_set
+            )
+            node.evidence_pool.add_evidence(ev)
+            # the evidence reactor floods it to all peers
+            deadline = time.monotonic() + 10
+            spread = False
+            while time.monotonic() < deadline and not spread:
+                spread = all(
+                    len(n.evidence_pool.pending_evidence(-1)[0]) >= 1
+                    for n in nodes[1:]
+                )
+                time.sleep(0.05)
+            assert spread, "evidence did not reach all peers"
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
