@@ -1,0 +1,7 @@
+"""``python -m cometbft_tpu`` entry point (cmd/cometbft/main.go:15)."""
+
+import sys
+
+from cometbft_tpu.cmd import main
+
+sys.exit(main())

@@ -158,7 +158,10 @@ class BlocksyncReactor(Reactor):
             ).start()
 
     def start_sync(self, state: State) -> None:
-        """Enter sync mode post-statesync (reactor.go SwitchToBlockSync)."""
+        """Enter sync mode post-statesync (reactor.go SwitchToBlockSync).
+        Idempotent: a no-op if the pool routine is already running."""
+        if self.block_sync.is_set():
+            return
         self.state = state
         self.pool.height = state.last_block_height + 1
         self.block_sync.set()

@@ -1,1 +1,300 @@
-"""Package placeholder — populated as layers land."""
+"""CLI (reference: cmd/cometbft/, commands at cmd/cometbft/commands/).
+
+``python -m cometbft_tpu <command>`` mirrors the reference's cobra
+commands: init, start, testnet, unsafe-reset-all, reset-state,
+rollback, gen-validator, gen-node-key, show-node-id, show-validator,
+version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import signal
+
+from cometbft_tpu.config import Config, default_config
+from cometbft_tpu.version import __version__
+
+
+def _load_config(home: str) -> Config:
+    if os.path.exists(os.path.join(home, "config", "config.toml")):
+        return Config.load(home)
+    cfg = default_config(home)
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """(commands/init.go)"""
+    from cometbft_tpu.node import init_files
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    gen = init_files(cfg, chain_id=args.chain_id or "")
+    NodeKey.load_or_generate(cfg.node_key_path)
+    print(f"Initialized node in {args.home} (chain {gen.chain_id})")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """(commands/run_node.go:97 NewRunNodeCmd)"""
+    from cometbft_tpu.node import Node
+
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.block_sync:
+        cfg.base.block_sync = True
+    node = Node(cfg)
+    node.start()
+    stop = {"done": False}
+
+    def handle(signum, frame):
+        stop["done"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    while not stop["done"]:
+        if node.wait(0.5):
+            break  # the node stopped on its own
+    if node.is_running():
+        node.stop()
+    return 0
+
+
+def cmd_reset_all(args) -> int:
+    """(commands/reset.go UnsafeResetAllCmd) — wipe data, keep keys."""
+    cfg = _load_config(args.home)
+    data_dir = cfg.db_dir
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    pv_state = cfg.priv_validator_state_path
+    os.makedirs(os.path.dirname(pv_state), exist_ok=True)
+    with open(pv_state, "w", encoding="utf-8") as f:
+        json.dump({"height": "0", "round": 0, "step": 0}, f)
+    print(f"Reset data in {data_dir}")
+    return 0
+
+
+def cmd_reset_state(args) -> int:
+    """(commands/reset.go ResetStateCmd) — wipe chain stores only."""
+    cfg = _load_config(args.home)
+    for name in ("blockstore", "state", "evidence", "tx_index"):
+        for suffix in (".db", ".sqlite", ""):
+            path = os.path.join(cfg.db_dir, name + suffix)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            elif os.path.exists(path):
+                os.remove(path)
+    print("Reset chain state")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """(commands/rollback.go)"""
+    from cometbft_tpu.state import Store as StateStore
+    from cometbft_tpu.state.rollback import rollback_state
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.utils.db import open_db
+
+    cfg = _load_config(args.home)
+    block_db = open_db("blockstore", cfg.base.db_backend, cfg.db_dir)
+    state_db = open_db("state", cfg.base.db_backend, cfg.db_dir)
+    try:
+        height, app_hash = rollback_state(
+            StateStore(state_db), BlockStore(block_db),
+            remove_block=args.hard,
+        )
+        print(
+            f"Rolled back state to height {height} "
+            f"and app hash {app_hash.hex().upper()}"
+        )
+    finally:
+        block_db.close()
+        state_db.close()
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """(commands/gen_validator.go) — emits the FULL key document, the
+    same shape FilePV persists, so it can be piped into
+    priv_validator_key.json."""
+    from cometbft_tpu.privval import FilePV
+
+    pv = FilePV.generate()
+    print(
+        json.dumps(
+            {
+                "address": pv.pub_key.address().hex().upper(),
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(pv.pub_key.bytes()).decode(),
+                },
+                "priv_key": {
+                    "type": "tendermint/PrivKeyEd25519",
+                    "value": base64.b64encode(
+                        pv._priv_key.bytes()
+                    ).decode(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    """Persists the key at node_key_path so the printed ID is the one
+    the node will actually use (gen_node_key.go LoadOrGenNodeKey)."""
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_generate(cfg.node_key_path)
+    print(nk.id())
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from cometbft_tpu.p2p.key import NodeKey
+
+    cfg = _load_config(args.home)
+    print(NodeKey.load(cfg.node_key_path).id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from cometbft_tpu.privval import FilePV
+
+    cfg = _load_config(args.home)
+    pv = FilePV.load(
+        cfg.priv_validator_key_path, cfg.priv_validator_state_path
+    )
+    print(
+        json.dumps(
+            {
+                "type": "tendermint/PubKeyEd25519",
+                "value": base64.b64encode(pv.pub_key.bytes()).decode(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """(commands/testnet.go) — N validator homes + shared genesis +
+    full-mesh persistent peers."""
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.utils.time import now_ns
+
+    n = args.v
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+    pvs, configs = [], []
+    for i in range(n):
+        home = os.path.join(args.o, f"node{i}")
+        cfg = default_config(home)
+        cfg.ensure_dirs()
+        pv = FilePV.generate(
+            cfg.priv_validator_key_path, cfg.priv_validator_state_path
+        )
+        pv.save()
+        NodeKey.load_or_generate(cfg.node_key_path)
+        pvs.append(pv)
+        configs.append(cfg)
+    gen = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=now_ns(),
+        validators=tuple(GenesisValidator(pv.pub_key, 1) for pv in pvs),
+    )
+    ids = [NodeKey.load(cfg.node_key_path).id() for cfg in configs]
+    for i, cfg in enumerate(configs):
+        port = args.starting_port + 2 * i
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{port + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"{ids[j]}@127.0.0.1:{args.starting_port + 2 * j}"
+            for j in range(n)
+            if j != i
+        )
+        gen.save_as(cfg.genesis_path)
+        cfg.save()
+    print(f"Successfully initialized {n} node directories in {args.o}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cometbft_tpu",
+        description="BFT state machine replication (TPU-native build)",
+    )
+    parser.add_argument(
+        "--home",
+        default=os.environ.get(
+            "CMTHOME", os.path.expanduser("~/.cometbft_tpu")
+        ),
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    p = sub.add_parser("init", help="initialize a node home")
+    p.add_argument("--chain-id", default="")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run the node")
+    p.add_argument("--proxy_app", default="")
+    p.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    p.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    p.add_argument("--p2p.persistent_peers", dest="persistent_peers",
+                   default="")
+    p.add_argument("--block_sync", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("unsafe-reset-all", help="wipe data, keep keys")
+    p.set_defaults(fn=cmd_reset_all)
+    p = sub.add_parser("reset-state", help="wipe chain stores")
+    p.set_defaults(fn=cmd_reset_state)
+
+    p = sub.add_parser("rollback", help="roll state back one height")
+    p.add_argument("--hard", action="store_true",
+                   help="also remove the block")
+    p.set_defaults(fn=cmd_rollback)
+
+    for name, fn in (
+        ("gen-validator", cmd_gen_validator),
+        ("gen-node-key", cmd_gen_node_key),
+        ("show-node-id", cmd_show_node_id),
+        ("show-validator", cmd_show_validator),
+        ("version", cmd_version),
+    ):
+        p = sub.add_parser(name)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("testnet", help="generate a localnet")
+    p.add_argument("--v", type=int, default=4)
+    p.add_argument("--o", default="./mytestnet")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--starting-port", type=int, default=26656)
+    p.set_defaults(fn=cmd_testnet)
+
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 1
+    return args.fn(args)
+
+
+__all__ = ["main"]

@@ -326,10 +326,26 @@ class Config:
             if getattr(self.consensus, name) < 0:
                 raise ConfigError(f"consensus {name} cannot be negative")
         if self.statesync.enable:
-            if len(self.statesync.rpc_servers) < 2:
-                raise ConfigError("statesync requires >= 2 rpc_servers")
+            # rpc_servers feed the light-client state provider; in-process
+            # embedders may instead inject providers directly (Node's
+            # state_providers), so one configured server is not an error —
+            # but a single server IS when any are configured (no witness).
+            if len(self.statesync.rpc_servers) == 1:
+                raise ConfigError(
+                    "statesync needs >= 2 rpc_servers (primary + witness)"
+                )
             if self.statesync.trust_height <= 0:
                 raise ConfigError("statesync requires trust_height > 0")
+            try:
+                trust_hash = bytes.fromhex(self.statesync.trust_hash)
+            except ValueError:
+                raise ConfigError(
+                    "statesync trust_hash must be hex"
+                ) from None
+            if len(trust_hash) != 32:
+                raise ConfigError(
+                    "statesync trust_hash must be 32 bytes of hex"
+                )
         if self.tx_index.indexer not in ("kv", "null", "psql"):
             raise ConfigError(f"unknown indexer {self.tx_index.indexer!r}")
 
@@ -427,7 +443,8 @@ def test_config(home: str = "") -> Config:
         peer_query_maj23_sleep_duration_ns=250 * 10**6,
     )
     cfg.mempool.recheck_timeout_ns = 10 * 10**6
-    cfg.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral port per test node
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral ports per test node
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     return cfg
 
 

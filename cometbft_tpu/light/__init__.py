@@ -1,1 +1,44 @@
-"""Package placeholder — populated as layers land."""
+"""Light plane — header verification without full blocks (reference:
+light/)."""
+
+from cometbft_tpu.light.client import (
+    Client,
+    ErrLightClientAttack,
+    LightClientError,
+    SEQUENTIAL,
+    SKIPPING,
+    TrustOptions,
+)
+from cometbft_tpu.light.provider import (
+    LightBlockNotFoundError,
+    NodeProvider,
+    Provider,
+    ProviderError,
+)
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    VerificationError,
+    verify,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "DEFAULT_TRUST_LEVEL",
+    "ErrLightClientAttack",
+    "LightBlockNotFoundError",
+    "LightClientError",
+    "LightStore",
+    "NodeProvider",
+    "Provider",
+    "ProviderError",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "TrustOptions",
+    "VerificationError",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+]

@@ -1,0 +1,294 @@
+"""Light client (reference: light/client.go:133).
+
+Verifies headers against a trusted root using sequential or skipping
+(bisection) verification, cross-checks every newly verified header
+against witness providers (fork detection, light/detector.go), and
+persists trusted blocks.  The 10k-header verification benchmark
+(BASELINE.json) exercises this plane's batch-verify calls.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from fractions import Fraction
+
+from cometbft_tpu.light.provider import Provider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    ErrNewValSetCantBeTrusted,
+    VerificationError,
+    verify as _verify,
+    verify_adjacent,
+)
+from cometbft_tpu.types.evidence import LightClientAttackEvidence
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.time import now_ns
+
+SEQUENTIAL = "sequential"   # client.go:44
+SKIPPING = "skipping"       # client.go:50
+
+DEFAULT_PRUNING_SIZE = 1000  # client.go:60
+DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 10**9
+
+
+class LightClientError(Exception):
+    pass
+
+
+class ErrLightClientAttack(LightClientError):
+    """(light/errors.go ErrLightClientAttack) — divergence between the
+    primary and a witness was detected and evidence submitted."""
+
+
+class NoWitnessesError(LightClientError):
+    pass
+
+
+@dataclass(frozen=True)
+class TrustOptions:
+    """(light/client.go:77 TrustOptions) — the subjective root of trust."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period_ns <= 0:
+            raise LightClientError("trusting period must be positive")
+        if self.height <= 0:
+            raise LightClientError("trust height must be positive")
+        if len(self.hash) != 32:
+            raise LightClientError("trust hash must be 32 bytes")
+
+
+class Client:
+    """(light/client.go:133 Client)"""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: list[Provider],
+        trusted_store: LightStore,
+        verification_mode: str = SKIPPING,
+        trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+        max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+        pruning_size: int = DEFAULT_PRUNING_SIZE,
+        logger: Logger | None = None,
+    ):
+        trust_options.validate()
+        self.chain_id = chain_id
+        self.trust_options = trust_options
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = trusted_store
+        self.mode = verification_mode
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.pruning_size = pruning_size
+        self.logger = logger or default_logger().with_fields(module="light")
+        self._mtx = threading.Lock()
+        self._initialize()
+
+    # -- initialization (client.go:265 initializeWithTrustOptions) -------
+
+    def _initialize(self) -> None:
+        existing = self.store.latest()
+        if existing is not None:
+            return  # already have a trust root (client.go checkTrustedHeaderUsingOptions simplified: keep store)
+        lb = self.primary.light_block(self.trust_options.height)
+        lb.validate_basic(self.chain_id)
+        if lb.hash() != self.trust_options.hash:
+            raise LightClientError(
+                f"primary's header hash {lb.hash().hex()[:12]} != "
+                f"trust hash {self.trust_options.hash.hex()[:12]}"
+            )
+        # +2/3 of ITS validator set signed it
+        from cometbft_tpu.light.verifier import _verify_self_commit
+
+        _verify_self_commit(lb, self.chain_id)
+        self._compare_with_witnesses(lb)
+        self.store.save(lb)
+
+    # -- public API -------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> LightBlock | None:
+        return self.store.get(height)
+
+    def latest_trusted(self) -> LightBlock | None:
+        return self.store.latest()
+
+    def update(self, now: int | None = None) -> LightBlock | None:
+        """Verify the primary's latest header (client.go:486 Update)."""
+        latest = self.primary.light_block(0)
+        trusted = self.store.latest()
+        if trusted is not None and latest.height <= trusted.height:
+            return None
+        return self.verify_light_block_at_height(latest.height, now)
+
+    def verify_light_block_at_height(
+        self, height: int, now: int | None = None
+    ) -> LightBlock:
+        """(client.go:473 VerifyLightBlockAtHeight)"""
+        if height <= 0:
+            raise LightClientError("height must be positive")
+        now = now_ns() if now is None else now
+        with self._mtx:
+            existing = self.store.get(height)
+            if existing is not None:
+                return existing
+            lb = self.primary.light_block(height)
+            lb.validate_basic(self.chain_id)
+            if lb.height != height:
+                raise LightClientError(
+                    f"primary returned height {lb.height}, wanted {height}"
+                )
+            self._verify_light_block(lb, now)
+            return lb
+
+    def verify_header(self, header, now: int | None = None) -> LightBlock:
+        """Verify a caller-supplied header by fetching its light block
+        (client.go:520 VerifyHeader)."""
+        lb = self.verify_light_block_at_height(header.height, now)
+        if lb.hash() != header.hash():
+            raise LightClientError(
+                "header differs from the verified header at that height"
+            )
+        return lb
+
+    # -- verification strategies -----------------------------------------
+
+    def _verify_light_block(self, new: LightBlock, now: int) -> None:
+        trusted = self.store.light_block_before(new.height)
+        if trusted is None:
+            # target below our first trusted block: backwards verification
+            first = self.store.first()
+            if first is None:
+                raise LightClientError("store has no trust root")
+            self._verify_backwards(first, new)
+            self._finalize_verified(new)
+            return
+        if self.mode == SEQUENTIAL:
+            self._verify_sequential(trusted, new, now)
+        else:
+            self._verify_skipping(trusted, new, now)
+        self._finalize_verified(new)
+
+    def _finalize_verified(self, new: LightBlock) -> None:
+        self._compare_with_witnesses(new)
+        self.store.save(new)
+        if self.store.size() > self.pruning_size:
+            self.store.prune(self.pruning_size)
+
+    def _verify_sequential(
+        self, trusted: LightBlock, new: LightBlock, now: int
+    ) -> None:
+        """(client.go:612 verifySequential) — fetch and verify every
+        intermediate header."""
+        current = trusted
+        for h in range(trusted.height + 1, new.height + 1):
+            nxt = (
+                new if h == new.height else self.primary.light_block(h)
+            )
+            nxt.validate_basic(self.chain_id)
+            verify_adjacent(
+                current, nxt, self.chain_id,
+                self.trust_options.period_ns, now,
+                self.max_clock_drift_ns,
+            )
+            if h != new.height:
+                self.store.save(nxt)
+            current = nxt
+
+    def _verify_skipping(
+        self, trusted: LightBlock, new: LightBlock, now: int
+    ) -> None:
+        """(client.go:705 verifySkipping) — bisection: try the jump; on
+        insufficient trusted power, verify the midpoint first."""
+        verified = [trusted]
+        pending = [new]
+        depth_guard = 0
+        while pending:
+            depth_guard += 1
+            if depth_guard > 10_000:
+                raise LightClientError("bisection did not converge")
+            base = verified[-1]
+            target = pending[-1]
+            try:
+                _verify(
+                    base, target, self.chain_id,
+                    self.trust_options.period_ns, now,
+                    self.trust_level, self.max_clock_drift_ns,
+                )
+                verified.append(target)
+                pending.pop()
+                if target.height != new.height:
+                    self.store.save(target)
+            except ErrNewValSetCantBeTrusted:
+                pivot = (base.height + target.height) // 2
+                if pivot in (base.height, target.height):
+                    raise LightClientError(
+                        "cannot bisect further — chain not verifiable "
+                        "within the trusting period"
+                    ) from None
+                mid = self.primary.light_block(pivot)
+                mid.validate_basic(self.chain_id)
+                pending.append(mid)
+
+    def _verify_backwards(self, trusted: LightBlock, new: LightBlock) -> None:
+        """(client.go:790 backwards) — hash-link each header back from
+        the trusted block to the target."""
+        current = trusted
+        for h in range(trusted.height - 1, new.height - 1, -1):
+            prev = new if h == new.height else self.primary.light_block(h)
+            prev.validate_basic(self.chain_id)
+            if current.header.last_block_id.hash != prev.hash():
+                raise VerificationError(
+                    f"header {h} does not hash-link to header {h + 1}"
+                )
+            current = prev
+
+    # -- fork detection (light/detector.go) ------------------------------
+
+    def _compare_with_witnesses(self, lb: LightBlock) -> None:
+        """(detector.go:33 detectDivergence) — any witness serving a
+        different header at this height implies an attack on one side;
+        build evidence and report it to the other side's provider."""
+        for witness in self.witnesses:
+            try:
+                w_lb = witness.light_block(lb.height)
+            except Exception:  # noqa: BLE001 — witness down: skip
+                continue
+            if w_lb.hash() == lb.hash():
+                continue
+            ev = LightClientAttackEvidence(
+                conflicting_header_hash=w_lb.hash(),
+                conflicting_commit=w_lb.signed_header.commit,
+                common_height=max(lb.height - 1, 1),
+                total_voting_power=w_lb.validator_set.total_voting_power(),
+                timestamp_ns=w_lb.time_ns,
+            )
+            for target in (self.primary, witness):
+                try:
+                    target.report_evidence(ev)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise ErrLightClientAttack(
+                f"witness header {w_lb.hash().hex()[:12]} conflicts with "
+                f"primary {lb.hash().hex()[:12]} at height {lb.height}"
+            )
+
+
+__all__ = [
+    "Client",
+    "ErrLightClientAttack",
+    "LightClientError",
+    "NoWitnessesError",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "TrustOptions",
+]

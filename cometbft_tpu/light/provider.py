@@ -1,0 +1,255 @@
+"""Light-block providers (reference: light/provider/provider.go).
+
+A provider serves light blocks for a chain.  ``NodeProvider`` reads a
+local node's stores directly (the in-process analog of the reference's
+http provider — the RPC-backed provider plugs in the same interface
+once the RPC plane lands)."""
+
+from __future__ import annotations
+
+from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+
+
+class ProviderError(Exception):
+    pass
+
+
+class LightBlockNotFoundError(ProviderError):
+    """(provider/errors.go ErrLightBlockNotFound)"""
+
+
+class Provider:
+    """(light/provider/provider.go:14 Provider)"""
+
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    def light_block(self, height: int) -> LightBlock:
+        """Height 0 means latest."""
+        raise NotImplementedError
+
+    def report_evidence(self, ev) -> None:
+        raise NotImplementedError
+
+
+class NodeProvider(Provider):
+    """Serves light blocks straight from a node's block/state stores."""
+
+    def __init__(self, chain_id: str, block_store, state_store,
+                 evidence_pool=None):
+        self._chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.evidence_pool = evidence_pool
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height)
+        if meta is None:
+            raise LightBlockNotFoundError(f"no block at height {height}")
+        # the canonical commit FOR height H is stored with block H+1;
+        # for the chain head fall back to the seen commit
+        commit = self.block_store.load_block_commit(height)
+        if commit is None:
+            commit = self.block_store.load_seen_commit(height)
+        if commit is None:
+            raise LightBlockNotFoundError(f"no commit for height {height}")
+        vals = self.state_store.load_validators(height)
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+
+    def report_evidence(self, ev) -> None:
+        if self.evidence_pool is not None:
+            self.evidence_pool.add_evidence(ev)
+
+    def consensus_params(self, height: int):
+        return self.state_store.load_consensus_params(height)
+
+
+# -- RPC-backed provider (reference: light/provider/http) ---------------
+
+def _ns_from_rfc3339(s: str) -> int:
+    from datetime import datetime, timezone
+
+    base, _, frac = s.rstrip("Z").partition(".")
+    dt = datetime.strptime(base, "%Y-%m-%dT%H:%M:%S").replace(
+        tzinfo=timezone.utc
+    )
+    ns = int(dt.timestamp()) * 1_000_000_000
+    if frac:
+        ns += int(frac.ljust(9, "0")[:9])
+    return ns
+
+
+def _header_from_json(d: dict):
+    from cometbft_tpu.types.block import BlockID, Header, PartSetHeader
+
+    def hx(key):
+        return bytes.fromhex(d.get(key) or "")
+
+    lbi = d.get("last_block_id") or {}
+    parts = lbi.get("parts") or {}
+    return Header(
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time_ns=_ns_from_rfc3339(d["time"]),
+        last_block_id=BlockID(
+            hash=bytes.fromhex(lbi.get("hash") or ""),
+            part_set_header=PartSetHeader(
+                total=int(parts.get("total") or 0),
+                hash=bytes.fromhex(parts.get("hash") or ""),
+            ),
+        ),
+        last_commit_hash=hx("last_commit_hash"),
+        data_hash=hx("data_hash"),
+        validators_hash=hx("validators_hash"),
+        next_validators_hash=hx("next_validators_hash"),
+        consensus_hash=hx("consensus_hash"),
+        app_hash=hx("app_hash"),
+        last_results_hash=hx("last_results_hash"),
+        evidence_hash=hx("evidence_hash"),
+        proposer_address=hx("proposer_address"),
+        version_block=int(d.get("version", {}).get("block", 0)),
+        version_app=int(d.get("version", {}).get("app", 0)),
+    )
+
+
+def _commit_from_json(d: dict):
+    import base64
+
+    from cometbft_tpu.types.block import (
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+
+    bid = d.get("block_id") or {}
+    parts = bid.get("parts") or {}
+    sigs = []
+    for s in d.get("signatures") or []:
+        sigs.append(
+            CommitSig(
+                block_id_flag=int(s["block_id_flag"]),
+                validator_address=bytes.fromhex(
+                    s.get("validator_address") or ""
+                ),
+                timestamp_ns=(
+                    _ns_from_rfc3339(s["timestamp"])
+                    if s.get("timestamp")
+                    else 0
+                ),
+                signature=(
+                    base64.b64decode(s["signature"])
+                    if s.get("signature")
+                    else b""
+                ),
+            )
+        )
+    return Commit(
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=BlockID(
+            hash=bytes.fromhex(bid.get("hash") or ""),
+            part_set_header=PartSetHeader(
+                total=int(parts.get("total") or 0),
+                hash=bytes.fromhex(parts.get("hash") or ""),
+            ),
+        ),
+        signatures=tuple(sigs),
+    )
+
+
+def _validator_set_from_json(vals: list):
+    import base64
+
+    from cometbft_tpu.crypto.ed25519 import Ed25519PubKey
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    return ValidatorSet(
+        [
+            Validator(
+                pub_key=Ed25519PubKey(
+                    base64.b64decode(v["pub_key"]["value"])
+                ),
+                voting_power=int(v["voting_power"]),
+                proposer_priority=int(v.get("proposer_priority", 0)),
+            )
+            for v in vals
+        ]
+    )
+
+
+class HTTPProvider(Provider):
+    """Light blocks over the JSON-RPC API (light/provider/http/http.go).
+
+    Uses /commit and /validators; evidence goes to /broadcast_evidence;
+    consensus params (verified by the caller against the header's
+    consensus_hash) via /consensus_params."""
+
+    def __init__(self, chain_id: str, address: str, timeout: float = 10.0):
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        self._chain_id = chain_id
+        base = address if "://" in address else f"http://{address}"
+        self.client = HTTPClient(base, timeout=timeout)
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        kwargs = {} if height == 0 else {"height": height}
+        try:
+            commit_resp = self.client.commit(**kwargs)
+            h = int(commit_resp["signed_header"]["header"]["height"])
+            vals_resp = self.client.validators(height=h, per_page=100)
+            vals = list(vals_resp["validators"])
+            while len(vals) < int(vals_resp["total"]):
+                more = self.client.validators(
+                    height=h, per_page=100,
+                    page=len(vals) // 100 + 1,
+                )
+                if not more["validators"]:
+                    break
+                vals.extend(more["validators"])
+        except Exception as exc:  # noqa: BLE001 — node down / pruned height
+            raise LightBlockNotFoundError(str(exc)) from exc
+        return LightBlock(
+            signed_header=SignedHeader(
+                header=_header_from_json(
+                    commit_resp["signed_header"]["header"]
+                ),
+                commit=_commit_from_json(
+                    commit_resp["signed_header"]["commit"]
+                ),
+            ),
+            validator_set=_validator_set_from_json(vals),
+        )
+
+    def report_evidence(self, ev) -> None:
+        from cometbft_tpu.types import codec
+
+        self.client.broadcast_evidence(
+            evidence=codec.encode_evidence(ev).hex()
+        )
+
+    def consensus_params(self, height: int):
+        from cometbft_tpu.types.params import ConsensusParams
+
+        resp = self.client.consensus_params(height=height)
+        return ConsensusParams.from_json_dict(resp["consensus_params"])
+
+
+__all__ = [
+    "HTTPProvider",
+    "LightBlockNotFoundError",
+    "NodeProvider",
+    "Provider",
+    "ProviderError",
+]

@@ -16,6 +16,14 @@ from cometbft_tpu.config import Config
 from cometbft_tpu.consensus import ConsensusState, Handshaker
 from cometbft_tpu.blocksync import BlocksyncReactor
 from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.rpc import Environment, JSONRPCServer
+from cometbft_tpu.state.txindex import (
+    BlockIndexer,
+    IndexerService,
+    NullIndexer,
+    TxIndexer,
+)
+from cometbft_tpu.statesync import StatesyncReactor
 from cometbft_tpu.evidence import EvidenceReactor, Pool as EvidencePool
 from cometbft_tpu.mempool.reactor import MempoolReactor
 from cometbft_tpu.p2p import (
@@ -95,6 +103,7 @@ class Node(BaseService):
         app: Application | None = None,
         genesis: GenesisDoc | None = None,
         priv_validator: FilePV | None = None,
+        state_providers: list | None = None,  # light providers for statesync
         logger: Logger | None = None,
     ):
         super().__init__(
@@ -122,8 +131,22 @@ class Node(BaseService):
         self.app = app if app is not None else default_app(config)
         self.proxy_app = AppConns(local_client_creator(self.app))
 
-        # 4. event bus (setup.go:181)
+        # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()
+        if config.tx_index.indexer == "kv":
+            self.indexer_db = open_db("tx_index", backend, db_dir)
+            self.tx_indexer = TxIndexer(self.indexer_db)
+            self.block_indexer = BlockIndexer(self.indexer_db)
+        else:
+            self.indexer_db = None
+            self.tx_indexer = NullIndexer()
+            self.block_indexer = NullIndexer()
+        self.indexer_service = IndexerService(
+            self.tx_indexer,
+            self.block_indexer,
+            self.event_bus,
+            logger=self.logger.with_fields(module="indexer"),
+        )
 
         # 5. privval (setup.go:698)
         if priv_validator is None and os.path.exists(
@@ -194,14 +217,18 @@ class Node(BaseService):
         # 11. p2p: reactors → transport → switch (setup.go:404-473)
         self.consensus_reactor = ConsensusReactor(
             self.consensus,
-            wait_sync=config.base.block_sync,
+            wait_sync=config.base.block_sync or config.statesync.enable,
             logger=self.logger.with_fields(module="consensus-reactor"),
         )
         self.blocksync_reactor = BlocksyncReactor(
             state,
             self.block_exec,
             self.block_store,
-            block_sync=config.base.block_sync,
+            # statesync owns the bootstrap when enabled; it hands off to
+            # blocksync via start_sync on completion (node.go blockSync
+            # && !stateSync)
+            block_sync=config.base.block_sync
+            and not config.statesync.enable,
             consensus_reactor=self.consensus_reactor,
             logger=self.logger.with_fields(module="blocksync"),
         )
@@ -215,11 +242,28 @@ class Node(BaseService):
             self.evidence_pool,
             logger=self.logger.with_fields(module="evidence-reactor"),
         )
+        # statesync (node/setup.go:557 startStateSync)
+        ss_enabled = config.statesync.enable
+        state_provider = None
+        if ss_enabled:
+            state_provider = self._make_state_provider(
+                config, genesis, state_providers or []
+            )
+        self.statesync_reactor = StatesyncReactor(
+            self.proxy_app.snapshot,
+            enabled=ss_enabled,
+            state_provider=state_provider,
+            on_complete=self._on_statesync_complete,
+            discovery_time=config.statesync.discovery_time_ns / 1e9,
+            logger=self.logger.with_fields(module="statesync"),
+        )
+
         reactors = {
             "BLOCKSYNC": self.blocksync_reactor,
             "CONSENSUS": self.consensus_reactor,
             "MEMPOOL": self.mempool_reactor,
             "EVIDENCE": self.evidence_reactor,
+            "STATESYNC": self.statesync_reactor,
         }
         self.node_key = NodeKey.load_or_generate(config.node_key_path)
         channels = bytes(
@@ -255,12 +299,107 @@ class Node(BaseService):
         for name, reactor in reactors.items():
             self.switch.add_reactor(name, reactor)
 
+        # 12. RPC (node.go:598 startRPC)
+        self.rpc_env = Environment(
+            block_store=self.block_store,
+            state_store=self.state_store,
+            consensus=self.consensus,
+            mempool=self.mempool,
+            switch=self.switch,
+            event_bus=self.event_bus,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            proxy_app=self.proxy_app,
+            evidence_pool=self.evidence_pool,
+            genesis=genesis,
+            node_info=node_info,
+            pub_key=(
+                priv_validator.pub_key if priv_validator is not None else None
+            ),
+            blocksync_reactor=self.blocksync_reactor,
+            statesync_reactor=self.statesync_reactor,
+        )
+        self.rpc_server: JSONRPCServer | None = None
+        if config.rpc.laddr:
+            rpc_addr = NetAddress.parse(config.rpc.laddr)
+            self.rpc_server = JSONRPCServer(
+                self.rpc_env.routes(),
+                ws_routes=self.rpc_env.ws_routes(),
+                host=rpc_addr.host,
+                port=rpc_addr.port,
+                on_ws_disconnect=self.rpc_env.drop_client,
+                logger=self.logger.with_fields(module="rpc"),
+            )
+
+    def _make_state_provider(self, config, genesis, providers):
+        """Light-client-verified state provider (stateprovider.go:39)."""
+        from cometbft_tpu.light import Client as LightClient, LightStore
+        from cometbft_tpu.statesync import LightClientStateProvider
+        from cometbft_tpu.light.client import TrustOptions
+        from cometbft_tpu.utils.db import MemDB
+
+        if not providers and config.statesync.rpc_servers:
+            from cometbft_tpu.light.provider import HTTPProvider
+
+            providers = [
+                HTTPProvider(genesis.chain_id, addr)
+                for addr in config.statesync.rpc_servers
+            ]
+        if len(providers) < 2:
+            # primary + at least one witness, or fork detection is a
+            # no-op and a lone malicious provider owns the bootstrap
+            # (mirrors the rpc_servers >= 2 config rule)
+            raise NodeError(
+                "statesync needs >= 2 light providers (primary + witness)"
+            )
+        trust = TrustOptions(
+            period_ns=config.statesync.trust_period_ns,
+            height=config.statesync.trust_height,
+            hash=bytes.fromhex(config.statesync.trust_hash),
+        )
+        lc = LightClient(
+            genesis.chain_id,
+            trust,
+            providers[0],
+            providers[1:],
+            LightStore(MemDB()),
+            logger=self.logger.with_fields(module="light"),
+        )
+        # params are fetched from the primary but verified against the
+        # light-verified header's consensus_hash in the state provider
+        params_fn = getattr(providers[0], "consensus_params", None)
+        return LightClientStateProvider(lc, consensus_params_fn=params_fn)
+
+    def _on_statesync_complete(self, state, commit) -> None:
+        """Bootstrap stores from the synced state, then blocksync the
+        remaining gap (node.go startStateSync completion)."""
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.state = state
+        self.consensus.state = state
+        self.mempool_reactor.enable_in_out_txs()
+        self.logger.info(
+            "state sync complete", height=state.last_block_height
+        )
+        if self.config.base.block_sync:
+            self.blocksync_reactor.start_sync(state)
+        else:
+            # operator chose consensus-only catch-up (node.go: blockSync
+            # && !stateSync gate applies post-statesync too)
+            self.consensus_reactor.switch_to_consensus(state)
+
     # -- lifecycle -------------------------------------------------------
 
     def on_start(self) -> None:
         """(node/node.go:580 OnStart)"""
         self.proxy_app.start()
         self.event_bus.start()
+
+        if self.config.statesync.enable:
+            # statesync path skips the handshake: the app will be
+            # restored from a snapshot, not replayed (node.go:363)
+            self._post_handshake_setup()
+            return
 
         # crash recovery: three-way height reconciliation (setup.go:222)
         hs = Handshaker(
@@ -280,6 +419,15 @@ class Node(BaseService):
             self.blocksync_reactor.pool.height,
             self.state.last_block_height + 1,
         )
+
+        self._post_handshake_setup()
+
+    def _post_handshake_setup(self) -> None:
+        self.indexer_service.start()
+        # RPC before p2p "so we can receive txs for the first block"
+        # (node.go:598)
+        if self.rpc_server is not None:
+            self.rpc_server.start()
 
         if isinstance(self.mempool, CListMempool):
             max_bytes = self.state.consensus_params.block.max_bytes
@@ -305,6 +453,9 @@ class Node(BaseService):
             channels=self.transport.node_info.channels,
             moniker=self.transport.node_info.moniker,
         )
+        # the RPC env reports the ACTUAL bound address, not the
+        # configured (possibly port-0) one
+        self.rpc_env.node_info = self.transport.node_info
         self.switch.start()
         peers = parse_peer_list(self.config.p2p.persistent_peers)
         if peers:
@@ -312,12 +463,16 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         services = (
+            self.rpc_server,
             self.switch,
             self.consensus,
+            self.indexer_service,
             self.event_bus,
             self.proxy_app,
         )
         for svc in services:
+            if svc is None:
+                continue
             try:
                 if svc.is_running():
                     svc.stop()
@@ -326,6 +481,8 @@ class Node(BaseService):
         self.block_store_db.close()
         self.state_db.close()
         self.evidence_db.close()
+        if self.indexer_db is not None:
+            self.indexer_db.close()
 
     # -- convenience -----------------------------------------------------
 

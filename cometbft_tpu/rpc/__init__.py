@@ -1,1 +1,13 @@
-"""Package placeholder — populated as layers land."""
+"""RPC plane — JSON-RPC/HTTP/WebSocket API (reference: rpc/)."""
+
+from cometbft_tpu.rpc.client import HTTPClient, LocalClient
+from cometbft_tpu.rpc.core import Environment
+from cometbft_tpu.rpc.jsonrpc import JSONRPCServer, RPCError
+
+__all__ = [
+    "Environment",
+    "HTTPClient",
+    "JSONRPCServer",
+    "LocalClient",
+    "RPCError",
+]

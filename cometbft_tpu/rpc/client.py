@@ -1,0 +1,85 @@
+"""RPC clients (reference: rpc/client/).
+
+- ``HTTPClient``: JSON-RPC over HTTP via urllib (rpc/client/http);
+- ``LocalClient``: direct calls into an Environment, no network
+  (rpc/client/local) — the embedding-friendly client.
+
+Both expose the route names as methods via ``call``.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from cometbft_tpu.rpc.jsonrpc import RPCError
+
+
+class HTTPClient:
+    """(rpc/client/http/http.go HTTP)"""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._next_id = 0
+
+    def call(self, method: str, **params):
+        self._next_id += 1
+        payload = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self._next_id,
+                "method": method,
+                "params": params,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.base_url,
+            data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = json.loads(resp.read())
+        if "error" in body and body["error"]:
+            err = body["error"]
+            raise RPCError(
+                err.get("code", -32603),
+                err.get("message", "unknown"),
+                err.get("data", ""),
+            )
+        return body["result"]
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(**params):
+            return self.call(name, **params)
+
+        return call
+
+
+class LocalClient:
+    """(rpc/client/local/local.go Local)"""
+
+    def __init__(self, env):
+        self.env = env
+        self._routes = env.routes()
+
+    def call(self, method: str, **params):
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCError(-32601, f"unknown method {method!r}")
+        return fn(**params)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(**params):
+            return self.call(name, **params)
+
+        return call
+
+
+__all__ = ["HTTPClient", "LocalClient"]

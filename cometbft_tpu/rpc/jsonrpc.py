@@ -1,0 +1,333 @@
+"""JSON-RPC 2.0 over HTTP + WebSocket (reference: rpc/jsonrpc/).
+
+- HTTP POST with a JSON-RPC envelope (single or batch) →
+  rpc/jsonrpc/server/http_json_handler.go;
+- HTTP GET ``/route?arg=val`` URI style →
+  rpc/jsonrpc/server/http_uri_handler.go;
+- ``/websocket`` upgraded via RFC 6455 (hand-rolled: this image has no
+  websocket lib) carrying the same envelopes, used for event
+  subscriptions → rpc/jsonrpc/server/ws_handler.go.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+
+# JSON-RPC error codes (rpc/jsonrpc/types/types.go)
+ERR_PARSE = -32700
+ERR_INVALID_REQUEST = -32600
+ERR_METHOD_NOT_FOUND = -32601
+ERR_INVALID_PARAMS = -32602
+ERR_INTERNAL = -32603
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+def make_response(req_id, result=None, error: RPCError | None = None) -> dict:
+    if error is not None:
+        return {
+            "jsonrpc": "2.0",
+            "id": req_id,
+            "error": {
+                "code": error.code,
+                "message": error.message,
+                "data": error.data,
+            },
+        }
+    return {"jsonrpc": "2.0", "id": req_id, "result": result}
+
+
+# -- WebSocket framing (RFC 6455) ---------------------------------------
+
+def ws_accept_key(client_key: str) -> str:
+    digest = hashlib.sha1((client_key + _WS_MAGIC).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def ws_read_frame(rfile) -> tuple[int, bytes] | None:
+    """Returns (opcode, payload) or None on EOF/close."""
+    head = rfile.read(2)
+    if len(head) < 2:
+        return None
+    b1, b2 = head
+    opcode = b1 & 0x0F
+    masked = bool(b2 & 0x80)
+    length = b2 & 0x7F
+    if length == 126:
+        length = struct.unpack(">H", rfile.read(2))[0]
+    elif length == 127:
+        length = struct.unpack(">Q", rfile.read(8))[0]
+    if length > 16 * 1024 * 1024:
+        return None
+    mask = rfile.read(4) if masked else b""
+    payload = rfile.read(length)
+    if masked:
+        payload = bytes(
+            c ^ mask[i % 4] for i, c in enumerate(payload)
+        )
+    if opcode == 0x8:  # close
+        return None
+    return opcode, payload
+
+
+def ws_write_frame(wfile, payload: bytes, opcode: int = 0x1) -> None:
+    header = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header += bytes([n])
+    elif n < 1 << 16:
+        header += bytes([126]) + struct.pack(">H", n)
+    else:
+        header += bytes([127]) + struct.pack(">Q", n)
+    wfile.write(header + payload)
+    wfile.flush()
+
+
+class JSONRPCServer(BaseService):
+    """(rpc/jsonrpc/server/http_server.go Serve)
+
+    ``routes``: name → callable(**kwargs) returning a JSON-able dict
+    (raise RPCError for structured failures).  ``ws_routes``: routes
+    that need the live connection (subscribe/unsubscribe) — they get a
+    ``_ws_ctx`` kwarg exposing ``send(dict)`` and ``client_id``.
+    """
+
+    def __init__(
+        self,
+        routes: dict,
+        ws_routes: dict | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_ws_disconnect=None,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="jsonrpc",
+            logger=logger or default_logger().with_fields(module="rpc-server"),
+        )
+        self.routes = routes
+        self.ws_routes = ws_routes or {}
+        self.on_ws_disconnect = on_ws_disconnect
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through our logger
+                outer.logger.debug("http " + (fmt % args))
+
+            def _send_json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    req = json.loads(raw) if raw else {}
+                except json.JSONDecodeError:
+                    self._send_json(
+                        make_response(
+                            None, error=RPCError(ERR_PARSE, "parse error")
+                        )
+                    )
+                    return
+                if isinstance(req, list):
+                    self._send_json([outer._dispatch(r) for r in req])
+                else:
+                    self._send_json(outer._dispatch(req))
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                route = url.path.strip("/")
+                if route == "websocket":
+                    self._upgrade_websocket()
+                    return
+                if route == "":
+                    self._send_json(
+                        {"routes": sorted(outer.routes) + sorted(outer.ws_routes)}
+                    )
+                    return
+                params = {k: _parse_uri_arg(v) for k, v in parse_qsl(url.query)}
+                self._send_json(
+                    outer._dispatch(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": -1,
+                            "method": route,
+                            "params": params,
+                        }
+                    )
+                )
+
+            def _upgrade_websocket(self):
+                key = self.headers.get("Sec-WebSocket-Key", "")
+                if not key:
+                    self.send_error(400, "missing websocket key")
+                    return
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", "websocket")
+                self.send_header("Connection", "Upgrade")
+                self.send_header("Sec-WebSocket-Accept", ws_accept_key(key))
+                self.end_headers()
+                outer._serve_websocket(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _dispatch(self, req: dict, ws_ctx=None) -> dict:
+        req_id = req.get("id", -1)
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        if not isinstance(params, dict):
+            return make_response(
+                req_id,
+                error=RPCError(ERR_INVALID_PARAMS, "params must be a map"),
+            )
+        fn = None
+        if ws_ctx is not None and method in self.ws_routes:
+            fn = self.ws_routes[method]
+            params = dict(params, _ws_ctx=ws_ctx)
+        elif method in self.routes:
+            fn = self.routes[method]
+        if fn is None:
+            return make_response(
+                req_id,
+                error=RPCError(
+                    ERR_METHOD_NOT_FOUND, f"unknown method {method!r}"
+                ),
+            )
+        try:
+            return make_response(req_id, result=fn(**params))
+        except RPCError as exc:
+            return make_response(req_id, error=exc)
+        except TypeError as exc:
+            return make_response(
+                req_id, error=RPCError(ERR_INVALID_PARAMS, str(exc))
+            )
+        except Exception as exc:  # noqa: BLE001 — handler bug or bad state
+            self.logger.error("rpc handler error", method=method,
+                              err=repr(exc))
+            return make_response(
+                req_id, error=RPCError(ERR_INTERNAL, str(exc))
+            )
+
+    # -- websocket session (ws_handler.go wsConnection) -------------------
+
+    def _serve_websocket(self, handler) -> None:
+        send_mtx = threading.Lock()
+        client_id = f"ws-{id(handler)}"
+
+        class WSContext:
+            def __init__(self):
+                self.client_id = client_id
+                self.alive = True
+
+            def send(self, obj: dict) -> bool:
+                try:
+                    with send_mtx:
+                        ws_write_frame(
+                            handler.wfile, json.dumps(obj).encode()
+                        )
+                    return True
+                except OSError:
+                    self.alive = False
+                    return False
+
+        ctx = WSContext()
+        try:
+            while not self._quit.is_set():
+                frame = ws_read_frame(handler.rfile)
+                if frame is None:
+                    return
+                opcode, payload = frame
+                if opcode == 0x9:  # ping → pong
+                    with send_mtx:
+                        ws_write_frame(handler.wfile, payload, opcode=0xA)
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    ctx.send(make_response(
+                        None, error=RPCError(ERR_PARSE, "parse error")
+                    ))
+                    continue
+                ctx.send(self._dispatch(req, ws_ctx=ctx))
+        except OSError:
+            pass
+        finally:
+            ctx.alive = False
+            if self.on_ws_disconnect is not None:
+                try:
+                    self.on_ws_disconnect(client_id)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # -- lifecycle --------------------------------------------------------
+
+    def on_start(self) -> None:
+        threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="jsonrpc-http",
+            daemon=True,
+        ).start()
+        self.logger.info("rpc server listening", host=self.host,
+                         port=self.port)
+
+    def on_stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _parse_uri_arg(value: str):
+    """URI args arrive as strings; JSON-decode the obvious scalars
+    (http_uri_handler.go arg parsing)."""
+    if value in ("true", "false"):
+        return value == "true"
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, ValueError):
+        return value
+
+
+__all__ = [
+    "ERR_INTERNAL",
+    "ERR_INVALID_PARAMS",
+    "ERR_INVALID_REQUEST",
+    "ERR_METHOD_NOT_FOUND",
+    "ERR_PARSE",
+    "JSONRPCServer",
+    "RPCError",
+    "make_response",
+    "ws_accept_key",
+    "ws_read_frame",
+    "ws_write_frame",
+]

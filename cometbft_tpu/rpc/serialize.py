@@ -1,0 +1,149 @@
+"""JSON serialization of domain types for the RPC surface (reference:
+the JSON shapes produced by rpc/core responses via cmtjson).
+
+Conventions mirror the reference wire JSON: 64-bit ints as strings,
+hashes as upper-hex, times as RFC3339 with nanoseconds.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+
+def hexb(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def b64(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+def time_rfc3339(ns: int) -> str:
+    dt = datetime.fromtimestamp(ns / 1e9, tz=timezone.utc)
+    frac = ns % 1_000_000_000
+    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac:09d}Z"
+
+
+def block_id_json(bid) -> dict:
+    return {
+        "hash": hexb(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": hexb(bid.part_set_header.hash),
+        },
+    }
+
+
+def header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version_block), "app": str(h.version_app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": time_rfc3339(h.time_ns),
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hexb(h.last_commit_hash),
+        "data_hash": hexb(h.data_hash),
+        "validators_hash": hexb(h.validators_hash),
+        "next_validators_hash": hexb(h.next_validators_hash),
+        "consensus_hash": hexb(h.consensus_hash),
+        "app_hash": hexb(h.app_hash),
+        "last_results_hash": hexb(h.last_results_hash),
+        "evidence_hash": hexb(h.evidence_hash),
+        "proposer_address": hexb(h.proposer_address),
+    }
+
+
+def commit_sig_json(cs) -> dict:
+    return {
+        "block_id_flag": cs.block_id_flag,
+        "validator_address": hexb(cs.validator_address),
+        "timestamp": time_rfc3339(cs.timestamp_ns),
+        "signature": b64(cs.signature) if cs.signature else None,
+    }
+
+
+def commit_json(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": block_id_json(c.block_id),
+        "signatures": [commit_sig_json(cs) for cs in c.signatures],
+    }
+
+
+def block_json(b) -> dict:
+    from cometbft_tpu.types import codec
+
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [b64(tx) for tx in b.data.txs]},
+        "evidence": {
+            "evidence": [
+                {"type": type(ev).__name__, "height": str(ev.height)}
+                for ev in b.evidence
+            ]
+        },
+        "last_commit": commit_json(b.last_commit) if b.last_commit else None,
+    }
+
+
+def block_meta_json(meta) -> dict:
+    return {
+        "block_id": block_id_json(meta.block_id),
+        "block_size": str(meta.block_size),
+        "header": header_json(meta.header),
+        "num_txs": str(meta.num_txs),
+    }
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": hexb(v.address),
+        "pub_key": {
+            "type": "tendermint/PubKeyEd25519",
+            "value": b64(v.pub_key.bytes()),
+        },
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def event_json(ev) -> dict:
+    return {
+        "type": ev.type,
+        "attributes": [
+            {"key": a.key, "value": a.value, "index": a.index}
+            for a in ev.attributes
+        ],
+    }
+
+
+def exec_tx_result_json(r) -> dict:
+    return {
+        "code": r.code,
+        "data": b64(r.data) if r.data else None,
+        "log": r.log,
+        "info": r.info,
+        "gas_wanted": str(r.gas_wanted),
+        "gas_used": str(r.gas_used),
+        "events": [event_json(e) for e in r.events or ()],
+        "codespace": r.codespace,
+    }
+
+
+__all__ = [
+    "b64",
+    "block_id_json",
+    "block_json",
+    "block_meta_json",
+    "commit_json",
+    "commit_sig_json",
+    "event_json",
+    "exec_tx_result_json",
+    "header_json",
+    "hexb",
+    "time_rfc3339",
+    "validator_json",
+]

@@ -1,0 +1,76 @@
+"""State rollback (reference: state/rollback.go:15).
+
+Rolls the state store back one height so the block can be re-executed
+— the escape hatch after a faulty upgrade produced a bad app hash.
+The block itself stays in the block store (reference semantics) unless
+``remove_block`` is set, matching `cometbft rollback [--hard]`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from cometbft_tpu.state import State, Store
+
+
+class RollbackError(Exception):
+    pass
+
+
+def rollback_state(state_store: Store, block_store,
+                   remove_block: bool = False) -> tuple[int, bytes]:
+    """Returns (new_height, new_app_hash) (rollback.go Rollback)."""
+    invalid_state = state_store.load()
+    if invalid_state is None or invalid_state.is_empty():
+        raise RollbackError("no state found to roll back")
+    height = block_store.height()
+
+    # the state at H may be ahead of the store when the final block was
+    # never saved (crash mid-commit); then state-only rollback suffices
+    if invalid_state.last_block_height == height + 1:
+        rolled_back_state = invalid_state
+    elif invalid_state.last_block_height != height:
+        raise RollbackError(
+            f"state height {invalid_state.last_block_height} does not "
+            f"match store height {height}"
+        )
+    else:
+        rolled_back_state = None
+
+    target = invalid_state.last_block_height - 1
+    rollback_block = block_store.load_block_meta(target)
+    if rollback_block is None:
+        raise RollbackError(f"no block meta at rollback height {target}")
+    # the block AFTER the rollback target carries target's app_hash
+    latest_block = block_store.load_block_meta(target + 1)
+    if latest_block is None:
+        raise RollbackError(f"no block meta at height {target + 1}")
+
+    previous_last_validators = state_store.load_validators(max(target - 1, 1))
+    current_validators = state_store.load_validators(target)
+    next_validators = state_store.load_validators(target + 1)
+    params = state_store.load_consensus_params(target + 1)
+
+    new_state = State(
+        chain_id=invalid_state.chain_id,
+        initial_height=invalid_state.initial_height,
+        last_block_height=target,
+        last_block_id=latest_block.header.last_block_id,
+        last_block_time_ns=rollback_block.header.time_ns,
+        validators=current_validators,
+        next_validators=next_validators,
+        last_validators=previous_last_validators,
+        last_height_validators_changed=invalid_state.last_height_validators_changed,
+        consensus_params=params,
+        last_height_params_changed=invalid_state.last_height_params_changed,
+        last_results_hash=latest_block.header.last_results_hash,
+        app_hash=latest_block.header.app_hash,
+        version_app=invalid_state.version_app,
+    )
+    state_store.save(new_state)
+    if remove_block and rolled_back_state is None:
+        block_store.prune_last_block()
+    return new_state.last_block_height, new_state.app_hash
+
+
+__all__ = ["RollbackError", "rollback_state"]

@@ -1,0 +1,318 @@
+"""Transaction and block indexing (reference: state/txindex/,
+state/indexer/block/kv/).
+
+The IndexerService subscribes to the event bus and writes two indexes:
+- tx index: tx hash → ExecTxResult, plus ``{type}.{attr}`` composite
+  event keys → tx hashes (state/txindex/kv/kv.go:42);
+- block index: event keys → heights (state/indexer/block/kv).
+
+Search supports the pubsub query DSL (``tx.height > 5 AND
+transfer.amount = '100'``) — the same language the event bus uses.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from cometbft_tpu.abci.types import ExecTxResult
+from cometbft_tpu.types.block import tx_hash
+from cometbft_tpu.types.event_bus import (
+    EVENT_QUERY_NEW_BLOCK,
+    EVENT_QUERY_TX,
+    EventBus,
+    flatten_abci_events,
+)
+from cometbft_tpu.utils.db import DB
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.utils.pubsub import Query
+from cometbft_tpu.utils.service import BaseService
+
+_PREFIX_RESULT = b"tx/"       # tx hash -> stored result
+_PREFIX_TXKEY = b"txk/"       # composite event key -> tx hash
+_PREFIX_BLOCKKEY = b"blk/"    # composite event key -> height
+_PREFIX_TXHEIGHT = b"txh/"    # height/index -> tx hash
+
+
+def _encode_result(height: int, index: int, tx: bytes,
+                   result: ExecTxResult) -> bytes:
+    w = ProtoWriter()
+    w.varint(1, height)
+    w.varint(2, index)
+    w.bytes_(3, tx)
+    w.varint(4, result.code)
+    w.bytes_(5, result.data)
+    w.string(6, result.log)
+    w.varint(7, result.gas_wanted & 0xFFFFFFFFFFFFFFFF)
+    w.varint(8, result.gas_used & 0xFFFFFFFFFFFFFFFF)
+    ev = ProtoWriter()
+    for event in result.events or ():
+        e = ProtoWriter()
+        e.string(1, event.type)
+        for attr in event.attributes:
+            a = ProtoWriter()
+            a.string(1, attr.key)
+            a.string(2, attr.value)
+            a.bool_(3, attr.index)
+            e.message(2, a.finish())
+        ev.message(1, e.finish())
+    w.message(9, ev.finish())
+    return w.finish()
+
+
+def _decode_result(data: bytes) -> dict:
+    from cometbft_tpu.abci.types import Event, EventAttribute
+
+    f = ProtoReader(data).to_dict()
+    events = []
+    if 9 in f:
+        ef = ProtoReader(bytes(f[9][0])).to_dict()
+        for raw in ef.get(1, []):
+            e = ProtoReader(bytes(raw)).to_dict()
+            attrs = []
+            for araw in e.get(2, []):
+                a = ProtoReader(bytes(araw)).to_dict()
+                attrs.append(
+                    EventAttribute(
+                        key=bytes(a.get(1, [b""])[0]).decode(),
+                        value=bytes(a.get(2, [b""])[0]).decode(),
+                        index=bool(a.get(3, [0])[0]),
+                    )
+                )
+            events.append(
+                Event(
+                    type=bytes(e.get(1, [b""])[0]).decode(),
+                    attributes=tuple(attrs),
+                )
+            )
+    return {
+        "height": int(f.get(1, [0])[0]),
+        "index": int(f.get(2, [0])[0]),
+        "tx": bytes(f.get(3, [b""])[0]),
+        "result": ExecTxResult(
+            code=int(f.get(4, [0])[0]),
+            data=bytes(f.get(5, [b""])[0]),
+            log=bytes(f.get(6, [b""])[0]).decode(),
+            gas_wanted=int(f.get(7, [0])[0]),
+            gas_used=int(f.get(8, [0])[0]),
+            events=tuple(events),
+        ),
+    }
+
+
+class TxIndexer:
+    """KV tx indexer (state/txindex/kv/kv.go:42)."""
+
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+
+    def index(self, height: int, index: int, tx: bytes,
+              result: ExecTxResult) -> None:
+        h = tx_hash(tx)
+        ops: list[tuple[bytes, bytes | None]] = [
+            (
+                _PREFIX_RESULT + h,
+                _encode_result(height, index, tx, result),
+            ),
+            (
+                _PREFIX_TXHEIGHT
+                + height.to_bytes(8, "big")
+                + index.to_bytes(4, "big"),
+                h,
+            ),
+        ]
+        events = flatten_abci_events(
+            result.events, {}, indexed_only=True
+        )
+        for key, values in events.items():
+            for value in values:
+                ops.append(
+                    (
+                        _PREFIX_TXKEY
+                        + key.encode()
+                        + b"/"
+                        + value.encode()
+                        + b"/"
+                        + height.to_bytes(8, "big")
+                        + index.to_bytes(4, "big"),
+                        h,
+                    )
+                )
+        with self._mtx:
+            self.db.write_batch(ops)
+
+    def get(self, hash_: bytes) -> dict | None:
+        raw = self.db.get(_PREFIX_RESULT + hash_)
+        return _decode_result(bytes(raw)) if raw is not None else None
+
+    def search(self, query: Query | str, limit: int = 100) -> list[dict]:
+        """Match indexed txs against a pubsub query.  Conditions on
+        ``tx.height`` / ``tx.hash`` plus event attributes are supported
+        by re-evaluating the query against each tx's flattened events —
+        correctness-first (kv.go Search does key-range planning)."""
+        if isinstance(query, str):
+            query = Query.parse(query)
+        out: list[dict] = []
+        seen: set[bytes] = set()
+        for _, h in self.db.prefix_iterator(_PREFIX_TXHEIGHT):
+            h = bytes(h)
+            if h in seen:
+                continue
+            seen.add(h)
+            entry = self.get(h)
+            if entry is None:
+                continue
+            events = flatten_abci_events(
+                entry["result"].events,
+                {
+                    "tx.hash": [h.hex().upper()],
+                    "tx.height": [str(entry["height"])],
+                },
+            )
+            if query.matches(events):
+                out.append(entry)
+                if len(out) >= limit:
+                    break
+        return out
+
+
+class BlockIndexer:
+    """KV block-event indexer (state/indexer/block/kv/kv.go)."""
+
+    def __init__(self, db: DB):
+        self.db = db
+        self._mtx = threading.Lock()
+
+    def index(self, height: int, finalize_events) -> None:
+        events = flatten_abci_events(
+            finalize_events, {}, indexed_only=True
+        )
+        ops: list[tuple[bytes, bytes | None]] = [
+            (_PREFIX_BLOCKKEY + b"height/" + height.to_bytes(8, "big"),
+             b"\x01")
+        ]
+        for key, values in events.items():
+            for value in values:
+                ops.append(
+                    (
+                        _PREFIX_BLOCKKEY
+                        + key.encode()
+                        + b"/"
+                        + value.encode()
+                        + b"/"
+                        + height.to_bytes(8, "big"),
+                        b"\x01",
+                    )
+                )
+        with self._mtx:
+            self.db.write_batch(ops)
+
+    def search(self, query: Query | str, limit: int = 100) -> list[int]:
+        """Heights whose block events match the query."""
+        if isinstance(query, str):
+            query = Query.parse(query)
+        matches: list[int] = []
+        # collect per-height flattened events
+        by_height: dict[int, dict[str, list[str]]] = {}
+        for key, _ in self.db.prefix_iterator(_PREFIX_BLOCKKEY):
+            rest = key[len(_PREFIX_BLOCKKEY):]
+            height = int.from_bytes(rest[-8:], "big")
+            body = rest[:-8].rstrip(b"/")
+            ev = by_height.setdefault(
+                height, {"block.height": [str(height)]}
+            )
+            if body and body != b"height":
+                k, _, v = body.rpartition(b"/")
+                ev.setdefault(k.decode(), []).append(v.decode())
+        for height in sorted(by_height):
+            if query.matches(by_height[height]):
+                matches.append(height)
+                if len(matches) >= limit:
+                    break
+        return matches
+
+
+class NullIndexer:
+    """(state/txindex/null, indexer/block/null)"""
+
+    def index(self, *a, **kw) -> None:
+        pass
+
+    def get(self, hash_: bytes) -> None:
+        return None
+
+    def search(self, query, limit: int = 100) -> list:
+        return []
+
+
+class IndexerService(BaseService):
+    """Subscribes to the event bus and drives both indexers
+    (state/txindex/indexer_service.go)."""
+
+    def __init__(
+        self,
+        tx_indexer,
+        block_indexer,
+        event_bus: EventBus,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="indexer",
+            logger=logger or default_logger().with_fields(module="indexer"),
+        )
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+
+    def on_start(self) -> None:
+        self._block_sub = self.event_bus.subscribe(
+            "indexer", EVENT_QUERY_NEW_BLOCK, capacity=200
+        )
+        self._tx_sub = self.event_bus.subscribe(
+            "indexer", EVENT_QUERY_TX, capacity=1000
+        )
+        threading.Thread(
+            target=self._run, name="indexer", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        try:
+            self.event_bus.unsubscribe_all("indexer")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _run(self) -> None:
+        while not self._quit.is_set():
+            for sub, handler in (
+                (self._block_sub, self._on_block),
+                (self._tx_sub, self._on_tx),
+            ):
+                try:
+                    msg = sub.next(timeout=0.1)
+                except TimeoutError:
+                    continue
+                except Exception:  # noqa: BLE001 — bus stopped
+                    return
+                try:
+                    handler(msg.data)
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.error("indexing failed", err=repr(exc))
+
+    def _on_block(self, data) -> None:
+        height = data.block.header.height
+        events = ()
+        if data.result_finalize_block is not None:
+            events = data.result_finalize_block.events
+        self.block_indexer.index(height, events)
+
+    def _on_tx(self, data) -> None:
+        self.tx_indexer.index(data.height, data.index, data.tx, data.result)
+
+
+__all__ = [
+    "BlockIndexer",
+    "IndexerService",
+    "NullIndexer",
+    "TxIndexer",
+]

@@ -1,1 +1,31 @@
-"""Package placeholder — populated as layers land."""
+"""Statesync plane — snapshot-based bootstrap (reference: statesync/)."""
+
+from cometbft_tpu.statesync.messages import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+)
+from cometbft_tpu.statesync.reactor import StatesyncReactor
+from cometbft_tpu.statesync.stateprovider import (
+    LightClientStateProvider,
+    StateProvider,
+)
+from cometbft_tpu.statesync.syncer import (
+    NoSnapshotsError,
+    Snapshot,
+    SnapshotPool,
+    SnapshotRejectedError,
+    Syncer,
+)
+
+__all__ = [
+    "CHUNK_CHANNEL",
+    "LightClientStateProvider",
+    "NoSnapshotsError",
+    "SNAPSHOT_CHANNEL",
+    "Snapshot",
+    "SnapshotPool",
+    "SnapshotRejectedError",
+    "StateProvider",
+    "StatesyncReactor",
+    "Syncer",
+]

@@ -164,6 +164,35 @@ class BlockStore:
 
     # -- pruning -------------------------------------------------------
 
+    def prune_last_block(self) -> None:
+        """Delete the newest block — the `rollback --hard` path
+        (store/store.go DeleteLatestBlock)."""
+        with self._mtx:
+            h = self._height
+            if h == 0:
+                raise BlockStoreError("block store is empty")
+            meta = self.load_block_meta(h)
+            ops: list[tuple[bytes, bytes | None]] = [
+                (_hkey(_META, h), None),
+                (_hkey(_COMMIT, h), None),
+                (_hkey(_COMMIT, h - 1), None),
+                (_hkey(_SEEN_COMMIT, h), None),
+            ]
+            if meta is not None:
+                ops.append((_HASH + meta.block_id.hash, None))
+                for i in range(meta.block_id.part_set_header.total):
+                    ops.append((_pkey(h, i), None))
+            prev_base, prev_height = self._base, self._height
+            self._height = h - 1
+            if self._height < self._base:
+                self._base = self._height
+            ops.append(self._save_state_ops())
+            try:
+                self._db.write_batch(ops)
+            except BaseException:
+                self._base, self._height = prev_base, prev_height
+                raise
+
     def prune_blocks(self, retain_height: int) -> int:
         """Remove blocks below ``retain_height``; returns count pruned
         (store/store.go PruneBlocks)."""

@@ -1,0 +1,104 @@
+"""CLI tests (reference: cmd/cometbft command tests)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from cometbft_tpu.cmd import main
+from cometbft_tpu.state.rollback import rollback_state
+
+
+def run_cli(*argv) -> int:
+    return main(list(argv))
+
+
+class TestBasicCommands:
+    def test_version(self, capsys):
+        assert run_cli("version") == 0
+        assert capsys.readouterr().out.strip()
+
+    def test_gen_node_key(self, capsys):
+        assert run_cli("gen-node-key") == 0
+        out = capsys.readouterr().out.strip()
+        assert len(out) == 40
+        bytes.fromhex(out)
+
+    def test_gen_validator(self, capsys):
+        assert run_cli("gen-validator") == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["pub_key"]["type"] == "tendermint/PubKeyEd25519"
+
+    def test_init_show_reset(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        assert run_cli("--home", home, "init", "--chain-id", "cli-chain") == 0
+        capsys.readouterr()
+        assert run_cli("--home", home, "show-node-id") == 0
+        node_id = capsys.readouterr().out.strip()
+        assert len(node_id) == 40
+        assert run_cli("--home", home, "show-validator") == 0
+        val = json.loads(capsys.readouterr().out)
+        assert val["type"] == "tendermint/PubKeyEd25519"
+        # init is idempotent (keeps keys + genesis)
+        assert run_cli("--home", home, "init") == 0
+        capsys.readouterr()
+        assert run_cli("--home", home, "show-node-id") == 0
+        assert capsys.readouterr().out.strip() == node_id
+        assert run_cli("--home", home, "unsafe-reset-all") == 0
+
+    def test_testnet_generation(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "net")
+        assert run_cli("testnet", "--v", "3", "--o", out_dir,
+                       "--starting-port", "27100") == 0
+        for i in range(3):
+            home = os.path.join(out_dir, f"node{i}")
+            assert os.path.exists(
+                os.path.join(home, "config", "genesis.json")
+            )
+            assert os.path.exists(
+                os.path.join(home, "config", "config.toml")
+            )
+        # all genesis files identical
+        docs = [
+            open(os.path.join(out_dir, f"node{i}", "config",
+                              "genesis.json")).read()
+            for i in range(3)
+        ]
+        assert len(set(docs)) == 1
+
+
+class TestRollback:
+    def test_rollback_one_height(self, tmp_path):
+        """Grow a chain, stop, roll back, verify state height."""
+        from tests.test_reactors import (
+            connect_star,
+            make_localnet,
+            wait_all_height,
+        )
+
+        nodes, privs, gen = make_localnet(tmp_path, 2)
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 4)
+            for n in nodes:
+                n.consensus.stop()
+            node = nodes[0]
+            before = node.state_store.load()
+            h, app_hash = rollback_state(
+                node.state_store, node.block_store, remove_block=True
+            )
+            assert h == before.last_block_height - 1
+            after = node.state_store.load()
+            assert after.last_block_height == h
+            assert node.block_store.height() == h
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass

@@ -1,0 +1,196 @@
+"""Light client tests (reference: light/client_test.go, verifier_test.go,
+detector_test.go) — run against a real 2-validator chain."""
+
+from __future__ import annotations
+
+import pytest
+
+from cometbft_tpu.light import (
+    Client,
+    ErrLightClientAttack,
+    LightStore,
+    NodeProvider,
+    SEQUENTIAL,
+    TrustOptions,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+from cometbft_tpu.light.verifier import (
+    ErrInvalidHeader,
+    ErrOldHeaderExpired,
+)
+from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+from cometbft_tpu.utils.db import MemDB
+from cometbft_tpu.utils.time import now_ns
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+WEEK_NS = 100 * 365 * 24 * 3600 * 10**9  # ample: test genesis time is fixed in 2023
+
+
+@pytest.fixture(scope="module")
+def chain(tmp_path_factory):
+    """A 2-validator chain grown to height >= 10, then stopped."""
+    tmp = tmp_path_factory.mktemp("lightchain")
+    nodes, privs, gen = make_localnet(tmp, 2)
+    for n in nodes:
+        n.start()
+    connect_star(nodes)
+    wait_all_height(nodes, 10)
+    for n in nodes:
+        n.consensus.stop()  # freeze the chain; stores stay open
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def provider_for(node):
+    return NodeProvider(
+        "reactor-test-chain", node.block_store, node.state_store
+    )
+
+
+def trust_root(node, height=1):
+    meta = node.block_store.load_block_meta(height)
+    return TrustOptions(
+        period_ns=WEEK_NS, height=height, hash=meta.block_id.hash
+    )
+
+
+class TestVerifier:
+    def _lb(self, node, h):
+        return provider_for(node).light_block(h)
+
+    def test_verify_adjacent_ok(self, chain):
+        lb1, lb2 = self._lb(chain[0], 1), self._lb(chain[0], 2)
+        verify_adjacent(lb1, lb2, "reactor-test-chain", WEEK_NS)
+
+    def test_verify_non_adjacent_ok(self, chain):
+        lb1, lb8 = self._lb(chain[0], 1), self._lb(chain[0], 8)
+        verify_non_adjacent(lb1, lb8, "reactor-test-chain", WEEK_NS)
+
+    def test_expired_trusted_header_rejected(self, chain):
+        lb1, lb2 = self._lb(chain[0], 1), self._lb(chain[0], 2)
+        with pytest.raises(ErrOldHeaderExpired):
+            verify_adjacent(
+                lb1, lb2, "reactor-test-chain",
+                trusting_period_ns=1,  # expired immediately
+                now=now_ns(),
+            )
+
+    def test_tampered_header_rejected(self, chain):
+        from dataclasses import replace
+
+        lb1, lb2 = self._lb(chain[0], 1), self._lb(chain[0], 2)
+        tampered_header = replace(lb2.header, app_hash=b"\xde\xad" * 16)
+        tampered = LightBlock(
+            signed_header=SignedHeader(
+                header=tampered_header, commit=lb2.signed_header.commit
+            ),
+            validator_set=lb2.validator_set,
+        )
+        with pytest.raises(Exception):
+            verify_adjacent(lb1, tampered, "reactor-test-chain", WEEK_NS)
+
+    def test_future_header_rejected(self, chain):
+        lb1, lb2 = self._lb(chain[0], 1), self._lb(chain[0], 2)
+        with pytest.raises(ErrInvalidHeader):
+            verify_adjacent(
+                lb1, lb2, "reactor-test-chain", WEEK_NS,
+                now=lb1.time_ns,  # "now" is before header 2's time
+                max_clock_drift_ns=0,
+            )
+
+
+class TestLightClient:
+    def test_skipping_verification(self, chain):
+        client = Client(
+            "reactor-test-chain",
+            trust_root(chain[0]),
+            provider_for(chain[0]),
+            [provider_for(chain[1])],
+            LightStore(MemDB()),
+        )
+        lb = client.verify_light_block_at_height(9)
+        assert lb.height == 9
+        assert client.trusted_light_block(9) is not None
+
+    def test_sequential_verification(self, chain):
+        client = Client(
+            "reactor-test-chain",
+            trust_root(chain[0]),
+            provider_for(chain[0]),
+            [provider_for(chain[1])],
+            LightStore(MemDB()),
+            verification_mode=SEQUENTIAL,
+        )
+        lb = client.verify_light_block_at_height(6)
+        assert lb.height == 6
+        # sequential stores every intermediate header
+        for h in range(1, 7):
+            assert client.trusted_light_block(h) is not None
+
+    def test_backwards_verification(self, chain):
+        client = Client(
+            "reactor-test-chain",
+            trust_root(chain[0], height=8),
+            provider_for(chain[0]),
+            [provider_for(chain[1])],
+            LightStore(MemDB()),
+        )
+        lb = client.verify_light_block_at_height(3)
+        assert lb.height == 3
+
+    def test_update_follows_head(self, chain):
+        client = Client(
+            "reactor-test-chain",
+            trust_root(chain[0]),
+            provider_for(chain[0]),
+            [provider_for(chain[1])],
+            LightStore(MemDB()),
+        )
+        latest = client.update()
+        assert latest is not None
+        assert latest.height >= 10
+
+    def test_divergent_witness_detected(self, chain):
+        from dataclasses import replace
+
+        class EvilProvider(NodeProvider):
+            """Serves a header with a forged app hash at every height."""
+
+            def __init__(self, inner):
+                super().__init__(
+                    "reactor-test-chain",
+                    inner.block_store,
+                    inner.state_store,
+                )
+                self.reported = []
+
+            def light_block(self, height):
+                lb = super().light_block(height)
+                forged = replace(lb.header, app_hash=b"\x66" * 32)
+                return LightBlock(
+                    signed_header=SignedHeader(
+                        header=forged, commit=lb.signed_header.commit
+                    ),
+                    validator_set=lb.validator_set,
+                )
+
+            def report_evidence(self, ev):
+                self.reported.append(ev)
+
+        evil = EvilProvider(provider_for(chain[1]))
+        client = Client(
+            "reactor-test-chain",
+            trust_root(chain[0]),
+            provider_for(chain[0]),
+            [],  # no witnesses at init...
+            LightStore(MemDB()),
+        )
+        client.witnesses = [evil]  # ...so init passes; then divergence
+        with pytest.raises(ErrLightClientAttack):
+            client.verify_light_block_at_height(5)
+        assert evil.reported, "evidence was not reported"

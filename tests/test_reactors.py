@@ -27,7 +27,7 @@ GENESIS_TIME = 1_700_000_000_000_000_000
 CHAIN = "reactor-test-chain"
 
 
-def make_localnet(tmp_path, n: int, connect: str = "star"):
+def make_localnet(tmp_path, n: int, app_factory=KVStoreApp):
     """n validator nodes sharing one genesis, each with its own home."""
     privs = [
         FilePV(ed.priv_key_from_secret(b"net-val%d" % i)) for i in range(n)
@@ -44,7 +44,7 @@ def make_localnet(tmp_path, n: int, connect: str = "star"):
         pv._key_path = cfg.priv_validator_key_path
         pv._state_path = cfg.priv_validator_state_path
         pv.save()
-        node = Node(cfg, app=KVStoreApp(), genesis=gen, priv_validator=pv)
+        node = Node(cfg, app=app_factory(), genesis=gen, priv_validator=pv)
         nodes.append(node)
     return nodes, privs, gen
 

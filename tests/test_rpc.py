@@ -1,0 +1,220 @@
+"""RPC plane tests: JSON-RPC over HTTP, URI GET, WebSocket
+subscriptions, indexer-backed queries (reference: rpc/core tests,
+rpc/jsonrpc/server tests)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import time
+
+import pytest
+
+from cometbft_tpu.rpc import HTTPClient, LocalClient, RPCError
+from cometbft_tpu.rpc.jsonrpc import ws_accept_key, ws_read_frame, ws_write_frame
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("rpcnet")
+    nodes, privs, gen = make_localnet(tmp, 2)
+    for n in nodes:
+        n.start()
+    connect_star(nodes)
+    wait_all_height(nodes, 3)
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def client_for(node) -> HTTPClient:
+    return HTTPClient(f"http://{node.rpc_server.host}:{node.rpc_server.port}")
+
+
+class TestInfoRoutes:
+    def test_health_and_status(self, net):
+        c = client_for(net[0])
+        assert c.health() == {}
+        status = c.status()
+        assert status["node_info"]["network"] == "reactor-test-chain"
+        assert int(status["sync_info"]["latest_block_height"]) >= 3
+        assert not status["sync_info"]["catching_up"]
+        assert status["validator_info"]["voting_power"] == "10"
+
+    def test_net_info_shows_peer(self, net):
+        c = client_for(net[0])
+        info = c.net_info()
+        assert info["listening"]
+        assert int(info["n_peers"]) == 1
+
+    def test_block_and_commit_and_header(self, net):
+        c = client_for(net[0])
+        blk = c.block(height=2)
+        assert blk["block"]["header"]["height"] == "2"
+        by_hash = c.block_by_hash(hash=blk["block_id"]["hash"])
+        assert by_hash["block_id"] == blk["block_id"]
+        commit = c.commit(height=2)
+        assert commit["signed_header"]["commit"]["height"] == "2"
+        hdr = c.header(height=2)
+        assert hdr["header"]["height"] == "2"
+
+    def test_blockchain_metas(self, net):
+        c = client_for(net[0])
+        info = c.blockchain(minHeight=1, maxHeight=3)
+        assert len(info["block_metas"]) == 3
+        # newest first
+        assert info["block_metas"][0]["header"]["height"] == "3"
+
+    def test_validators_and_params(self, net):
+        c = client_for(net[0])
+        vals = c.validators(height=2)
+        assert vals["total"] == "2"
+        params = c.consensus_params(height=2)
+        assert "block" in params["consensus_params"]
+
+    def test_genesis_and_abci_info(self, net):
+        c = client_for(net[0])
+        gen = c.genesis()
+        assert gen["genesis"]["chain_id"] == "reactor-test-chain"
+        info = c.abci_info()
+        assert int(info["response"]["last_block_height"]) >= 1
+
+    def test_consensus_state(self, net):
+        c = client_for(net[0])
+        rs = c.consensus_state()
+        assert int(rs["round_state"]["height"]) >= 3
+        dump = c.dump_consensus_state()
+        assert len(dump["peers"]) == 1
+
+    def test_unknown_method_and_bad_height(self, net):
+        c = client_for(net[0])
+        with pytest.raises(RPCError) as e:
+            c.call("no_such_route")
+        assert e.value.code == -32601
+        with pytest.raises(RPCError):
+            c.block(height=10**9)
+
+    def test_uri_get_route(self, net):
+        import urllib.request
+
+        node = net[0]
+        url = (
+            f"http://{node.rpc_server.host}:{node.rpc_server.port}"
+            f"/block?height=2"
+        )
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["result"]["block"]["header"]["height"] == "2"
+
+
+class TestTxRoutes:
+    def test_broadcast_tx_commit_and_query(self, net):
+        c = client_for(net[0])
+        tx = b"rpc-key=rpc-val"
+        res = c.broadcast_tx_commit(tx=tx.hex(), timeout=30.0)
+        assert res["check_tx"]["code"] == 0
+        assert res["tx_result"]["code"] == 0
+        height = int(res["height"])
+        assert height > 0
+        # abci query sees it
+        q = c.abci_query(data=b"rpc-key".hex())
+        assert base64.b64decode(q["response"]["value"]) == b"rpc-val"
+        # the indexer can find it by hash and by query
+        time.sleep(0.3)
+        got = c.tx(hash=res["hash"])
+        assert got["height"] == str(height)
+        found = c.tx_search(query=f"tx.height={height}")
+        assert int(found["total_count"]) >= 1
+
+    def test_broadcast_tx_sync_and_mempool_routes(self, net):
+        c = client_for(net[1])
+        res = c.broadcast_tx_sync(tx=b"sync-key=1".hex())
+        assert res["code"] == 0
+        stats = c.num_unconfirmed_txs()
+        assert int(stats["total"]) >= 0  # may already be committed
+
+    def test_block_results(self, net):
+        c = client_for(net[0])
+        tx = b"results-key=x"
+        res = c.broadcast_tx_commit(tx=tx.hex(), timeout=30.0)
+        br = c.block_results(height=int(res["height"]))
+        assert len(br["txs_results"]) >= 1
+
+
+class TestLocalClient:
+    def test_local_client_mirrors_http(self, net):
+        lc = LocalClient(net[0].rpc_env)
+        assert lc.status()["node_info"]["network"] == "reactor-test-chain"
+        assert lc.block(height=1)["block"]["header"]["height"] == "1"
+
+
+class TestWebSocket:
+    def _ws_connect(self, node):
+        sock = socket.create_connection(
+            (node.rpc_server.host, node.rpc_server.port), timeout=10
+        )
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\n"
+                f"Host: {node.rpc_server.host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        rfile = sock.makefile("rb")
+        status = rfile.readline()
+        assert b"101" in status
+        while rfile.readline() not in (b"\r\n", b""):
+            pass
+        return sock, rfile
+
+    def _ws_send(self, sock, obj):
+        payload = json.dumps(obj).encode()
+        mask = b"\x01\x02\x03\x04"
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            head = bytes([0x81, 0x80 | n])
+        else:
+            import struct
+
+            head = bytes([0x81, 0x80 | 126]) + struct.pack(">H", n)
+        sock.sendall(head + mask + masked)
+
+    def test_subscribe_new_block(self, net):
+        node = net[0]
+        sock, rfile = self._ws_connect(node)
+        try:
+            self._ws_send(
+                sock,
+                {
+                    "jsonrpc": "2.0",
+                    "id": 1,
+                    "method": "subscribe",
+                    "params": {"query": "tm.event='NewBlock'"},
+                },
+            )
+            # first frame: subscribe ack; then block events stream in
+            opcode, ack = ws_read_frame(rfile)
+            assert json.loads(ack)["id"] == 1
+            deadline = time.monotonic() + 20
+            got_block = False
+            while time.monotonic() < deadline and not got_block:
+                frame = ws_read_frame(rfile)
+                assert frame is not None
+                _, payload = frame
+                msg = json.loads(payload)
+                result = msg.get("result") or {}
+                if result.get("query") == "tm.event='NewBlock'":
+                    assert "block" in result["data"]["value"]
+                    got_block = True
+            assert got_block
+        finally:
+            sock.close()
