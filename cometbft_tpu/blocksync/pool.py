@@ -216,10 +216,15 @@ class BlockPool:
             if req is None:
                 return ""
             peer_id = req.peer_id
-            req.peer_id = ""
-            req.block = None
-            req.request_time = 0.0
             self._peers.pop(peer_id, None)
+            # orphan every in-flight request assigned to the removed
+            # peer, or they'd sit out the full request timeout
+            # (reference RemovePeer redoes all of a peer's requests)
+            for r in self._requesters.values():
+                if r.peer_id == peer_id:
+                    r.peer_id = ""
+                    r.block = None
+                    r.request_time = 0.0
             return peer_id
 
     # -- progress (pool.go IsCaughtUp) -----------------------------------

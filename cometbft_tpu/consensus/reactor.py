@@ -237,14 +237,15 @@ class PeerState:
         self, msg: VoteSetBitsMessage, our_votes: BitArray | None
     ) -> None:
         """(reactor.go ApplyVoteSetBitsMessage) — if we know our vote
-        set for that BlockID, OR the peer's claim with what we know
-        they know; else replace."""
+        set for that BlockID, the peer's claim is authoritative within
+        our set (votes.sub(ourVotes).or(msg.votes)): bits outside our
+        set are kept, bits within it are replaced; else replace."""
         with self._mtx:
             prs = self.prs
             if prs.height == msg.height:
                 arr = self._get_vote_bit_array_locked(msg.round, msg.type)
                 if arr is not None and our_votes is not None:
-                    had = arr.or_(our_votes.and_(msg.votes))
+                    had = arr.sub(our_votes).or_(msg.votes)
                     self._set_vote_bit_array_locked(msg.round, msg.type, had)
                 else:
                     self._set_vote_bit_array_locked(

@@ -142,36 +142,144 @@ class Pool:
         meta = self.block_store.load_block_meta(ev.height)
         return meta.header.time_ns if meta is not None else ev.timestamp_ns
 
+    def _load_signed_header(self, height: int):
+        """Our chain's SignedHeader at ``height`` (verify.go:264
+        getSignedHeader)."""
+        from cometbft_tpu.types.light_block import SignedHeader
+
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if commit is None:
+            # at the chain tip the +2/3 commit is only known locally
+            commit = self.block_store.load_seen_commit(height)
+        if meta is None or commit is None:
+            return None
+        return SignedHeader(header=meta.header, commit=commit)
+
     def _verify_light_client_attack(
         self, ev: LightClientAttackEvidence, state: State
     ) -> int:
-        """(verify.go:110 VerifyLightClientAttack) — structural checks:
-        common height exists, conflicting commit has +1/3 of the common
-        val set's power signed over the conflicting header."""
+        """(verify.go:110 VerifyLightClientAttack) — the conflicting
+        commit must carry real signatures: +1/3 of the common set's
+        power in the lunatic case (trusting verification), and +2/3 of
+        the conflicting set itself, all signatures checked; the listed
+        byzantine validators must match the actual signers."""
+        from fractions import Fraction
+
+        from cometbft_tpu.types import validation
+
+        cb = ev.conflicting_block
+        if cb is None or cb.commit is None or not cb.commit.signatures:
+            raise EvidenceInvalidError("missing conflicting block/commit")
         if ev.common_height <= 0:
             raise EvidenceInvalidError("non-positive common height")
         if ev.common_height > state.last_block_height:
             raise EvidenceInvalidError("common height in the future")
-        commit = ev.conflicting_commit
-        if commit is None or not commit.signatures:
-            raise EvidenceInvalidError("missing conflicting commit")
+        if ev.common_height > cb.height:
+            raise EvidenceInvalidError(
+                "common height above conflicting block height"
+            )
+
+        common_header = self._load_signed_header(ev.common_height)
+        if common_header is None:
+            raise EvidenceExpiredError(
+                f"no header at common height {ev.common_height}"
+            )
         try:
-            val_set = self.state_store.load_validators(ev.common_height)
+            common_vals = self.state_store.load_validators(ev.common_height)
         except Exception as exc:  # noqa: BLE001 — pruned/missing state
             raise EvidenceExpiredError(
                 f"no validator set for height {ev.common_height}: {exc}"
             ) from exc
-        if ev.total_voting_power != val_set.total_voting_power():
-            raise EvidenceInvalidError("total voting power mismatch")
-        # at least one byzantine validator must be in the common set
-        for addr in ev.byzantine_validators:
-            _, val = val_set.get_by_address(addr)
-            if val is None:
-                raise EvidenceInvalidError(
-                    "byzantine validator not in common set"
+        chain_id = state.chain_id
+
+        # Trusted header at the conflicting height; in a forward lunatic
+        # attack we don't have one yet and fall back to our latest.
+        trusted = common_header
+        if ev.common_height != cb.height:
+            trusted = self._load_signed_header(cb.height)
+            if trusted is None:
+                trusted = self._load_signed_header(self.block_store.height())
+                if trusted is None:
+                    raise EvidenceExpiredError("no trusted header available")
+                if trusted.header.time_ns < cb.time_ns:
+                    raise EvidenceInvalidError(
+                        "latest block time is before conflicting block time"
+                    )
+            # lunatic: one verification jump from the common set, every
+            # signature checked (VerifyCommitLightTrustingAllSignatures)
+            try:
+                validation.verify_commit_light_trusting(
+                    chain_id,
+                    common_vals,
+                    cb.commit,
+                    trust_level=Fraction(1, 3),
+                    count_all=True,
                 )
-        meta = self.block_store.load_block_meta(ev.common_height)
-        return meta.header.time_ns if meta is not None else ev.timestamp_ns
+            except validation.CommitError as exc:
+                raise EvidenceInvalidError(
+                    f"conflicting commit not signed by +1/3 of the "
+                    f"common validator set: {exc}"
+                ) from exc
+        elif ev.conflicting_header_is_invalid(trusted.header):
+            raise EvidenceInvalidError(
+                "common height equals conflicting height, so the "
+                "conflicting header must be correctly derived"
+            )
+
+        # +2/3 of the conflicting block's own validator set, all
+        # signatures checked (VerifyCommitLightAllSignatures).
+        if cb.validator_set is None or len(cb.validator_set) == 0:
+            raise EvidenceInvalidError("missing conflicting validator set")
+        if cb.header.validators_hash != cb.validator_set.hash():
+            raise EvidenceInvalidError(
+                "conflicting validator set does not match its header"
+            )
+        try:
+            validation.verify_commit_light(
+                chain_id,
+                cb.validator_set,
+                cb.commit.block_id,
+                cb.height,
+                cb.commit,
+                count_all=True,
+            )
+        except validation.CommitError as exc:
+            raise EvidenceInvalidError(
+                f"invalid commit from conflicting block: {exc}"
+            ) from exc
+        if cb.commit.block_id.hash != cb.hash():
+            raise EvidenceInvalidError(
+                "conflicting commit signs a different header"
+            )
+
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceInvalidError("total voting power mismatch")
+
+        # forward lunatic must violate monotonically increasing time;
+        # otherwise the conflicting header must actually differ.
+        if cb.height > trusted.header.height:
+            if cb.time_ns > trusted.header.time_ns:
+                raise EvidenceInvalidError(
+                    "conflicting block doesn't violate monotonic time"
+                )
+        elif trusted.hash() == cb.hash():
+            raise EvidenceInvalidError(
+                "conflicting header matches our own header"
+            )
+
+        # byzantine validators must be derived from the actual
+        # conflicting signatures, not the sender's say-so.
+        expected = ev.get_byzantine_validators(common_vals, trusted)
+        if tuple(v.address for v in expected) != tuple(
+            ev.byzantine_validators
+        ):
+            raise EvidenceInvalidError(
+                "byzantine validator list does not match the "
+                "conflicting commit's signers"
+            )
+
+        return common_header.header.time_ns
 
     # -- ingestion -------------------------------------------------------
 
@@ -279,13 +387,23 @@ class Pool:
                 ):
                     drop.append(key)
             # committed markers only matter within the age window — once
-            # expired evidence can no longer enter a block, drop them too
-            for key, _ in self.db.prefix_iterator(_PREFIX_COMMITTED):
+            # expired evidence can no longer enter a block, drop them
+            # too.  Expiry needs BOTH block age and duration exceeded
+            # (same rule as verify/pending pruning), otherwise a marker
+            # could vanish while its evidence is still admissible and
+            # the same evidence committed twice.
+            for key, raw in self.db.prefix_iterator(_PREFIX_COMMITTED):
                 ev_height = int.from_bytes(
                     key[len(_PREFIX_COMMITTED):len(_PREFIX_COMMITTED) + 8],
                     "big",
                 )
-                if height - ev_height > params.max_age_num_blocks:
+                ev_time = (
+                    int.from_bytes(raw[:8], "big") if len(raw) >= 8 else 0
+                )
+                if (
+                    height - ev_height > params.max_age_num_blocks
+                    and now - ev_time > params.max_age_duration_ns
+                ):
                     drop.append(key)
             for key in drop:
                 self.db.delete(key)
@@ -306,7 +424,14 @@ class Pool:
 
     def _mark_committed_locked(self, ev) -> None:
         self.db.delete(_key(_PREFIX_PENDING, ev.height, ev.hash()))
-        self.db.set(_key(_PREFIX_COMMITTED, ev.height, ev.hash()), b"\x01")
+        # marker value = evidence time, so expiry can apply the
+        # duration condition as well as the block-age one
+        meta = self.block_store.load_block_meta(ev.height)
+        ev_time = meta.header.time_ns if meta is not None else ev.timestamp_ns
+        self.db.set(
+            _key(_PREFIX_COMMITTED, ev.height, ev.hash()),
+            max(ev_time, 0).to_bytes(8, "big"),
+        )
 
     # -- reactor support -------------------------------------------------
 

@@ -254,10 +254,34 @@ class Client:
 
     # -- fork detection (light/detector.go) ------------------------------
 
+    def _make_attack_evidence(
+        self, conflicting: LightBlock, common: LightBlock, trusted: LightBlock
+    ) -> LightClientAttackEvidence:
+        """(detector.go newLightClientAttackEvidence) — ``common`` is
+        the latest trusted block both sides agree on; ``trusted`` is the
+        header we believe at the conflicting height.  Total power and
+        the byzantine list come from the common-height validator set and
+        the actual conflicting signatures, so full nodes' checks pass."""
+        from dataclasses import replace
+
+        ev = LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=common.height,
+            total_voting_power=common.validator_set.total_voting_power(),
+            timestamp_ns=common.time_ns,
+        )
+        byz = ev.get_byzantine_validators(
+            common.validator_set, trusted.signed_header
+        )
+        return replace(
+            ev, byzantine_validators=tuple(v.address for v in byz)
+        )
+
     def _compare_with_witnesses(self, lb: LightBlock) -> None:
         """(detector.go:33 detectDivergence) — any witness serving a
         different header at this height implies an attack on one side;
-        build evidence and report it to the other side's provider."""
+        we can't tell which, so build evidence against each side and
+        report it to the other."""
         for witness in self.witnesses:
             try:
                 w_lb = witness.light_block(lb.height)
@@ -265,18 +289,23 @@ class Client:
                 continue
             if w_lb.hash() == lb.hash():
                 continue
-            ev = LightClientAttackEvidence(
-                conflicting_header_hash=w_lb.hash(),
-                conflicting_commit=w_lb.signed_header.commit,
-                common_height=max(lb.height - 1, 1),
-                total_voting_power=w_lb.validator_set.total_voting_power(),
-                timestamp_ns=w_lb.time_ns,
-            )
-            for target in (self.primary, witness):
-                try:
-                    target.report_evidence(ev)
-                except Exception:  # noqa: BLE001
-                    pass
+            common = self.store.light_block_before(lb.height)
+            if common is None:
+                self.logger.error(
+                    "divergence detected but no trusted block below the "
+                    "conflicting height — cannot build attack evidence",
+                    height=lb.height,
+                )
+            else:
+                # witness's block is the fraud → tell the primary
+                ev_w = self._make_attack_evidence(w_lb, common, lb)
+                # primary's block is the fraud → tell the witness
+                ev_p = self._make_attack_evidence(lb, common, w_lb)
+                for target, ev in ((self.primary, ev_w), (witness, ev_p)):
+                    try:
+                        target.report_evidence(ev)
+                    except Exception:  # noqa: BLE001
+                        pass
             raise ErrLightClientAttack(
                 f"witness header {w_lb.hash().hex()[:12]} conflicts with "
                 f"primary {lb.hash().hex()[:12]} at height {lb.height}"

@@ -156,8 +156,7 @@ def encode_evidence(ev) -> bytes:
         w.message(1, inner.finish())
     elif isinstance(ev, LightClientAttackEvidence):
         inner = ProtoWriter()
-        inner.bytes_(1, ev.conflicting_header_hash)
-        inner.message(2, encode_commit(ev.conflicting_commit))
+        inner.message(1, ev.conflicting_block.encode())
         inner.varint(3, ev.common_height)
         for addr in ev.byzantine_validators:
             inner.bytes_(4, addr)
@@ -187,10 +186,13 @@ def decode_evidence(data: bytes):
             timestamp_ns=decode_timestamp(ef[5][0]) if 5 in ef else 0,
         )
     if 2 in f:
+        from cometbft_tpu.types.light_block import LightBlock
+
         ef = ProtoReader(f[2][0]).to_dict()
+        if 1 not in ef:
+            raise ValueError("light client attack evidence missing block")
         return LightClientAttackEvidence(
-            conflicting_header_hash=bytes(ef.get(1, [b""])[0]),
-            conflicting_commit=decode_commit(ef[2][0]) if 2 in ef else None,
+            conflicting_block=LightBlock.decode(bytes(ef[1][0])),
             common_height=s64(ef.get(3, [0])[0]),
             byzantine_validators=tuple(bytes(a) for a in ef.get(4, [])),
             total_voting_power=s64(ef.get(5, [0])[0]),

@@ -63,27 +63,86 @@ class DuplicateVoteEvidence:
 
 @dataclass(frozen=True)
 class LightClientAttackEvidence:
-    """A conflicting light block signed by a subset of validators
-    (types/evidence.go:176). The conflicting block is carried as its
-    header-level data; full verification lives in evidence/verify."""
+    """A conflicting light block (header + commit + validator set)
+    signed by a subset of validators (types/evidence.go:176).  The full
+    light block is carried so verifiers can check the conflicting
+    commit's signatures; full verification lives in evidence/pool."""
 
-    conflicting_header_hash: bytes
-    conflicting_commit: object  # Commit
+    conflicting_block: object  # LightBlock
     common_height: int
-    byzantine_validators: tuple[bytes, ...] = ()  # addresses
+    byzantine_validators: tuple[bytes, ...] = ()  # addresses, power-ordered
     total_voting_power: int = 0
     timestamp_ns: int = 0
 
     @property
     def height(self) -> int:
+        """Last height primary and witness agreed — the height the
+        byzantine validators are known to have been bonded at
+        (types/evidence.go:341 Height)."""
         return self.common_height
 
+    @property
+    def conflicting_header_hash(self) -> bytes:
+        return self.conflicting_block.hash()
+
     def hash(self) -> bytes:
-        from cometbft_tpu.types import codec
+        """Hash over (conflicting header hash, common height) only, so
+        permutations of the same attack with different signature subsets
+        collide and can't be committed twice (types/evidence.go:329)."""
         from cometbft_tpu.utils.protoio import ProtoWriter
 
         w = ProtoWriter()
-        w.bytes_(1, self.conflicting_header_hash)
+        w.bytes_(1, self.conflicting_block.hash())
         w.varint(2, self.common_height & 0xFFFFFFFFFFFFFFFF)
-        w.message(3, codec.encode_commit(self.conflicting_commit))
         return tmhash.sum256(w.finish())
+
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic-attack test: the conflicting header could not have
+        been produced by the validator set our chain had at that height
+        (types/evidence.go:313 ConflictingHeaderIsInvalid)."""
+        ch = self.conflicting_block.header
+        return (
+            trusted_header.validators_hash != ch.validators_hash
+            or trusted_header.next_validators_hash != ch.next_validators_hash
+            or trusted_header.consensus_hash != ch.consensus_hash
+            or trusted_header.app_hash != ch.app_hash
+            or trusted_header.last_results_hash != ch.last_results_hash
+        )
+
+    def get_byzantine_validators(
+        self, common_vals: ValidatorSet, trusted
+    ) -> list:
+        """Derive the malicious validators from the actual conflicting
+        signatures (types/evidence.go:260 GetByzantineValidators).
+
+        Lunatic attack → common-set validators who committed the
+        conflicting header.  Equivocation (same round) → validators who
+        committed in both headers.  Amnesia → unattributable, empty.
+        ``trusted`` is the SignedHeader our chain has at the conflicting
+        height.
+        """
+        cb = self.conflicting_block
+        validators = []
+        if self.conflicting_header_is_invalid(trusted.header):
+            for cs in cb.commit.signatures:
+                if not cs.is_commit():
+                    continue
+                _, val = common_vals.get_by_address(cs.validator_address)
+                if val is None:
+                    continue
+                validators.append(val)
+        elif trusted.commit.round == cb.commit.round:
+            for i, sig_a in enumerate(cb.commit.signatures):
+                if not sig_a.is_commit():
+                    continue
+                if i >= len(trusted.commit.signatures):
+                    continue
+                if not trusted.commit.signatures[i].is_commit():
+                    continue
+                _, val = cb.validator_set.get_by_address(
+                    sig_a.validator_address
+                )
+                if val is not None:
+                    validators.append(val)
+        validators.sort(key=lambda v: (-v.voting_power, v.address))
+        return validators

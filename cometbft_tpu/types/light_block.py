@@ -86,6 +86,10 @@ class LightBlock:
         return self.signed_header.header
 
     @property
+    def commit(self) -> Commit:
+        return self.signed_header.commit
+
+    @property
     def time_ns(self) -> int:
         return self.signed_header.header.time_ns
 

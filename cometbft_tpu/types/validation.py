@@ -194,9 +194,14 @@ def verify_commit_light(
     block_id: BlockID,
     height: int,
     commit: Commit,
+    count_all: bool = False,
 ) -> None:
     """Verify only until +2/3 is reached; nil votes skipped
-    (validation.go:63)."""
+    (validation.go:63).  ``count_all=True`` checks every commit
+    signature with no early break (VerifyCommitLightAllSignatures),
+    required when the commit is used as evidence — nil votes are still
+    skipped, so a garbage nil entry can't poison otherwise-valid
+    evidence."""
     _check_dims(vals, commit, height, block_id)
     needed = vals.total_voting_power() * 2 // 3
     _verify(
@@ -205,7 +210,7 @@ def verify_commit_light(
         commit,
         needed,
         count_sig=lambda cs: cs.is_commit(),
-        count_all=False,
+        count_all=count_all,
         lookup_by_address=False,
     )
 
@@ -215,10 +220,13 @@ def verify_commit_light_trusting(
     vals: ValidatorSet,
     commit: Commit,
     trust_level: Fraction = Fraction(1, 3),
+    count_all: bool = False,
 ) -> None:
     """Light-client trusting verification: signatures matched by address
     against the *trusted* set; needs > trust_level of its power
-    (validation.go:129)."""
+    (validation.go:129).  ``count_all=True`` checks every signature with
+    no early break (VerifyCommitLightTrustingAllSignatures), required
+    when the commit is used as evidence."""
     if trust_level.denominator == 0:
         raise ValueError("trust level has zero denominator")
     if not (0 < trust_level <= 1):
@@ -232,6 +240,6 @@ def verify_commit_light_trusting(
         commit,
         needed,
         count_sig=lambda cs: cs.is_commit(),
-        count_all=False,
+        count_all=count_all,
         lookup_by_address=True,
     )

@@ -64,6 +64,15 @@ class ValidatorSet:
     def __len__(self) -> int:
         return len(self.validators)
 
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, ValidatorSet)
+            and self.validators == other.validators
+        )
+
+    def __hash__(self) -> int:
+        return hash(tuple(v.address for v in self.validators))
+
     def total_voting_power(self) -> int:
         """Cached — the membership of a ValidatorSet instance is fixed
         (updates return new sets), and vote tallying queries this per

@@ -86,3 +86,38 @@ def make_commit(
             )
         )
     return vote_set.make_commit()
+
+
+def make_light_block(
+    vals: ValidatorSet,
+    keys: list[ed.Ed25519PrivKey],
+    height: int = 1,
+    chain_id: str = CHAIN_ID,
+    time_ns: int = 1_700_000_000_000_000_000,
+    app_hash: bytes = b"",
+):
+    """A self-consistent LightBlock: header carries the set's real hash
+    and the commit signs the header's real hash."""
+    from cometbft_tpu.types.block import Header
+    from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+
+    header = Header(
+        chain_id=chain_id,
+        height=height,
+        time_ns=time_ns,
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        app_hash=app_hash,
+        proposer_address=vals.validators[0].address,
+    )
+    h = header.hash()
+    block_id = BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+    commit = make_commit(
+        vals, keys, block_id, height=height, chain_id=chain_id
+    )
+    return LightBlock(
+        signed_header=SignedHeader(header=header, commit=commit),
+        validator_set=vals,
+    )

@@ -230,6 +230,112 @@ class TestEvidencePool:
                 except Exception:
                     pass
 
+    def _lunatic_evidence(self, node, privs, conflicting_height=2,
+                          common_height=1):
+        """Build verifiable lunatic-attack evidence against the real
+        chain: the conflicting header differs from ours (bad app hash)
+        but carries genuine +2/3 signatures from the validator set."""
+        from dataclasses import replace as dreplace
+
+        from cometbft_tpu.types import BlockID, PartSetHeader
+        from cometbft_tpu.types.evidence import LightClientAttackEvidence
+        from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+        from tests.helpers import make_commit
+
+        val_set = node.state_store.load_validators(conflicting_height)
+        by_addr = {pv.pub_key.address(): pv._priv_key for pv in privs}
+        keys = [by_addr[v.address] for v in val_set.validators]
+        real = node.block_store.load_block_meta(conflicting_height)
+        header = dreplace(real.header, app_hash=b"\xaa" * 32)
+        hh = header.hash()
+        bid = BlockID(
+            hash=hh, part_set_header=PartSetHeader(total=1, hash=hh[::-1])
+        )
+        commit = make_commit(
+            val_set, keys, bid, height=conflicting_height, chain_id=CHAIN
+        )
+        cb = LightBlock(
+            signed_header=SignedHeader(header=header, commit=commit),
+            validator_set=val_set,
+        )
+        common_vals = node.state_store.load_validators(common_height)
+        ev = LightClientAttackEvidence(
+            conflicting_block=cb,
+            common_height=common_height,
+            total_voting_power=common_vals.total_voting_power(),
+            timestamp_ns=node.block_store.load_block_meta(
+                common_height
+            ).header.time_ns,
+        )
+        trusted = SignedHeader(
+            header=real.header,
+            commit=node.block_store.load_block_commit(conflicting_height),
+        )
+        byz = ev.get_byzantine_validators(common_vals, trusted)
+        return dreplace(
+            ev, byzantine_validators=tuple(v.address for v in byz)
+        )
+
+    def test_light_client_attack_evidence_verified(self, tmp_path):
+        """Real-signature lunatic evidence passes full verification and
+        flows through the pending/committed lifecycle."""
+        nodes, privs = self._produced_node(tmp_path)
+        try:
+            node = nodes[0]
+            ev = self._lunatic_evidence(node, privs)
+            assert len(ev.byzantine_validators) == 4
+            pool = node.evidence_pool
+            pool.add_evidence(ev)
+            pending, _ = pool.pending_evidence(-1)
+            assert [e.hash() for e in pending] == [ev.hash()]
+            pool.check_evidence([ev])
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_light_client_attack_frameup_rejected(self, tmp_path):
+        """Evidence whose byzantine list or signatures don't hold up is
+        rejected — honest validators can't be framed."""
+        from dataclasses import replace as dreplace
+
+        from cometbft_tpu.evidence.pool import EvidenceInvalidError
+
+        nodes, privs = self._produced_node(tmp_path)
+        try:
+            node = nodes[0]
+            ev = self._lunatic_evidence(node, privs)
+            # (a) fabricated byzantine list (subset) != actual signers
+            framed = dreplace(
+                ev, byzantine_validators=ev.byzantine_validators[:1]
+            )
+            with pytest.raises(EvidenceInvalidError):
+                node.evidence_pool.verify(framed)
+            # (b) forged signatures: zero out every commit sig
+            cb = ev.conflicting_block
+            bad_sigs = tuple(
+                dreplace(cs, signature=b"\x00" * 64)
+                for cs in cb.commit.signatures
+            )
+            bad_commit = dreplace(cb.commit, signatures=bad_sigs)
+            bad_cb = dreplace(
+                cb,
+                signed_header=dreplace(
+                    cb.signed_header, commit=bad_commit
+                ),
+            )
+            forged = dreplace(ev, conflicting_block=bad_cb)
+            with pytest.raises(EvidenceInvalidError):
+                node.evidence_pool.verify(forged)
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
     def test_evidence_gossip_between_nodes(self, tmp_path):
         nodes, privs = self._produced_node(tmp_path)
         try:

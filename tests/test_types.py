@@ -410,13 +410,12 @@ class TestRegressions:
 
     def test_light_client_attack_evidence_codec(self):
         from cometbft_tpu.types import LightClientAttackEvidence
+        from tests.helpers import make_light_block
 
         vals, keys = make_val_set(4)
-        bid = make_block_id()
-        commit = make_commit(vals, keys, bid)
+        lb = make_light_block(vals, keys, height=2)
         ev = LightClientAttackEvidence(
-            conflicting_header_hash=bid.hash,
-            conflicting_commit=commit,
+            conflicting_block=lb,
             common_height=1,
             byzantine_validators=(keys[0].pub_key().address(),),
             total_voting_power=40,
