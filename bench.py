@@ -3,6 +3,11 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline (BASELINE.json north star): 1M verifies/sec on one TPU v5e.
 
+Measures steady-state *pipelined* throughput — several launches kept in
+flight so host->device transfer overlaps device compute, the way the
+node's replay paths (blocksync, light sync) drive the kernel.  Sync
+single-launch latency is logged to stderr alongside.
+
 Run with the default environment (TPU via the axon platform); falls
 back to whatever jax.devices() offers (CPU in dev shells).
 """
@@ -27,13 +32,18 @@ def main() -> None:
     import jax
 
     from cometbft_tpu.crypto import ed25519 as ed
-    from cometbft_tpu.ops.ed25519_verify import verify_arrays
+    from cometbft_tpu.ops.ed25519_verify import (
+        verify_arrays,
+        verify_stream,
+    )
 
     dev = jax.devices()[0]
     log(f"device: {dev}")
+    on_cpu = dev.platform == "cpu"
 
     # Full batch on accelerators; small batch on the CPU dev fallback.
-    n = 256 if dev.platform == "cpu" else 4096
+    n = 256 if on_cpu else 4096
+    nchunks = 2 if on_cpu else 8
     msglen = 120
     rng = np.random.RandomState(0)
     priv = ed.gen_priv_key()
@@ -51,27 +61,44 @@ def main() -> None:
 
     t0 = time.time()
     out = verify_arrays(pubs, sigs, msgs)
-    log(f"first launch (compile) {time.time() - t0:.1f}s")
+    log(f"first launch (compile or cache load) {time.time() - t0:.1f}s")
     assert bool(out.all()), "benchmark signatures must verify"
 
-    # timed runs
-    best = float("inf")
+    # sync latency (one launch, transfers + compute + result fetch)
+    lat = float("inf")
     for i in range(3):
         t0 = time.time()
         out = verify_arrays(pubs, sigs, msgs)
-        dt = time.time() - t0
-        log(f"run {i}: {n} sigs in {dt * 1e3:.1f} ms = {n / dt:,.0f} sigs/s")
-        best = min(best, dt)
+        lat = min(lat, time.time() - t0)
     assert bool(out.all())
+    log(f"sync latency: {lat * 1e3:.1f} ms/launch ({n} sigs)")
 
-    value = n / best
+    # steady-state pipelined throughput over nchunks in-flight launches
+    best = 0.0
+    for trial in range(3):
+        t0 = time.time()
+        total = 0
+        for res in verify_stream(
+            ((pubs, sigs, msgs) for _ in range(nchunks)),
+            max_in_flight=nchunks,
+        ):
+            assert bool(res.all())
+            total += len(res)
+        dt = time.time() - t0
+        rate = total / dt
+        log(
+            f"pipelined trial {trial}: {total} sigs in {dt * 1e3:.1f} ms "
+            f"= {rate:,.0f} sigs/s"
+        )
+        best = max(best, rate)
+
     print(
         json.dumps(
             {
                 "metric": "ed25519_batch_verify_throughput",
-                "value": round(value, 1),
+                "value": round(best, 1),
                 "unit": "sigs/sec",
-                "vs_baseline": round(value / BASELINE_SIGS_PER_SEC, 4),
+                "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 4),
             }
         )
     )

@@ -18,6 +18,25 @@ isolate verification in a worker process (the node runtime never mixes
 these kernels with float ML workloads in-process).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the verify kernel's first compile is
+# ~90s; caching it across processes turns every later startup into a
+# few-second cache load. Opt out with CMT_TPU_NO_COMPILE_CACHE=1.
+if not os.environ.get("CMT_TPU_NO_COMPILE_CACHE"):
+    try:
+        _cache_dir = os.environ.get(
+            "CMT_TPU_COMPILE_CACHE_DIR",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "cometbft_tpu_xla"
+            ),
+        )
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — older jax without these knobs
+        pass
