@@ -1,0 +1,481 @@
+"""ABCI wire codec — request/response envelopes for the socket protocol
+(reference: proto/cometbft/abci/v1/types.proto Request/Response oneofs,
+abci/server/socket_server.go framing).
+
+Declarative per-type field specs drive a small generic encoder: each
+request/response dataclass maps to proto fields 1..n in declaration
+order.  Envelope oneof numbers follow the reference's Request (echo=1
+... finalize_block=20) and Response (exception=1 ... finalize_block=21)
+so the method dispatch table reads against the upstream proto.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from cometbft_tpu.abci import types as T
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+
+class AbciCodecError(ValueError):
+    pass
+
+
+# -- field kinds --------------------------------------------------------
+
+def _f(no: int, attr: str, kind: str, cls=None):
+    return (no, attr, kind, cls)
+
+
+# Spec: type -> [(field_no, attr, kind, nested_cls)]
+# kinds: str, bytes, int (zigzag svarint), bool, enum, msg, params_json,
+#        rep_bytes, rep_str, rep_int, rep_msg
+_SPEC: dict[type, list] = {
+    T.EventAttribute: [
+        _f(1, "key", "str"),
+        _f(2, "value", "str"),
+        _f(3, "index", "bool"),
+    ],
+    T.Event: [
+        _f(1, "type", "str"),
+        _f(2, "attributes", "rep_msg", T.EventAttribute),
+    ],
+    T.ValidatorUpdate: [
+        _f(1, "pub_key_type", "str"),
+        _f(2, "pub_key_bytes", "bytes"),
+        _f(3, "power", "int"),
+    ],
+    T.ExecTxResult: [
+        _f(1, "code", "int"),
+        _f(2, "data", "bytes"),
+        _f(3, "log", "str"),
+        _f(4, "info", "str"),
+        _f(5, "gas_wanted", "int"),
+        _f(6, "gas_used", "int"),
+        _f(7, "events", "rep_msg", T.Event),
+        _f(8, "codespace", "str"),
+    ],
+    T.VoteInfo: [
+        _f(1, "validator_address", "bytes"),
+        _f(2, "validator_power", "int"),
+        _f(3, "block_id_flag", "int"),
+    ],
+    T.CommitInfo: [
+        _f(1, "round", "int"),
+        _f(2, "votes", "rep_msg", T.VoteInfo),
+    ],
+    T.Misbehavior: [
+        _f(1, "type", "int"),
+        _f(2, "validator_address", "bytes"),
+        _f(3, "validator_power", "int"),
+        _f(4, "height", "int"),
+        _f(5, "time_ns", "int"),
+        _f(6, "total_voting_power", "int"),
+    ],
+    T.Snapshot: [
+        _f(1, "height", "int"),
+        _f(2, "format", "int"),
+        _f(3, "chunks", "int"),
+        _f(4, "hash", "bytes"),
+        _f(5, "metadata", "bytes"),
+    ],
+    # requests
+    T.InfoRequest: [
+        _f(1, "version", "str"),
+        _f(2, "block_version", "int"),
+        _f(3, "p2p_version", "int"),
+        _f(4, "abci_version", "str"),
+    ],
+    T.QueryRequest: [
+        _f(1, "data", "bytes"),
+        _f(2, "path", "str"),
+        _f(3, "height", "int"),
+        _f(4, "prove", "bool"),
+    ],
+    T.CheckTxRequest: [
+        _f(1, "tx", "bytes"),
+        _f(2, "type", "int"),
+    ],
+    T.InitChainRequest: [
+        _f(1, "time_ns", "int"),
+        _f(2, "chain_id", "str"),
+        _f(3, "consensus_params", "params_json"),
+        _f(4, "validators", "rep_msg", T.ValidatorUpdate),
+        _f(5, "app_state_bytes", "bytes"),
+        _f(6, "initial_height", "int"),
+    ],
+    T.PrepareProposalRequest: [
+        _f(1, "max_tx_bytes", "int"),
+        _f(2, "txs", "rep_bytes"),
+        _f(3, "local_last_commit", "msg", T.CommitInfo),
+        _f(4, "misbehavior", "rep_msg", T.Misbehavior),
+        _f(5, "height", "int"),
+        _f(6, "time_ns", "int"),
+        _f(7, "next_validators_hash", "bytes"),
+        _f(8, "proposer_address", "bytes"),
+    ],
+    T.ProcessProposalRequest: [
+        _f(1, "txs", "rep_bytes"),
+        _f(2, "proposed_last_commit", "msg", T.CommitInfo),
+        _f(3, "misbehavior", "rep_msg", T.Misbehavior),
+        _f(4, "hash", "bytes"),
+        _f(5, "height", "int"),
+        _f(6, "time_ns", "int"),
+        _f(7, "next_validators_hash", "bytes"),
+        _f(8, "proposer_address", "bytes"),
+    ],
+    T.ExtendVoteRequest: [
+        _f(1, "hash", "bytes"),
+        _f(2, "height", "int"),
+        _f(3, "round", "int"),
+        _f(4, "time_ns", "int"),
+        _f(5, "txs", "rep_bytes"),
+        _f(6, "proposed_last_commit", "msg", T.CommitInfo),
+        _f(7, "misbehavior", "rep_msg", T.Misbehavior),
+        _f(8, "next_validators_hash", "bytes"),
+        _f(9, "proposer_address", "bytes"),
+    ],
+    T.VerifyVoteExtensionRequest: [
+        _f(1, "hash", "bytes"),
+        _f(2, "validator_address", "bytes"),
+        _f(3, "height", "int"),
+        _f(4, "vote_extension", "bytes"),
+    ],
+    T.FinalizeBlockRequest: [
+        _f(1, "txs", "rep_bytes"),
+        _f(2, "decided_last_commit", "msg", T.CommitInfo),
+        _f(3, "misbehavior", "rep_msg", T.Misbehavior),
+        _f(4, "hash", "bytes"),
+        _f(5, "height", "int"),
+        _f(6, "time_ns", "int"),
+        _f(7, "next_validators_hash", "bytes"),
+        _f(8, "proposer_address", "bytes"),
+        _f(9, "syncing_to_height", "int"),
+    ],
+    T.OfferSnapshotRequest: [
+        _f(1, "snapshot", "msg", T.Snapshot),
+        _f(2, "app_hash", "bytes"),
+    ],
+    T.LoadSnapshotChunkRequest: [
+        _f(1, "height", "int"),
+        _f(2, "format", "int"),
+        _f(3, "chunk", "int"),
+    ],
+    T.ApplySnapshotChunkRequest: [
+        _f(1, "index", "int"),
+        _f(2, "chunk", "bytes"),
+        _f(3, "sender", "str"),
+    ],
+    # responses
+    T.InfoResponse: [
+        _f(1, "data", "str"),
+        _f(2, "version", "str"),
+        _f(3, "app_version", "int"),
+        _f(4, "last_block_height", "int"),
+        _f(5, "last_block_app_hash", "bytes"),
+    ],
+    T.QueryResponse: [
+        _f(1, "code", "int"),
+        _f(2, "log", "str"),
+        _f(3, "info", "str"),
+        _f(4, "index", "int"),
+        _f(5, "key", "bytes"),
+        _f(6, "value", "bytes"),
+        # proof_ops (field 7) intentionally unsupported on the wire
+        _f(8, "height", "int"),
+        _f(9, "codespace", "str"),
+    ],
+    T.CheckTxResponse: [
+        _f(1, "code", "int"),
+        _f(2, "data", "bytes"),
+        _f(3, "log", "str"),
+        _f(4, "info", "str"),
+        _f(5, "gas_wanted", "int"),
+        _f(6, "gas_used", "int"),
+        _f(7, "codespace", "str"),
+    ],
+    T.InitChainResponse: [
+        _f(1, "consensus_params", "params_json"),
+        _f(2, "validators", "rep_msg", T.ValidatorUpdate),
+        _f(3, "app_hash", "bytes"),
+    ],
+    T.PrepareProposalResponse: [
+        _f(1, "txs", "rep_bytes"),
+    ],
+    T.ProcessProposalResponse: [
+        _f(1, "status", "enum", T.ProposalStatus),
+    ],
+    T.ExtendVoteResponse: [
+        _f(1, "vote_extension", "bytes"),
+    ],
+    T.VerifyVoteExtensionResponse: [
+        _f(1, "status", "enum", T.VerifyStatus),
+    ],
+    T.FinalizeBlockResponse: [
+        _f(1, "events", "rep_msg", T.Event),
+        _f(2, "tx_results", "rep_msg", T.ExecTxResult),
+        _f(3, "validator_updates", "rep_msg", T.ValidatorUpdate),
+        _f(4, "consensus_param_updates", "params_json"),
+        _f(5, "app_hash", "bytes"),
+    ],
+    T.CommitResponse: [
+        _f(1, "retain_height", "int"),
+    ],
+    T.ListSnapshotsResponse: [
+        _f(1, "snapshots", "rep_msg", T.Snapshot),
+    ],
+    T.OfferSnapshotResponse: [
+        _f(1, "result", "enum", T.OfferSnapshotResult),
+    ],
+    T.LoadSnapshotChunkResponse: [
+        _f(1, "chunk", "bytes"),
+    ],
+    T.ApplySnapshotChunkResponse: [
+        _f(1, "result", "enum", T.ApplySnapshotChunkResult),
+        _f(2, "refetch_chunks", "rep_int"),
+        _f(3, "reject_senders", "rep_str"),
+    ],
+}
+
+
+def _encode_params(params) -> bytes:
+    return json.dumps(params.to_json_dict(), sort_keys=True).encode()
+
+
+def _decode_params(raw: bytes):
+    from cometbft_tpu.types.params import ConsensusParams
+
+    return ConsensusParams.from_json_dict(json.loads(bytes(raw).decode()))
+
+
+def encode_msg(obj) -> bytes:
+    spec = _SPEC.get(type(obj))
+    if spec is None:
+        raise AbciCodecError(f"no wire spec for {type(obj).__name__}")
+    w = ProtoWriter()
+    for no, attr, kind, cls in spec:
+        v = getattr(obj, attr)
+        if kind == "str":
+            w.string(no, v)
+        elif kind == "bytes":
+            w.bytes_(no, bytes(v))
+        elif kind == "int" or kind == "enum":
+            w.svarint(no, int(v))
+        elif kind == "bool":
+            w.varint(no, 1 if v else 0)
+        elif kind == "msg":
+            if v is not None:
+                w.message(no, encode_msg(v))
+        elif kind == "params_json":
+            if v is not None:
+                w.bytes_(no, _encode_params(v))
+        elif kind == "rep_bytes":
+            for item in v:
+                w.bytes_(no, bytes(item))
+        elif kind == "rep_str":
+            for item in v:
+                w.string(no, item)
+        elif kind == "rep_int":
+            for item in v:
+                w.svarint(no, int(item))
+        elif kind == "rep_msg":
+            for item in v:
+                w.message(no, encode_msg(item))
+        else:  # pragma: no cover
+            raise AbciCodecError(f"unknown kind {kind}")
+    return w.finish()
+
+
+def _unzig(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def decode_msg(cls: type, raw: bytes):
+    spec = _SPEC.get(cls)
+    if spec is None:
+        raise AbciCodecError(f"no wire spec for {cls.__name__}")
+    try:
+        f = ProtoReader(bytes(raw)).to_dict()
+    except Exception as exc:
+        raise AbciCodecError(f"malformed {cls.__name__}: {exc}") from exc
+    kwargs = {}
+    for no, attr, kind, sub in spec:
+        vals = f.get(no)
+        try:
+            if kind == "str":
+                kwargs[attr] = (
+                    bytes(vals[0]).decode() if vals else ""
+                )
+            elif kind == "bytes":
+                kwargs[attr] = bytes(vals[0]) if vals else b""
+            elif kind == "int":
+                kwargs[attr] = _unzig(int(vals[0])) if vals else 0
+            elif kind == "enum":
+                kwargs[attr] = sub(_unzig(int(vals[0]))) if vals else sub(0)
+            elif kind == "bool":
+                kwargs[attr] = bool(vals[0]) if vals else False
+            elif kind == "msg":
+                kwargs[attr] = decode_msg(sub, vals[0]) if vals else None
+            elif kind == "params_json":
+                kwargs[attr] = _decode_params(vals[0]) if vals else None
+            elif kind == "rep_bytes":
+                kwargs[attr] = tuple(bytes(v) for v in (vals or []))
+            elif kind == "rep_str":
+                kwargs[attr] = tuple(
+                    bytes(v).decode() for v in (vals or [])
+                )
+            elif kind == "rep_int":
+                kwargs[attr] = tuple(_unzig(int(v)) for v in (vals or []))
+            elif kind == "rep_msg":
+                kwargs[attr] = tuple(
+                    decode_msg(sub, v) for v in (vals or [])
+                )
+        except AbciCodecError:
+            raise
+        except Exception as exc:
+            raise AbciCodecError(
+                f"malformed {cls.__name__}.{attr}: {exc}"
+            ) from exc
+    # FinalizeBlockRequest.decided_last_commit is non-optional
+    if cls is T.FinalizeBlockRequest and kwargs.get("decided_last_commit") is None:
+        kwargs["decided_last_commit"] = T.CommitInfo()
+    return cls(**kwargs)
+
+
+# -- envelopes ----------------------------------------------------------
+
+@dataclass(frozen=True)
+class Echo:
+    message: str = ""
+
+
+@dataclass(frozen=True)
+class Flush:
+    pass
+
+
+@dataclass(frozen=True)
+class ResponseException:
+    error: str = ""
+
+
+_SPEC[Echo] = [_f(1, "message", "str")]
+_SPEC[Flush] = []
+_SPEC[ResponseException] = [_f(1, "error", "str")]
+
+# Zero-argument methods get empty request placeholder types so the
+# envelope stays uniform (the reference has CommitRequest{} etc.).
+
+
+@dataclass(frozen=True)
+class CommitRequest:
+    pass
+
+
+@dataclass(frozen=True)
+class ListSnapshotsRequest:
+    pass
+
+
+_SPEC[CommitRequest] = []
+_SPEC[ListSnapshotsRequest] = []
+
+# oneof numbers from proto/cometbft/abci/v1/types.proto Request
+_REQUEST_ONEOF: list[tuple[int, type]] = [
+    (1, Echo),
+    (2, Flush),
+    (3, T.InfoRequest),
+    (5, T.InitChainRequest),
+    (6, T.QueryRequest),
+    (8, T.CheckTxRequest),
+    (11, CommitRequest),
+    (12, ListSnapshotsRequest),
+    (13, T.OfferSnapshotRequest),
+    (14, T.LoadSnapshotChunkRequest),
+    (15, T.ApplySnapshotChunkRequest),
+    (16, T.PrepareProposalRequest),
+    (17, T.ProcessProposalRequest),
+    (18, T.ExtendVoteRequest),
+    (19, T.VerifyVoteExtensionRequest),
+    (20, T.FinalizeBlockRequest),
+]
+
+# oneof numbers from proto/.../types.proto Response
+_RESPONSE_ONEOF: list[tuple[int, type]] = [
+    (1, ResponseException),
+    (2, Echo),
+    (3, Flush),
+    (4, T.InfoResponse),
+    (6, T.InitChainResponse),
+    (7, T.QueryResponse),
+    (9, T.CheckTxResponse),
+    (12, T.CommitResponse),
+    (13, T.ListSnapshotsResponse),
+    (14, T.OfferSnapshotResponse),
+    (15, T.LoadSnapshotChunkResponse),
+    (16, T.ApplySnapshotChunkResponse),
+    (17, T.PrepareProposalResponse),
+    (18, T.ProcessProposalResponse),
+    (19, T.ExtendVoteResponse),
+    (20, T.VerifyVoteExtensionResponse),
+    (21, T.FinalizeBlockResponse),
+]
+
+_REQ_NO = {cls: no for no, cls in _REQUEST_ONEOF}
+_REQ_CLS = {no: cls for no, cls in _REQUEST_ONEOF}
+_RESP_NO = {cls: no for no, cls in _RESPONSE_ONEOF}
+_RESP_CLS = {no: cls for no, cls in _RESPONSE_ONEOF}
+
+
+def _encode_envelope(obj, table: dict) -> bytes:
+    no = table.get(type(obj))
+    if no is None:
+        raise AbciCodecError(f"not an envelope type: {type(obj).__name__}")
+    w = ProtoWriter()
+    w.message(no, encode_msg(obj))
+    return w.finish()
+
+
+def _decode_envelope(raw: bytes, table: dict):
+    try:
+        f = ProtoReader(bytes(raw)).to_dict()
+    except Exception as exc:
+        raise AbciCodecError(f"malformed envelope: {exc}") from exc
+    for no, vals in f.items():
+        cls = table.get(no)
+        if cls is not None and vals:
+            return decode_msg(cls, vals[0])
+    raise AbciCodecError("empty or unknown envelope")
+
+
+def encode_request(req) -> bytes:
+    return _encode_envelope(req, _REQ_NO)
+
+
+def decode_request(raw: bytes):
+    return _decode_envelope(raw, _REQ_CLS)
+
+
+def encode_response(resp) -> bytes:
+    return _encode_envelope(resp, _RESP_NO)
+
+
+def decode_response(raw: bytes):
+    return _decode_envelope(raw, _RESP_CLS)
+
+
+__all__ = [
+    "AbciCodecError",
+    "CommitRequest",
+    "Echo",
+    "Flush",
+    "ListSnapshotsRequest",
+    "ResponseException",
+    "decode_msg",
+    "decode_request",
+    "decode_response",
+    "encode_msg",
+    "encode_request",
+    "encode_response",
+]

@@ -140,34 +140,17 @@ class ExecTxResult:
         return w.finish()
 
     def encode(self) -> bytes:
-        w = ProtoWriter()
-        w.varint(1, self.code)
-        w.bytes_(2, self.data)
-        w.string(3, self.log)
-        w.string(4, self.info)
-        w.varint(5, self.gas_wanted & 0xFFFFFFFFFFFFFFFF)
-        w.varint(6, self.gas_used & 0xFFFFFFFFFFFFFFFF)
-        for ev in self.events:
-            w.message(7, encode_event(ev))
-        w.string(8, self.codespace)
-        return w.finish()
+        """Full wire/persistent encoding — one spec shared with the
+        socket protocol (abci/codec)."""
+        from cometbft_tpu.abci import codec
+
+        return codec.encode_msg(self)
 
     @classmethod
     def decode(cls, data: bytes) -> "ExecTxResult":
-        f = ProtoReader(data).to_dict()
-        events = [decode_event(raw) for raw in f.get(7, [])]
-        from cometbft_tpu.types.codec import s64
+        from cometbft_tpu.abci import codec
 
-        return cls(
-            code=int(f.get(1, [0])[0]),
-            data=bytes(f.get(2, [b""])[0]),
-            log=bytes(f.get(3, [b""])[0]).decode(),
-            info=bytes(f.get(4, [b""])[0]).decode(),
-            gas_wanted=s64(f.get(5, [0])[0]),
-            gas_used=s64(f.get(6, [0])[0]),
-            events=tuple(events),
-            codespace=bytes(f.get(8, [b""])[0]).decode(),
-        )
+        return codec.decode_msg(cls, data)
 
 
 def results_hash(results: list[ExecTxResult]) -> bytes:
@@ -384,64 +367,18 @@ class FinalizeBlockResponse:
     app_hash: bytes = b""
 
     def encode(self) -> bytes:
-        """Persistent encoding for the state store (ABCIResponses).
-        Covers every field — block events and param updates included —
-        so crash-replay and block_results RPC see what the app returned."""
-        w = ProtoWriter()
-        for ev in self.events:
-            w.message(1, encode_event(ev))
-        for r in self.tx_results:
-            w.message(2, r.encode())
-        for vu in self.validator_updates:
-            v = ProtoWriter()
-            v.string(1, vu.pub_key_type)
-            v.bytes_(2, vu.pub_key_bytes)
-            v.varint(3, vu.power)
-            w.message(3, v.finish())
-        if self.consensus_param_updates is not None:
-            import json
+        """Persistent encoding for the state store (ABCIResponses) —
+        one spec shared with the socket protocol (abci/codec), so the
+        store format and the wire format cannot diverge."""
+        from cometbft_tpu.abci import codec
 
-            w.bytes_(
-                4,
-                json.dumps(
-                    self.consensus_param_updates.to_json_dict(),
-                    sort_keys=True,
-                ).encode(),
-            )
-        w.bytes_(5, self.app_hash)
-        return w.finish()
+        return codec.encode_msg(self)
 
     @classmethod
     def decode(cls, data: bytes) -> "FinalizeBlockResponse":
-        f = ProtoReader(data).to_dict()
-        updates = []
-        for raw in f.get(3, []):
-            uf = ProtoReader(raw).to_dict()
-            updates.append(
-                ValidatorUpdate(
-                    pub_key_type=bytes(uf.get(1, [b""])[0]).decode(),
-                    pub_key_bytes=bytes(uf.get(2, [b""])[0]),
-                    power=int(uf.get(3, [0])[0]),
-                )
-            )
-        param_updates = None
-        if 4 in f:
-            import json
+        from cometbft_tpu.abci import codec
 
-            from cometbft_tpu.types.params import ConsensusParams
-
-            param_updates = ConsensusParams.from_json_dict(
-                json.loads(bytes(f[4][0]).decode())
-            )
-        return cls(
-            events=tuple(decode_event(raw) for raw in f.get(1, [])),
-            tx_results=tuple(
-                ExecTxResult.decode(raw) for raw in f.get(2, [])
-            ),
-            validator_updates=tuple(updates),
-            consensus_param_updates=param_updates,
-            app_hash=bytes(f.get(5, [b""])[0]),
-        )
+        return codec.decode_msg(cls, data)
 
 
 @dataclass(frozen=True)

@@ -42,7 +42,11 @@ from cometbft_tpu.mempool import (
     pre_check_max_bytes,
 )
 from cometbft_tpu.privval import FilePV
-from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.proxy import (
+    AppConns,
+    default_client_creator,
+    local_client_creator,
+)
 from cometbft_tpu.state import (
     Store as StateStore,
     load_state_from_db_or_genesis,
@@ -127,9 +131,15 @@ class Node(BaseService):
         self.genesis = genesis
         state = load_state_from_db_or_genesis(self.state_store, genesis)
 
-        # 3. proxy app (setup.go:172)
-        self.app = app if app is not None else default_app(config)
-        self.proxy_app = AppConns(local_client_creator(self.app))
+        # 3. proxy app (setup.go:172) — external process for tcp://
+        # and unix:// addresses, builtin in-process otherwise
+        proxy_addr = config.base.proxy_app
+        if app is None and proxy_addr.startswith(("tcp://", "unix://")):
+            self.app = None
+            self.proxy_app = AppConns(default_client_creator(proxy_addr))
+        else:
+            self.app = app if app is not None else default_app(config)
+            self.proxy_app = AppConns(local_client_creator(self.app))
 
         # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()

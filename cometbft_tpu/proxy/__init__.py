@@ -134,6 +134,40 @@ def unsync_local_client_creator(app: Application) -> ClientCreator:
     return ClientCreator(app, sync=False)
 
 
+class RemoteClientCreator:
+    """Clients for an external app over the ABCI socket protocol —
+    one fresh socket per logical connection (proxy/client.go
+    NewRemoteClientCreator + abci/client/socket_client.go)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0):
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+
+    def new_client(self):
+        from cometbft_tpu.abci.client import SocketClient
+
+        return SocketClient(
+            self._addr, connect_timeout=self._connect_timeout
+        )
+
+
+def remote_client_creator(
+    addr: str, connect_timeout: float = 10.0
+) -> RemoteClientCreator:
+    return RemoteClientCreator(addr, connect_timeout)
+
+
+def default_client_creator(proxy_app: str, app: Application | None = None):
+    """config.proxy_app -> creator (proxy/client.go DefaultClientCreator):
+    tcp:// and unix:// addresses mean an external app process; anything
+    else is a builtin served in-process."""
+    if proxy_app.startswith(("tcp://", "unix://")):
+        return remote_client_creator(proxy_app)
+    if app is None:
+        raise ValueError(f"builtin app {proxy_app!r} requires an instance")
+    return local_client_creator(app)
+
+
 class AppConns(BaseService):
     """The four typed connections (proxy/multi_app_conn.go:42)."""
 
@@ -146,10 +180,31 @@ class AppConns(BaseService):
         self.snapshot = creator.new_client()
 
     def on_start(self) -> None:
-        pass
+        # Remote clients connect lazily; surface connection failures at
+        # service start (node.OnStart) rather than first use.
+        for client in (
+            self.consensus,
+            self.mempool,
+            self.query,
+            self.snapshot,
+        ):
+            connect = getattr(client, "ensure_connected", None)
+            if connect is not None:
+                connect()
 
     def on_stop(self) -> None:
-        pass
+        for client in (
+            self.consensus,
+            self.mempool,
+            self.query,
+            self.snapshot,
+        ):
+            close = getattr(client, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
 
 
 def new_app_conns(creator: ClientCreator) -> AppConns:

@@ -1,0 +1,279 @@
+"""ABCI socket protocol tests: wire codec roundtrips, server/client
+request loop, and a localnet where the kvstore app runs as a separate
+OS process (reference: abci/server/socket_server_test.go,
+abci/client/socket_client_test.go, e2e ABCI connection modes)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import types as T
+from cometbft_tpu.abci.client import AbciClientError, SocketClient
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.types.params import ConsensusParams
+
+
+def roundtrip_request(req):
+    return codec.decode_request(codec.encode_request(req))
+
+
+def roundtrip_response(resp):
+    return codec.decode_response(codec.encode_response(resp))
+
+
+class TestCodec:
+    def test_request_roundtrips(self):
+        reqs = [
+            codec.Echo(message="hi"),
+            codec.Flush(),
+            T.InfoRequest(version="1.0", block_version=11, p2p_version=9),
+            T.InitChainRequest(
+                time_ns=123,
+                chain_id="c",
+                consensus_params=ConsensusParams(),
+                validators=(
+                    T.ValidatorUpdate("ed25519", b"\x01" * 32, 10),
+                ),
+                app_state_bytes=b"state",
+                initial_height=5,
+            ),
+            T.QueryRequest(data=b"k", path="/store", height=3, prove=True),
+            T.CheckTxRequest(tx=b"tx-bytes", type=T.CHECK_TX_TYPE_RECHECK),
+            codec.CommitRequest(),
+            codec.ListSnapshotsRequest(),
+            T.OfferSnapshotRequest(
+                snapshot=T.Snapshot(1, 2, 3, b"h", b"m"), app_hash=b"a"
+            ),
+            T.LoadSnapshotChunkRequest(height=9, format=1, chunk=4),
+            T.ApplySnapshotChunkRequest(index=1, chunk=b"c", sender="n0"),
+            T.PrepareProposalRequest(
+                max_tx_bytes=100,
+                txs=(b"a", b"b"),
+                local_last_commit=T.CommitInfo(
+                    round=1,
+                    votes=(T.VoteInfo(b"\x02" * 20, 10, 2),),
+                ),
+                misbehavior=(
+                    T.Misbehavior(1, b"\x03" * 20, 10, 4, 999, 40),
+                ),
+                height=7,
+                time_ns=-1,
+                next_validators_hash=b"\x04" * 32,
+                proposer_address=b"\x05" * 20,
+            ),
+            T.ProcessProposalRequest(txs=(b"t",), height=2, hash=b"\x06" * 32),
+            T.ExtendVoteRequest(hash=b"\x07" * 32, height=3, round=1),
+            T.VerifyVoteExtensionRequest(
+                hash=b"h", validator_address=b"v", height=2,
+                vote_extension=b"e",
+            ),
+            T.FinalizeBlockRequest(
+                txs=(b"x", b"y"),
+                decided_last_commit=T.CommitInfo(round=0),
+                hash=b"\x08" * 32,
+                height=10,
+                time_ns=42,
+                syncing_to_height=11,
+            ),
+        ]
+        for req in reqs:
+            rt = roundtrip_request(req)
+            if isinstance(req, T.InitChainRequest):
+                # params compare via their json form
+                assert rt.consensus_params.to_json_dict() == (
+                    req.consensus_params.to_json_dict()
+                )
+                import dataclasses
+
+                assert dataclasses.replace(
+                    rt, consensus_params=None
+                ) == dataclasses.replace(req, consensus_params=None)
+            else:
+                assert rt == req, req
+
+    def test_response_roundtrips(self):
+        resps = [
+            codec.ResponseException(error="boom"),
+            T.InfoResponse(
+                data="kv", version="v", app_version=1,
+                last_block_height=9, last_block_app_hash=b"\x01" * 32,
+            ),
+            T.QueryResponse(code=1, key=b"k", value=b"v", height=2, log="l"),
+            T.CheckTxResponse(code=3, log="bad", gas_wanted=5),
+            T.InitChainResponse(app_hash=b"h"),
+            T.PrepareProposalResponse(txs=(b"a",)),
+            T.ProcessProposalResponse(status=T.ProposalStatus.ACCEPT),
+            T.ExtendVoteResponse(vote_extension=b"x"),
+            T.VerifyVoteExtensionResponse(status=T.VerifyStatus.REJECT),
+            T.FinalizeBlockResponse(
+                events=(T.Event("e", (T.EventAttribute("k", "v", False),)),),
+                tx_results=(T.ExecTxResult(code=0, data=b"d"),),
+                validator_updates=(
+                    T.ValidatorUpdate("ed25519", b"\x01" * 32, 0),
+                ),
+                app_hash=b"\x02" * 32,
+            ),
+            T.CommitResponse(retain_height=4),
+            T.ListSnapshotsResponse(
+                snapshots=(T.Snapshot(1, 1, 1, b"h", b""),)
+            ),
+            T.OfferSnapshotResponse(result=T.OfferSnapshotResult.REJECT),
+            T.LoadSnapshotChunkResponse(chunk=b"data"),
+            T.ApplySnapshotChunkResponse(
+                result=T.ApplySnapshotChunkResult.RETRY,
+                refetch_chunks=(1, 2),
+                reject_senders=("bad",),
+            ),
+        ]
+        for resp in resps:
+            assert roundtrip_response(resp) == resp, resp
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            codec.decode_request(b"\xff\xff\xff")
+        with pytest.raises(ValueError):
+            codec.decode_request(b"")  # empty envelope
+
+
+class TestSocketLoop:
+    def test_client_server_roundtrip(self, tmp_path):
+        srv = SocketServer(f"unix://{tmp_path}/abci.sock", KVStoreApp())
+        srv.start()
+        try:
+            cli = SocketClient(srv.listen_addr)
+            assert cli.echo("ping") == "ping"
+            cli.flush()
+            info = cli.info(T.InfoRequest())
+            assert info.last_block_height == 0
+            resp = cli.check_tx(T.CheckTxRequest(tx=b"k=v"))
+            assert resp.is_ok
+            # malformed tx rejected by the app, not the transport
+            bad = cli.check_tx(T.CheckTxRequest(tx=b"not-a-kv-pair" * 9))
+            assert isinstance(bad, T.CheckTxResponse)
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_tcp_and_error_latch(self):
+        srv = SocketServer("tcp://127.0.0.1:0", KVStoreApp())
+        srv.start()
+        try:
+            cli = SocketClient(srv.listen_addr)
+            assert cli.echo("x") == "x"
+            srv.stop()
+            with pytest.raises(AbciClientError):
+                cli.info(T.InfoRequest())
+            # latched dead
+            with pytest.raises(AbciClientError):
+                cli.echo("y")
+        finally:
+            srv.stop()
+
+    def test_four_connections_share_one_app(self, tmp_path):
+        from cometbft_tpu.proxy import AppConns, remote_client_creator
+
+        srv = SocketServer(f"unix://{tmp_path}/app.sock", KVStoreApp())
+        srv.start()
+        try:
+            conns = AppConns(remote_client_creator(srv.listen_addr))
+            conns.start()
+            assert conns.consensus is not conns.mempool
+            r = conns.consensus.init_chain(
+                T.InitChainRequest(chain_id="c", initial_height=1)
+            )
+            assert isinstance(r, T.InitChainResponse)
+            assert conns.mempool.check_tx(
+                T.CheckTxRequest(tx=b"a=1")
+            ).is_ok
+            conns.stop()
+        finally:
+            srv.stop()
+
+
+class TestExternalAppLocalnet:
+    def test_chain_commits_through_external_process(self, tmp_path):
+        """A 2-validator localnet where node 0's app is kvstore in a
+        separate OS process over a unix socket (VERDICT item 4 done
+        criterion)."""
+        from cometbft_tpu.config import test_config as make_test_config
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.p2p.netaddr import NetAddress
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tests.test_reactors import CHAIN, GENESIS_TIME, wait_all_height
+
+        sock = f"unix://{tmp_path}/ext-app.sock"
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "cometbft_tpu.abci.server",
+                "--app",
+                "kvstore",
+                "--addr",
+                sock,
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        nodes = []
+        try:
+            privs = [
+                FilePV(ed.priv_key_from_secret(b"ext%d" % i))
+                for i in range(2)
+            ]
+            gen = GenesisDoc(
+                chain_id=CHAIN,
+                genesis_time_ns=GENESIS_TIME,
+                validators=tuple(
+                    GenesisValidator(pv.pub_key, 10) for pv in privs
+                ),
+            )
+            for i, pv in enumerate(privs):
+                cfg = make_test_config(str(tmp_path / f"n{i}"))
+                cfg.ensure_dirs()
+                if i == 0:
+                    cfg.base.proxy_app = sock
+                    nodes.append(
+                        Node(cfg, app=None, genesis=gen, priv_validator=pv)
+                    )
+                else:
+                    nodes.append(
+                        Node(
+                            cfg,
+                            app=KVStoreApp(),
+                            genesis=gen,
+                            priv_validator=pv,
+                        )
+                    )
+            for n in nodes:
+                n.start()
+            addr = nodes[0].transport.listen_addr
+            nodes[1].switch.dial_peer_with_address(
+                NetAddress(id=addr.id, host=addr.host, port=addr.port),
+                persistent=True,
+            )
+            wait_all_height(nodes, 3, timeout=60)
+            # both apps computed the same app hash chain
+            m0 = nodes[0].block_store.load_block_meta(3)
+            m1 = nodes[1].block_store.load_block_meta(3)
+            assert m0.header.app_hash == m1.header.app_hash
+            assert nodes[0].app is None  # truly external
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
