@@ -1,0 +1,229 @@
+"""North-star benchmark matrix (BASELINE.md "North-star targets").
+
+Measures the five driver-specified configurations through the REAL
+verification paths (types/validation.verify_commit* -> crypto.batch ->
+TpuBatchVerifier), not raw kernel calls:
+
+  1. 64-sig BatchVerifier micro-bench
+  2. VerifyCommit on a 150-validator commit (e2e latency)
+  3. VerifyCommit on a 10k-validator commit (e2e latency; <2ms target
+     is device-compute; the e2e number includes host sign-bytes
+     encoding and link transfer)
+  4. light-header sync: 150-validator commits verified at scale with
+     pipelined launches (10k headers modeled; n_run actually measured)
+  5. blocksync replay: 1k-validator commits, pipelined (1k blocks
+     modeled; mixed ed25519+bls variant lands with the BLS backend)
+
+Prints one JSON line per config and writes BENCH_ALL.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("CMT_TPU_DEVICE_MIN_BATCH", "1")
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+CHAIN_ID = "bench-chain"
+
+
+def make_commit_fixture(nvals: int):
+    """Real valset + commit: every validator signs its canonical
+    precommit bytes (the exact messages verify_commit reconstructs)."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    keys = [ed.priv_key_from_secret(b"bench%d" % i) for i in range(nvals)]
+    vals = ValidatorSet(
+        [Validator(k.pub_key(), 10) for k in keys]
+    )
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    h = bytes(range(32))
+    bid = BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+    sigs = []
+    for i, k in enumerate(ordered):
+        ts = 1_700_000_000_000_000_000 + i
+        msg = canonical.vote_sign_bytes(
+            CHAIN_ID, canonical.PRECOMMIT_TYPE, 1, 0, bid, ts
+        )
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=k.pub_key().address(),
+                timestamp_ns=ts,
+                signature=k.sign(msg),
+            )
+        )
+    commit = Commit(height=1, round=0, block_id=bid, signatures=tuple(sigs))
+    return vals, commit, bid
+
+
+def timed(fn, warmups: int = 1, iters: int = 3) -> float:
+    for _ in range(warmups):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import jax
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops.ed25519_verify import (
+        TpuBatchVerifier,
+        verify_stream,
+    )
+    from cometbft_tpu.types import validation
+
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    log(f"device: {dev}")
+    results = []
+
+    def record(config: str, value: float, unit: str, **extra):
+        row = {"config": config, "value": round(value, 2), "unit": unit}
+        row.update(extra)
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # ---- config 1: 64-sig micro-bench --------------------------------
+    rng = np.random.RandomState(7)
+    priv = ed.gen_priv_key()
+    msgs64 = [rng.bytes(120) for _ in range(64)]
+    sigs64 = [priv.sign(m) for m in msgs64]
+    pub = priv.pub_key()
+
+    def micro():
+        bv = TpuBatchVerifier(device_min_batch=1)
+        for m, s in zip(msgs64, sigs64):
+            bv.add(pub, m, s)
+        ok, bits = bv.verify()
+        assert ok, "micro-bench sigs must verify"
+
+    dt = timed(micro)
+    record(
+        "micro_64sig", 64 / dt, "sigs/sec", latency_ms=round(dt * 1e3, 2)
+    )
+
+    # ---- config 2: VerifyCommit @ 150 validators ---------------------
+    t0 = time.time()
+    vals150, commit150, bid150 = make_commit_fixture(150)
+    log(f"150-val fixture in {time.time() - t0:.1f}s")
+
+    def vc150():
+        validation.verify_commit(CHAIN_ID, vals150, bid150, 1, commit150)
+
+    dt = timed(vc150)
+    record(
+        "verify_commit_150", dt * 1e3, "ms",
+        sigs_per_sec=round(150 / dt, 1),
+    )
+
+    # ---- config 3: VerifyCommit @ 10k validators ---------------------
+    nbig = 1000 if on_cpu else 10_000
+    t0 = time.time()
+    vals10k, commit10k, bid10k = make_commit_fixture(nbig)
+    log(f"{nbig}-val fixture in {time.time() - t0:.1f}s")
+
+    def vc10k():
+        validation.verify_commit(CHAIN_ID, vals10k, bid10k, 1, commit10k)
+
+    dt = timed(vc10k)
+    record(
+        f"verify_commit_{nbig}", dt * 1e3, "ms",
+        sigs_per_sec=round(nbig / dt, 1), target_ms=2.0,
+    )
+
+    # ---- configs 4+5: pipelined multi-commit throughput --------------
+    # The replay planes (light sync, blocksync) verify many independent
+    # commits; the node drives them through verify_stream so launches
+    # overlap.  Jobs are grouped to fill device batches.
+    def stream_config(name, vals, commit, n_commits, modeled):
+        nsig = commit.size()
+        pubs = np.stack(
+            [
+                np.frombuffer(
+                    vals.get_by_index(i).pub_key.bytes(), dtype=np.uint8
+                )
+                for i in range(nsig)
+            ]
+        )
+        sigs = np.stack(
+            [
+                np.frombuffer(cs.signature, dtype=np.uint8)
+                for cs in commit.signatures
+            ]
+        )
+        msgs = [
+            commit.vote_sign_bytes(CHAIN_ID, i) for i in range(nsig)
+        ]
+        group = max(1, 4096 // nsig)  # commits per launch
+
+        def jobs():
+            done = 0
+            while done < n_commits:
+                k = min(group, n_commits - done)
+                yield (
+                    np.concatenate([pubs] * k),
+                    np.concatenate([sigs] * k),
+                    msgs * k,
+                )
+                done += k
+
+        t0 = time.perf_counter()
+        total = 0
+        for res in verify_stream(jobs(), max_in_flight=8):
+            assert bool(res.all())
+            total += len(res)
+        dt = time.perf_counter() - t0
+        record(
+            name, total / dt, "sigs/sec",
+            commits_per_sec=round(n_commits / dt, 1),
+            n_commits_run=n_commits, n_commits_modeled=modeled,
+        )
+
+    n4 = 64 if on_cpu else 1024
+    stream_config("light_sync_150val", vals150, commit150, n4, 10_000)
+    vals1k, commit1k, bid1k = make_commit_fixture(
+        128 if on_cpu else 1000
+    )
+    n5 = 16 if on_cpu else 256
+    stream_config("blocksync_replay_1kval", vals1k, commit1k, n5, 1000)
+
+    with open(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_ALL.json"),
+        "w",
+    ) as f:
+        json.dump(
+            {"device": str(dev), "results": results}, f, indent=1
+        )
+    log("wrote BENCH_ALL.json")
+
+
+if __name__ == "__main__":
+    main()

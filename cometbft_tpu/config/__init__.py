@@ -127,6 +127,7 @@ class P2PConfig:
     recv_rate: int = 5120000
     pex: bool = True
     seed_mode: bool = False
+    ensure_peers_interval_ns: int = 30 * 10**9  # pex ensurePeersPeriod
     private_peer_ids: str = ""
     allow_duplicate_ip: bool = False
     handshake_timeout_ns: int = 20 * 10**9
@@ -444,6 +445,8 @@ def test_config(home: str = "") -> Config:
     )
     cfg.mempool.recheck_timeout_ns = 10 * 10**6
     cfg.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral ports per test node
+    cfg.p2p.addr_book_strict = False     # loopback addrs are dialable here
+    cfg.p2p.ensure_peers_interval_ns = 500 * 10**6
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
     return cfg
 

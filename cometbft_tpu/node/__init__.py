@@ -158,8 +158,22 @@ class Node(BaseService):
             logger=self.logger.with_fields(module="indexer"),
         )
 
-        # 5. privval (setup.go:698)
-        if priv_validator is None and os.path.exists(
+        # 5. privval (setup.go:698) — a priv_validator_laddr means the
+        # key lives in an external signer process that dials us
+        self.privval_listener = None
+        if priv_validator is None and config.base.priv_validator_laddr:
+            from cometbft_tpu.privval.signer import (
+                SignerClient,
+                SignerListenerEndpoint,
+            )
+
+            self.privval_listener = SignerListenerEndpoint(
+                config.base.priv_validator_laddr,
+                genesis.chain_id,
+                logger=self.logger.with_fields(module="privval"),
+            )
+            priv_validator = SignerClient(self.privval_listener)
+        elif priv_validator is None and os.path.exists(
             config.priv_validator_key_path
         ):
             priv_validator = FilePV.load(
@@ -275,6 +289,36 @@ class Node(BaseService):
             "EVIDENCE": self.evidence_reactor,
             "STATESYNC": self.statesync_reactor,
         }
+
+        # PEX + address book (node/setup.go createSwitch/createPEXReactor)
+        self.addr_book = None
+        self.pex_reactor = None
+        if config.p2p.pex:
+            from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+
+            book_path = config.addr_book_path
+            self.addr_book = AddrBook(
+                book_path,
+                strict=config.p2p.addr_book_strict,
+                logger=self.logger.with_fields(module="addrbook"),
+            )
+            seeds = parse_peer_list(config.p2p.seeds)
+            if config.p2p.private_peer_ids:
+                self.addr_book.add_private_ids(
+                    [
+                        s.strip()
+                        for s in config.p2p.private_peer_ids.split(",")
+                        if s.strip()
+                    ]
+                )
+            self.pex_reactor = PexReactor(
+                self.addr_book,
+                seeds=seeds,
+                seed_mode=config.p2p.seed_mode,
+                ensure_interval=config.p2p.ensure_peers_interval_ns / 1e9,
+                logger=self.logger.with_fields(module="pex"),
+            )
+            reactors["PEX"] = self.pex_reactor
         self.node_key = NodeKey.load_or_generate(config.node_key_path)
         channels = bytes(
             d.id for r in reactors.values() for d in r.get_channels()
@@ -308,6 +352,15 @@ class Node(BaseService):
         )
         for name, reactor in reactors.items():
             self.switch.add_reactor(name, reactor)
+        if self.addr_book is not None:
+            self.switch.addr_book = self.addr_book
+            self.addr_book.add_our_address(
+                NetAddress(
+                    id=self.node_key.id(),
+                    host="127.0.0.1",
+                    port=0,
+                )
+            )
 
         # 12. RPC (node.go:598 startRPC)
         self.rpc_env = Environment(
@@ -324,7 +377,9 @@ class Node(BaseService):
             genesis=genesis,
             node_info=node_info,
             pub_key=(
-                priv_validator.pub_key if priv_validator is not None else None
+                (lambda: priv_validator.pub_key)
+                if priv_validator is not None
+                else None
             ),
             blocksync_reactor=self.blocksync_reactor,
             statesync_reactor=self.statesync_reactor,
@@ -402,6 +457,16 @@ class Node(BaseService):
 
     def on_start(self) -> None:
         """(node/node.go:580 OnStart)"""
+        if self.privval_listener is not None:
+            # the external signer must be reachable before consensus
+            # needs a signature (node.go waits for the remote signer)
+            self.privval_listener.start()
+            if not self.privval_listener.wait_for_signer():
+                raise NodeError(
+                    "no remote signer connected to "
+                    f"{self.config.base.priv_validator_laddr} within "
+                    "the accept deadline"
+                )
         self.proxy_app.start()
         self.event_bus.start()
 
@@ -479,6 +544,7 @@ class Node(BaseService):
             self.indexer_service,
             self.event_bus,
             self.proxy_app,
+            self.privval_listener,
         )
         for svc in services:
             if svc is None:

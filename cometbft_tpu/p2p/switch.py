@@ -227,6 +227,11 @@ class Switch(BaseService):
         peer.start()
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
+        if outbound and self.addr_book is not None:
+            # a completed outbound handshake proves the address good
+            # (addrbook.go MarkGood promotion to an old bucket)
+            self.addr_book.add_address(addr, addr)
+            self.addr_book.mark_good(ni.node_id)
         self.logger.info(
             "added peer", peer=ni.node_id[:10],
             direction="out" if outbound else "in",

@@ -94,13 +94,21 @@ class Environment:
         self.evidence_pool = evidence_pool
         self.genesis = genesis
         self.node_info = node_info
-        self.pub_key = pub_key
+        # a PubKey, or a zero-arg callable resolving to one (remote
+        # signers aren't connected until the node starts)
+        self._pub_key = pub_key
         self.blocksync_reactor = blocksync_reactor
         self.statesync_reactor = statesync_reactor
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
         self._subs_mtx = threading.Lock()
 
     # -- route tables (routes.go:15-63) ---------------------------------
+
+
+    @property
+    def pub_key(self):
+        pk = self._pub_key
+        return pk() if callable(pk) else pk
 
     def routes(self) -> dict:
         return {

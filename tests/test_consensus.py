@@ -67,7 +67,7 @@ def make_node(tmp_path, n_stub_validators=0, backend="memdb", app=None):
     return node, stubs
 
 
-def wait_for_height(node, h, timeout=20.0):
+def wait_for_height(node, h, timeout=45.0):  # generous: nproc=1 box
     deadline = time.time() + timeout
     while node.height() < h:
         if time.time() > deadline:
