@@ -123,6 +123,7 @@ class ConsensusState(BaseService):
         priv_validator=None,
         event_bus: EventBus | None = None,
         wal=None,
+        metrics=None,
         logger: Logger | None = None,
     ):
         super().__init__(
@@ -135,6 +136,9 @@ class ConsensusState(BaseService):
         self.priv_validator = priv_validator
         self.event_bus = event_bus
         self.wal = wal if wal is not None else NopWAL()
+        from cometbft_tpu.metrics import ConsensusMetrics
+
+        self.metrics = metrics if metrics is not None else ConsensusMetrics()
 
         # round state (round_state.go RoundState) — guarded by _rs_mtx for
         # readers (gossip, RPC); written only by the receive routine.
@@ -403,6 +407,9 @@ class ConsensusState(BaseService):
         self.height = height
         self.round = 0
         self.step = STEP_NEW_HEIGHT
+        self.metrics.height.set(height)
+        self.metrics.validators.set(len(validators))
+        self.metrics.validators_power.set(validators.total_voting_power())
         if self.commit_time_ns == 0:
             self.start_time_ns = now_ns() + self.config.timeout_commit_ns
         else:
@@ -467,6 +474,7 @@ class ConsensusState(BaseService):
             )
         self.round = round_
         self.step = STEP_NEW_ROUND
+        self.metrics.rounds.set(round_)
         if round_ != 0:
             # round 0 keeps the proposal received during NewHeight wait
             self.proposal = None
@@ -923,6 +931,16 @@ class ConsensusState(BaseService):
             hash=(block.hash() or b"").hex()[:12],
             num_txs=len(block.data.txs),
         )
+        m = self.metrics
+        m.committed_height.set(height)
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.inc(len(block.data.txs))
+        m.block_size_bytes.set(len(block.encode()))
+        prev = self.block_store.load_block_meta(height - 1)
+        if prev is not None and prev.header.time_ns:
+            m.block_interval_seconds.observe(
+                max(0.0, (block.header.time_ns - prev.header.time_ns) / 1e9)
+            )
         self._update_to_state(new_state)
         self._schedule_round_0()
 

@@ -1,0 +1,253 @@
+"""secp256k1 ECDSA (reference: crypto/secp256k1/secp256k1.go).
+
+Bitcoin-style keys: 33-byte compressed pubkeys, addresses =
+RIPEMD160(SHA256(pubkey)) (secp256k1.go:146), 64-byte compact r||s
+signatures over SHA256(msg) with low-S normalization (secp256k1.go:124
+— malleability rejection), deterministic RFC-6979 nonces.
+
+Host-side: secp256k1 is a long-tail key type for app compatibility;
+the batch plane stays ed25519/BLS.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from cometbft_tpu.crypto import PrivKey, PubKey
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33
+PRIV_KEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+# Curve: y^2 = x^3 + 7 over F_P, group order N (SEC2 v2 §2.4.1).
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+# -- group law (Jacobian coordinates) ----------------------------------
+
+def _jac_double(pt):
+    x, y, z = pt
+    if y == 0:
+        return (0, 1, 0)
+    s = 4 * x * y * y % P
+    m = 3 * x * x % P  # a = 0
+    x2 = (m * m - 2 * s) % P
+    y2 = (m * (s - x2) - 8 * y * y * y * y) % P
+    z2 = 2 * y * z % P
+    return (x2, y2, z2)
+
+
+def _jac_add(p1, p2):
+    if p1[2] == 0:
+        return p2
+    if p2[2] == 0:
+        return p1
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    z1z1 = z1 * z1 % P
+    z2z2 = z2 * z2 % P
+    u1 = x1 * z2z2 % P
+    u2 = x2 * z1z1 % P
+    s1 = y1 * z2 * z2z2 % P
+    s2 = y2 * z1 * z1z1 % P
+    if u1 == u2:
+        if s1 != s2:
+            return (0, 1, 0)
+        return _jac_double(p1)
+    h = (u2 - u1) % P
+    r = (s2 - s1) % P
+    h2 = h * h % P
+    h3 = h * h2 % P
+    u1h2 = u1 * h2 % P
+    x3 = (r * r - h3 - 2 * u1h2) % P
+    y3 = (r * (u1h2 - x3) - s1 * h3) % P
+    z3 = h * z1 * z2 % P
+    return (x3, y3, z3)
+
+
+def _jac_mul(pt, k: int):
+    acc = (0, 1, 0)
+    while k:
+        if k & 1:
+            acc = _jac_add(acc, pt)
+        pt = _jac_double(pt)
+        k >>= 1
+    return acc
+
+
+def _to_affine(pt):
+    x, y, z = pt
+    if z == 0:
+        return None
+    zi = _inv(z, P)
+    zi2 = zi * zi % P
+    return (x * zi2 % P, y * zi2 * zi % P)
+
+
+_G = (GX, GY, 1)
+
+
+def _compress(x: int, y: int) -> bytes:
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decompress(data: bytes):
+    if len(data) != PUB_KEY_SIZE or data[0] not in (2, 3):
+        return None
+    x = int.from_bytes(data[1:], "big")
+    if x >= P:
+        return None
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        return None
+    if (y & 1) != (data[0] & 1):
+        y = P - y
+    return (x, y)
+
+
+# -- RFC 6979 deterministic nonce --------------------------------------
+
+def _rfc6979_k(priv: int, msg_hash: bytes) -> int:
+    holder = b"\x01" * 32
+    key = b"\x00" * 32
+    x = priv.to_bytes(32, "big")
+    h1 = msg_hash
+    key = hmac.new(key, holder + b"\x00" + x + h1, hashlib.sha256).digest()
+    holder = hmac.new(key, holder, hashlib.sha256).digest()
+    key = hmac.new(key, holder + b"\x01" + x + h1, hashlib.sha256).digest()
+    holder = hmac.new(key, holder, hashlib.sha256).digest()
+    while True:
+        holder = hmac.new(key, holder, hashlib.sha256).digest()
+        k = int.from_bytes(holder, "big")
+        if 1 <= k < N:
+            return k
+        key = hmac.new(key, holder + b"\x00", hashlib.sha256).digest()
+        holder = hmac.new(key, holder, hashlib.sha256).digest()
+
+
+class Secp256k1PubKey(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(
+                f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes"
+            )
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        """Bitcoin-style RIPEMD160(SHA256(pubkey)) (secp256k1.go:146)."""
+        sha = hashlib.sha256(self._bytes).digest()
+        return hashlib.new("ripemd160", sha).digest()
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        pt = _decompress(self._bytes)
+        if pt is None:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < N and 1 <= s < N):
+            return False
+        if s > N // 2:
+            return False  # low-S only (malleability, secp256k1.go:130)
+        e = int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+        w = _inv(s, N)
+        u1 = e * w % N
+        u2 = r * w % N
+        res = _jac_add(
+            _jac_mul(_G, u1), _jac_mul((pt[0], pt[1], 1), u2)
+        )
+        aff = _to_affine(res)
+        if aff is None:
+            return False
+        return aff[0] % N == r
+
+
+class Secp256k1PrivKey(PrivKey):
+    __slots__ = ("_d",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(
+                f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes"
+            )
+        d = int.from_bytes(data, "big")
+        if not (1 <= d < N):
+            raise ValueError("secp256k1 privkey out of range")
+        self._d = d
+
+    def bytes(self) -> bytes:
+        return self._d.to_bytes(32, "big")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def pub_key(self) -> Secp256k1PubKey:
+        x, y = _to_affine(_jac_mul(_G, self._d))
+        return Secp256k1PubKey(_compress(x, y))
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte r||s with low-S (secp256k1.go:118 Sign)."""
+        h = hashlib.sha256(msg).digest()
+        e = int.from_bytes(h, "big") % N
+        while True:
+            k = _rfc6979_k(self._d, h)
+            aff = _to_affine(_jac_mul(_G, k))
+            r = aff[0] % N
+            if r == 0:
+                h = hashlib.sha256(h).digest()
+                continue
+            s = _inv(k, N) * (e + r * self._d) % N
+            if s == 0:
+                h = hashlib.sha256(h).digest()
+                continue
+            if s > N // 2:
+                s = N - s
+            return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def gen_priv_key() -> Secp256k1PrivKey:
+    import os
+
+    while True:
+        raw = os.urandom(32)
+        try:
+            return Secp256k1PrivKey(raw)
+        except ValueError:
+            continue
+
+
+def priv_key_from_secret(secret: bytes) -> Secp256k1PrivKey:
+    """sha256(secret) -> scalar (secp256k1.go:95 GenPrivKeySecp256k1)."""
+    d = int.from_bytes(hashlib.sha256(secret).digest(), "big") % (N - 1) + 1
+    return Secp256k1PrivKey(d.to_bytes(32, "big"))
+
+
+__all__ = [
+    "KEY_TYPE",
+    "PRIV_KEY_SIZE",
+    "PUB_KEY_SIZE",
+    "SIGNATURE_SIZE",
+    "Secp256k1PrivKey",
+    "Secp256k1PubKey",
+    "gen_priv_key",
+    "priv_key_from_secret",
+]

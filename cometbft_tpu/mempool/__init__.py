@@ -133,7 +133,11 @@ class CListMempool:
         cache_size: int = 10000,
         keep_invalid_txs_in_cache: bool = False,
         recheck: bool = True,
+        metrics=None,
     ):
+        from cometbft_tpu.metrics import MempoolMetrics
+
+        self.metrics = metrics if metrics is not None else MempoolMetrics()
         self._proxy = proxy_app_conn
         self._height = height
         self._size_limit = size
@@ -220,6 +224,7 @@ class CListMempool:
             except MempoolError as e:
                 post_err = e
         if res.code != 0 or post_err is not None:
+            self.metrics.failed_txs.inc()
             if not self._keep_invalid:
                 self.cache.remove(tx)
             if post_err is not None:
@@ -243,6 +248,9 @@ class CListMempool:
                 senders={sender} if sender else set(),
             )
             self._txs_bytes += len(tx)
+            self.metrics.size.set(len(self._txs))
+            self.metrics.size_bytes.set(self._txs_bytes)
+            self.metrics.tx_size_bytes.observe(len(tx))
             self._notify_available()
             self._new_tx_cond.notify_all()
 

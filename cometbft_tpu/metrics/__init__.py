@@ -1,0 +1,178 @@
+"""Per-module metrics structs (reference: internal/consensus/metrics.go,
+mempool/metrics.go, p2p/metrics.go, state/metrics.go — the structs
+metricsgen generates and node/node.go:334 wires).
+
+Each struct takes a ``utils.metrics.Registry`` (or None for no-op
+metrics, the reference's NopMetrics) and exposes typed fields the
+subsystems update on their hot paths.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.utils.metrics import DEFAULT_TIME_BUCKETS, Registry
+
+
+class _Nop:
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def labels(self, **kv):
+        return self
+
+
+_NOP = _Nop()
+
+
+class ConsensusMetrics:
+    """(internal/consensus/metrics.go:23 Metrics)"""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.height = self.rounds = self.validators = _NOP
+            self.validators_power = self.byzantine_validators = _NOP
+            self.num_txs = self.total_txs = self.block_size_bytes = _NOP
+            self.block_interval_seconds = self.committed_height = _NOP
+            self.block_parts = self.quorum_prevote_delay = _NOP
+            return
+        s = "consensus"
+        self.height = reg.gauge(s, "height", "Height of the chain.")
+        self.rounds = reg.gauge(
+            s, "rounds", "Number of rounds at the latest height."
+        )
+        self.validators = reg.gauge(
+            s, "validators", "Number of validators."
+        )
+        self.validators_power = reg.gauge(
+            s, "validators_power", "Total voting power of validators."
+        )
+        self.byzantine_validators = reg.gauge(
+            s, "byzantine_validators",
+            "Number of validators who tried to double sign.",
+        )
+        self.num_txs = reg.gauge(
+            s, "num_txs", "Number of transactions in the latest block."
+        )
+        self.total_txs = reg.counter(
+            s, "total_txs", "Total number of transactions committed."
+        )
+        self.block_size_bytes = reg.gauge(
+            s, "block_size_bytes", "Size of the latest block in bytes."
+        )
+        self.block_interval_seconds = reg.histogram(
+            s, "block_interval_seconds",
+            "Time between this and the last block.",
+            buckets=(0.5, 1, 2, 3, 5, 10, 30, 60),
+        )
+        self.committed_height = reg.gauge(
+            s, "latest_block_height", "Latest committed block height."
+        )
+        self.block_parts = reg.counter(
+            s, "block_parts",
+            "Block parts transmitted per peer.",
+            labels=("peer_id",),
+        )
+        self.quorum_prevote_delay = reg.gauge(
+            s, "quorum_prevote_delay",
+            "Seconds from proposal timestamp to +2/3 prevote quorum.",
+            labels=("proposer_address",),
+        )
+
+
+class MempoolMetrics:
+    """(mempool/metrics.go Metrics)"""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.size = self.size_bytes = self.tx_size_bytes = _NOP
+            self.failed_txs = self.evicted_txs = self.recheck_times = _NOP
+            return
+        s = "mempool"
+        self.size = reg.gauge(s, "size", "Number of uncommitted txs.")
+        self.size_bytes = reg.gauge(
+            s, "size_bytes", "Total size of the mempool in bytes."
+        )
+        self.tx_size_bytes = reg.histogram(
+            s, "tx_size_bytes", "Tx sizes in bytes.",
+            buckets=(16, 64, 256, 1024, 4096, 16384, 65536, 262144),
+        )
+        self.failed_txs = reg.counter(
+            s, "failed_txs", "Number of failed CheckTx."
+        )
+        self.evicted_txs = reg.counter(
+            s, "evicted_txs", "Number of evicted txs."
+        )
+        self.recheck_times = reg.counter(
+            s, "recheck_times", "Number of recheck passes."
+        )
+
+
+class P2PMetrics:
+    """(p2p/metrics.go Metrics)"""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.peers = _NOP
+            self.message_receive_bytes_total = _NOP
+            self.message_send_bytes_total = _NOP
+            return
+        s = "p2p"
+        self.peers = reg.gauge(s, "peers", "Number of connected peers.")
+        self.message_receive_bytes_total = reg.counter(
+            s, "message_receive_bytes_total",
+            "Bytes received per channel.", labels=("chID",),
+        )
+        self.message_send_bytes_total = reg.counter(
+            s, "message_send_bytes_total",
+            "Bytes sent per channel.", labels=("chID",),
+        )
+
+
+class StateMetrics:
+    """(state/metrics.go Metrics)"""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.block_processing_time = _NOP
+            self.consensus_param_updates = _NOP
+            self.validator_set_updates = _NOP
+            return
+        s = "state"
+        self.block_processing_time = reg.histogram(
+            s, "block_processing_time",
+            "Seconds spent processing a block (FinalizeBlock).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.consensus_param_updates = reg.counter(
+            s, "consensus_param_updates",
+            "Number of consensus parameter updates by the app.",
+        )
+        self.validator_set_updates = reg.counter(
+            s, "validator_set_updates",
+            "Number of validator set updates by the app.",
+        )
+
+
+class NodeMetrics:
+    """Bundle wired at node assembly (node/node.go:334)."""
+
+    def __init__(self, reg: Registry | None = None):
+        self.registry = reg
+        self.consensus = ConsensusMetrics(reg)
+        self.mempool = MempoolMetrics(reg)
+        self.p2p = P2PMetrics(reg)
+        self.state = StateMetrics(reg)
+
+
+__all__ = [
+    "ConsensusMetrics",
+    "MempoolMetrics",
+    "NodeMetrics",
+    "P2PMetrics",
+    "StateMetrics",
+]

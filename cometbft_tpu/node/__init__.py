@@ -117,6 +117,22 @@ class Node(BaseService):
         config.validate_basic()
         self.config = config
 
+        # 0. metrics plane (node/node.go:334 metricsProvider)
+        from cometbft_tpu.metrics import NodeMetrics
+        from cometbft_tpu.utils.metrics import MetricsServer, Registry
+
+        if config.instrumentation.prometheus:
+            registry = Registry(config.instrumentation.namespace)
+            self.metrics = NodeMetrics(registry)
+            self.metrics_server = MetricsServer(
+                registry,
+                config.instrumentation.prometheus_listen_addr,
+                logger=self.logger.with_fields(module="metrics"),
+            )
+        else:
+            self.metrics = NodeMetrics(None)
+            self.metrics_server = None
+
         # 1. stores (node/node.go:320 initDBs)
         backend = config.base.db_backend
         db_dir = config.db_dir
@@ -199,6 +215,7 @@ class Node(BaseService):
                 cache_size=config.mempool.cache_size,
                 keep_invalid_txs_in_cache=config.mempool.keep_invalid_txs_in_cache,
                 recheck=config.mempool.recheck,
+                metrics=self.metrics.mempool,
             )
 
         # 8. evidence pool (setup.go:329 createEvidenceReactor)
@@ -218,6 +235,7 @@ class Node(BaseService):
             block_store=self.block_store,
             event_bus=self.event_bus,
             evidence_pool=self.evidence_pool,
+            metrics=self.metrics.state,
             logger=self.logger.with_fields(module="executor"),
         )
 
@@ -235,6 +253,7 @@ class Node(BaseService):
             priv_validator=self.priv_validator,
             event_bus=self.event_bus,
             wal=self.wal,
+            metrics=self.metrics.consensus,
             logger=self.logger.with_fields(module="consensus"),
         )
 
@@ -348,6 +367,7 @@ class Node(BaseService):
             ),
             max_inbound=config.p2p.max_num_inbound_peers,
             max_outbound=config.p2p.max_num_outbound_peers,
+            metrics=self.metrics.p2p,
             logger=self.logger.with_fields(module="switch"),
         )
         for name, reactor in reactors.items():
@@ -457,6 +477,8 @@ class Node(BaseService):
 
     def on_start(self) -> None:
         """(node/node.go:580 OnStart)"""
+        if self.metrics_server is not None:
+            self.metrics_server.start()
         if self.privval_listener is not None:
             # the external signer must be reachable before consensus
             # needs a signature (node.go waits for the remote signer)
@@ -545,6 +567,7 @@ class Node(BaseService):
             self.event_bus,
             self.proxy_app,
             self.privval_listener,
+            self.metrics_server,
         )
         for svc in services:
             if svc is None:

@@ -39,6 +39,7 @@ class Switch(BaseService):
         mconn_config: MConnConfig | None = None,
         max_inbound: int = 40,
         max_outbound: int = 10,
+        metrics=None,
         logger: Logger | None = None,
     ):
         super().__init__(
@@ -58,6 +59,9 @@ class Switch(BaseService):
         self._persistent_addrs: dict[str, NetAddress] = {}
         self._mtx = threading.Lock()
         self.addr_book = None  # set by node wiring when PEX is enabled
+        from cometbft_tpu.metrics import P2PMetrics
+
+        self.metrics = metrics if metrics is not None else P2PMetrics()
 
     # -- reactor registration (switch.go:134 AddReactor) ----------------
 
@@ -225,6 +229,7 @@ class Switch(BaseService):
             conn.close()
             return False
         peer.start()
+        self.metrics.peers.set(self.peers.size())
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
         if outbound and self.addr_book is not None:
@@ -239,6 +244,9 @@ class Switch(BaseService):
         return True
 
     def _dispatch(self, peer: Peer, ch_id: int, msg: bytes) -> None:
+        self.metrics.message_receive_bytes_total.labels(
+            chID=f"{ch_id:#x}"
+        ).inc(len(msg))
         reactor = self._reactor_by_channel.get(ch_id)
         if reactor is None:
             self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
@@ -268,6 +276,7 @@ class Switch(BaseService):
     def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
         if not self.peers.remove(peer):
             return
+        self.metrics.peers.set(self.peers.size())
         try:
             if peer.is_running():
                 peer.stop()
@@ -282,6 +291,9 @@ class Switch(BaseService):
         """Fire-and-forget to every peer via the per-channel send
         queues — a full queue drops rather than blocks, matching the
         reference's async Broadcast semantics."""
+        self.metrics.message_send_bytes_total.labels(
+            chID=f"{ch_id:#x}"
+        ).inc(len(msg) * self.peers.size())
         for peer in self.peers.copy():
             peer.try_send(ch_id, msg)
 

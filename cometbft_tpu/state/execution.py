@@ -317,6 +317,7 @@ class BlockExecutor:
         evidence_pool=None,
         block_store=None,
         event_bus: EventBus | None = None,
+        metrics=None,
         logger: Logger | None = None,
     ):
         self.state_store = state_store
@@ -325,6 +326,9 @@ class BlockExecutor:
         self.ev_pool = evidence_pool or _NopEvidencePool()
         self.block_store = block_store
         self.event_bus = event_bus
+        from cometbft_tpu.metrics import StateMetrics
+
+        self.metrics = metrics if metrics is not None else StateMetrics()
         self.logger = logger or default_logger().with_fields(module="executor")
         self.retain_height = 0  # last app-requested retain height
 
@@ -432,6 +436,11 @@ class BlockExecutor:
         )
         resp = self.proxy_app.finalize_block(req)
         elapsed_ms = (now_ns() - start) / 1e6
+        self.metrics.block_processing_time.observe(elapsed_ms / 1e3)
+        if resp.validator_updates:
+            self.metrics.validator_set_updates.inc()
+        if resp.consensus_param_updates is not None:
+            self.metrics.consensus_param_updates.inc()
         self.logger.info(
             "finalized block",
             height=block.header.height,
