@@ -1,10 +1,15 @@
 """edwards25519 group operations for the batch-verify kernel.
 
-Points are pytrees (X, Y, Z, T) of lazy field elements (ops/field.py),
-extended twisted Edwards coordinates with a=-1 ("Twisted Edwards Curves
-Revisited", Hisil et al. 2008 — unified/complete formulas, so there is
-no per-lane control flow on point identity: every lane of the batch
-executes the same straight-line code, which is what XLA wants).
+Points are pytrees (X, Y, Z, T) of lazy field elements (ops/field.py,
+limbs-first: shape (26, *batch)), extended twisted Edwards coordinates
+with a=-1 ("Twisted Edwards Curves Revisited", Hisil et al. 2008 —
+unified/complete formulas, so there is no per-lane control flow on
+point identity: every lane of the batch executes the same straight-line
+code, which is what XLA wants).
+
+Byte and nibble arrays at this layer are feature-first too: encodings
+are (32, *batch) uint8, scalar windows (64, *batch) int32 — the batch
+axis stays last so it maps onto TPU vector lanes end to end.
 
 Scalar multiplication strategy (per verify, Q = [S]B + [h](-A)):
 - [S]B fixed base: a 64x16 comb table of j*16^w*B in precomputed-Niels
@@ -14,8 +19,8 @@ Scalar multiplication strategy (per verify, Q = [S]B + [h](-A)):
   -A), then 64 scan steps of 4 doublings + 1 table add.
 
 Lazy-limb growth budget: every coordinate produced here is a mul output
-(limbs < 2^17); formulas chain at most 2 add/subs before the next mul,
-staying far under field.mul's |limb| < 2^24 input requirement.
+(limbs < 2^11); formulas chain at most 2 add/subs before the next mul,
+which is exactly field.mul's input budget (see ops/field.py docstring).
 """
 
 from __future__ import annotations
@@ -38,7 +43,7 @@ WINDOWS = 64  # 4-bit windows over 256-bit scalars
 
 
 def _niels_from_affine(x: int, y: int) -> np.ndarray:
-    """(y+x, y-x, 2dxy) limbs — shape (3, 16)."""
+    """(y+x, y-x, 2dxy) limbs — shape (3, NLIMBS)."""
     return np.stack(
         [
             F.from_int((y + x) % _ref.P),
@@ -49,12 +54,12 @@ def _niels_from_affine(x: int, y: int) -> np.ndarray:
 
 
 def _build_comb_table() -> np.ndarray:
-    """COMB[w][j] = j * 16^w * B as Niels triples; shape (64, 16, 3, 16).
+    """COMB[w][j] = j * 16^w * B as Niels triples; shape (64, 16, 3, 26).
 
     j=0 is the Niels identity (1, 1, 0), which the mixed add treats as
     a no-op projectively — so table lookups need no identity branch.
     """
-    table = np.zeros((WINDOWS, 16, 3, F.NLIMBS), dtype=np.int64)
+    table = np.zeros((WINDOWS, 16, 3, F.NLIMBS), dtype=np.int32)
     base = _ref.B_POINT
     for w in range(WINDOWS):
         acc = _ref.IDENTITY
@@ -70,14 +75,16 @@ def _build_comb_table() -> np.ndarray:
     return table
 
 
-B_COMB = _build_comb_table()  # (64, 16, 3, 16) int64
+B_COMB = _build_comb_table()  # (64, 16, 3, 26) int32
 
 
 # -- point algebra -----------------------------------------------------
 
 def identity(batch_shape=()) -> tuple:
-    z = jnp.zeros((*batch_shape, F.NLIMBS), dtype=F.DTYPE)
-    one = jnp.broadcast_to(jnp.asarray(F.ONE), (*batch_shape, F.NLIMBS))
+    z = jnp.zeros((F.NLIMBS, *batch_shape), dtype=F.DTYPE)
+    one = jnp.broadcast_to(
+        F.cvec(F.ONE, 1 + len(batch_shape)), (F.NLIMBS, *batch_shape)
+    )
     return (z, one, one, z)
 
 
@@ -87,7 +94,7 @@ def pt_add(p, q):
     x2, y2, z2, t2 = q
     a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
     b = F.mul(F.add(y1, x1), F.add(y2, x2))
-    c = F.mul(F.mul(t1, jnp.asarray(TWO_D_LIMBS)), t2)
+    c = F.mul(F.mul(t1, F.cvec(TWO_D_LIMBS, t1.ndim)), t2)
     dd = F.mul_small(F.mul(z1, z2), 2)
     e = F.sub(b, a)
     f = F.sub(dd, c)
@@ -138,59 +145,62 @@ def pt_is_identity(p):
 # -- decompression (ZIP-215) -------------------------------------------
 
 def decompress(enc):
-    """(..., 32) uint8 -> (point, valid_mask).
+    """(32, *batch) uint8 -> (point, valid_mask).
 
     ZIP-215 rules (crypto/ed25519/ed25519.go:39 semantics): the 255-bit
     y is reduced mod p implicitly (non-canonical encodings accepted);
     rejection only for non-square x^2 candidates; x=0 with sign bit set
     ("-0") is accepted. Matches crypto/edwards.decode_point.
     """
-    sign = (enc[..., 31] >> 7).astype(F.DTYPE)
+    sign = (enc[31] >> 7).astype(F.DTYPE)
     y = F.from_bytes_le(enc)
-    y = y.at[..., 15].add(-((sign << 15) << 0))  # clear bit 255
+    # clear bit 255: limb 25 covers bits [250, 260), so bit 255 is its
+    # bit 5.
+    y = y.at[F.NLIMBS - 1].add(-(sign << 5))
     yy = F.square(y)
-    u = F.sub(yy, jnp.asarray(F.ONE))
-    v = F.add(F.mul(yy, jnp.asarray(D_LIMBS)), jnp.asarray(F.ONE))
+    one = F.cvec(F.ONE, y.ndim)
+    u = F.sub(yy, one)
+    v = F.add(F.mul(yy, F.cvec(D_LIMBS, y.ndim)), one)
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
     x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
     vxx = F.mul(v, F.square(x))
     ok1 = F.eq(vxx, u)
     ok2 = F.eq(vxx, F.neg(u))
-    x = F.select(ok2, F.mul(x, jnp.asarray(SQRT_M1_LIMBS)), x)
+    x = F.select(ok2, F.mul(x, F.cvec(SQRT_M1_LIMBS, y.ndim)), x)
     valid = ok1 | ok2
     flip = F.is_odd(x) != (sign == 1)
     x = F.select(flip, F.neg(x), x)
-    return (x, y, jnp.broadcast_to(jnp.asarray(F.ONE), y.shape), F.mul(x, y)), valid
+    z = jnp.broadcast_to(one, y.shape)
+    return (x, y, z, F.mul(x, y)), valid
 
 
 # -- scalar windows ----------------------------------------------------
 
 def nibbles_from_bytes_le(b):
-    """(..., 32) uint8 scalar -> (..., 64) int32 4-bit windows, little-
-    endian (window w has weight 16^w)."""
+    """(32, *batch) uint8 scalar -> (64, *batch) int32 4-bit windows,
+    little-endian (window w has weight 16^w)."""
     b = b.astype(jnp.int32)
     lo = b & 0xF
     hi = b >> 4
-    return jnp.stack([lo, hi], axis=-1).reshape(*b.shape[:-1], 64)
+    return jnp.stack([lo, hi], axis=1).reshape(64, *b.shape[1:])
 
 
 def comb_mul_base(s_nibbles):
     """[S]B via the Niels comb: 64 table lookups + mixed adds.
 
-    s_nibbles: (..., 64) int32. Returns an extended point.
+    s_nibbles: (64, *batch) int32. Returns an extended point.
     """
-    batch = s_nibbles.shape[:-1]
-    table = jnp.asarray(B_COMB)  # (64, 16, 3, 16)
+    batch = s_nibbles.shape[1:]
+    table = jnp.asarray(B_COMB)  # (64, 16, 3, 26)
 
     def body(acc, xs):
-        tbl_w, nib = xs  # (16, 3, 16), (...,)
-        entry = tbl_w[nib]  # gather -> (..., 3, 16)
-        n = (entry[..., 0, :], entry[..., 1, :], entry[..., 2, :])
-        return pt_add_niels(acc, n), None
+        tbl_w, nib = xs  # (16, 3, 26), (*batch,)
+        entry = tbl_w[nib]  # gather -> (*batch, 3, 26)
+        e = jnp.moveaxis(entry, (-2, -1), (0, 1))  # (3, 26, *batch)
+        return pt_add_niels(acc, (e[0], e[1], e[2])), None
 
-    nibs_t = jnp.moveaxis(s_nibbles, -1, 0)  # (64, ...)
-    acc, _ = lax.scan(body, identity(batch), (table, nibs_t))
+    acc, _ = lax.scan(body, identity(batch), (table, s_nibbles))
     return acc
 
 
@@ -198,29 +208,27 @@ def window_mul(k_nibbles, p):
     """[k]P for a per-lane point P: windowed double-and-add.
 
     Builds the 16-entry multiples table (15 adds), then scans windows
-    MSB-first: acc = 16*acc + T[nib]. k_nibbles: (..., 64) int32.
+    MSB-first: acc = 16*acc + T[nib]. k_nibbles: (64, *batch) int32.
     """
-    batch = k_nibbles.shape[:-1]
-    # table[j] = j*P, extended coords; stack along a new axis -3.
+    batch = k_nibbles.shape[1:]
+    # table[j] = j*P, extended coords; stack along a new LEADING axis.
     entries = [identity(batch), p]
     for _ in range(14):
         entries.append(pt_add(entries[-1], p))
     table = tuple(
-        jnp.stack([e[c] for e in entries], axis=-2) for c in range(4)
-    )  # each (..., 16 entries, 16 limbs)
+        jnp.stack([e[c] for e in entries], axis=0) for c in range(4)
+    )  # each (16, 26, *batch)
 
     def body(acc, nib):
         for _ in range(4):
             acc = pt_double(acc)
-        idx = nib[..., None, None].astype(jnp.int32)
+        idx = nib[None, None].astype(jnp.int32)  # (1, 1, *batch)
         entry = tuple(
-            jnp.take_along_axis(table[c], idx, axis=-2)[..., 0, :]
-            for c in range(4)
+            jnp.take_along_axis(table[c], idx, axis=0)[0] for c in range(4)
         )
         return pt_add(acc, entry), None
 
-    nibs_t = jnp.moveaxis(k_nibbles, -1, 0)[::-1]  # (64, ...) MSB first
-    acc, _ = lax.scan(body, identity(batch), nibs_t)
+    acc, _ = lax.scan(body, identity(batch), k_nibbles[::-1])
     return acc
 
 

@@ -15,11 +15,26 @@ has to re-verify on batch failure because the RLC trick only yields a
 single bit; data-parallel verification gives the per-vote bits for
 free).
 
-Batch shaping: inputs are padded to (power-of-two batch, message-length
-bucket) so the jit cache stays small and shapes stay static for XLA.
+Batch shaping (TPU-first):
+- Device arrays are **feature-first**: the packed buffer is
+  (100+bucket, batch) so the batch axis rides the 128-wide vector
+  lanes (see ops/field.py design notes).
+- Inputs are padded to (power-of-two batch, message-length bucket) so
+  the jit cache stays small and shapes stay static for XLA.
+- Batches larger than MAX_LAUNCH split into multiple asynchronously
+  dispatched launches (one XLA program executes at a time on the chip,
+  but transfers and host packing overlap device compute). MAX_LAUNCH
+  bounds the working set so XLA's fusions stay within on-chip memory —
+  measured round 3: one huge launch falls off a memory cliff, pipelined
+  8-16k launches do not.
+- A and R decompress as ONE concatenated batch (32, 2B): the sqrt
+  exponentiation chain is the deepest part of the graph, and fusing
+  both halves halves the traced program.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -37,45 +52,60 @@ from cometbft_tpu.ops import sha512 as SH
 _BUCKETS = (128, 256, 512, 1024, 4096)
 _MIN_BATCH = 8
 
+#: Largest single device launch (lanes). Above this, verify_arrays
+#: splits into pipelined launches. Derived from round-3 measurement:
+#: 8192 sustains peak device rate; 65536 in one launch hits an
+#: XLA memory cliff.
+MAX_LAUNCH = int(os.environ.get("CMT_TPU_MAX_LAUNCH", 8192))
+
 
 def build_padded_input(r_enc, a_enc, msg, msglen, nblocks: int):
     """Assemble SHA-512 input R || A || M with FIPS 180-4 padding, fully
     vectorized (per-lane dynamic message length, static bucket width).
 
-    SHA padding is minimal per message: each lane's 0x80 marker and
-    16-byte big-endian bit length land at the end of *its own* final
-    block, not the bucket's. Returns (buf, nblocks_lane)."""
+    Inputs are feature-first: r_enc/a_enc (32, B), msg (M, B),
+    msglen (B,). SHA padding is minimal per message: each lane's 0x80
+    marker and 16-byte big-endian bit length land at the end of *its
+    own* final block, not the bucket's. Returns (buf (width, B) uint8,
+    nblocks_lane (B,))."""
     width = nblocks * 128
-    batch = msg.shape[:-1]
     content = jnp.concatenate(
         [r_enc.astype(jnp.int64), a_enc.astype(jnp.int64), msg.astype(jnp.int64)],
-        axis=-1,
+        axis=0,
     )
-    pad = [(0, 0)] * len(batch) + [(0, width - content.shape[-1])]
-    content = jnp.pad(content, pad)
-    total = (64 + msglen).astype(jnp.int64)[..., None]  # (..., 1)
+    content = jnp.pad(
+        content, [(0, width - content.shape[0])] + [(0, 0)] * (msg.ndim - 1)
+    )
+    total = (64 + msglen).astype(jnp.int64)[None]       # (1, B)
     nblocks_lane = (total + 17 + 127) // 128            # ceil((total+17)/128)
     lane_width = nblocks_lane * 128
-    idx = jnp.arange(width, dtype=jnp.int64)
+    idx = jnp.arange(width, dtype=jnp.int64).reshape(
+        (width,) + (1,) * (msg.ndim - 1)
+    )
     buf = jnp.where(idx < total, content, 0)
     buf = jnp.where(idx == total, 0x80, buf)
     bitlen = total * 8
     pos_from_end = lane_width - 1 - idx
     lenbyte = (bitlen >> jnp.minimum(8 * pos_from_end, 56)) & 0xFF
     buf = jnp.where((pos_from_end >= 0) & (pos_from_end < 8), lenbyte, buf)
-    return buf.astype(jnp.uint8), nblocks_lane[..., 0]
+    return buf.astype(jnp.uint8), nblocks_lane[0]
 
 
 def verify_kernel(pub, sig, msg, msglen, nblocks: int):
-    """(..., 32) u8, (..., 64) u8, (..., M) u8, (...,) i32 -> (...,) bool.
+    """(32, B) u8, (64, B) u8, (M, B) u8, (B,) i32 -> (B,) bool.
 
     Semantics are bit-identical to crypto.edwards.verify_zip215 (the
     pure-Python oracle); differential fuzz in tests/test_ops_kernel.py.
     """
-    r_enc = sig[..., :32]
-    s_bytes = sig[..., 32:]
-    a_pt, a_ok = C.decompress(pub)
-    r_pt, r_ok = C.decompress(r_enc)
+    n = pub.shape[-1]
+    r_enc = sig[:32]
+    s_bytes = sig[32:]
+    # one decompression for A and R, concatenated on the trailing batch
+    # axis: (32, ..., 2B)
+    both, both_ok = C.decompress(jnp.concatenate([pub, r_enc], axis=-1))
+    a_pt = tuple(c[..., :n] for c in both)
+    r_pt = tuple(c[..., n:] for c in both)
+    a_ok, r_ok = both_ok[..., :n], both_ok[..., n:]
     s_ok = SC.bytes_lt_l(s_bytes)
 
     buf, nblocks_lane = build_padded_input(r_enc, pub, msg, msglen, nblocks)
@@ -91,23 +121,19 @@ def verify_kernel(pub, sig, msg, msglen, nblocks: int):
 
 
 def verify_kernel_packed(buf, bucket: int, nblocks: int):
-    """Single-buffer variant: (..., 32+64+bucket+4) u8 -> (...,) bool.
+    """Single-buffer variant: (32+64+bucket+4, B) u8 -> (B,) bool.
 
     One fused input buffer means ONE host->device transfer per launch —
     on links where per-transfer latency dominates (PCIe dispatch, or a
     tunneled PJRT backend), 4 separate transfers would quadruple the
-    fixed cost.  Layout: pub[32] | sig[64] | msg[bucket] | msglen_le[4].
+    fixed cost.  Row layout: pub[32] | sig[64] | msg[bucket] |
+    msglen_le[4].
     """
-    pub = buf[..., :32]
-    sig = buf[..., 32:96]
-    msg = buf[..., 96 : 96 + bucket]
-    lnb = buf[..., 96 + bucket : 100 + bucket].astype(jnp.int32)
-    msglen = (
-        lnb[..., 0]
-        | (lnb[..., 1] << 8)
-        | (lnb[..., 2] << 16)
-        | (lnb[..., 3] << 24)
-    )
+    pub = buf[:32]
+    sig = buf[32:96]
+    msg = buf[96 : 96 + bucket]
+    lnb = buf[96 + bucket : 100 + bucket].astype(jnp.int32)
+    msglen = lnb[0] | (lnb[1] << 8) | (lnb[2] << 16) | (lnb[3] << 24)
     return verify_kernel(pub, sig, msg, msglen, nblocks)
 
 
@@ -129,52 +155,78 @@ def _next_pow2(n: int) -> int:
 
 
 def pack_inputs(
-    pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]
+    pub: np.ndarray, sig: np.ndarray, msgs: list[bytes], start: int = 0,
+    end: int | None = None,
 ) -> tuple[np.ndarray, int]:
-    """Pad + pack (pub, sig, msgs) into the (batch, 100+bucket) u8
-    layout of verify_kernel_packed. Returns (packed, bucket)."""
-    n = len(msgs)
-    maxlen = max((len(m) for m in msgs), default=0)
+    """Pad + pack (pub, sig, msgs[start:end]) into the feature-first
+    (100+bucket, batch) u8 layout of verify_kernel_packed — fully
+    vectorized, no per-message Python loop. Returns (packed, bucket)."""
+    if end is None:
+        end = len(msgs)
+    n = end - start
+    lens = np.fromiter((len(msgs[i]) for i in range(start, end)),
+                       dtype=np.int64, count=n)
+    maxlen = int(lens.max()) if n else 0
     bucket = next((b for b in _BUCKETS if b >= maxlen), None)
     if bucket is None:
         raise ValueError(f"message too large for device path: {maxlen}")
     batch = max(_next_pow2(n), _MIN_BATCH)
-    packed = np.zeros((batch, 100 + bucket), dtype=np.uint8)
-    packed[:n, :32] = pub
-    packed[:n, 32:96] = sig
-    for i, m in enumerate(msgs):
-        packed[i, 96 : 96 + len(m)] = np.frombuffer(m, dtype=np.uint8)
-        packed[i, 96 + bucket : 100 + bucket] = np.frombuffer(
-            np.array(len(m), dtype="<i4").tobytes(), dtype=np.uint8
-        )
+    packed = np.zeros((100 + bucket, batch), dtype=np.uint8)
+    packed[:32, :n] = pub[start:end].T
+    packed[32:96, :n] = sig[start:end].T
+    flat = np.frombuffer(b"".join(msgs[start:end]), dtype=np.uint8)
+    if n and (lens == lens[0]).all():
+        if lens[0]:
+            packed[96 : 96 + int(lens[0]), :n] = flat.reshape(n, -1).T
+    elif n:
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        col = np.repeat(np.arange(n), lens)
+        row = 96 + (np.arange(len(flat)) - offs[col])
+        packed[row, col] = flat
+    packed[96 + bucket : 100 + bucket, :n] = (
+        lens.astype("<u4").view(np.uint8).reshape(n, 4).T
+    )
     return packed, bucket
 
 
+def _dispatch(pub, sig, msgs, start, end):
+    packed, bucket = pack_inputs(pub, sig, msgs, start, end)
+    fn = _compiled(packed.shape[-1], bucket)
+    return fn(jax.device_put(packed))
+
+
 def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
-    """Enqueue one verification launch without waiting: returns
-    (device_array, n).  The transfer and execution are dispatched
-    asynchronously; call ``np.asarray`` on the result (or use
-    verify_stream) to synchronize.  Keeping several launches in flight
-    pipelines transfer against compute and amortizes per-launch latency
-    — essential for replay workloads (1k blocks x 1k commits)."""
-    packed, bucket = pack_inputs(pub, sig, msgs)
-    fn = _compiled(packed.shape[0], bucket)
-    return fn(jax.device_put(packed)), len(msgs)
+    """Enqueue verification launches without waiting: returns a list of
+    (device_array, chunk_len) pairs. Batches over MAX_LAUNCH split into
+    several launches, all dispatched before any result is awaited, so
+    transfers and host packing overlap device compute. Call
+    ``np.asarray`` on the parts (or use verify_stream) to synchronize.
+    Each device array is pow2-padded — slice to its chunk_len."""
+    n = len(msgs)
+    parts = []
+    for start in range(0, max(n, 1), MAX_LAUNCH):
+        end = min(start + MAX_LAUNCH, n)
+        parts.append((_dispatch(pub, sig, msgs, start, end), end - start))
+    return parts
+
+
+def _finish(parts) -> np.ndarray:
+    return np.concatenate([np.asarray(p)[:k] for p, k in parts])
 
 
 def verify_arrays(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     """Host entry: numpy (n,32), (n,64), list of n messages -> bool[n].
 
-    Pads to (pow2 batch, length bucket) and runs one device launch.
+    Pads to (pow2 batch, length bucket); one device launch per
+    MAX_LAUNCH chunk.
     """
-    out, n = verify_arrays_async(pub, sig, msgs)
-    return np.asarray(out)[:n]
+    return _finish(verify_arrays_async(pub, sig, msgs))
 
 
 def verify_stream(jobs, max_in_flight: int = 8):
     """Pipelined verification: ``jobs`` yields (pub, sig, msgs) tuples;
     yields bool[n] results in order, keeping up to ``max_in_flight``
-    launches outstanding so device compute overlaps host packing and
+    jobs outstanding so device compute overlaps host packing and
     transfers."""
     from collections import deque
 
@@ -182,17 +234,15 @@ def verify_stream(jobs, max_in_flight: int = 8):
     for job in jobs:
         pending.append(verify_arrays_async(*job))
         if len(pending) >= max_in_flight:
-            out, n = pending.popleft()
-            yield np.asarray(out)[:n]
+            yield _finish(pending.popleft())
     while pending:
-        out, n = pending.popleft()
-        yield np.asarray(out)[:n]
+        yield _finish(pending.popleft())
 
 
 #: Below this batch size the host verifier is faster than a device
 #: launch (fixed dispatch cost + one-time XLA compile per shape); the
-#: device path wins from dozens of signatures up to the 10k-validator
-#: north star. Overridable for benchmarking via CMT_TPU_DEVICE_MIN_BATCH.
+#: device path wins from there up to the 10k-validator north star.
+#: Overridable for benchmarking via CMT_TPU_DEVICE_MIN_BATCH.
 DEVICE_MIN_BATCH = 64
 
 
@@ -202,8 +252,6 @@ class TpuBatchVerifier(BatchVerifier):
     """
 
     def __init__(self, device_min_batch: int | None = None) -> None:
-        import os
-
         if device_min_batch is None:
             device_min_batch = int(
                 os.environ.get("CMT_TPU_DEVICE_MIN_BATCH", DEVICE_MIN_BATCH)

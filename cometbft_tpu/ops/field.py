@@ -1,29 +1,37 @@
-"""GF(2^255-19) arithmetic on 16x16-bit limbs — the kernel's number system.
+"""GF(2^255-19) arithmetic on 26x10-bit limbs of int32 — the kernel's
+number system.
 
 Design notes (TPU-first):
-- A field element is an int64 array of shape (..., 16): little-endian
-  limbs, nominally 16 bits each but stored *lazily* — limbs may be any
-  signed value with |limb| < 2^26 (the "loose" invariant). All ops
-  broadcast over leading batch dims, so one traced program verifies an
-  entire validator set.
-- add/sub are single vector adds with NO carry work. Carries are only
-  resolved inside mul (where products must not overflow i64) and at
-  canonical boundaries (encode/compare). This keeps the op count per
-  group operation small enough that XLA emits short, fusable
-  vector code — no per-limb scalar slicing anywhere on the hot path.
-- Carry resolution is *vectorized relaxation*: every limb computes its
-  carry simultaneously; carries shift up one limb per iteration (the
-  2^256 wraparound folds in as x38, since 2^256 ≡ 38 mod p). Three
-  iterations shrink any mul column set to limbs < 2^22; sequential
-  per-limb propagation exists only in the rarely-used canonical path.
-- Overflow budget: mul inputs require |limb| < 2^26. Columns then
-  bound by 16*2^52, and the x38 fold keeps everything < 2^62 in i64.
-  mul outputs have limbs < 2^22, and each add/sub grows the bound by
-  one bit — so up to 4 chained add/subs between muls are safe. The
-  curve formulas (ops/curve.py) never chain more than 3.
+- **Limbs-first layout**: a field element is an int32 array of shape
+  (26, *batch) — the small limb axis leads and the batch axis is LAST,
+  so the batch dimension maps onto the TPU's 128-wide vector lanes.
+  (Batch-last limbs would put the 26-limb axis in the lane dimension,
+  padding every tile to 128 lanes — 20% utilization; round-3 profiling
+  measured the full kernel at ~3% of VPU peak in that layout, and large
+  batches miscompiled on the axon backend. Limbs-first fixed both.)
+- TPU VPUs are 32-bit machines: int64 is emulated (pairs of i32 with
+  synthesized wide multiplies) at ~6.6x the cost of native i32 ops for
+  this workload, so limbs are int32.
+- Radix 10 is chosen so that (a) schoolbook product columns — up to 26
+  products of two 13-bit limbs — stay under 2^31, and (b) the modular
+  wrap factor is SMALL: capacity is 26*10 = 260 bits and 2^260 ≡ 608
+  (mod p), so a carry-relaxation pass can multiply a full-size carry by
+  the wrap without overflowing i32.
+- add/sub are single vector adds with NO carry work. Budget: **mul
+  inputs may carry at most 2 chained add/subs** (limbs grow 2^11 ->
+  2^13; 26·2^13·2^13 = 2^30.7 < 2^31). The curve formulas
+  (ops/curve.py) never chain more than 2.
+- Carry resolution is *vectorized relaxation*: every limb releases its
+  carry simultaneously; carries shift up one limb per iteration, the
+  top carry folding into limb 0 as x608. mul's high columns are first
+  relaxed as their own 27-limb block (2 passes, shift-only), folded
+  x608 (block overflow limb x608^2), then 4 low passes leave limbs
+  < 2^11.
 
+Lazy limbs may be signed; all shifts are arithmetic (floor division).
 The semantic ground truth is cometbft_tpu.crypto.edwards (pure-Python
-big-int oracle); tests differential-fuzz every op against it.
+big-int oracle); tests differential-fuzz every op against it
+(tests/test_ops_field.py).
 """
 
 from __future__ import annotations
@@ -35,37 +43,43 @@ from jax import lax
 
 from cometbft_tpu.crypto.edwards import P
 
-NLIMBS = 16
-LIMB_BITS = 16
+NLIMBS = 26
+LIMB_BITS = 10
 MASK = (1 << LIMB_BITS) - 1
+CAPACITY = NLIMBS * LIMB_BITS  # 260
 
-DTYPE = jnp.int64
+DTYPE = jnp.int32
 
-# Relaxation wrap factors: carry out of limb 15 re-enters at limb 0 with
-# weight 2^256 ≡ 38 (mod p).
-_WRAP = np.ones(NLIMBS, dtype=np.int64)
-_WRAP[0] = 38
+# 2^260 = 2^5 * 2^255 ≡ 32 * 19 = 608 (mod p); carries out of limb 25
+# re-enter at limb 0 with this weight.
+WRAP = (1 << (CAPACITY - 255)) * 19  # 608
+assert pow(2, CAPACITY, P) == WRAP
+
+_WRAP_VEC = np.ones(NLIMBS, dtype=np.int32)
+_WRAP_VEC[0] = WRAP
 
 
 # -- host-side conversions (tests, table generation) -------------------
 
 def from_int(x: int) -> np.ndarray:
-    """Python int -> limb array (host helper)."""
+    """Python int -> (26,) limb array (host helper)."""
     if x < 0 or x >= 1 << 256:
         raise ValueError("field element out of range")
     return np.array(
-        [(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int64
+        [(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
     )
 
 
 def to_int(limbs) -> int:
-    """Limb array -> python int (host helper; accepts lazy/signed limbs)."""
+    """(26, ...) limb array -> python int of lane 0 if batched, or of
+    the single element (host helper; accepts lazy/signed limbs)."""
     arr = np.asarray(limbs, dtype=np.int64)
-    return sum(int(arr[..., i]) << (LIMB_BITS * i) for i in range(NLIMBS))
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(NLIMBS))
 
 
 def batch_from_ints(xs: list[int]) -> np.ndarray:
-    return np.stack([from_int(x) for x in xs])
+    """ints -> (26, n) limbs-first batch."""
+    return np.stack([from_int(x) for x in xs], axis=-1)
 
 
 P_LIMBS = from_int(P)
@@ -73,21 +87,35 @@ ZERO = from_int(0)
 ONE = from_int(1)
 
 
+def cvec(c: np.ndarray, ndim: int):
+    """Broadcast a host (26,)-constant against a (26, *batch) element:
+    numpy/jnp broadcasting aligns trailing axes, so leading-limb layout
+    needs the constant reshaped to (26, 1, ..., 1)."""
+    return jnp.asarray(c).reshape((c.shape[0],) + (1,) * (ndim - 1))
+
+
+def _shift_up(carry):
+    """Row j of the result is carry[j-1]; row 0 is zero (no wrap)."""
+    pad = [(1, 0)] + [(0, 0)] * (carry.ndim - 1)
+    return jnp.pad(carry, pad)[: carry.shape[0]]
+
+
 # -- carry machinery ---------------------------------------------------
 
 def relax(c, iters: int = 4):
     """Vectorized carry relaxation: all limbs release their carry at
     once; carries travel one limb per iteration, the top carry folding
-    into limb 0 as x38. Signed-safe (arithmetic shift = floor division).
+    into limb 0 as x608. Signed-safe (arithmetic shift = floor div).
 
-    Convergence: each iteration shifts carry magnitude down 16 bits but
-    the x38 wrap adds ~5.3 bits back at limb 0. Four iterations take any
-    |column| < 2^58 down to limbs < 2^17.
+    Because WRAP < 2^10, the fold never overflows: a first-pass carry
+    is < 2^21 and 608 * 2^21 < 2^31. Four passes take mul columns
+    (< 2^31) down to limbs < 2^11.
     """
+    w = cvec(_WRAP_VEC, c.ndim)
     for _ in range(iters):
         carry = c >> LIMB_BITS
         lo = c - (carry << LIMB_BITS)
-        c = lo + jnp.roll(carry, 1, axis=-1) * _WRAP
+        c = lo + jnp.roll(carry, 1, axis=0) * w
     return c
 
 
@@ -105,22 +133,46 @@ def neg(a):
     return -a
 
 
+def _columns(a, b):
+    """Schoolbook columns cols[j] = sum_i a[i]*b[j-i], shape (51, *b):
+    a padded copy of b is sliced at 26 static offsets and stacked, so
+    the contraction is one elementwise multiply + a single sum over the
+    26-long leading axis — no scatter, no reshape tricks."""
+    pad = [(NLIMBS - 1, NLIMBS - 1)] + [(0, 0)] * (b.ndim - 1)
+    bp = jnp.pad(b, pad)  # (76, *batch)
+    s = jnp.stack(
+        [
+            bp[NLIMBS - 1 - i : NLIMBS - 1 - i + 2 * NLIMBS - 1]
+            for i in range(NLIMBS)
+        ]
+    )  # (26, 51, *batch); s[i, j] = b[j - i]
+    return (a[:, None] * s).sum(axis=0, dtype=DTYPE)
+
+
+def _fold_high(cols):
+    """51 columns -> 26 lazy limbs: relax the 25 high columns as their
+    own block (2 shift-only passes; the padded rows absorb the shifted
+    carries), then fold x608 (x608^2 for the block's overflow row)."""
+    ndim = cols.ndim
+    low = cols[:NLIMBS]
+    high = jnp.pad(
+        cols[NLIMBS:], [(0, 2)] + [(0, 0)] * (ndim - 1)
+    )  # (27, *batch); row j has weight 2^(260 + 10j)
+    for _ in range(2):
+        carry = high >> LIMB_BITS
+        high = (high - (carry << LIMB_BITS)) + _shift_up(carry)
+    low = low + high[:NLIMBS] * jnp.int32(WRAP)
+    # row 26 has weight 2^(260+260) ≡ 608^2
+    tail = high[NLIMBS : NLIMBS + 1] * jnp.int32(WRAP * WRAP)
+    return low + jnp.pad(tail, [(0, NLIMBS - 1)] + [(0, 0)] * (ndim - 1))
+
+
 def mul(a, b):
-    """Field multiply: skewed outer product -> 31 columns -> x38 fold ->
-    4 relaxation rounds. Inputs must satisfy |limb| < 2^24 (mul outputs
-    have limbs < 2^17, so up to ~6 chained add/subs stay in budget)."""
-    o = a[..., :, None] * b[..., None, :]  # (..., 16, 16)
-    # Skew trick: pad rows to width 32, flatten, drop the tail, and
-    # re-view as (16, 31) — row i lands shifted right by i, so a plain
-    # sum over rows yields the 31 schoolbook columns.
-    batch = o.shape[:-2]
-    o = jnp.pad(o, [(0, 0)] * len(batch) + [(0, 0), (0, NLIMBS)])
-    o = o.reshape(*batch, 2 * NLIMBS * NLIMBS)[..., : 31 * NLIMBS]
-    cols = o.reshape(*batch, NLIMBS, 31).sum(axis=-2)  # (..., 31)
-    low = cols[..., :NLIMBS]
-    high = cols[..., NLIMBS:]
-    low = low + 38 * jnp.pad(high, [(0, 0)] * len(batch) + [(0, 1)])
-    return relax(low)
+    """Field multiply: shifted-stack columns -> high fold -> 4
+    relaxation passes. Budget: 26 * max|a_i| * max|b_j| < 2^31, i.e.
+    each operand may be a mul output (< 2^11) plus up to 2 lazy
+    add/subs. Output limbs < 2^11."""
+    return relax(_fold_high(_columns(a, b)))
 
 
 def square(a):
@@ -128,8 +180,8 @@ def square(a):
 
 
 def mul_small(a, k: int):
-    """Multiply by a small host constant (|k| <= 2^15); lazy (one bit
-    of growth per doubling of k — callers budget accordingly)."""
+    """Multiply by a small host constant; lazy (adds log2(k) bits to
+    the limb bound — callers budget accordingly)."""
     return a * k
 
 
@@ -137,31 +189,37 @@ def mul_small(a, k: int):
 
 def _propagate_seq(c):
     """Exact sequential carry pass (canonical boundaries only): limbs to
-    [0, 2^16), returning (limbs, signed_carry_out) with weight 2^256."""
+    [0, 2^10), returning (limbs, signed_carry_out) with weight 2^260."""
     out = []
-    carry = jnp.zeros_like(c[..., 0])
+    carry = jnp.zeros_like(c[0])
     for i in range(NLIMBS):
-        t = c[..., i] + carry
+        t = c[i] + carry
         out.append(t & MASK)
         carry = t >> LIMB_BITS
-    return jnp.stack(out, axis=-1), carry
+    return jnp.stack(out, axis=0), carry
 
 
 def _narrow(a):
-    """Lazy limbs -> limbs in [0, 2^16) with the value in [0, 2^256)."""
+    """Lazy limbs -> limbs in [0, 2^10) with the value in [0, 2p)."""
     limbs, carry = _propagate_seq(relax(a, iters=2))
-    limbs = limbs.at[..., 0].add(38 * carry)
+    limbs = limbs.at[0].add(WRAP * carry)
     limbs, carry = _propagate_seq(limbs)
-    limbs = limbs.at[..., 0].add(38 * carry)
+    limbs = limbs.at[0].add(WRAP * carry)
+    limbs, _ = _propagate_seq(limbs)
+    # value < 2^260; split the top limb at bit 255: t*2^250 with t < 2^10
+    # becomes 19*(t >> 5) at limb 0 + (t & 31)*2^250 — result < 2^255+608.
+    t = limbs[NLIMBS - 1]
+    limbs = limbs.at[NLIMBS - 1].set(t & 31)
+    limbs = limbs.at[0].add(19 * (t >> 5))
     limbs, _ = _propagate_seq(limbs)
     return limbs
 
 
 def _cond_sub_p(limbs):
     """Subtract p when limbs >= p; inputs/outputs in narrow form."""
-    diff, borrow = _propagate_seq(limbs - P_LIMBS)
+    diff, borrow = _propagate_seq(limbs - cvec(P_LIMBS, limbs.ndim))
     ge = borrow >= 0
-    return jnp.where(ge[..., None], diff, limbs)
+    return jnp.where(ge[None], diff, limbs)
 
 
 def reduce_full(a):
@@ -171,37 +229,47 @@ def reduce_full(a):
 
 def eq(a, b):
     """Canonical equality of lazy elements."""
-    return jnp.all(reduce_full(sub(a, b)) == 0, axis=-1)
+    return jnp.all(reduce_full(sub(a, b)) == 0, axis=0)
 
 
 def is_zero(a):
-    return jnp.all(reduce_full(a) == 0, axis=-1)
+    return jnp.all(reduce_full(a) == 0, axis=0)
 
 
 def is_odd(a):
     """Low bit of the canonical value."""
-    return (reduce_full(a)[..., 0] & 1).astype(jnp.bool_)
+    return (reduce_full(a)[0] & 1).astype(jnp.bool_)
 
 
 def select(mask, a, b):
-    """Per-lane select: mask shape (...,), a/b shape (..., 16)."""
-    return jnp.where(mask[..., None], a, b)
+    """Per-lane select: mask shape (*batch,), a/b shape (26, *batch)."""
+    return jnp.where(mask[None], a, b)
 
 
-# -- byte conversions (device side) ------------------------------------
+# -- byte conversions (device side; bytes are feature-first (32, *b)) --
+
+# limb i covers bits [10i, 10i+10): three byte taps starting at 10i//8.
+_FB_IDX = np.array([(10 * i) // 8 for i in range(NLIMBS)])
+_FB_SHIFT = np.array([(10 * i) % 8 for i in range(NLIMBS)], dtype=np.int32)
+# byte j covers bits [8j, 8j+8): two limb taps starting at 8j//10.
+_TB_IDX = np.array([(8 * j) // 10 for j in range(32)])
+_TB_SHIFT = np.array([(8 * j) % 10 for j in range(32)], dtype=np.int32)
+
 
 def from_bytes_le(b):
-    """(..., 32) uint8 -> narrow limbs (value < 2^256, unreduced)."""
-    b = b.astype(DTYPE)
-    return b[..., 0::2] + (b[..., 1::2] << 8)
+    """(32, *batch) uint8 -> narrow limbs (value < 2^256, unreduced)."""
+    ext = jnp.pad(
+        b.astype(DTYPE), [(0, 2)] + [(0, 0)] * (b.ndim - 1)
+    )  # (34, *batch)
+    word = ext[_FB_IDX] | (ext[_FB_IDX + 1] << 8) | (ext[_FB_IDX + 2] << 16)
+    return (word >> cvec(_FB_SHIFT, b.ndim)) & MASK
 
 
 def to_bytes_le(a):
-    """Canonical little-endian 32 bytes."""
-    r = reduce_full(a)
-    lo = (r & 0xFF).astype(jnp.uint8)
-    hi = ((r >> 8) & 0xFF).astype(jnp.uint8)
-    return jnp.stack([lo, hi], axis=-1).reshape(*r.shape[:-1], 32)
+    """Canonical little-endian bytes, shape (32, *batch)."""
+    r = jnp.pad(reduce_full(a), [(0, 1)] + [(0, 0)] * (a.ndim - 1))
+    word = r[_TB_IDX] | (r[_TB_IDX + 1] << LIMB_BITS)
+    return ((word >> cvec(_TB_SHIFT, a.ndim)) & 0xFF).astype(jnp.uint8)
 
 
 # -- exponentiation chains ---------------------------------------------

@@ -3,9 +3,12 @@
 L = 2^252 + c (c ≈ 2^124.4). The 512-bit SHA digest is reduced with the
 identity 2^256 ≡ -16c (mod L): three split-multiply-subtract rounds
 shrink 512 bits to ~256, then one approximate-quotient step plus two
-conditional corrections give the canonical value. All limbs are signed
-int64 base-2^16 (negative intermediates are fine; see ops/field.py for
-the carry conventions).
+conditional corrections give the canonical value. Limbs are signed
+int64 base-2^16, **limbs-first**: arrays are (nlimbs, *batch) so the
+batch rides the TPU lane dimension (negative intermediates are fine;
+see ops/field.py for the carry conventions). This runs once per
+signature — a rounding error next to the curve arithmetic — so the
+int64 emulation cost on TPU is acceptable.
 
 Ground truth: ``int.from_bytes(digest, 'little') % L`` — differential
 tests in tests/test_ops_kernel.py.
@@ -18,6 +21,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from cometbft_tpu.crypto.edwards import L
+from cometbft_tpu.ops.field import cvec as _cvec
 
 LIMB_BITS = 16
 MASK = (1 << LIMB_BITS) - 1
@@ -35,13 +39,18 @@ L_LIMBS = _limbs_const(L, 16)
 L16_LIMBS = _limbs_const(16 * L, 17)
 
 
+def _row_pad(a, before: int, after: int):
+    return jnp.pad(a, [(before, after)] + [(0, 0)] * (a.ndim - 1))
+
+
 def _mul_const(a, const: np.ndarray):
-    """(..., na) limbs x host constant (nc limbs) -> (..., na+nc-1) columns."""
-    na, nc = a.shape[-1], len(const)
-    out = jnp.zeros((*a.shape[:-1], na + nc - 1), dtype=a.dtype)
+    """(na, *batch) limbs x host constant (nc limbs) -> (na+nc-1, *batch)
+    columns."""
+    na, nc = a.shape[0], len(const)
+    out = jnp.zeros((na + nc - 1, *a.shape[1:]), dtype=a.dtype)
     for j in range(nc):
         if const[j]:
-            out = out.at[..., j : j + na].add(int(const[j]) * a)
+            out = out + _row_pad(int(const[j]) * a, j, nc - 1 - j)
     return out
 
 
@@ -49,41 +58,43 @@ def _relax(c, iters: int):
     """Carry relaxation without modular wrap. The top limb absorbs its
     own carry (stays lazy) so no value is ever discarded; callers size
     arrays so the top limb's true value fits its i64 lane."""
+    n = c.shape[0]
     for _ in range(iters):
-        carry = (c >> LIMB_BITS).at[..., -1].set(0)
+        carry = c >> LIMB_BITS
+        carry = carry * jnp.asarray(
+            np.concatenate([np.ones(n - 1, np.int64), [0]])
+        ).reshape((n,) + (1,) * (c.ndim - 1))
         lo = c - (carry << LIMB_BITS)
-        c = lo + jnp.roll(carry, 1, axis=-1)
+        c = lo + _row_pad(carry, 1, 0)[:n]
     return c
 
 
 def _propagate(c):
     """Exact sequential pass -> (limbs in [0,2^16), signed carry out)."""
     out = []
-    carry = jnp.zeros_like(c[..., 0])
-    for i in range(c.shape[-1]):
-        t = c[..., i] + carry
+    carry = jnp.zeros_like(c[0])
+    for i in range(c.shape[0]):
+        t = c[i] + carry
         out.append(t & MASK)
         carry = t >> LIMB_BITS
-    return jnp.stack(out, axis=-1), carry
+    return jnp.stack(out, axis=0), carry
 
 
 def _fold_step(n, width: int):
-    """n (..., w) -> LO(16) - HI*16c, resized to ``width`` limbs."""
-    lo = n[..., :16]
-    hi = n[..., 16:]
+    """n (w, *batch) -> LO(16) - HI*16c, resized to ``width`` limbs."""
+    lo = n[:16]
+    hi = n[16:]
     prod = _mul_const(hi, K_LIMBS)
-    w = max(width, prod.shape[-1])
-    out = jnp.zeros((*n.shape[:-1], w), dtype=n.dtype)
-    out = out.at[..., :16].add(lo)
-    out = out.at[..., : prod.shape[-1]].add(-prod)
-    return _relax(out, 3)[..., :width]
+    w = max(width, prod.shape[0])
+    out = _row_pad(lo, 0, w - 16) - _row_pad(prod, 0, w - prod.shape[0])
+    return _relax(out, 3)[:width]
 
 
 def reduce_digest(digest_le):
-    """(..., 64) uint8 little-endian digest -> (..., 16) canonical limbs
-    of the value mod L."""
+    """(64, *batch) uint8 little-endian digest -> (16, *batch) canonical
+    limbs of the value mod L."""
     b = digest_le.astype(jnp.int64)
-    n = b[..., 0::2] + (b[..., 1::2] << 8)      # (..., 32) limbs
+    n = b[0::2] + (b[1::2] << 8)                 # (32, *batch) limbs
     n = _fold_step(n, 25)                        # |n| < 2^390
     n = _fold_step(n, 18)                        # |n| < 2^265
     # After the third fold n = LO - HI*K with LO >= -eps (relaxed limbs)
@@ -92,32 +103,34 @@ def reduce_digest(digest_le):
     # make positive: negative side is > -2^135, so one add of
     # 16L = 2^256 + 16c > 2^256 always suffices
     _, carry = _propagate(n)
-    n = jnp.where((carry < 0)[..., None], n + jnp.asarray(L16_LIMBS), n)
+    n = jnp.where((carry < 0)[None], n + _cvec(L16_LIMBS, n.ndim), n)
     limbs, _ = _propagate(n)                     # in [0, 2^262), 17 limbs
     # approximate quotient: q = floor(n / 2^252) < 2^10
-    q = (limbs[..., 15] >> 12) + (limbs[..., 16] << 4)
-    prod = _mul_const(q[..., None], L_LIMBS)     # lazy columns, 16 limbs
-    pad17 = [(0, 0)] * (limbs.ndim - 1) + [(0, 1)]
-    n = limbs - jnp.pad(prod, pad17)             # in (-2^135, 2^252 + 2^135)
-    l_pad = jnp.pad(jnp.asarray(L_LIMBS), (0, 1))
+    q = (limbs[15] >> 12) + (limbs[16] << 4)
+    prod = _mul_const(q[None], L_LIMBS)          # lazy columns, 16 limbs
+    n = limbs - _row_pad(prod, 0, 1)             # in (-2^135, 2^252 + 2^135)
+    l_pad = _cvec(np.concatenate([L_LIMBS, [0]]), n.ndim)
     _, carry = _propagate(n)
-    n = jnp.where((carry < 0)[..., None], n + l_pad, n)
+    n = jnp.where((carry < 0)[None], n + l_pad, n)
     d, borrow = _propagate(n - l_pad)
-    n = jnp.where((borrow >= 0)[..., None], d, _propagate(n)[0])
-    return n[..., :16]
+    n = jnp.where((borrow >= 0)[None], d, _propagate(n)[0])
+    return n[:16]
 
 
 def bytes_lt_l(s_bytes):
-    """(..., 32) uint8 little-endian -> bool mask: value < L (the
+    """(32, *batch) uint8 little-endian -> bool mask: value < L (the
     canonical-S check, RFC 8032 / ZIP-215 rule 2)."""
     b = s_bytes.astype(jnp.int64)
-    s = b[..., 0::2] + (b[..., 1::2] << 8)
-    _, borrow = _propagate(s - jnp.asarray(L_LIMBS))
+    s = b[0::2] + (b[1::2] << 8)
+    _, borrow = _propagate(s - _cvec(L_LIMBS, s.ndim))
     return borrow < 0
 
 
 def limbs_to_nibbles(limbs16):
-    """(..., 16) canonical limbs -> (..., 64) little-endian 4-bit windows."""
-    shifts = jnp.arange(0, 16, 4, dtype=jnp.int64)
-    nib = (limbs16[..., None] >> shifts) & 0xF
-    return nib.reshape(*limbs16.shape[:-1], 64).astype(jnp.int32)
+    """(16, *batch) canonical limbs -> (64, *batch) little-endian 4-bit
+    windows."""
+    shifts = jnp.arange(0, 16, 4, dtype=jnp.int64).reshape(
+        (1, 4) + (1,) * (limbs16.ndim - 1)
+    )
+    nib = (limbs16[:, None] >> shifts) & 0xF
+    return nib.reshape(64, *limbs16.shape[1:]).astype(jnp.int32)

@@ -2,8 +2,11 @@
 
 Ed25519 verification needs k = SHA-512(R || A || M) per signature; doing
 it on-device keeps the whole batch in one launch with zero host round
-trips. 64-bit words use jnp.uint64 (emulated as u32 pairs on TPU; the
-hash is a rounding error next to the curve arithmetic).
+trips. 64-bit words use jnp.uint64 (emulated as u32 pairs on TPU).
+Arrays are **feature-first**: byte buffers are (nbytes, *batch), word
+arrays (nwords, *batch) — the batch axis is last so it maps onto TPU
+vector lanes; the per-round working variables a..h are plain (*batch,)
+vectors, which is exactly the shape the VPU wants.
 
 Round constants and IVs are derived on host from first principles
 (fractional parts of cube/square roots of the first primes, FIPS 180-4)
@@ -56,25 +59,23 @@ def _rotr(x, n: int):
 
 
 def _schedule(words):
-    """(..., 16) u64 block words -> (80, ...) expanded schedule."""
+    """(16, *batch) u64 block words -> (80, *batch) expanded schedule."""
 
     def body(win, _):
-        s0 = _rotr(win[..., 1], 1) ^ _rotr(win[..., 1], 8) ^ (
-            win[..., 1] >> np.uint64(7)
+        s0 = _rotr(win[1], 1) ^ _rotr(win[1], 8) ^ (win[1] >> np.uint64(7))
+        s1 = _rotr(win[14], 19) ^ _rotr(win[14], 61) ^ (
+            win[14] >> np.uint64(6)
         )
-        s1 = _rotr(win[..., 14], 19) ^ _rotr(win[..., 14], 61) ^ (
-            win[..., 14] >> np.uint64(6)
-        )
-        new = win[..., 0] + s0 + win[..., 9] + s1
-        win = jnp.roll(win, -1, axis=-1).at[..., 15].set(new)
+        new = win[0] + s0 + win[9] + s1
+        win = jnp.concatenate([win[1:], new[None]], axis=0)
         return win, new
 
     _, extra = lax.scan(body, words, None, length=64)
-    return jnp.concatenate([jnp.moveaxis(words, -1, 0), extra], axis=0)
+    return jnp.concatenate([words, extra], axis=0)
 
 
 def _compress(state, words):
-    """One SHA-512 block: state (..., 8) u64, words (..., 16) u64."""
+    """One SHA-512 block: state (8, *batch) u64, words (16, *batch) u64."""
     w = _schedule(words)
 
     def round_body(carry, xs):
@@ -88,50 +89,52 @@ def _compress(state, words):
         t2 = big0 + maj
         return (t1 + t2, a, b, c, d + t1, e, f, g), None
 
-    init = tuple(state[..., i] for i in range(8))
+    init = tuple(state[i] for i in range(8))
     out, _ = lax.scan(round_body, init, (w, jnp.asarray(_K)))
-    return state + jnp.stack(out, axis=-1)
+    return state + jnp.stack(out, axis=0)
 
 
 def bytes_to_words(buf):
-    """(..., n*8) uint8 big-endian -> (..., n) uint64."""
+    """(n*8, *batch) uint8 big-endian -> (n, *batch) uint64."""
     b = buf.astype(jnp.uint64)
-    b = b.reshape(*buf.shape[:-1], buf.shape[-1] // 8, 8)
+    b = b.reshape(buf.shape[0] // 8, 8, *buf.shape[1:])
     shifts = jnp.asarray(
         np.arange(56, -8, -8, dtype=np.uint64), dtype=jnp.uint64
-    )
-    return (b << shifts).sum(axis=-1, dtype=jnp.uint64)
+    ).reshape((1, 8) + (1,) * (buf.ndim - 1))
+    return (b << shifts).sum(axis=1, dtype=jnp.uint64)
 
 
 def words_to_bytes(words):
-    """(..., n) uint64 -> (..., n*8) uint8 big-endian."""
+    """(n, *batch) uint64 -> (n*8, *batch) uint8 big-endian."""
     shifts = jnp.asarray(
         np.arange(56, -8, -8, dtype=np.uint64), dtype=jnp.uint64
-    )
-    b = (words[..., None] >> shifts) & jnp.uint64(0xFF)
-    return b.astype(jnp.uint8).reshape(*words.shape[:-1], words.shape[-1] * 8)
+    ).reshape((1, 8) + (1,) * (words.ndim - 1))
+    b = (words[:, None] >> shifts) & jnp.uint64(0xFF)
+    return b.astype(jnp.uint8).reshape(words.shape[0] * 8, *words.shape[1:])
 
 
 def sha512_padded(buf, nblocks: int, nblocks_lane=None):
-    """Digest of a pre-padded buffer: (..., nblocks*128) uint8 -> (..., 64).
+    """Digest of a pre-padded buffer: (nblocks*128, *batch) uint8 ->
+    (64, *batch).
 
     The caller supplies full padding (0x80 marker + big-endian bit
     length); see ed25519_verify.build_padded_input. SHA padding is
     *minimal* per message, so lanes may use fewer blocks than the static
-    bucket maximum: ``nblocks_lane`` (..., int) selects how many blocks
+    bucket maximum: ``nblocks_lane`` (*batch,) selects how many blocks
     each lane actually absorbs (trailing blocks are computed then
     discarded — branch-free SPMD).
     """
-    words = bytes_to_words(buf).reshape(*buf.shape[:-1], nblocks, 16)
+    words = bytes_to_words(buf).reshape(nblocks, 16, *buf.shape[1:])
     state = jnp.broadcast_to(
-        jnp.asarray(_IV), (*buf.shape[:-1], 8)
+        jnp.asarray(_IV).reshape((8,) + (1,) * (buf.ndim - 1)),
+        (8, *buf.shape[1:]),
     ).astype(jnp.uint64)
     for i in range(nblocks):
-        new = _compress(state, words[..., i, :])
+        new = _compress(state, words[i])
         if nblocks_lane is None:
             state = new
         else:
-            state = jnp.where((i < nblocks_lane)[..., None], new, state)
+            state = jnp.where((i < nblocks_lane)[None], new, state)
     return words_to_bytes(state)
 
 

@@ -46,9 +46,10 @@ def shard_batch(mesh: Mesh, arr, axes: tuple[str | None, ...]):
 
 
 def sharded_verify_fn(mesh: Mesh, nblocks: int = 2):
-    """jit of the batch-verify kernel over a (blocks, sigs, ...) batch:
-    dimension 0 shards over the ``blocks`` mesh axis, dimension 1 over
-    ``sigs``. Returns per-signature validity with the same sharding.
+    """jit of the batch-verify kernel over feature-first arrays with a
+    (blocks, sigs) trailing batch: byte arrays are (nbytes, H, V) with H
+    sharded over the ``blocks`` mesh axis and V over ``sigs``. Returns
+    per-signature validity (H, V) with the same sharding.
 
     The kernel body is pure elementwise/gather compute, so XLA partitions
     it with zero cross-chip collectives — each chip verifies its shard of
@@ -61,7 +62,7 @@ def sharded_verify_fn(mesh: Mesh, nblocks: int = 2):
         return verify_kernel(pub, sig, msg, msglen, nblocks=nblocks)
 
     in_shardings = tuple(
-        NamedSharding(mesh, P(BLOCK_AXIS, SIG_AXIS, None)) for _ in range(3)
+        NamedSharding(mesh, P(None, BLOCK_AXIS, SIG_AXIS)) for _ in range(3)
     ) + (NamedSharding(mesh, data_spec),)
     return jax.jit(
         step,

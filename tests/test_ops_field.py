@@ -34,36 +34,36 @@ class TestFieldOps:
         subv = jax.jit(F.sub)(A, B)
         mulv = jax.jit(F.mul)(A, B)
         for i, (x, y) in enumerate(zip(xs, ys)):
-            assert F.to_int(addv[i]) % P == (x + y) % P
-            assert F.to_int(subv[i]) % P == (x - y) % P
-            assert F.to_int(mulv[i]) % P == (x * y) % P
+            assert F.to_int(addv[:, i]) % P == (x + y) % P
+            assert F.to_int(subv[:, i]) % P == (x - y) % P
+            assert F.to_int(mulv[:, i]) % P == (x * y) % P
             # mul restores the lazy-limb budget
-            assert all(abs(int(v)) < 1 << 17 for v in np.asarray(mulv[i]))
+            assert all(abs(int(v)) < 1 << 11 for v in np.asarray(mulv[:, i]))
 
     def test_lazy_chain_stays_correct(self, cases):
-        """Chained carry-free add/subs between muls (the growth budget)."""
+        """Chained carry-free add/subs between muls: the documented
+        budget is 2 chained add/subs per mul operand (limbs 2^11 ->
+        2^13; 26 * 2^13 * 2^13 < 2^31)."""
         xs, ys, A, B = cases
 
         def chain(a, b):
             t = F.mul(a, b)
-            for _ in range(5):
-                t = F.add(t, F.sub(t, b))
-            return F.mul(t, t)
+            u = F.add(t, t)                  # 1 lazy op
+            v = F.sub(F.add(t, t), b)        # 2 chained lazy ops
+            return F.mul(u, v)
 
         cv = jax.jit(chain)(A, B)
         for i, (x, y) in enumerate(zip(xs, ys)):
             t = (x * y) % P
-            for _ in range(5):
-                t = (t + (t - y)) % P
-            assert F.to_int(cv[i]) % P == (t * t) % P
+            assert F.to_int(cv[:, i]) % P == (2 * t * (2 * t - y)) % P
 
     def test_reduce_full_and_neg(self, cases):
         xs, _, A, _ = cases
         rf = jax.jit(F.reduce_full)(A)
         ng = jax.jit(lambda a: F.reduce_full(F.neg(a)))(A)
         for i, x in enumerate(xs):
-            assert F.to_int(rf[i]) == x % P
-            assert F.to_int(ng[i]) == (-x) % P
+            assert F.to_int(rf[:, i]) == x % P
+            assert F.to_int(ng[:, i]) == (-x) % P
 
     def test_exponentiation_chains(self, cases):
         xs, _, A, _ = cases
@@ -71,8 +71,8 @@ class TestFieldOps:
         p22 = jax.jit(F.pow22523)(A)
         for i, x in enumerate(xs):
             want_inv = pow(x, P - 2, P)
-            assert F.to_int(inv[i]) % P == want_inv
-            assert F.to_int(p22[i]) % P == pow(x % P, (P - 5) // 8, P)
+            assert F.to_int(inv[:, i]) % P == want_inv
+            assert F.to_int(p22[:, i]) % P == pow(x % P, (P - 5) // 8, P)
 
     def test_eq_is_zero_nonunique_repr(self):
         assert bool(F.eq(jnp.array(F.from_int(P)), jnp.array(F.from_int(0))))

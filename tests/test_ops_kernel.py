@@ -65,15 +65,15 @@ class TestCurve:
         encs.append(bad)  # non-square
         expect.append(False)
         arr = jnp.asarray(
-            np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(len(encs), 32)
+            np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(len(encs), 32).T
         )
         pt_dev, valid = jax.jit(C.decompress)(arr)
         assert [bool(v) for v in np.asarray(valid)] == expect
         for i, p in enumerate(pts):
-            assert affine_eq(tuple(c[i] for c in pt_dev), p)
+            assert affine_eq(tuple(c[:, i] for c in pt_dev), p)
         for i in (4, 5):  # ZIP-215 cases agree with the oracle decoder
             ref = E.decode_point(encs[i])
-            assert affine_eq(tuple(c[i] for c in pt_dev), ref)
+            assert affine_eq(tuple(c[:, i] for c in pt_dev), ref)
 
     def test_scalar_mults_vs_oracle(self, rng):
         scalars = [rng.randrange(0, E.L) for _ in range(4)]
@@ -82,18 +82,23 @@ class TestCurve:
                 [
                     np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)
                     for s in scalars
-                ]
+                ],
+                axis=-1,
             )
         )
         comb = jax.jit(lambda b: C.comb_mul_base(C.nibbles_from_bytes_le(b)))(sb)
         pts = [E.pt_mul(rng.randrange(1, E.L), E.B_POINT) for _ in range(4)]
-        p4 = tuple(jnp.stack([to_dev(p)[c] for p in pts]) for c in range(4))
+        p4 = tuple(
+            jnp.stack([to_dev(p)[c] for p in pts], axis=-1) for c in range(4)
+        )
         win = jax.jit(lambda b, p: C.window_mul(C.nibbles_from_bytes_le(b), p))(
             sb, p4
         )
         for i, s in enumerate(scalars):
-            assert affine_eq(tuple(c[i] for c in comb), E.pt_mul(s, E.B_POINT))
-            assert affine_eq(tuple(c[i] for c in win), E.pt_mul(s, pts[i]))
+            assert affine_eq(
+                tuple(c[:, i] for c in comb), E.pt_mul(s, E.B_POINT)
+            )
+            assert affine_eq(tuple(c[:, i] for c in win), E.pt_mul(s, pts[i]))
 
     def test_identity_and_mul8(self):
         assert bool(np.asarray(C.pt_is_identity(C.identity(()))))
@@ -124,19 +129,21 @@ class TestScalarModL:
             [
                 np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8)
                 for v in vals
-            ]
+            ],
+            axis=-1,
         )
         red = np.asarray(jax.jit(SC.reduce_digest)(jnp.asarray(digests)))
         nib = np.asarray(SC.limbs_to_nibbles(jnp.asarray(red)))
         for i, v in enumerate(vals):
-            got = sum(int(red[i][j]) << (16 * j) for j in range(16))
+            got = sum(int(red[j, i]) << (16 * j) for j in range(16))
             assert got == v % E.L
-            assert sum(int(nib[i][j]) << (4 * j) for j in range(64)) == v % E.L
+            assert sum(int(nib[j, i]) << (4 * j) for j in range(64)) == v % E.L
 
     def test_bytes_lt_l(self):
         vals = [0, 1, E.L - 1, E.L, E.L + 1, 2**256 - 1]
         sb = np.stack(
-            [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals]
+            [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals],
+            axis=-1,
         )
         lt = np.asarray(jax.jit(SC.bytes_lt_l)(jnp.asarray(sb)))
         assert [bool(v) for v in lt] == [v < E.L for v in vals]
@@ -208,3 +215,27 @@ class TestBatchVerifyKernel:
             bv.add(priv.pub_key(), m + b"?", sig)
             ok, res = bv.verify()
             assert not ok and res == [True, False]
+
+
+class TestChunkedLaunches:
+    def test_non_pow2_max_launch_alignment(self, rng, monkeypatch):
+        """Chunk outputs are pow2-padded per launch; results must be
+        sliced per chunk, not globally (regression: a non-pow2
+        MAX_LAUNCH misaligned every verdict after the first chunk)."""
+        from cometbft_tpu.ops import ed25519_verify as ev
+
+        monkeypatch.setattr(ev, "MAX_LAUNCH", 10)
+        bv = TpuBatchVerifier(device_min_batch=0)
+        oracle = []
+        priv = ed.gen_priv_key()
+        for i in range(23):  # 3 chunks: 10 (pad 16), 10 (pad 16), 3 (pad 8)
+            m = bytes([i]) * 40
+            sig = bytearray(priv.sign(m))
+            ok = True
+            if i in (9, 10, 22):  # straddle every chunk boundary
+                sig[5] ^= 0x40
+                ok = False
+            bv.add(priv.pub_key(), m, bytes(sig))
+            oracle.append(ok)
+        _, results = bv.verify()
+        assert results == oracle
