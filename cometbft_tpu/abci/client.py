@@ -37,6 +37,7 @@ class SocketClient:
         self,
         addr: str,
         connect_timeout: float = 10.0,
+        request_timeout: float | None = None,
         logger: Logger | None = None,
     ):
         self.addr = addr
@@ -49,6 +50,19 @@ class SocketClient:
         self._error: BaseException | None = None
         self._closed = False
         self._connect_timeout = connect_timeout
+        # Optional per-request deadline so a hung external app can be
+        # surfaced as AbciClientError instead of blocking forever. OFF
+        # by default (0), matching the reference socket client, which
+        # blocks indefinitely per request — a legitimately slow
+        # FinalizeBlock (large replay, heavy app) must not kill the
+        # connection. Opt in via CMT_ABCI_REQUEST_TIMEOUT (seconds).
+        if request_timeout is None:
+            import os
+
+            request_timeout = float(
+                os.environ.get("CMT_ABCI_REQUEST_TIMEOUT", 0.0)
+            )
+        self._request_timeout = request_timeout
 
     def ensure_connected(self) -> None:
         """Connect lazily: construction never blocks (the node builds
@@ -73,6 +87,9 @@ class SocketClient:
                     s.connect(target)
                 else:
                     s = socket.create_connection(target, timeout=5.0)
+                if self._request_timeout > 0:
+                    s.settimeout(self._request_timeout)
+                else:
                     s.settimeout(None)
                 self._sock = s
                 self._file = s.makefile("rb")

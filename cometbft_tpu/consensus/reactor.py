@@ -314,16 +314,23 @@ class PeerState:
     ) -> None:
         """(reactor.go EnsureCatchupCommitRound)"""
         with self._mtx:
-            prs = self.prs
-            if prs.height != height:
-                return
-            if prs.catchup_commit_round == round_:
-                return
-            prs.catchup_commit_round = round_
-            if round_ == prs.round and prs.precommits is not None:
-                prs.catchup_commit = prs.precommits
-            else:
-                prs.catchup_commit = BitArray(num_validators)
+            self._ensure_catchup_commit_round_locked(
+                height, round_, num_validators
+            )
+
+    def _ensure_catchup_commit_round_locked(
+        self, height: int, round_: int, num_validators: int
+    ) -> None:
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        if round_ == prs.round and prs.precommits is not None:
+            prs.catchup_commit = prs.precommits
+        else:
+            prs.catchup_commit = BitArray(num_validators)
 
     def set_has_vote(self, vote: Vote) -> None:
         with self._mtx:
@@ -397,6 +404,20 @@ class PeerState:
         round_ = votes.round
         vote_type = votes.signed_msg_type
         with self._mtx:
+            # A commit-carrying set (precommits with a +2/3 block) makes
+            # its round the peer's catchup-commit round first, so a peer
+            # whose own round has moved past the commit round still gets
+            # the commit votes (reactor.go:1306 "Lazily set data") —
+            # without this, a validator stuck one height back at a later
+            # round never receives the committed precommits and the
+            # whole network stalls behind it.
+            if (
+                vote_type == PRECOMMIT_TYPE
+                and votes.two_thirds_majority() is not None
+            ):
+                self._ensure_catchup_commit_round_locked(
+                    height, round_, num_validators
+                )
             self._ensure_vote_bit_arrays_locked(height, num_validators)
             peer_arr = self._get_vote_bit_array_for_height_locked(
                 height, round_, vote_type

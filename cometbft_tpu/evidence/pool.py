@@ -88,6 +88,16 @@ class Pool:
         else:
             raise EvidenceInvalidError(f"unknown evidence type {type(ev)}")
 
+        # the timestamp field must equal our own header time at the
+        # evidence height (verify.go:31-34) — otherwise the committed
+        # evidence time is sender-controlled and non-deterministic
+        # across proposers.
+        if ev.timestamp_ns != ev_time:
+            raise EvidenceInvalidError(
+                f"evidence time {ev.timestamp_ns} != header time "
+                f"{ev_time} at evidence height"
+            )
+
         # age window (verify.go:36-60)
         params = state.consensus_params.evidence
         age_blocks = height - ev.height
@@ -138,9 +148,16 @@ class Pool:
                 vote.sign_bytes(chain_id), vote.signature
             ):
                 raise EvidenceInvalidError("invalid vote signature")
-        # evidence time = block time at that height (pool.go:308)
+        # evidence time = block time at that height (pool.go:308); a
+        # missing header means we cannot pin the time, and trusting the
+        # sender's field would let stale evidence evade the age window
+        # (verify.go "don't have header at height").
         meta = self.block_store.load_block_meta(ev.height)
-        return meta.header.time_ns if meta is not None else ev.timestamp_ns
+        if meta is None:
+            raise EvidenceExpiredError(
+                f"no header at evidence height {ev.height}"
+            )
+        return meta.header.time_ns
 
     def _load_signed_header(self, height: int):
         """Our chain's SignedHeader at ``height`` (verify.go:264
@@ -356,8 +373,20 @@ class Pool:
         for vote_a, vote_b in buf:
             try:
                 val_set = self.state_store.load_validators(vote_a.height)
+                # evidence time = our header time at the vote height
+                # (pool.go:271 processConsensusBuffer), so every honest
+                # proposer derives the identical evidence bytes. Without
+                # the header we must not guess: peers pin the timestamp
+                # to their own header and would reject ours.
+                meta = self.block_store.load_block_meta(vote_a.height)
+                if meta is None:
+                    self.logger.error(
+                        "failed to make evidence: no block meta",
+                        height=vote_a.height,
+                    )
+                    continue
                 ev = DuplicateVoteEvidence.from_votes(
-                    vote_a, vote_b, state.last_block_time_ns, val_set
+                    vote_a, vote_b, meta.header.time_ns, val_set
                 )
             except Exception as exc:  # noqa: BLE001
                 self.logger.error("failed to make evidence", err=repr(exc))

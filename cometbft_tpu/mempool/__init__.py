@@ -361,6 +361,10 @@ class CListMempool:
                 self._txs_bytes -= len(mt.tx)
         if self._recheck_enabled and self._txs:
             self._recheck_txs()
+        # gauges must track shrinkage too, or an emptying mempool keeps
+        # reporting its old size until the next successful add
+        self.metrics.size.set(len(self._txs))
+        self.metrics.size_bytes.set(self._txs_bytes)
         if self._txs:
             self._notify_available()
 
@@ -377,6 +381,7 @@ class CListMempool:
             if res.code != 0:
                 self._txs.pop(key, None)
                 self._txs_bytes -= len(mt.tx)
+                self.metrics.evicted_txs.inc()
                 if not self._keep_invalid:
                     self.cache.remove(mt.tx)
 
@@ -385,6 +390,8 @@ class CListMempool:
             self._txs.clear()
             self._txs_bytes = 0
             self.cache.reset()
+            self.metrics.size.set(0)
+            self.metrics.size_bytes.set(0)
 
 
 class NopMempool:

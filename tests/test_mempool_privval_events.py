@@ -331,3 +331,35 @@ def test_filepv_sign_proposal(tmp_path):
     pv.sign_vote(CHAIN, make_vote(pv))
     with pytest.raises(DoubleSignError):
         pv.sign_proposal(CHAIN, replace(prop, block_id=make_block_id(b"x")))
+
+
+def test_mempool_gauges_track_shrinkage():
+    """size/size_bytes gauges must follow update/flush removals, not
+    only the add path (advisor finding: an emptying mempool kept
+    reporting its old size)."""
+    from cometbft_tpu.metrics import MempoolMetrics
+    from cometbft_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    app = KVStoreApp()
+    conns = AppConns(local_client_creator(app))
+    mp = CListMempool(conns.mempool, metrics=MempoolMetrics(reg))
+
+    def gauge(name):
+        for line in reg.expose().splitlines():
+            if line.startswith(f"cometbft_mempool_{name} "):
+                return float(line.split()[-1])
+        raise AssertionError(f"gauge {name} not found")
+
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    assert gauge("size") == 2
+    mp.lock()
+    mp.update(1, [b"a=1", b"b=2"], [ExecTxResult(code=0)] * 2)
+    mp.unlock()
+    assert gauge("size") == 0
+    assert gauge("size_bytes") == 0
+    mp.check_tx(b"c=3")
+    assert gauge("size") == 1
+    mp.flush()
+    assert gauge("size") == 0

@@ -159,16 +159,23 @@ class TestLocalnet:
 
 
 class TestEvidencePool:
-    def _produced_node(self, tmp_path):
+    def _produced_node(self, tmp_path, halt: bool = False):
         nodes, privs, gen = make_localnet(tmp_path, 4)
         for n in nodes:
             n.start()
         connect_star(nodes)
         wait_all_height(nodes, 2)
+        if halt:
+            # freeze the chain so the pool can be driven deterministically:
+            # with consensus running, node0's own proposer scoops pending
+            # evidence into the next block and empties the pool mid-test.
+            for n in nodes:
+                n.switch.stop()
+                n.consensus.stop()
         return nodes, privs
 
     def test_duplicate_vote_evidence_lifecycle(self, tmp_path):
-        nodes, privs = self._produced_node(tmp_path)
+        nodes, privs = self._produced_node(tmp_path, halt=True)
         try:
             node = nodes[0]
             state = node.state_store.load()
@@ -181,9 +188,10 @@ class TestEvidencePool:
                              height=1, chain_id=CHAIN)
             vb = signed_vote(privs[1]._priv_key, idx, make_block_id(b"b"),
                              height=1, chain_id=CHAIN)
-            ev = DuplicateVoteEvidence.from_votes(
-                va, vb, state.last_block_time_ns, val_set
-            )
+            # evidence time must equal our header time at the evidence
+            # height (verify.go:31-34)
+            ev_time = node.block_store.load_block_meta(1).header.time_ns
+            ev = DuplicateVoteEvidence.from_votes(va, vb, ev_time, val_set)
             pool = node.evidence_pool
             pool.add_evidence(ev)
             pending, size = pool.pending_evidence(-1)
@@ -279,7 +287,7 @@ class TestEvidencePool:
     def test_light_client_attack_evidence_verified(self, tmp_path):
         """Real-signature lunatic evidence passes full verification and
         flows through the pending/committed lifecycle."""
-        nodes, privs = self._produced_node(tmp_path)
+        nodes, privs = self._produced_node(tmp_path, halt=True)
         try:
             node = nodes[0]
             ev = self._lunatic_evidence(node, privs)
@@ -348,9 +356,8 @@ class TestEvidencePool:
                              height=1, chain_id=CHAIN)
             vb = signed_vote(privs[2]._priv_key, idx, make_block_id(b"y"),
                              height=1, chain_id=CHAIN)
-            ev = DuplicateVoteEvidence.from_votes(
-                va, vb, state.last_block_time_ns, val_set
-            )
+            ev_time = node.block_store.load_block_meta(1).header.time_ns
+            ev = DuplicateVoteEvidence.from_votes(va, vb, ev_time, val_set)
             node.evidence_pool.add_evidence(ev)
             # the evidence reactor floods it to all peers
             deadline = time.monotonic() + 10
@@ -358,6 +365,7 @@ class TestEvidencePool:
             while time.monotonic() < deadline and not spread:
                 spread = all(
                     len(n.evidence_pool.pending_evidence(-1)[0]) >= 1
+                    or n.evidence_pool._is_committed(ev)
                     for n in nodes[1:]
                 )
                 time.sleep(0.05)
