@@ -59,9 +59,14 @@ class SocketClient:
         if request_timeout is None:
             import os
 
-            request_timeout = float(
-                os.environ.get("CMT_ABCI_REQUEST_TIMEOUT", 0.0)
-            )
+            raw = os.environ.get("CMT_ABCI_REQUEST_TIMEOUT", "0")
+            try:
+                request_timeout = float(raw)
+            except ValueError as exc:
+                raise AbciClientError(
+                    f"CMT_ABCI_REQUEST_TIMEOUT must be seconds as a "
+                    f"number, got {raw!r}"
+                ) from exc
         self._request_timeout = request_timeout
 
     def ensure_connected(self) -> None:

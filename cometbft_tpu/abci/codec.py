@@ -2,16 +2,21 @@
 (reference: proto/cometbft/abci/v1/types.proto Request/Response oneofs,
 abci/server/socket_server.go framing).
 
-Declarative per-type field specs drive a small generic encoder: each
-request/response dataclass maps to proto fields 1..n in declaration
-order.  Envelope oneof numbers follow the reference's Request (echo=1
-... finalize_block=20) and Response (exception=1 ... finalize_block=21)
-so the method dispatch table reads against the upstream proto.
+Declarative per-type field specs drive a small generic encoder. The
+encoding is proto3-FAITHFUL to the upstream ABCI surface: field numbers
+match proto/cometbft/abci/v1/types.proto exactly (including reserved
+gaps like CheckTxRequest.type=3 and CommitResponse.retain_height=3),
+integers are plain varints with 64-bit two's complement for negatives
+(proto3 int64 — NOT zigzag), timestamps/durations are nested
+google.protobuf.Timestamp/Duration messages, ConsensusParams is the
+nested cometbft.types.v1.ConsensusParams message, and zero values are
+omitted — so external ABCI apps speaking the upstream protocol
+interoperate on the wire. Unsupported corners are documented inline
+(QueryResponse.proof_ops is never emitted).
 """
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass
 
 from cometbft_tpu.abci import types as T
@@ -29,7 +34,10 @@ def _f(no: int, attr: str, kind: str, cls=None):
 
 
 # Spec: type -> [(field_no, attr, kind, nested_cls)]
-# kinds: str, bytes, int (zigzag svarint), bool, enum, msg, params_json,
+# kinds: str, bytes, int (plain proto3 varint, two's complement), bool,
+#        enum (plain varint), msg, time (google.protobuf.Timestamp),
+#        dur (google.protobuf.Duration), params (ConsensusParams msg),
+#        validator (nested Validator from (address, power) attr pair),
 #        rep_bytes, rep_str, rep_int, rep_msg
 _SPEC: dict[type, list] = {
     T.EventAttribute: [
@@ -42,9 +50,10 @@ _SPEC: dict[type, list] = {
         _f(2, "attributes", "rep_msg", T.EventAttribute),
     ],
     T.ValidatorUpdate: [
-        _f(1, "pub_key_type", "str"),
-        _f(2, "pub_key_bytes", "bytes"),
-        _f(3, "power", "int"),
+        # field 1 reserved upstream (legacy pub_key)
+        _f(2, "power", "int"),
+        _f(3, "pub_key_bytes", "bytes"),
+        _f(4, "pub_key_type", "str"),
     ],
     T.ExecTxResult: [
         _f(1, "code", "int"),
@@ -57,9 +66,19 @@ _SPEC: dict[type, list] = {
         _f(8, "codespace", "str"),
     ],
     T.VoteInfo: [
-        _f(1, "validator_address", "bytes"),
-        _f(2, "validator_power", "int"),
+        _f(1, ("validator_address", "validator_power"), "validator"),
+        # field 2 reserved upstream (signed_last_block)
         _f(3, "block_id_flag", "int"),
+    ],
+    T.ExtendedVoteInfo: [
+        _f(1, ("validator_address", "validator_power"), "validator"),
+        _f(3, "vote_extension", "bytes"),
+        _f(4, "extension_signature", "bytes"),
+        _f(5, "block_id_flag", "int"),
+    ],
+    T.ExtendedCommitInfo: [
+        _f(1, "round", "int"),
+        _f(2, "votes", "rep_msg", T.ExtendedVoteInfo),
     ],
     T.CommitInfo: [
         _f(1, "round", "int"),
@@ -67,11 +86,10 @@ _SPEC: dict[type, list] = {
     ],
     T.Misbehavior: [
         _f(1, "type", "int"),
-        _f(2, "validator_address", "bytes"),
-        _f(3, "validator_power", "int"),
-        _f(4, "height", "int"),
-        _f(5, "time_ns", "int"),
-        _f(6, "total_voting_power", "int"),
+        _f(2, ("validator_address", "validator_power"), "validator"),
+        _f(3, "height", "int"),
+        _f(4, "time_ns", "time"),
+        _f(5, "total_voting_power", "int"),
     ],
     T.Snapshot: [
         _f(1, "height", "int"),
@@ -95,12 +113,13 @@ _SPEC: dict[type, list] = {
     ],
     T.CheckTxRequest: [
         _f(1, "tx", "bytes"),
-        _f(2, "type", "int"),
+        # field 2 reserved upstream
+        _f(3, "type", "int"),
     ],
     T.InitChainRequest: [
-        _f(1, "time_ns", "int"),
+        _f(1, "time_ns", "time"),
         _f(2, "chain_id", "str"),
-        _f(3, "consensus_params", "params_json"),
+        _f(3, "consensus_params", "params"),
         _f(4, "validators", "rep_msg", T.ValidatorUpdate),
         _f(5, "app_state_bytes", "bytes"),
         _f(6, "initial_height", "int"),
@@ -108,10 +127,10 @@ _SPEC: dict[type, list] = {
     T.PrepareProposalRequest: [
         _f(1, "max_tx_bytes", "int"),
         _f(2, "txs", "rep_bytes"),
-        _f(3, "local_last_commit", "msg", T.CommitInfo),
+        _f(3, "local_last_commit", "msg", T.ExtendedCommitInfo),
         _f(4, "misbehavior", "rep_msg", T.Misbehavior),
         _f(5, "height", "int"),
-        _f(6, "time_ns", "int"),
+        _f(6, "time_ns", "time"),
         _f(7, "next_validators_hash", "bytes"),
         _f(8, "proposer_address", "bytes"),
     ],
@@ -121,20 +140,21 @@ _SPEC: dict[type, list] = {
         _f(3, "misbehavior", "rep_msg", T.Misbehavior),
         _f(4, "hash", "bytes"),
         _f(5, "height", "int"),
-        _f(6, "time_ns", "int"),
+        _f(6, "time_ns", "time"),
         _f(7, "next_validators_hash", "bytes"),
         _f(8, "proposer_address", "bytes"),
     ],
     T.ExtendVoteRequest: [
+        # NOTE: the dataclass carries ``round`` for in-process apps, but
+        # the upstream proto has no round field — the wire drops it.
         _f(1, "hash", "bytes"),
         _f(2, "height", "int"),
-        _f(3, "round", "int"),
-        _f(4, "time_ns", "int"),
-        _f(5, "txs", "rep_bytes"),
-        _f(6, "proposed_last_commit", "msg", T.CommitInfo),
-        _f(7, "misbehavior", "rep_msg", T.Misbehavior),
-        _f(8, "next_validators_hash", "bytes"),
-        _f(9, "proposer_address", "bytes"),
+        _f(3, "time_ns", "time"),
+        _f(4, "txs", "rep_bytes"),
+        _f(5, "proposed_last_commit", "msg", T.CommitInfo),
+        _f(6, "misbehavior", "rep_msg", T.Misbehavior),
+        _f(7, "next_validators_hash", "bytes"),
+        _f(8, "proposer_address", "bytes"),
     ],
     T.VerifyVoteExtensionRequest: [
         _f(1, "hash", "bytes"),
@@ -148,7 +168,7 @@ _SPEC: dict[type, list] = {
         _f(3, "misbehavior", "rep_msg", T.Misbehavior),
         _f(4, "hash", "bytes"),
         _f(5, "height", "int"),
-        _f(6, "time_ns", "int"),
+        _f(6, "time_ns", "time"),
         _f(7, "next_validators_hash", "bytes"),
         _f(8, "proposer_address", "bytes"),
         _f(9, "syncing_to_height", "int"),
@@ -177,14 +197,15 @@ _SPEC: dict[type, list] = {
     ],
     T.QueryResponse: [
         _f(1, "code", "int"),
-        _f(2, "log", "str"),
-        _f(3, "info", "str"),
-        _f(4, "index", "int"),
-        _f(5, "key", "bytes"),
-        _f(6, "value", "bytes"),
-        # proof_ops (field 7) intentionally unsupported on the wire
-        _f(8, "height", "int"),
-        _f(9, "codespace", "str"),
+        # field 2 reserved upstream (data; use value)
+        _f(3, "log", "str"),
+        _f(4, "info", "str"),
+        _f(5, "index", "int"),
+        _f(6, "key", "bytes"),
+        _f(7, "value", "bytes"),
+        # proof_ops (field 8) intentionally unsupported on the wire
+        _f(9, "height", "int"),
+        _f(10, "codespace", "str"),
     ],
     T.CheckTxResponse: [
         _f(1, "code", "int"),
@@ -193,10 +214,11 @@ _SPEC: dict[type, list] = {
         _f(4, "info", "str"),
         _f(5, "gas_wanted", "int"),
         _f(6, "gas_used", "int"),
-        _f(7, "codespace", "str"),
+        _f(7, "events", "rep_msg", T.Event),
+        _f(8, "codespace", "str"),
     ],
     T.InitChainResponse: [
-        _f(1, "consensus_params", "params_json"),
+        _f(1, "consensus_params", "params"),
         _f(2, "validators", "rep_msg", T.ValidatorUpdate),
         _f(3, "app_hash", "bytes"),
     ],
@@ -216,11 +238,13 @@ _SPEC: dict[type, list] = {
         _f(1, "events", "rep_msg", T.Event),
         _f(2, "tx_results", "rep_msg", T.ExecTxResult),
         _f(3, "validator_updates", "rep_msg", T.ValidatorUpdate),
-        _f(4, "consensus_param_updates", "params_json"),
+        _f(4, "consensus_param_updates", "params"),
         _f(5, "app_hash", "bytes"),
+        _f(6, "next_block_delay_ns", "dur"),
     ],
     T.CommitResponse: [
-        _f(1, "retain_height", "int"),
+        # fields 1-2 reserved upstream (legacy data)
+        _f(3, "retain_height", "int"),
     ],
     T.ListSnapshotsResponse: [
         _f(1, "snapshots", "rep_msg", T.Snapshot),
@@ -239,14 +263,141 @@ _SPEC: dict[type, list] = {
 }
 
 
+def _encode_duration(ns: int) -> bytes:
+    """google.protobuf.Duration: seconds(1) int64 + nanos(2) int32."""
+    w = ProtoWriter()
+    w.varint(1, (ns // 1_000_000_000) & 0xFFFFFFFFFFFFFFFF)
+    w.varint(2, ns % 1_000_000_000)
+    return w.finish()
+
+
+def _decode_duration(raw: bytes) -> int:
+    from cometbft_tpu.utils.protoio import int64_from_varint
+
+    f = ProtoReader(bytes(raw)).to_dict()
+    sec = int64_from_varint(int(f.get(1, [0])[0]))
+    return sec * 1_000_000_000 + int(f.get(2, [0])[0])
+
+
+def _encode_i64_value(v: int) -> bytes:
+    """google.protobuf.Int64Value wrapper: value(1)."""
+    w = ProtoWriter()
+    w.varint(1, v & 0xFFFFFFFFFFFFFFFF)
+    return w.finish()
+
+
+def _decode_i64_value(raw: bytes) -> int:
+    from cometbft_tpu.utils.protoio import int64_from_varint
+
+    f = ProtoReader(bytes(raw)).to_dict()
+    return int64_from_varint(int(f.get(1, [0])[0]))
+
+
 def _encode_params(params) -> bytes:
-    return json.dumps(params.to_json_dict(), sort_keys=True).encode()
+    """cometbft.types.v1.ConsensusParams (params.proto:14): block(1),
+    evidence(2), validator(3), version(4, not tracked — omitted),
+    synchrony(6), feature(7)."""
+    w = ProtoWriter()
+    b = ProtoWriter()
+    b.varint(1, params.block.max_bytes & 0xFFFFFFFFFFFFFFFF)
+    b.varint(2, params.block.max_gas & 0xFFFFFFFFFFFFFFFF)
+    w.message(1, b.finish())
+    e = ProtoWriter()
+    e.varint(1, params.evidence.max_age_num_blocks)
+    e.message(2, _encode_duration(params.evidence.max_age_duration_ns))
+    e.varint(3, params.evidence.max_bytes)
+    w.message(2, e.finish())
+    v = ProtoWriter()
+    for t in params.validator.pub_key_types:
+        v.string(1, t)
+    w.message(3, v.finish())
+    sy = ProtoWriter()
+    sy.message(1, _encode_duration(params.synchrony.precision_ns))
+    sy.message(2, _encode_duration(params.synchrony.message_delay_ns))
+    w.message(6, sy.finish())
+    fe = ProtoWriter()
+    if params.feature.vote_extensions_enable_height > 0:
+        fe.message(
+            1, _encode_i64_value(params.feature.vote_extensions_enable_height)
+        )
+    if params.feature.pbts_enable_height > 0:
+        fe.message(2, _encode_i64_value(params.feature.pbts_enable_height))
+    w.message(7, fe.finish())
+    return w.finish()
 
 
 def _decode_params(raw: bytes):
-    from cometbft_tpu.types.params import ConsensusParams
+    from cometbft_tpu.types.params import (
+        BlockParams,
+        ConsensusParams,
+        EvidenceParams,
+        FeatureParams,
+        SynchronyParams,
+        ValidatorParams,
+    )
+    from cometbft_tpu.utils.protoio import int64_from_varint as s64
 
-    return ConsensusParams.from_json_dict(json.loads(bytes(raw).decode()))
+    f = ProtoReader(bytes(raw)).to_dict()
+    block, evidence = BlockParams(), EvidenceParams()
+    validator, synchrony = ValidatorParams(), SynchronyParams()
+    feature = FeatureParams()
+    if 1 in f:
+        bf = ProtoReader(_as_bytes(f[1][0])).to_dict()
+        block = BlockParams(
+            max_bytes=s64(int(bf.get(1, [0])[0])),
+            max_gas=s64(int(bf.get(2, [0])[0])),
+        )
+    if 2 in f:
+        ef = ProtoReader(_as_bytes(f[2][0])).to_dict()
+        evidence = EvidenceParams(
+            max_age_num_blocks=s64(int(ef.get(1, [0])[0])),
+            max_age_duration_ns=(
+                _decode_duration(_as_bytes(ef[2][0])) if 2 in ef else 0
+            ),
+            max_bytes=s64(int(ef.get(3, [0])[0])),
+        )
+    if 3 in f:
+        vf = ProtoReader(_as_bytes(f[3][0])).to_dict()
+        validator = ValidatorParams(
+            pub_key_types=tuple(
+                _as_bytes(t).decode() for t in vf.get(1, [])
+            )
+        )
+    if 6 in f:
+        sf = ProtoReader(_as_bytes(f[6][0])).to_dict()
+        synchrony = SynchronyParams(
+            precision_ns=(
+                _decode_duration(_as_bytes(sf[1][0])) if 1 in sf else 0
+            ),
+            message_delay_ns=(
+                _decode_duration(_as_bytes(sf[2][0])) if 2 in sf else 0
+            ),
+        )
+    if 7 in f:
+        ff = ProtoReader(_as_bytes(f[7][0])).to_dict()
+        feature = FeatureParams(
+            vote_extensions_enable_height=(
+                _decode_i64_value(_as_bytes(ff[1][0])) if 1 in ff else 0
+            ),
+            pbts_enable_height=(
+                _decode_i64_value(_as_bytes(ff[2][0])) if 2 in ff else 0
+            ),
+        )
+    return ConsensusParams(
+        block=block,
+        evidence=evidence,
+        validator=validator,
+        synchrony=synchrony,
+        feature=feature,
+    )
+
+
+def _encode_wire_validator(address: bytes, power: int) -> bytes:
+    """abci Validator: address(1) bytes, power(3) int64."""
+    w = ProtoWriter()
+    w.bytes_(1, bytes(address))
+    w.varint(3, power & 0xFFFFFFFFFFFFFFFF)
+    return w.finish()
 
 
 def encode_msg(obj) -> bytes:
@@ -255,21 +406,40 @@ def encode_msg(obj) -> bytes:
         raise AbciCodecError(f"no wire spec for {type(obj).__name__}")
     w = ProtoWriter()
     for no, attr, kind, cls in spec:
+        if kind == "validator":
+            addr_attr, power_attr = attr
+            w.message(
+                no,
+                _encode_wire_validator(
+                    getattr(obj, addr_attr), getattr(obj, power_attr)
+                ),
+            )
+            continue
         v = getattr(obj, attr)
         if kind == "str":
             w.string(no, v)
         elif kind == "bytes":
             w.bytes_(no, bytes(v))
         elif kind == "int" or kind == "enum":
-            w.svarint(no, int(v))
+            # proto3 int64/uint64/uint32/enum: plain varint, negatives
+            # as 64-bit two's complement (ProtoWriter omits zero)
+            w.varint(no, int(v) & 0xFFFFFFFFFFFFFFFF)
         elif kind == "bool":
             w.varint(no, 1 if v else 0)
+        elif kind == "time":
+            if v:
+                from cometbft_tpu.types import canonical as _canon
+
+                w.message(no, _canon.encode_timestamp(int(v)))
+        elif kind == "dur":
+            if v:
+                w.message(no, _encode_duration(int(v)))
         elif kind == "msg":
             if v is not None:
                 w.message(no, encode_msg(v))
-        elif kind == "params_json":
+        elif kind == "params":
             if v is not None:
-                w.bytes_(no, _encode_params(v))
+                w.message(no, _encode_params(v))
         elif kind == "rep_bytes":
             for item in v:
                 w.bytes_(no, bytes(item))
@@ -278,7 +448,7 @@ def encode_msg(obj) -> bytes:
                 w.string(no, item)
         elif kind == "rep_int":
             for item in v:
-                w.svarint(no, int(item))
+                w.varint(no, int(item) & 0xFFFFFFFFFFFFFFFF)
         elif kind == "rep_msg":
             for item in v:
                 w.message(no, encode_msg(item))
@@ -287,8 +457,16 @@ def encode_msg(obj) -> bytes:
     return w.finish()
 
 
-def _unzig(v: int) -> int:
-    return (v >> 1) ^ -(v & 1)
+def _as_bytes(v) -> bytes:
+    """Wire value -> bytes, rejecting type confusion: a varint/fixed
+    value where a length-delimited field is expected must error, not be
+    reinterpreted (bytes(huge_int) would allocate huge_int ZEROS — a
+    decoder DoS found by fuzzing)."""
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        raise AbciCodecError(
+            f"expected length-delimited field, got {type(v).__name__}"
+        )
+    return bytes(v)
 
 
 def decode_msg(cls: type, raw: bytes):
@@ -299,37 +477,63 @@ def decode_msg(cls: type, raw: bytes):
         f = ProtoReader(bytes(raw)).to_dict()
     except Exception as exc:
         raise AbciCodecError(f"malformed {cls.__name__}: {exc}") from exc
+    from cometbft_tpu.types import codec as _tcodec
+    from cometbft_tpu.utils.protoio import int64_from_varint as _s64
+
     kwargs = {}
     for no, attr, kind, sub in spec:
         vals = f.get(no)
         try:
-            if kind == "str":
+            if kind == "validator":
+                addr_attr, power_attr = attr
+                addr, power = b"", 0
+                if vals:
+                    vf = ProtoReader(_as_bytes(vals[0])).to_dict()
+                    addr = _as_bytes(vf.get(1, [b""])[0])
+                    power = _s64(int(vf.get(3, [0])[0]))
+                kwargs[addr_attr] = addr
+                kwargs[power_attr] = power
+            elif kind == "str":
                 kwargs[attr] = (
-                    bytes(vals[0]).decode() if vals else ""
+                    _as_bytes(vals[0]).decode() if vals else ""
                 )
             elif kind == "bytes":
-                kwargs[attr] = bytes(vals[0]) if vals else b""
+                kwargs[attr] = _as_bytes(vals[0]) if vals else b""
             elif kind == "int":
-                kwargs[attr] = _unzig(int(vals[0])) if vals else 0
+                kwargs[attr] = _s64(int(vals[0])) if vals else 0
             elif kind == "enum":
-                kwargs[attr] = sub(_unzig(int(vals[0]))) if vals else sub(0)
+                kwargs[attr] = sub(int(vals[0])) if vals else sub(0)
             elif kind == "bool":
                 kwargs[attr] = bool(vals[0]) if vals else False
+            elif kind == "time":
+                kwargs[attr] = (
+                    _tcodec.decode_timestamp(_as_bytes(vals[0]))
+                    if vals
+                    else 0
+                )
+            elif kind == "dur":
+                kwargs[attr] = (
+                    _decode_duration(_as_bytes(vals[0])) if vals else 0
+                )
             elif kind == "msg":
-                kwargs[attr] = decode_msg(sub, vals[0]) if vals else None
-            elif kind == "params_json":
-                kwargs[attr] = _decode_params(vals[0]) if vals else None
+                kwargs[attr] = (
+                    decode_msg(sub, _as_bytes(vals[0])) if vals else None
+                )
+            elif kind == "params":
+                kwargs[attr] = (
+                    _decode_params(_as_bytes(vals[0])) if vals else None
+                )
             elif kind == "rep_bytes":
-                kwargs[attr] = tuple(bytes(v) for v in (vals or []))
+                kwargs[attr] = tuple(_as_bytes(v) for v in (vals or []))
             elif kind == "rep_str":
                 kwargs[attr] = tuple(
-                    bytes(v).decode() for v in (vals or [])
+                    _as_bytes(v).decode() for v in (vals or [])
                 )
             elif kind == "rep_int":
-                kwargs[attr] = tuple(_unzig(int(v)) for v in (vals or []))
+                kwargs[attr] = tuple(_s64(int(v)) for v in (vals or []))
             elif kind == "rep_msg":
                 kwargs[attr] = tuple(
-                    decode_msg(sub, v) for v in (vals or [])
+                    decode_msg(sub, _as_bytes(v)) for v in (vals or [])
                 )
         except AbciCodecError:
             raise
@@ -445,7 +649,7 @@ def _decode_envelope(raw: bytes, table: dict):
     for no, vals in f.items():
         cls = table.get(no)
         if cls is not None and vals:
-            return decode_msg(cls, vals[0])
+            return decode_msg(cls, _as_bytes(vals[0]))
     raise AbciCodecError("empty or unknown envelope")
 
 

@@ -217,6 +217,7 @@ class CheckTxResponse:
     info: str = ""
     gas_wanted: int = 0
     gas_used: int = 0
+    events: tuple = ()
     codespace: str = ""
 
     @property
@@ -239,6 +240,23 @@ class InitChainResponse:
     consensus_params: object | None = None
     validators: tuple[ValidatorUpdate, ...] = ()
     app_hash: bytes = b""
+
+
+@dataclass(frozen=True)
+class ExtendedVoteInfo:
+    """(types.proto ExtendedVoteInfo)"""
+    validator_address: bytes = b""
+    validator_power: int = 0
+    vote_extension: bytes = b""
+    extension_signature: bytes = b""
+    block_id_flag: int = 0
+
+
+@dataclass(frozen=True)
+class ExtendedCommitInfo:
+    """(types.proto ExtendedCommitInfo)"""
+    round: int = 0
+    votes: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -365,6 +383,7 @@ class FinalizeBlockResponse:
     validator_updates: tuple[ValidatorUpdate, ...] = ()
     consensus_param_updates: object | None = None
     app_hash: bytes = b""
+    next_block_delay_ns: int = 0
 
     def encode(self) -> bytes:
         """Persistent encoding for the state store (ABCIResponses) —

@@ -189,6 +189,31 @@ def cmd_show_validator(args) -> int:
     return 0
 
 
+def cmd_inspect(args) -> int:
+    """(internal/inspect/inspect.go) read-only RPC over a stopped
+    node's stores."""
+    from cometbft_tpu.inspect import Inspector
+
+    cfg = _load_config(args.home)
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    insp = Inspector(cfg)
+    insp.start()
+    stop = {"done": False}
+
+    def handle(signum, frame):
+        stop["done"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    import time as _time
+
+    while not stop["done"]:
+        _time.sleep(0.2)
+    insp.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -267,6 +292,13 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_reset_all)
     p = sub.add_parser("reset-state", help="wipe chain stores")
     p.set_defaults(fn=cmd_reset_state)
+
+    p = sub.add_parser(
+        "inspect",
+        help="read-only RPC server over the stores of a stopped node",
+    )
+    p.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    p.set_defaults(fn=cmd_inspect)
 
     p = sub.add_parser("rollback", help="roll state back one height")
     p.add_argument("--hard", action="store_true",

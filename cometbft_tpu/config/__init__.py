@@ -204,6 +204,9 @@ class StorageConfig:
     pruning_interval_ns: int = 10 * 10**9
     compact: bool = False
     compaction_interval: int = 1000
+    # when true the pruner also respects the data companion's retain
+    # height (config.toml [storage.pruning.data_companion])
+    companion_pruning: bool = False
 
 
 @dataclass

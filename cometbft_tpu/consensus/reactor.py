@@ -404,17 +404,20 @@ class PeerState:
         round_ = votes.round
         vote_type = votes.signed_msg_type
         with self._mtx:
-            # A commit-carrying set (precommits with a +2/3 block) makes
+            # A commit-carrying set (precommits with a +2/3 majority for
+            # an actual BLOCK — a nil majority is not a commit) makes
             # its round the peer's catchup-commit round first, so a peer
             # whose own round has moved past the commit round still gets
-            # the commit votes (reactor.go:1306 "Lazily set data") —
-            # without this, a validator stuck one height back at a later
-            # round never receives the committed precommits and the
-            # whole network stalls behind it.
-            if (
-                vote_type == PRECOMMIT_TYPE
-                and votes.two_thirds_majority() is not None
-            ):
+            # the commit votes (reactor.go:1306 "Lazily set data" +
+            # VoteSet.IsCommit) — without this, a validator stuck one
+            # height back at a later round never receives the committed
+            # precommits and the whole network stalls behind it.
+            maj = (
+                votes.two_thirds_majority()
+                if vote_type == PRECOMMIT_TYPE
+                else None
+            )
+            if maj is not None and not maj.is_nil():
                 self._ensure_catchup_commit_round_locked(
                     height, round_, num_validators
                 )

@@ -1,0 +1,102 @@
+"""Inspect: a read-only RPC server over a stopped node's data stores
+(reference: internal/inspect/inspect.go).
+
+After a consensus failure a node may refuse to start, but its persisted
+state still needs examining. The Inspector serves the query-only subset
+of the JSON-RPC surface — blocks, commits, state, validators, indexed
+txs — straight from the databases, without constructing any live
+component (no p2p, no consensus, no mempool, no app).
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.config import Config
+from cometbft_tpu.rpc.core import Environment
+from cometbft_tpu.rpc.jsonrpc import JSONRPCServer
+from cometbft_tpu.state import Store as StateStore
+from cometbft_tpu.state.txindex import BlockIndexer, NullIndexer, TxIndexer
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.genesis import GenesisDoc
+from cometbft_tpu.utils.db import open_db
+from cometbft_tpu.utils.log import Logger, default_logger
+
+# Query-only routes safe without live components
+# (internal/inspect/rpc/rpc.go Routes).
+_INSPECT_ROUTES = (
+    "health",
+    "genesis",
+    "genesis_chunked",
+    "blockchain",
+    "block",
+    "block_by_hash",
+    "block_results",
+    "commit",
+    "header",
+    "header_by_hash",
+    "tx",
+    "tx_search",
+    "block_search",
+    "validators",
+    "consensus_params",
+)
+
+
+class Inspector:
+    """(inspect.go Inspector)"""
+
+    def __init__(self, config: Config, logger: Logger | None = None):
+        self.config = config
+        self.logger = logger or default_logger().with_fields(module="inspect")
+        backend = config.base.db_backend
+        db_dir = config.db_dir
+        self._dbs = []
+
+        def _open(name: str):
+            db = open_db(name, backend, db_dir)
+            self._dbs.append(db)
+            return db
+
+        self.block_store = BlockStore(_open("blockstore"))
+        self.state_store = StateStore(_open("state"))
+        if config.tx_index.indexer == "kv":
+            ixdb = _open("tx_index")
+            tx_indexer, block_indexer = TxIndexer(ixdb), BlockIndexer(ixdb)
+        else:
+            tx_indexer = block_indexer = NullIndexer()
+        genesis = GenesisDoc.from_file(config.genesis_path)
+        env = Environment(
+            block_store=self.block_store,
+            state_store=self.state_store,
+            tx_indexer=tx_indexer,
+            block_indexer=block_indexer,
+            genesis=genesis,
+        )
+        all_routes = env.routes()
+        self.routes = {k: all_routes[k] for k in _INSPECT_ROUTES}
+        from cometbft_tpu.p2p.netaddr import NetAddress
+
+        addr = NetAddress.parse(config.rpc.laddr)
+        self.server = JSONRPCServer(
+            self.routes,
+            host=addr.host,
+            port=addr.port,
+            logger=self.logger.with_fields(module="inspect-rpc"),
+        )
+
+    def start(self) -> None:
+        self.server.start()
+        self.logger.info(
+            "inspect server listening",
+            addr=f"{self.server.host}:{self.server.port}",
+            routes=len(self.routes),
+        )
+
+    def stop(self) -> None:
+        try:
+            self.server.stop()
+        finally:
+            for db in self._dbs:
+                try:
+                    db.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass

@@ -141,12 +141,16 @@ class StateMetrics:
             self.block_processing_time = _NOP
             self.consensus_param_updates = _NOP
             self.validator_set_updates = _NOP
+            self.pruned_blocks = _NOP
             return
         s = "state"
         self.block_processing_time = reg.histogram(
             s, "block_processing_time",
             "Seconds spent processing a block (FinalizeBlock).",
             buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.pruned_blocks = reg.counter(
+            s, "pruned_blocks", "Blocks removed by the background pruner."
         )
         self.consensus_param_updates = reg.counter(
             s, "consensus_param_updates",

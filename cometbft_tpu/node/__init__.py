@@ -147,10 +147,13 @@ class Node(BaseService):
         self.genesis = genesis
         state = load_state_from_db_or_genesis(self.state_store, genesis)
 
-        # 3. proxy app (setup.go:172) — external process for tcp://
-        # and unix:// addresses, builtin in-process otherwise
+        # 3. proxy app (setup.go:172) — external process for tcp://,
+        # unix:// (socket protocol) and grpc:// addresses, builtin
+        # in-process otherwise
         proxy_addr = config.base.proxy_app
-        if app is None and proxy_addr.startswith(("tcp://", "unix://")):
+        if app is None and proxy_addr.startswith(
+            ("tcp://", "unix://", "grpc://")
+        ):
             self.app = None
             self.proxy_app = AppConns(default_client_creator(proxy_addr))
         else:
@@ -238,6 +241,51 @@ class Node(BaseService):
             metrics=self.metrics.state,
             logger=self.logger.with_fields(module="executor"),
         )
+
+        # 9b. background pruner (node.go:1067 createPruner): consumes the
+        # retain heights the app (and optionally a data companion)
+        # persists, and deletes blocks/state/ABCI results behind them.
+        from cometbft_tpu.state.pruner import Pruner
+
+        self.pruner = Pruner(
+            self.state_store,
+            self.block_store,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            interval_s=config.storage.pruning_interval_ns / 1e9,
+            companion_enabled=config.storage.companion_pruning,
+            metrics=self.metrics.state,
+            logger=self.logger.with_fields(module="pruner"),
+        )
+        self.block_exec.pruner = self.pruner
+
+        # 9c. gRPC data + privileged services (rpc/grpc/server): opt-in
+        # via [grpc] laddr / privileged_laddr.
+        self.grpc_server = None
+        self.grpc_privileged = None
+        if config.grpc.laddr:
+            from cometbft_tpu.rpc.grpc_services import GrpcDataServer
+
+            self.grpc_server = GrpcDataServer(
+                config.grpc.laddr,
+                self.block_store,
+                self.state_store,
+                version_enabled=config.grpc.version_service_enabled,
+                block_enabled=config.grpc.block_service_enabled,
+                block_results_enabled=(
+                    config.grpc.block_results_service_enabled
+                ),
+                logger=self.logger.with_fields(module="grpc"),
+            )
+        if config.grpc.privileged_laddr and config.grpc.pruning_service_enabled:
+            from cometbft_tpu.rpc.grpc_services import GrpcPrivilegedServer
+
+            self.pruner.companion_enabled = True
+            self.grpc_privileged = GrpcPrivilegedServer(
+                config.grpc.privileged_laddr,
+                self.pruner,
+                logger=self.logger.with_fields(module="grpc-privileged"),
+            )
 
         # 10. WAL + consensus (setup.go:369).  memdb nodes are ephemeral
         # (tests): give them a no-op WAL.
@@ -403,6 +451,7 @@ class Node(BaseService):
             ),
             blocksync_reactor=self.blocksync_reactor,
             statesync_reactor=self.statesync_reactor,
+            unsafe=config.rpc.unsafe,
         )
         self.rpc_server: JSONRPCServer | None = None
         if config.rpc.laddr:
@@ -557,9 +606,18 @@ class Node(BaseService):
         peers = parse_peer_list(self.config.p2p.persistent_peers)
         if peers:
             self.switch.dial_peers_async(peers, persistent=True)
+        if self.grpc_server is not None:
+            self.grpc_server.start()
+        if self.grpc_privileged is not None:
+            self.grpc_privileged.start()
+        # pruner last (node.go:645)
+        self.pruner.start()
 
     def on_stop(self) -> None:
         services = (
+            self.pruner,
+            self.grpc_server,
+            self.grpc_privileged,
             self.rpc_server,
             self.switch,
             self.consensus,

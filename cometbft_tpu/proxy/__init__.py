@@ -157,10 +157,28 @@ def remote_client_creator(
     return RemoteClientCreator(addr, connect_timeout)
 
 
+class GrpcRemoteClientCreator:
+    """Clients for an external app over ABCI gRPC — one channel-backed
+    client per logical connection (proxy/client.go NewRemoteClientCreator
+    with transport "grpc" + abci/client/grpc_client.go)."""
+
+    def __init__(self, addr: str, connect_timeout: float = 10.0):
+        self._addr = addr
+        self._connect_timeout = connect_timeout
+
+    def new_client(self):
+        from cometbft_tpu.abci.grpc import GrpcClient
+
+        return GrpcClient(self._addr, connect_timeout=self._connect_timeout)
+
+
 def default_client_creator(proxy_app: str, app: Application | None = None):
     """config.proxy_app -> creator (proxy/client.go DefaultClientCreator):
-    tcp:// and unix:// addresses mean an external app process; anything
-    else is a builtin served in-process."""
+    tcp:// and unix:// addresses mean an external app over the socket
+    protocol, grpc:// over gRPC; anything else is a builtin served
+    in-process."""
+    if proxy_app.startswith("grpc://"):
+        return GrpcRemoteClientCreator(proxy_app)
     if proxy_app.startswith(("tcp://", "unix://")):
         return remote_client_creator(proxy_app)
     if app is None:

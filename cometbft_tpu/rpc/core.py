@@ -81,6 +81,7 @@ class Environment:
         pub_key=None,
         blocksync_reactor=None,
         statesync_reactor=None,
+        unsafe=False,
     ):
         self.block_store = block_store
         self.state_store = state_store
@@ -99,6 +100,8 @@ class Environment:
         self._pub_key = pub_key
         self.blocksync_reactor = blocksync_reactor
         self.statesync_reactor = statesync_reactor
+        self.unsafe = unsafe
+        self._gen_chunks: list[str] | None = None  # lazy (env.go InitGenesisChunks)
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
         self._subs_mtx = threading.Lock()
 
@@ -111,7 +114,7 @@ class Environment:
         return pk() if callable(pk) else pk
 
     def routes(self) -> dict:
-        return {
+        routes = {
             "health": self.health,
             "status": self.status,
             "net_info": self.net_info,
@@ -138,7 +141,14 @@ class Environment:
             "broadcast_evidence": self.broadcast_evidence,
             "abci_query": self.abci_query,
             "abci_info": self.abci_info,
+            "genesis_chunked": self.genesis_chunked,
+            "check_tx": self.check_tx,
         }
+        if self.unsafe:
+            # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
+            routes["unsafe_dial_seeds"] = self.unsafe_dial_seeds
+            routes["unsafe_dial_peers"] = self.unsafe_dial_peers
+        return routes
 
     def ws_routes(self) -> dict:
         return {
@@ -256,7 +266,43 @@ class Environment:
     def genesis_route(self) -> dict:
         import json as _json
 
+        if len(self._genesis_chunks()) > 1:
+            raise RPCError(
+                -32603,
+                "genesis response is too large, please use the "
+                "genesis_chunked API instead",
+            )
         return {"genesis": _json.loads(self.genesis.to_json())}
+
+    _GENESIS_CHUNK_SIZE = 16 * 1024 * 1024  # net.go:16 genesisChunkSize
+
+    def _genesis_chunks(self) -> list[str]:
+        if self._gen_chunks is None:
+            import base64 as _b64
+
+            raw = self.genesis.to_json().encode()
+            size = self._GENESIS_CHUNK_SIZE
+            self._gen_chunks = [
+                _b64.b64encode(raw[i : i + size]).decode()
+                for i in range(0, max(len(raw), 1), size)
+            ]
+        return self._gen_chunks
+
+    def genesis_chunked(self, chunk=0) -> dict:
+        """(rpc/core/net.go:115 GenesisChunked)"""
+        chunks = self._genesis_chunks()
+        cid = _to_int(chunk, "chunk")
+        if not 0 <= cid < len(chunks):
+            raise RPCError(
+                -32602,
+                f"there are {len(chunks)} chunks, {cid} is invalid "
+                f"(should be between 0 and {len(chunks) - 1})",
+            )
+        return {
+            "chunk": str(cid),
+            "total": str(len(chunks)),
+            "data": chunks[cid],
+        }
 
     # -- blocks -----------------------------------------------------------
 
@@ -547,6 +593,49 @@ class Environment:
             self.mempool.check_tx(raw)
         except Exception:  # noqa: BLE001
             pass
+
+    def check_tx(self, tx=None) -> dict:
+        """Run CheckTx against the app WITHOUT adding to the mempool
+        (rpc/core/mempool.go:211 CheckTx)."""
+        from cometbft_tpu.abci.types import CHECK_TX_TYPE_CHECK, CheckTxRequest
+
+        raw = _to_bytes(tx, "tx")
+        res = self.proxy_app.mempool.check_tx(
+            CheckTxRequest(tx=raw, type=CHECK_TX_TYPE_CHECK)
+        )
+        return {
+            "code": res.code,
+            "data": b64(res.data) if res.data else "",
+            "log": res.log,
+            "codespace": res.codespace,
+            "gas_wanted": str(res.gas_wanted),
+            "gas_used": str(res.gas_used),
+        }
+
+    def unsafe_dial_seeds(self, seeds=None) -> dict:
+        """(rpc/core/net.go:50 UnsafeDialSeeds)"""
+        from cometbft_tpu.p2p.netaddr import parse_peer_list
+
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        spec = ",".join(seeds) if isinstance(seeds, list) else str(seeds)
+        addrs = parse_peer_list(spec)
+        self.switch.dial_peers_async(addrs, persistent=False)
+        return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+    def unsafe_dial_peers(self, peers=None, persistent=False,
+                          unconditional=False, private=False) -> dict:
+        """(rpc/core/net.go:63 UnsafeDialPeers)"""
+        from cometbft_tpu.p2p.netaddr import parse_peer_list
+
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+        spec = ",".join(peers) if isinstance(peers, list) else str(peers)
+        addrs = parse_peer_list(spec)
+        self.switch.dial_peers_async(
+            addrs, persistent=bool(persistent)
+        )
+        return {"log": "Dialing peers in progress. See /net_info for details"}
 
     def broadcast_tx_sync(self, tx=None) -> dict:
         raw = _to_bytes(tx, "tx")

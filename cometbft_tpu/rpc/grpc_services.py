@@ -1,0 +1,335 @@
+"""gRPC data services (reference: rpc/grpc/server/services/): version,
+block, block-results, and the privileged pruning service.
+
+The reference treats gRPC as a first-class API surface next to
+JSON-RPC: explorers stream GetLatestHeight, data companions fetch
+blocks/results and drive pruning via the privileged endpoint
+(rpc/grpc/server/server.go). Here each service is a generic-handler
+gRPC server over this framework's proto wire helpers — block payloads
+reuse types/codec.encode_block, so a block fetched over gRPC is
+byte-identical to one gossiped on p2p.
+
+The privileged server binds its own address (config [grpc]
+privileged_laddr) so operators can firewall pruning control away from
+the public data plane, exactly the reference's split.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from cometbft_tpu.types import codec as tcodec
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.version import (
+    ABCI_SEMVER,
+    BLOCK_PROTOCOL,
+    P2P_PROTOCOL,
+    __version__,
+)
+
+VERSION_SERVICE = "cometbft.services.version.v1.VersionService"
+BLOCK_SERVICE = "cometbft.services.block.v1.BlockService"
+BLOCK_RESULTS_SERVICE = (
+    "cometbft.services.block_results.v1.BlockResultsService"
+)
+PRUNING_SERVICE = "cometbft.services.pruning.v1.PruningService"
+
+
+def _parse_addr(addr: str) -> str:
+    for prefix in ("grpc://", "tcp://"):
+        if addr.startswith(prefix):
+            return addr[len(prefix):]
+    return addr
+
+
+def _uvarint_field(raw: bytes, no: int, default: int = 0) -> int:
+    f = ProtoReader(bytes(raw)).to_dict()
+    vals = f.get(no)
+    return int(vals[0]) if vals else default
+
+
+class _GenericService(grpc.GenericRpcHandler):
+    """Dispatch /<service>/<method> to {(service, method): fn} where fn
+    is either (bytes) -> bytes (unary) or a generator (streaming)."""
+
+    def __init__(self, table: dict, streaming: set):
+        self._table = table
+        self._streaming = streaming
+
+    def service(self, details):
+        service, _, method = details.method.lstrip("/").partition("/")
+        fn = self._table.get((service, method))
+        if fn is None:
+            return None
+        ident = lambda b: b  # noqa: E731
+
+        def unary(request, context):
+            try:
+                return fn(request)
+            except KeyError as exc:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+            except ValueError as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            except Exception as exc:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(exc))
+
+        def stream(request, context):
+            try:
+                yield from fn(request, context)
+            except Exception as exc:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, repr(exc))
+
+        if (service, method) in self._streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                stream, request_deserializer=ident, response_serializer=ident
+            )
+        return grpc.unary_unary_rpc_method_handler(
+            unary, request_deserializer=ident, response_serializer=ident
+        )
+
+
+class GrpcDataServer(BaseService):
+    """Public data plane: version/block/block-results services
+    (rpc/grpc/server/server.go Serve)."""
+
+    def __init__(
+        self,
+        addr: str,
+        block_store,
+        state_store,
+        version_enabled: bool = True,
+        block_enabled: bool = True,
+        block_results_enabled: bool = True,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="grpc-data",
+            logger=logger or default_logger().with_fields(module="grpc"),
+        )
+        self.block_store = block_store
+        self.state_store = state_store
+        table: dict = {}
+        streaming: set = set()
+        if version_enabled:
+            table[(VERSION_SERVICE, "GetVersion")] = self._get_version
+        if block_enabled:
+            table[(BLOCK_SERVICE, "GetByHeight")] = self._get_by_height
+            table[(BLOCK_SERVICE, "GetLatestHeight")] = self._latest_heights
+            streaming.add((BLOCK_SERVICE, "GetLatestHeight"))
+        if block_results_enabled:
+            table[(BLOCK_RESULTS_SERVICE, "GetBlockResults")] = (
+                self._get_block_results
+            )
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (_GenericService(table, streaming),)
+        )
+        self.port = self._server.add_insecure_port(_parse_addr(addr))
+
+    # GetVersionResponse: node(1) str, abci(2) str, p2p(3) u64, block(4) u64
+    def _get_version(self, raw: bytes) -> bytes:
+        w = ProtoWriter()
+        w.string(1, __version__)
+        w.string(2, ABCI_SEMVER)
+        w.varint(3, P2P_PROTOCOL)
+        w.varint(4, BLOCK_PROTOCOL)
+        return w.finish()
+
+    # GetByHeightRequest: height(1); Response: block_id(1), block(2)
+    def _get_by_height(self, raw: bytes) -> bytes:
+        height = _uvarint_field(raw, 1)
+        if height == 0:
+            height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height)
+        block = self.block_store.load_block(height)
+        if meta is None or block is None:
+            raise KeyError(f"no block at height {height}")
+        w = ProtoWriter()
+        w.message(1, meta.block_id.encode())
+        w.message(2, tcodec.encode_block(block))
+        return w.finish()
+
+    # GetLatestHeightResponse: height(1) — server streams each new height
+    def _latest_heights(self, raw: bytes, context):
+        import time as _time
+
+        last = 0
+        while context.is_active() and not self._quit.is_set():
+            h = self.block_store.height()
+            if h > last:
+                last = h
+                w = ProtoWriter()
+                w.varint(1, h)
+                yield w.finish()
+            else:
+                _time.sleep(0.05)
+
+    # GetBlockResultsRequest: height(1); Response: height(1),
+    # finalize_block_response(2, our FinalizeBlockResponse encoding)
+    def _get_block_results(self, raw: bytes) -> bytes:
+        height = _uvarint_field(raw, 1)
+        if height == 0:
+            height = self.block_store.height()
+        resp = self.state_store.load_finalize_block_response(height)
+        if resp is None:
+            raise KeyError(f"no block results at height {height}")
+        w = ProtoWriter()
+        w.varint(1, height)
+        w.message(2, resp.encode())
+        return w.finish()
+
+    def on_start(self) -> None:
+        self._server.start()
+        self.logger.info("grpc data server listening", port=self.port)
+
+    def on_stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+
+class GrpcPrivilegedServer(BaseService):
+    """Privileged plane: the pruning service a data companion uses to
+    move retain heights (rpc/grpc/server/services/pruningservice)."""
+
+    def __init__(self, addr: str, pruner, logger: Logger | None = None):
+        super().__init__(
+            name="grpc-privileged",
+            logger=logger
+            or default_logger().with_fields(module="grpc-privileged"),
+        )
+        self.pruner = pruner
+        table = {
+            (PRUNING_SERVICE, "SetBlockRetainHeight"): self._set_block,
+            (PRUNING_SERVICE, "GetBlockRetainHeight"): self._get_block,
+            (PRUNING_SERVICE, "SetBlockResultsRetainHeight"): (
+                self._set_results
+            ),
+            (PRUNING_SERVICE, "GetBlockResultsRetainHeight"): (
+                self._get_results
+            ),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self._server.add_generic_rpc_handlers(
+            (_GenericService(table, set()),)
+        )
+        self.port = self._server.add_insecure_port(_parse_addr(addr))
+
+    def _set_block(self, raw: bytes) -> bytes:
+        height = _uvarint_field(raw, 1)
+        self.pruner.set_companion_block_retain_height(height)
+        return b""
+
+    # GetBlockRetainHeightResponse: app_retain_height(1),
+    # pruning_service_retain_height(2)
+    def _get_block(self, raw: bytes) -> bytes:
+        w = ProtoWriter()
+        w.varint(1, self.pruner.get_application_retain_height())
+        w.varint(2, self.pruner.get_companion_block_retain_height())
+        return w.finish()
+
+    def _set_results(self, raw: bytes) -> bytes:
+        height = _uvarint_field(raw, 1)
+        self.pruner.set_abci_results_retain_height(height)
+        return b""
+
+    def _get_results(self, raw: bytes) -> bytes:
+        w = ProtoWriter()
+        w.varint(1, self.pruner.get_abci_results_retain_height())
+        return w.finish()
+
+    def on_start(self) -> None:
+        self._server.start()
+        self.logger.info("grpc privileged server listening", port=self.port)
+
+    def on_stop(self) -> None:
+        self._server.stop(grace=1.0)
+
+
+class GrpcClient:
+    """Thin client for the data + privileged services (the reference's
+    rpc/grpc/client package)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        self.addr = _parse_addr(addr)
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(self.addr)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _unary(self, service: str, method: str, payload: bytes) -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        return fn(payload, timeout=self.timeout)
+
+    def get_version(self) -> dict:
+        raw = self._unary(VERSION_SERVICE, "GetVersion", b"")
+        f = ProtoReader(raw).to_dict()
+        return {
+            "node": bytes(f.get(1, [b""])[0]).decode(),
+            "abci": bytes(f.get(2, [b""])[0]).decode(),
+            "p2p": int(f.get(3, [0])[0]),
+            "block": int(f.get(4, [0])[0]),
+        }
+
+    def get_block_by_height(self, height: int = 0):
+        w = ProtoWriter()
+        w.varint(1, height)
+        raw = self._unary(BLOCK_SERVICE, "GetByHeight", w.finish())
+        f = ProtoReader(raw).to_dict()
+        block_id = tcodec.decode_block_id(bytes(f[1][0]))
+        block = tcodec.decode_block(bytes(f[2][0]))
+        return block_id, block
+
+    def get_latest_height_stream(self):
+        fn = self._channel.unary_stream(
+            f"/{BLOCK_SERVICE}/GetLatestHeight",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        for raw in fn(b""):
+            yield _uvarint_field(raw, 1)
+
+    def get_block_results(self, height: int = 0):
+        from cometbft_tpu.abci.types import FinalizeBlockResponse
+
+        w = ProtoWriter()
+        w.varint(1, height)
+        raw = self._unary(
+            BLOCK_RESULTS_SERVICE, "GetBlockResults", w.finish()
+        )
+        f = ProtoReader(raw).to_dict()
+        return (
+            int(f.get(1, [0])[0]),
+            FinalizeBlockResponse.decode(bytes(f[2][0])),
+        )
+
+    # privileged
+    def set_block_retain_height(self, height: int) -> None:
+        w = ProtoWriter()
+        w.varint(1, height)
+        self._unary(PRUNING_SERVICE, "SetBlockRetainHeight", w.finish())
+
+    def get_block_retain_height(self) -> tuple[int, int]:
+        raw = self._unary(PRUNING_SERVICE, "GetBlockRetainHeight", b"")
+        f = ProtoReader(raw).to_dict()
+        return int(f.get(1, [0])[0]), int(f.get(2, [0])[0])
+
+    def set_block_results_retain_height(self, height: int) -> None:
+        w = ProtoWriter()
+        w.varint(1, height)
+        self._unary(
+            PRUNING_SERVICE, "SetBlockResultsRetainHeight", w.finish()
+        )
+
+    def get_block_results_retain_height(self) -> int:
+        raw = self._unary(
+            PRUNING_SERVICE, "GetBlockResultsRetainHeight", b""
+        )
+        return _uvarint_field(raw, 1)

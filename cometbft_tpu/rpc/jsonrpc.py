@@ -147,7 +147,7 @@ class JSONRPCServer(BaseService):
                 raw = self.rfile.read(length)
                 try:
                     req = json.loads(raw) if raw else {}
-                except json.JSONDecodeError:
+                except ValueError:  # JSONDecodeError or UnicodeDecodeError
                     self._send_json(
                         make_response(
                             None, error=RPCError(ERR_PARSE, "parse error")
@@ -201,8 +201,25 @@ class JSONRPCServer(BaseService):
     # -- dispatch ---------------------------------------------------------
 
     def _dispatch(self, req: dict, ws_ctx=None) -> dict:
+        # the body may decode to null / a scalar / a list element that
+        # isn't an object — answer Invalid Request, never crash the
+        # connection (fuzz: rpc_jsonrpc_server_test.go)
+        if not isinstance(req, dict):
+            return make_response(
+                -1,
+                error=RPCError(
+                    ERR_INVALID_REQUEST, "request must be an object"
+                ),
+            )
         req_id = req.get("id", -1)
+        if not isinstance(req_id, (str, int, float, type(None))):
+            req_id = -1  # ids must be JSON primitives (rfc: string/number)
         method = req.get("method", "")
+        if not isinstance(method, str):
+            return make_response(
+                req_id,
+                error=RPCError(ERR_INVALID_REQUEST, "method must be a string"),
+            )
         params = req.get("params") or {}
         if not isinstance(params, dict):
             return make_response(

@@ -222,11 +222,42 @@ def _hkey(prefix: bytes, height: int) -> bytes:
     return prefix + height.to_bytes(8, "big")
 
 
+#: Version marker for the persistent ABCI-response encoding. Bumped when
+#: abci/codec.py's wire format changes incompatibly (it doubles as the
+#: storage format via FinalizeBlockResponse.encode). v2 = proto3-faithful
+#: plain-varint encoding; v1 (unmarked) = the earlier zigzag/JSON codec.
+_FORMAT_KEY = b"abciResponsesFormat"
+_FORMAT_VERSION = b"v2-proto3"
+
+
+class StoreFormatError(Exception):
+    """The on-disk ABCI responses were written by an incompatible codec
+    version; re-sync or delete the state DB (there is no migration)."""
+
+
 class Store:
     """Persistent state store (state/store.go:112 dbStore)."""
 
     def __init__(self, db: DB):
         self._db = db
+        marker = db.get(_FORMAT_KEY)
+        if marker is None:
+            # Fail loudly instead of decoding old bytes wrongly: a DB
+            # that already holds ABCI responses but no format marker was
+            # written by the pre-proto3 codec.
+            has_old = next(iter(db.prefix_iterator(_ABCI_RESP)), None)
+            if has_old is not None:
+                raise StoreFormatError(
+                    "state DB holds ABCI responses in the legacy "
+                    "(pre-proto3) encoding; wipe the chain stores "
+                    "(unsafe-reset-all) or re-sync"
+                )
+            db.set(_FORMAT_KEY, _FORMAT_VERSION)
+        elif bytes(marker) != _FORMAT_VERSION:
+            raise StoreFormatError(
+                f"state DB ABCI-response format {bytes(marker)!r} != "
+                f"supported {_FORMAT_VERSION!r}"
+            )
 
     def load(self) -> State | None:
         raw = self._db.get(_STATE_KEY)
@@ -314,6 +345,18 @@ class Store:
             ]
             if ops:
                 self._db.write_batch(ops)
+
+    def prune_abci_responses(self, retain_height: int) -> None:
+        """Delete FinalizeBlock responses below ``retain_height`` only
+        (the data companion's separate axis, pruner.go pruneABCIResponses)."""
+        ops = [
+            (k, None)
+            for k, _ in self._db.iterator(
+                _ABCI_RESP, _hkey(_ABCI_RESP, retain_height)
+            )
+        ]
+        if ops:
+            self._db.write_batch(ops)
 
 
 def load_state_from_db_or_genesis(store: Store, gen: GenesisDoc) -> State:

@@ -331,6 +331,7 @@ class BlockExecutor:
         self.metrics = metrics if metrics is not None else StateMetrics()
         self.logger = logger or default_logger().with_fields(module="executor")
         self.retain_height = 0  # last app-requested retain height
+        self.pruner = None  # wired by the node (state/pruner.py)
 
     # -- proposal path ---------------------------------------------------
 
@@ -473,6 +474,13 @@ class BlockExecutor:
         self._fire_events(block, block_id, resp)
         # advisory for the background pruner (node/node.go createPruner)
         self.retain_height = max(retain_height, 0)
+        if self.pruner is not None and retain_height > 0:
+            try:
+                self.pruner.set_application_retain_height(retain_height)
+            except Exception as exc:  # noqa: BLE001 — never block commit
+                self.logger.error(
+                    "failed to record retain height", err=repr(exc)
+                )
         return new_state
 
     def _commit(

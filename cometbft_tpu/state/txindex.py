@@ -146,6 +146,25 @@ class TxIndexer:
         raw = self.db.get(_PREFIX_RESULT + hash_)
         return _decode_result(bytes(raw)) if raw is not None else None
 
+    def prune(self, retain_height: int) -> None:
+        """Drop tx entries below ``retain_height`` (the pruner's indexer
+        axis; reference kv.go Prune). Event keys embed the height before
+        a 4-byte index, result records are located via the height rows."""
+        ops: list[tuple[bytes, bytes | None]] = []
+        bound = retain_height.to_bytes(8, "big")
+        for key, h in self.db.iterator(
+            _PREFIX_TXHEIGHT, _PREFIX_TXHEIGHT + bound
+        ):
+            ops.append((bytes(key), None))
+            ops.append((_PREFIX_RESULT + bytes(h), None))
+        for key, _ in self.db.prefix_iterator(_PREFIX_TXKEY):
+            height = int.from_bytes(key[-12:-4], "big")
+            if height < retain_height:
+                ops.append((bytes(key), None))
+        if ops:
+            with self._mtx:
+                self.db.write_batch(ops)
+
     def search(self, query: Query | str, limit: int = 100) -> list[dict]:
         """Match indexed txs against a pubsub query.  Conditions on
         ``tx.height`` / ``tx.hash`` plus event attributes are supported
@@ -208,6 +227,17 @@ class BlockIndexer:
         with self._mtx:
             self.db.write_batch(ops)
 
+    def prune(self, retain_height: int) -> None:
+        """Drop block-event entries below ``retain_height``."""
+        ops: list[tuple[bytes, bytes | None]] = []
+        for key, _ in self.db.prefix_iterator(_PREFIX_BLOCKKEY):
+            height = int.from_bytes(key[-8:], "big")
+            if height < retain_height:
+                ops.append((bytes(key), None))
+        if ops:
+            with self._mtx:
+                self.db.write_batch(ops)
+
     def search(self, query: Query | str, limit: int = 100) -> list[int]:
         """Heights whose block events match the query."""
         if isinstance(query, str):
@@ -244,6 +274,9 @@ class NullIndexer:
 
     def search(self, query, limit: int = 100) -> list:
         return []
+
+    def prune(self, retain_height: int) -> None:
+        pass
 
 
 class IndexerService(BaseService):

@@ -162,6 +162,10 @@ class ProtoReader:
         while off < len(buf):
             key, off = decode_uvarint(buf, off)
             field, wt = key >> 3, key & 7
+            if field == 0:
+                # proto3 field numbers start at 1; rejecting 0 also cuts
+                # off degenerate all-zero buffers immediately
+                raise ValueError("invalid field number 0")
             if wt == 0:
                 val, off = decode_uvarint(buf, off)
                 yield field, wt, val

@@ -55,9 +55,17 @@ class TestCodec:
             T.PrepareProposalRequest(
                 max_tx_bytes=100,
                 txs=(b"a", b"b"),
-                local_last_commit=T.CommitInfo(
+                local_last_commit=T.ExtendedCommitInfo(
                     round=1,
-                    votes=(T.VoteInfo(b"\x02" * 20, 10, 2),),
+                    votes=(
+                        T.ExtendedVoteInfo(
+                            validator_address=b"\x02" * 20,
+                            validator_power=10,
+                            vote_extension=b"ext",
+                            extension_signature=b"sig",
+                            block_id_flag=2,
+                        ),
+                    ),
                 ),
                 misbehavior=(
                     T.Misbehavior(1, b"\x03" * 20, 10, 4, 999, 40),
@@ -68,7 +76,9 @@ class TestCodec:
                 proposer_address=b"\x05" * 20,
             ),
             T.ProcessProposalRequest(txs=(b"t",), height=2, hash=b"\x06" * 32),
-            T.ExtendVoteRequest(hash=b"\x07" * 32, height=3, round=1),
+            # NOTE: round is NOT carried on the wire (upstream proto has
+            # no round field in ExtendVoteRequest)
+            T.ExtendVoteRequest(hash=b"\x07" * 32, height=3),
             T.VerifyVoteExtensionRequest(
                 hash=b"h", validator_address=b"v", height=2,
                 vote_extension=b"e",

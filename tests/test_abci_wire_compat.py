@@ -1,0 +1,285 @@
+"""Byte-level compatibility of the ABCI codec with upstream proto3.
+
+Ground truth is the real protobuf runtime: we build the upstream
+message types dynamically from descriptors that restate
+proto/cometbft/abci/v1/types.proto (field numbers, types, reserved
+gaps), serialize with protobuf, and require our codec to decode those
+exact bytes — and protobuf to parse ours. This is what makes external
+ABCI apps written against the reference protocol interoperate with this
+node's socket/gRPC transports.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+google = pytest.importorskip("google.protobuf")
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from cometbft_tpu.abci import codec
+from cometbft_tpu.abci import types as T
+
+_POOL = descriptor_pool.DescriptorPool()
+
+_F = descriptor_pb2.FieldDescriptorProto
+
+
+def _field(name, number, ftype, label=_F.LABEL_OPTIONAL, type_name=None):
+    f = _F(name=name, number=number, type=ftype, label=label)
+    if type_name:
+        f.type_name = type_name
+    return f
+
+
+def _msg(name, *fields):
+    m = descriptor_pb2.DescriptorProto(name=name)
+    m.field.extend(fields)
+    return m
+
+
+def _build_pool():
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="abci_compat.proto",
+        package="compat.abci",
+        syntax="proto3",
+    )
+    fd.message_type.extend(
+        [
+            _msg(
+                "Timestamp",
+                _field("seconds", 1, _F.TYPE_INT64),
+                _field("nanos", 2, _F.TYPE_INT32),
+            ),
+            _msg(
+                "Validator",
+                _field("address", 1, _F.TYPE_BYTES),
+                _field("power", 3, _F.TYPE_INT64),
+            ),
+            _msg(
+                "Event",
+                _field("type", 1, _F.TYPE_STRING),
+                _field(
+                    "attributes",
+                    2,
+                    _F.TYPE_MESSAGE,
+                    _F.LABEL_REPEATED,
+                    ".compat.abci.EventAttribute",
+                ),
+            ),
+            _msg(
+                "EventAttribute",
+                _field("key", 1, _F.TYPE_STRING),
+                _field("value", 2, _F.TYPE_STRING),
+                _field("index", 3, _F.TYPE_BOOL),
+            ),
+            _msg(
+                "CheckTxRequest",
+                _field("tx", 1, _F.TYPE_BYTES),
+                _field("type", 3, _F.TYPE_INT32),
+            ),
+            _msg(
+                "CheckTxResponse",
+                _field("code", 1, _F.TYPE_UINT32),
+                _field("data", 2, _F.TYPE_BYTES),
+                _field("log", 3, _F.TYPE_STRING),
+                _field("info", 4, _F.TYPE_STRING),
+                _field("gas_wanted", 5, _F.TYPE_INT64),
+                _field("gas_used", 6, _F.TYPE_INT64),
+                _field(
+                    "events",
+                    7,
+                    _F.TYPE_MESSAGE,
+                    _F.LABEL_REPEATED,
+                    ".compat.abci.Event",
+                ),
+                _field("codespace", 8, _F.TYPE_STRING),
+            ),
+            _msg(
+                "QueryResponse",
+                _field("code", 1, _F.TYPE_UINT32),
+                _field("log", 3, _F.TYPE_STRING),
+                _field("info", 4, _F.TYPE_STRING),
+                _field("index", 5, _F.TYPE_INT64),
+                _field("key", 6, _F.TYPE_BYTES),
+                _field("value", 7, _F.TYPE_BYTES),
+                _field("height", 9, _F.TYPE_INT64),
+                _field("codespace", 10, _F.TYPE_STRING),
+            ),
+            _msg(
+                "ValidatorUpdate",
+                _field("power", 2, _F.TYPE_INT64),
+                _field("pub_key_bytes", 3, _F.TYPE_BYTES),
+                _field("pub_key_type", 4, _F.TYPE_STRING),
+            ),
+            _msg(
+                "VoteInfo",
+                _field(
+                    "validator",
+                    1,
+                    _F.TYPE_MESSAGE,
+                    type_name=".compat.abci.Validator",
+                ),
+                _field("block_id_flag", 3, _F.TYPE_INT32),
+            ),
+            _msg(
+                "CommitInfo",
+                _field("round", 1, _F.TYPE_INT32),
+                _field(
+                    "votes",
+                    2,
+                    _F.TYPE_MESSAGE,
+                    _F.LABEL_REPEATED,
+                    ".compat.abci.VoteInfo",
+                ),
+            ),
+            _msg(
+                "Misbehavior",
+                _field("type", 1, _F.TYPE_INT32),
+                _field(
+                    "validator",
+                    2,
+                    _F.TYPE_MESSAGE,
+                    type_name=".compat.abci.Validator",
+                ),
+                _field("height", 3, _F.TYPE_INT64),
+                _field(
+                    "time",
+                    4,
+                    _F.TYPE_MESSAGE,
+                    type_name=".compat.abci.Timestamp",
+                ),
+                _field("total_voting_power", 5, _F.TYPE_INT64),
+            ),
+            _msg(
+                "FinalizeBlockRequest",
+                _field("txs", 1, _F.TYPE_BYTES, _F.LABEL_REPEATED),
+                _field(
+                    "decided_last_commit",
+                    2,
+                    _F.TYPE_MESSAGE,
+                    type_name=".compat.abci.CommitInfo",
+                ),
+                _field(
+                    "misbehavior",
+                    3,
+                    _F.TYPE_MESSAGE,
+                    _F.LABEL_REPEATED,
+                    ".compat.abci.Misbehavior",
+                ),
+                _field("hash", 4, _F.TYPE_BYTES),
+                _field("height", 5, _F.TYPE_INT64),
+                _field(
+                    "time",
+                    6,
+                    _F.TYPE_MESSAGE,
+                    type_name=".compat.abci.Timestamp",
+                ),
+                _field("next_validators_hash", 7, _F.TYPE_BYTES),
+                _field("proposer_address", 8, _F.TYPE_BYTES),
+                _field("syncing_to_height", 9, _F.TYPE_INT64),
+            ),
+            _msg(
+                "CommitResponse",
+                _field("retain_height", 3, _F.TYPE_INT64),
+            ),
+        ]
+    )
+    _POOL.Add(fd)
+    return {
+        m: message_factory.GetMessageClass(
+            _POOL.FindMessageTypeByName(f"compat.abci.{m}")
+        )
+        for m in (
+            "CheckTxRequest",
+            "CheckTxResponse",
+            "QueryResponse",
+            "ValidatorUpdate",
+            "CommitInfo",
+            "Misbehavior",
+            "FinalizeBlockRequest",
+            "CommitResponse",
+        )
+    }
+
+
+PB = _build_pool()
+
+
+class TestUpstreamWireCompat:
+    def test_check_tx_request(self):
+        ref = PB["CheckTxRequest"](tx=b"tx-bytes", type=1)
+        ours = codec.decode_msg(T.CheckTxRequest, ref.SerializeToString())
+        assert ours.tx == b"tx-bytes" and ours.type == 1
+        back = PB["CheckTxRequest"].FromString(codec.encode_msg(ours))
+        assert back == ref
+
+    def test_check_tx_response_with_events(self):
+        ref = PB["CheckTxResponse"](
+            code=4, log="rejected", gas_wanted=-1, gas_used=7,
+            codespace="app",
+        )
+        ev = ref.events.add()
+        ev.type = "tx"
+        attr = ev.attributes.add()
+        attr.key, attr.value, attr.index = "k", "v", True
+        ours = codec.decode_msg(T.CheckTxResponse, ref.SerializeToString())
+        assert ours.code == 4 and ours.gas_wanted == -1
+        assert ours.codespace == "app"
+        assert ours.events[0].attributes[0].key == "k"
+        assert PB["CheckTxResponse"].FromString(
+            codec.encode_msg(ours)
+        ) == ref
+
+    def test_query_response_field_numbers(self):
+        ref = PB["QueryResponse"](
+            code=1, log="l", index=5, key=b"k", value=b"v", height=9,
+            codespace="cs",
+        )
+        ours = codec.decode_msg(T.QueryResponse, ref.SerializeToString())
+        assert (ours.key, ours.value, ours.height) == (b"k", b"v", 9)
+        assert PB["QueryResponse"].FromString(codec.encode_msg(ours)) == ref
+
+    def test_finalize_block_request(self):
+        ref = PB["FinalizeBlockRequest"](
+            txs=[b"a", b"b"], hash=b"\x08" * 32, height=10,
+            syncing_to_height=11,
+        )
+        ref.decided_last_commit.round = 2
+        v = ref.decided_last_commit.votes.add()
+        v.validator.address = b"\x02" * 20
+        v.validator.power = 10
+        v.block_id_flag = 2
+        m = ref.misbehavior.add()
+        m.type = 1
+        m.validator.address = b"\x03" * 20
+        m.validator.power = 10
+        m.height = 4
+        m.time.seconds = 1
+        m.time.nanos = 5
+        m.total_voting_power = 40
+        ours = codec.decode_msg(
+            T.FinalizeBlockRequest, ref.SerializeToString()
+        )
+        assert ours.txs == (b"a", b"b")
+        assert ours.decided_last_commit.votes[0].validator_address == (
+            b"\x02" * 20
+        )
+        assert ours.misbehavior[0].time_ns == 1_000_000_005
+        assert PB["FinalizeBlockRequest"].FromString(
+            codec.encode_msg(ours)
+        ) == ref
+
+    def test_validator_update_and_commit_response(self):
+        ref = PB["ValidatorUpdate"](
+            power=12, pub_key_bytes=b"\x01" * 32, pub_key_type="ed25519"
+        )
+        ours = codec.decode_msg(T.ValidatorUpdate, ref.SerializeToString())
+        assert ours.power == 12 and ours.pub_key_type == "ed25519"
+        assert PB["ValidatorUpdate"].FromString(
+            codec.encode_msg(ours)
+        ) == ref
+        cref = PB["CommitResponse"](retain_height=77)
+        cours = codec.decode_msg(T.CommitResponse, cref.SerializeToString())
+        assert cours.retain_height == 77
+        assert codec.encode_msg(cours) == cref.SerializeToString()

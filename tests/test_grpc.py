@@ -1,0 +1,147 @@
+"""gRPC plane tests: ABCI over gRPC (client/server + a localnet node
+driving an external gRPC app) and the data/privileged gRPC services
+(reference: abci/client/grpc_client.go, rpc/grpc/server/services/)."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci import types as T
+from cometbft_tpu.abci.grpc import GrpcClient as AbciGrpcClient
+from cometbft_tpu.abci.grpc import GrpcServer as AbciGrpcServer
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.rpc.grpc_services import GrpcClient as DataGrpcClient
+from tests.test_reactors import (
+    connect_star,
+    make_localnet,
+    wait_all_height,
+)
+
+
+class TestAbciGrpc:
+    def test_roundtrip_all_methods(self):
+        srv = AbciGrpcServer(KVStoreApp(), "127.0.0.1:0")
+        srv.start()
+        try:
+            c = AbciGrpcClient(f"127.0.0.1:{srv.port}")
+            assert c.echo("ping") == "ping"
+            c.flush()
+            info = c.info(T.InfoRequest(version="t"))
+            assert info.data == "kvstore"
+            res = c.check_tx(
+                T.CheckTxRequest(tx=b"a=1", type=T.CHECK_TX_TYPE_CHECK)
+            )
+            assert res.code == 0
+            bad = c.check_tx(
+                T.CheckTxRequest(tx=b"nope", type=T.CHECK_TX_TYPE_CHECK)
+            )
+            assert bad.code != 0
+            snaps = c.list_snapshots()
+            assert snaps.snapshots == ()
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_connect_timeout(self):
+        from cometbft_tpu.proxy import AbciClientError
+
+        c = AbciGrpcClient("127.0.0.1:1", connect_timeout=0.5)
+        with pytest.raises(AbciClientError):
+            c.echo("x")
+
+    def test_localnet_with_external_grpc_app(self, tmp_path):
+        """A validator whose app lives in an external gRPC process keeps
+        consensus with builtin-app validators (the e2e 'grpc' ABCI
+        connection mode)."""
+        ext_app = KVStoreApp()
+        srv = AbciGrpcServer(ext_app, "127.0.0.1:0")
+        srv.start()
+
+        def cfg_hook(i, cfg):
+            if i == 0:
+                cfg.base.proxy_app = f"grpc://127.0.0.1:{srv.port}"
+
+        nodes, _, _ = make_localnet(tmp_path, 3, configure=cfg_hook)
+        # node0 must use the external app: clear the builtin
+        try:
+            for n in nodes:
+                n.start()
+            connect_star(nodes)
+            wait_all_height(nodes, 3)
+            # the external app actually executed blocks
+            assert ext_app._height >= 3
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+            srv.stop()
+
+
+class TestDataServices:
+    @pytest.fixture(scope="class")
+    def net(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("grpcnet")
+
+        def cfg_hook(i, cfg):
+            cfg.grpc.laddr = "127.0.0.1:0"
+            cfg.grpc.privileged_laddr = "127.0.0.1:0"
+            cfg.grpc.pruning_service_enabled = True
+
+        nodes, _, _ = make_localnet(tmp, 2, configure=cfg_hook)
+        for n in nodes:
+            n.start()
+        connect_star(nodes)
+        wait_all_height(nodes, 3)
+        yield nodes
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+    def test_version_service(self, net):
+        c = DataGrpcClient(f"127.0.0.1:{net[0].grpc_server.port}")
+        v = c.get_version()
+        assert v["block"] == 11 and v["p2p"] == 9 and v["abci"] == "2.1.0"
+        c.close()
+
+    def test_block_service(self, net):
+        c = DataGrpcClient(f"127.0.0.1:{net[0].grpc_server.port}")
+        block_id, block = c.get_block_by_height(2)
+        assert block.header.height == 2
+        assert block_id.hash == block.hash()
+        # matches the store's view byte-for-byte
+        assert (
+            block.hash()
+            == net[0].block_store.load_block_meta(2).block_id.hash
+        )
+        heights = c.get_latest_height_stream()
+        h = next(heights)
+        assert h >= 3
+        c.close()
+
+    def test_block_results_service(self, net):
+        c = DataGrpcClient(f"127.0.0.1:{net[0].grpc_server.port}")
+        height, resp = c.get_block_results(2)
+        assert height == 2
+        assert resp.app_hash != b"" or resp.tx_results is not None
+        c.close()
+
+    def test_privileged_pruning_service(self, net):
+        node = net[0]
+        c = DataGrpcClient(f"127.0.0.1:{node.grpc_privileged.port}")
+        c.set_block_retain_height(2)
+        app_h, companion_h = c.get_block_retain_height()
+        assert companion_h == 2
+        c.set_block_results_retain_height(2)
+        assert c.get_block_results_retain_height() == 2
+        # pruning routes are NOT on the public data server
+        pub = DataGrpcClient(f"127.0.0.1:{node.grpc_server.port}")
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError):
+            pub.set_block_retain_height(2)
+        pub.close()
+        c.close()

@@ -1,0 +1,143 @@
+"""Background pruner service (reference state/pruner.go:25): retain
+heights persist across restarts, the effective minimum wins when the
+data companion is enabled, and a pruning pass removes blocks, state,
+ABCI responses, and index entries behind the target."""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci.types import ExecTxResult, FinalizeBlockResponse
+from cometbft_tpu.state import Store
+from cometbft_tpu.state.pruner import Pruner, PrunerError
+from cometbft_tpu.state.txindex import BlockIndexer, TxIndexer
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.utils.db import MemDB
+
+from tests.test_store_state import build_chain
+
+
+def _stores(n=6):
+    bs = BlockStore(MemDB())
+    blocks, parts, commits = build_chain(n)
+    for block, ps, commit in zip(blocks, parts, commits):
+        bs.save_block(block, ps, commit)
+    ss = Store(MemDB())
+    for h in range(1, n + 1):
+        ss.save_finalize_block_response(
+            h, FinalizeBlockResponse(tx_results=(ExecTxResult(code=0),))
+        )
+    return ss, bs, blocks
+
+
+def test_retain_heights_persist_and_validate():
+    ss, bs, _ = _stores()
+    p = Pruner(ss, bs)
+    assert p.get_application_retain_height() == 0
+    p.set_application_retain_height(3)
+    assert p.get_application_retain_height() == 3
+    # never moves backwards
+    p.set_application_retain_height(2)
+    assert p.get_application_retain_height() == 3
+    with pytest.raises(PrunerError):
+        p.set_companion_block_retain_height(0)
+    with pytest.raises(PrunerError):
+        p.set_companion_block_retain_height(100)  # above store height
+    # persisted: a new pruner over the same state DB sees the heights
+    p2 = Pruner(ss, bs)
+    assert p2.get_application_retain_height() == 3
+
+
+def test_effective_minimum_with_companion():
+    ss, bs, _ = _stores()
+    p = Pruner(ss, bs, companion_enabled=True)
+    p.set_application_retain_height(5)
+    # companion hasn't spoken yet: nothing may be pruned
+    assert p.effective_retain_height() == 0
+    p.set_companion_block_retain_height(3)
+    assert p.effective_retain_height() == 3
+    # without companion mode the app height rules
+    p2 = Pruner(ss, bs, companion_enabled=False)
+    assert p2.effective_retain_height() == 5
+
+
+def test_prune_once_removes_everything_behind_target():
+    ss, bs, blocks = _stores(6)
+    txdb = MemDB()
+    txi = TxIndexer(txdb)
+    bli = BlockIndexer(txdb)
+    for h in range(1, 7):
+        txi.index(h, 0, b"tx-%d" % h, ExecTxResult(code=0))
+        bli.index(h, ())
+    p = Pruner(ss, bs, tx_indexer=txi, block_indexer=bli)
+    p.set_application_retain_height(4)
+    pruned, base = p.prune_once()
+    assert pruned == 3 and base == 4
+    assert bs.load_block(3) is None and bs.load_block(4) is not None
+    # tx index rows behind the target are gone, newer ones remain
+    from cometbft_tpu.state.txindex import tx_hash
+
+    assert txi.get(tx_hash(b"tx-2")) is None
+    assert txi.get(tx_hash(b"tx-5")) is not None
+    assert bli.search("block.height = 2") == []
+    assert bli.search("block.height = 5") == [5]
+    # ABCI responses pruned on their own axis
+    assert ss.load_finalize_block_response(5) is not None
+    p.set_abci_results_retain_height(5)
+    p.prune_once()
+    assert ss.load_finalize_block_response(4) is None
+    assert ss.load_finalize_block_response(5) is not None
+
+
+def test_background_loop_prunes():
+    ss, bs, _ = _stores(6)
+    p = Pruner(ss, bs, interval_s=0.05)
+    p.start()
+    try:
+        p.set_application_retain_height(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and bs.base() < 5:
+            time.sleep(0.02)
+        assert bs.base() == 5
+    finally:
+        p.stop()
+
+
+def test_node_prunes_behind_app_retain_height(tmp_path):
+    """End-to-end: an app that requests retain via Commit sees old
+    blocks disappear from a running node (node.go:1067 createPruner)."""
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.abci.types import CommitResponse
+    from tests.test_reactors import (
+        connect_star,
+        make_localnet,
+        wait_all_height,
+    )
+
+    class RetainApp(KVStoreApp):
+        def commit(self):
+            super().commit()
+            return CommitResponse(retain_height=max(self._height - 2, 0))
+
+    def cfg_hook(i, cfg):
+        cfg.storage.pruning_interval_ns = int(0.1e9)
+
+    nodes, _, _ = make_localnet(
+        tmp_path, 2, app_factory=RetainApp, configure=cfg_hook
+    )
+    try:
+        for n in nodes:
+            n.start()
+        connect_star(nodes)
+        wait_all_height(nodes, 5)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and nodes[0].block_store.base() < 2:
+            time.sleep(0.05)
+        assert nodes[0].block_store.base() >= 2
+        assert nodes[0].block_store.load_block(1) is None
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass

@@ -27,8 +27,9 @@ GENESIS_TIME = 1_700_000_000_000_000_000
 CHAIN = "reactor-test-chain"
 
 
-def make_localnet(tmp_path, n: int, app_factory=KVStoreApp):
-    """n validator nodes sharing one genesis, each with its own home."""
+def make_localnet(tmp_path, n: int, app_factory=KVStoreApp, configure=None):
+    """n validator nodes sharing one genesis, each with its own home.
+    ``configure(i, cfg)`` may mutate each node's config pre-construction."""
     privs = [
         FilePV(ed.priv_key_from_secret(b"net-val%d" % i)) for i in range(n)
     ]
@@ -40,11 +41,21 @@ def make_localnet(tmp_path, n: int, app_factory=KVStoreApp):
     nodes = []
     for i, pv in enumerate(privs):
         cfg = make_test_config(str(tmp_path / f"node{i}"))
+        if configure is not None:
+            configure(i, cfg)
         cfg.ensure_dirs()
         pv._key_path = cfg.priv_validator_key_path
         pv._state_path = cfg.priv_validator_state_path
         pv.save()
-        node = Node(cfg, app=app_factory(), genesis=gen, priv_validator=pv)
+        external = cfg.base.proxy_app.startswith(
+            ("tcp://", "unix://", "grpc://")
+        )
+        node = Node(
+            cfg,
+            app=None if external else app_factory(),
+            genesis=gen,
+            priv_validator=pv,
+        )
         nodes.append(node)
     return nodes, privs, gen
 

@@ -218,3 +218,62 @@ class TestWebSocket:
             assert got_block
         finally:
             sock.close()
+
+
+class TestNewRoutes:
+    def test_genesis_chunked(self, net):
+        c = client_for(net[0])
+        res = c.call("genesis_chunked", chunk=0)
+        assert res["chunk"] == "0" and int(res["total"]) >= 1
+        decoded = json.loads(base64.b64decode(res["data"]))
+        assert decoded["chain_id"] == "reactor-test-chain"
+        with pytest.raises(RPCError):
+            c.call("genesis_chunked", chunk=99)
+
+    def test_check_tx_does_not_enter_mempool(self, net):
+        c = client_for(net[0])
+        before = int(c.call("num_unconfirmed_txs")["total"])
+        res = c.call(
+            "check_tx", tx=base64.b64encode(b"k=checkonly").decode()
+        )
+        assert res["code"] == 0
+        assert int(c.call("num_unconfirmed_txs")["total"]) == before
+        bad = c.call("check_tx", tx=base64.b64encode(b"notakv").decode())
+        assert bad["code"] != 0
+
+    def test_unsafe_routes_gated(self, net):
+        c = client_for(net[0])
+        # test nodes don't enable config.rpc.unsafe
+        with pytest.raises(RPCError):
+            c.call("unsafe_dial_seeds", seeds="x")
+
+    def test_unsafe_dial_peers_when_enabled(self, tmp_path):
+        from tests.test_reactors import make_localnet as mk
+        def cfg_hook(i, cfg):
+            if i == 0:
+                cfg.rpc.unsafe = True
+
+        nodes, _, _ = mk(tmp_path, 2, configure=cfg_hook)
+        try:
+            for n in nodes:
+                n.start()
+            c = client_for(nodes[0])
+            addr = nodes[1].transport.listen_addr
+            res = c.call(
+                "unsafe_dial_peers",
+                peers=f"{addr.id}@{addr.host}:{addr.port}",
+                persistent=True,
+            )
+            assert "Dialing" in res["log"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if int(c.net_info()["n_peers"]) == 1:
+                    break
+                time.sleep(0.05)
+            assert int(c.net_info()["n_peers"]) == 1
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
