@@ -447,8 +447,18 @@ def encode_msg(obj) -> bytes:
             for item in v:
                 w.string(no, item)
         elif kind == "rep_int":
-            for item in v:
-                w.varint(no, int(item) & 0xFFFFFFFFFFFFFFFF)
+            # proto3 canonical form for repeated scalars is PACKED:
+            # one length-delimited field holding concatenated varints.
+            if v:
+                from cometbft_tpu.utils.protoio import encode_uvarint
+
+                w.bytes_(
+                    no,
+                    b"".join(
+                        encode_uvarint(int(item) & 0xFFFFFFFFFFFFFFFF)
+                        for item in v
+                    ),
+                )
         elif kind == "rep_msg":
             for item in v:
                 w.message(no, encode_msg(item))
@@ -530,7 +540,22 @@ def decode_msg(cls: type, raw: bytes):
                     _as_bytes(v).decode() for v in (vals or [])
                 )
             elif kind == "rep_int":
-                kwargs[attr] = tuple(_s64(int(v)) for v in (vals or []))
+                # accept both packed (bytes of concatenated varints,
+                # proto3 canonical) and unpacked (one varint per key)
+                items = []
+                for v in vals or []:
+                    if isinstance(v, (bytes, bytearray)):
+                        from cometbft_tpu.utils.protoio import (
+                            decode_uvarint,
+                        )
+
+                        off = 0
+                        while off < len(v):
+                            n, off = decode_uvarint(v, off)
+                            items.append(_s64(n))
+                    else:
+                        items.append(_s64(int(v)))
+                kwargs[attr] = tuple(items)
             elif kind == "rep_msg":
                 kwargs[attr] = tuple(
                     decode_msg(sub, _as_bytes(v)) for v in (vals or [])

@@ -15,6 +15,7 @@ byte-level compatibility proof against the real protobuf runtime.
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent import futures
 
@@ -159,6 +160,7 @@ class GrpcClient:
         self,
         addr: str,
         connect_timeout: float = 10.0,
+        request_timeout: float | None = None,
         logger: Logger | None = None,
     ):
         self.addr = _parse_grpc_addr(addr)
@@ -166,6 +168,16 @@ class GrpcClient:
             module="abci-grpc-client"
         )
         self._connect_timeout = connect_timeout
+        if request_timeout is None:
+            raw = os.environ.get("CMT_ABCI_REQUEST_TIMEOUT", "")
+            if raw:
+                try:
+                    request_timeout = float(raw)
+                except ValueError as exc:
+                    raise AbciClientError(
+                        f"malformed CMT_ABCI_REQUEST_TIMEOUT: {raw!r}"
+                    ) from exc
+        self._request_timeout = request_timeout
         self._channel: grpc.Channel | None = None
         self._lock = threading.Lock()
         self._closed = False
@@ -190,11 +202,14 @@ class GrpcClient:
         self._channel = ch
 
     def close(self) -> None:
-        with self._lock:
-            self._closed = True
-            if self._channel is not None:
-                self._channel.close()
-                self._channel = None
+        # Deliberately NOT taking self._lock: grpc.Channel.close() is
+        # thread-safe and cancels in-flight RPCs, so a request hung in
+        # _roundtrip (which holds the lock) can't wedge shutdown.
+        self._closed = True
+        ch = self._channel
+        self._channel = None
+        if ch is not None:
+            ch.close()
 
     def _roundtrip(self, method: str, req):
         req_cls, resp_cls = _METHODS[method]
@@ -212,7 +227,9 @@ class GrpcClient:
                 response_deserializer=lambda b: b,
             )
             try:
-                raw = fn(codec.encode_msg(req))
+                raw = fn(
+                    codec.encode_msg(req), timeout=self._request_timeout
+                )
             except grpc.RpcError as exc:
                 raise AbciClientError(
                     f"abci grpc call {method} failed: {exc}"

@@ -27,8 +27,15 @@ def _ed25519_factory() -> BatchVerifier:
         return _ed.CpuBatchVerifier()
 
 
+def _bls_factory() -> BatchVerifier:
+    from cometbft_tpu.crypto import bls12381 as _bls
+
+    return _bls.BlsBatchVerifier()
+
+
 REGISTRY: dict[str, Callable[[], BatchVerifier]] = {
     _ed.KEY_TYPE: _ed25519_factory,
+    "bls12_381": _bls_factory,
 }
 
 

@@ -16,6 +16,8 @@ the public data plane, exactly the reference's split.
 
 from __future__ import annotations
 
+import threading
+
 from concurrent import futures
 
 import grpc
@@ -52,6 +54,12 @@ def _uvarint_field(raw: bytes, no: int, default: int = 0) -> int:
     return int(vals[0]) if vals else default
 
 
+class _TooManyStreams(Exception):
+    """Raised by streaming handlers when the stream cap is hit; the
+    dispatch wrapper maps it to RESOURCE_EXHAUSTED (context.abort
+    inside a handler would be re-caught and masked as INTERNAL)."""
+
+
 class _GenericService(grpc.GenericRpcHandler):
     """Dispatch /<service>/<method> to {(service, method): fn} where fn
     is either (bytes) -> bytes (unary) or a generator (streaming)."""
@@ -80,6 +88,8 @@ class _GenericService(grpc.GenericRpcHandler):
         def stream(request, context):
             try:
                 yield from fn(request, context)
+            except _TooManyStreams as exc:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
             except Exception as exc:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL, repr(exc))
 
@@ -124,7 +134,13 @@ class GrpcDataServer(BaseService):
             table[(BLOCK_RESULTS_SERVICE, "GetBlockResults")] = (
                 self._get_block_results
             )
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        # Streams park a worker thread for their whole life; cap them
+        # BELOW the pool size so idle height subscribers can never
+        # starve the unary endpoints (availability, not fairness).
+        self._stream_slots = threading.BoundedSemaphore(8)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16)
+        )
         self._server.add_generic_rpc_handlers(
             (_GenericService(table, streaming),)
         )
@@ -155,18 +171,22 @@ class GrpcDataServer(BaseService):
 
     # GetLatestHeightResponse: height(1) — server streams each new height
     def _latest_heights(self, raw: bytes, context):
-        import time as _time
-
-        last = 0
-        while context.is_active() and not self._quit.is_set():
-            h = self.block_store.height()
-            if h > last:
-                last = h
-                w = ProtoWriter()
-                w.varint(1, h)
-                yield w.finish()
-            else:
-                _time.sleep(0.05)
+        if not self._stream_slots.acquire(blocking=False):
+            raise _TooManyStreams("too many concurrent height streams")
+        try:
+            last = 0
+            while context.is_active() and not self._quit.is_set():
+                h = self.block_store.height()
+                if h > last:
+                    last = h
+                    w = ProtoWriter()
+                    w.varint(1, h)
+                    yield w.finish()
+                else:
+                    # quit-aware wait doubles as the poll interval
+                    self._quit.wait(0.1)
+        finally:
+            self._stream_slots.release()
 
     # GetBlockResultsRequest: height(1); Response: height(1),
     # finalize_block_response(2, our FinalizeBlockResponse encoding)

@@ -156,7 +156,12 @@ class TxIndexer:
             _PREFIX_TXHEIGHT, _PREFIX_TXHEIGHT + bound
         ):
             ops.append((bytes(key), None))
-            ops.append((_PREFIX_RESULT + bytes(h), None))
+            # The result record is keyed by tx hash only; if the same
+            # tx bytes were re-indexed at a retained height, the hash
+            # row now holds the NEWER record — leave it alive.
+            rec = self.get(bytes(h))
+            if rec is None or rec["height"] < retain_height:
+                ops.append((_PREFIX_RESULT + bytes(h), None))
         for key, _ in self.db.prefix_iterator(_PREFIX_TXKEY):
             height = int.from_bytes(key[-12:-4], "big")
             if height < retain_height:

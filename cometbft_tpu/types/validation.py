@@ -60,17 +60,21 @@ def _check_dims(vals: ValidatorSet, commit: Commit, height: int, block_id: Block
         )
 
 
-def _should_batch_verify(commit: Commit, entries: list[_Entry], vals) -> bool:
-    """(validation.go:15) >= 2 sigs, all batch-capable, same key type."""
-    if len(entries) < 2:
-        return False
-    key_types = {
-        vals.get_by_index(e.val_idx).pub_key.type() for e in entries
-    }
-    if len(key_types) != 1:
-        return False
-    pk = vals.get_by_index(entries[0].val_idx).pub_key
-    return crypto_batch.supports_batch_verifier(pk)
+def _batch_groups(entries: list[_Entry], vals) -> list[list[_Entry]]:
+    """Group entries by pubkey type for the crypto pass.
+
+    The reference batches only when the whole commit shares one
+    batch-capable key type and otherwise verifies serially
+    (validation.go:15 shouldBatchVerify); grouping instead means a
+    mixed ed25519+bls12381 commit still gets ONE device launch for
+    its ed25519 votes and ONE multi-pairing for its BLS votes — the
+    BASELINE mega-commit shape."""
+    groups: dict[str, list[_Entry]] = {}
+    for e in entries:
+        groups.setdefault(
+            vals.get_by_index(e.val_idx).pub_key.type(), []
+        ).append(e)
+    return list(groups.values())
 
 
 def _verify(
@@ -129,33 +133,35 @@ def _verify(
         if not count_all and counted_power > voting_power_needed:
             break
 
-    # crypto pass — one device launch for the whole commit
-    verifier = None
-    if _should_batch_verify(commit, entries, vals):
-        verifier = crypto_batch.create_batch_verifier(
-            vals.get_by_index(entries[0].val_idx).pub_key
-        )
-    if verifier is not None:
-        for e in entries:
-            verifier.add(
-                vals.get_by_index(e.val_idx).pub_key,
-                commit.vote_sign_bytes(chain_id, e.idx),
-                commit.signatures[e.idx].signature,
-            )
-        ok, results = verifier.verify()
-        if not ok:
-            bad = next(i for i, r in enumerate(results) if not r)
-            raise InvalidCommitSignatures(
-                f"wrong signature (#{entries[bad].idx})"
-            )
-    else:
-        for e in entries:
-            pk = vals.get_by_index(e.val_idx).pub_key
-            if not pk.verify_signature(
-                commit.vote_sign_bytes(chain_id, e.idx),
-                commit.signatures[e.idx].signature,
-            ):
-                raise InvalidCommitSignatures(f"wrong signature (#{e.idx})")
+    # crypto pass — one batch launch per key type in the commit
+    for group in _batch_groups(entries, vals):
+        pk0 = vals.get_by_index(group[0].val_idx).pub_key
+        verifier = None
+        if len(group) >= 2 and crypto_batch.supports_batch_verifier(pk0):
+            verifier = crypto_batch.create_batch_verifier(pk0)
+        if verifier is not None:
+            for e in group:
+                verifier.add(
+                    vals.get_by_index(e.val_idx).pub_key,
+                    commit.vote_sign_bytes(chain_id, e.idx),
+                    commit.signatures[e.idx].signature,
+                )
+            ok, results = verifier.verify()
+            if not ok:
+                bad = next(i for i, r in enumerate(results) if not r)
+                raise InvalidCommitSignatures(
+                    f"wrong signature (#{group[bad].idx})"
+                )
+        else:
+            for e in group:
+                pk = vals.get_by_index(e.val_idx).pub_key
+                if not pk.verify_signature(
+                    commit.vote_sign_bytes(chain_id, e.idx),
+                    commit.signatures[e.idx].signature,
+                ):
+                    raise InvalidCommitSignatures(
+                        f"wrong signature (#{e.idx})"
+                    )
 
     for e in entries:
         if e.counts:

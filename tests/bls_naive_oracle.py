@@ -1,0 +1,746 @@
+"""Naive BLS12-381 pairing oracle — TEST-ONLY differential ground
+truth for cometbft_tpu/crypto/bls12381.py (the fast tower
+implementation).  This is the round-2 dense-polynomial implementation:
+Fq12 as Fq[w]/(w^12 - 2w^6 + 2) with schoolbook multiplication and a
+full (p^12-1)/r final exponentiation — orders of magnitude slower but
+straight-line-obvious, which is exactly what an oracle should be.
+tests/test_bls.py checks fast == oracle^3 through the representation
+isomorphism.
+
+This is a from-scratch host implementation of the curve tower
+(Fq -> Fq2 -> Fq12 as polynomials mod w^12 - 2w^6 + 2), the optimal-ate
+pairing (Miller loop + final exponentiation), and BLS sign/verify/
+aggregate.  Verification uses a product-of-Miller-loops multi-pairing
+so an n-signature aggregate costs n+1 Miller loops and ONE final
+exponentiation.
+
+Deviation from the reference ciphersuite: hash-to-G1 uses
+try-and-increment with cofactor clearing rather than RFC 9380's SSWU
+map (same security for signing/verification, not constant-time and not
+cross-implementation compatible — the crypto seam lets a blst-class
+C++ backend replace this without touching callers).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cometbft_tpu.crypto import PrivKey, PubKey
+
+KEY_TYPE = "bls12_381"
+PRIV_KEY_SIZE = 32
+PUB_KEY_SIZE = 96      # G2 compressed (const.go:7)
+SIGNATURE_SIZE = 48    # G1 compressed
+
+# Field and curve parameters (draft-irtf-cfrg-pairing-friendly-curves).
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+H1 = 0x396C8C005555E1568C00AAAB0000AAAB  # G1 cofactor
+BLS_X = 0xD201000000010000  # |x|; the BLS parameter is -x
+
+_G1X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+_G1Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+_G2X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+_G2Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+
+# -- Fq ----------------------------------------------------------------
+
+def _finv(a: int) -> int:
+    return pow(a, -1, P)
+
+
+# -- Fq2: a + b*u, u^2 = -1 --------------------------------------------
+
+def f2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def f2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def f2_mul(a, b):
+    t0 = a[0] * b[0] % P
+    t1 = a[1] * b[1] % P
+    return (
+        (t0 - t1) % P,
+        ((a[0] + a[1]) * (b[0] + b[1]) - t0 - t1) % P,
+    )
+
+
+def f2_sq(a):
+    return f2_mul(a, a)
+
+
+def f2_inv(a):
+    d = _finv((a[0] * a[0] + a[1] * a[1]) % P)
+    return (a[0] * d % P, (-a[1]) * d % P)
+
+
+def f2_neg(a):
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+F2_ZERO = (0, 0)
+F2_ONE = (1, 0)
+_B2 = (4, 4)  # G2 curve constant 4(u+1)
+
+
+def f2_pow(a, e: int):
+    out = F2_ONE
+    while e:
+        if e & 1:
+            out = f2_mul(out, a)
+        a = f2_sq(a)
+        e >>= 1
+    return out
+
+
+def f2_sqrt(a):
+    """sqrt in Fq2 (p^2 ≡ 9 mod 16 algorithm, simple variant)."""
+    if a == F2_ZERO:
+        return F2_ZERO
+    # candidate via a^((p^2+7)/16) ... use generic Tonelli on Fq2 by
+    # exploiting a^((p^2-1)/2) = 1 check and the identity sqrt via
+    # a^((p+1)/4) pattern lifted: try c = a^((p^2+7)/16)*t for small
+    # twists.  Simpler: complex method — sqrt(a0+a1 u) via norms.
+    a0, a1 = a
+    if a1 == 0:
+        # sqrt of an Fq element inside Fq2
+        c = pow(a0, (P + 1) // 4, P)
+        if c * c % P == a0:
+            return (c, 0)
+        # a0 is a QNR in Fq; sqrt is purely imaginary: (i*t)^2 = -t^2
+        t = pow((-a0) % P, (P + 1) // 4, P)
+        if t * t % P == (-a0) % P:
+            return (0, t)
+        return None
+    alpha = (a0 * a0 + a1 * a1) % P  # norm
+    s = pow(alpha, (P + 1) // 4, P)
+    if s * s % P != alpha:
+        return None
+    delta = (a0 + s) * _finv(2) % P
+    x0 = pow(delta, (P + 1) // 4, P)
+    if x0 * x0 % P != delta:
+        delta = (a0 - s) * _finv(2) % P
+        x0 = pow(delta, (P + 1) // 4, P)
+        if x0 * x0 % P != delta:
+            return None
+    x1 = a1 * _finv(2 * x0 % P) % P
+    cand = (x0, x1)
+    return cand if f2_sq(cand) == a else None
+
+
+# -- Fq12 as Fq[w]/(w^12 - 2w^6 + 2) -----------------------------------
+# u (the Fq2 generator) embeds as w^6 - 1.
+
+_F12_LEN = 12
+
+
+def f12_one():
+    c = [0] * 12
+    c[0] = 1
+    return tuple(c)
+
+
+def f12_mul(a, b):
+    t = [0] * 23
+    for i, ai in enumerate(a):
+        if ai:
+            for j, bj in enumerate(b):
+                if bj:
+                    t[i + j] += ai * bj
+    # reduce modulo w^12 = 2w^6 - 2
+    for i in range(22, 11, -1):
+        v = t[i]
+        if v:
+            t[i] = 0
+            t[i - 6] += 2 * v
+            t[i - 12] -= 2 * v
+    return tuple(v % P for v in t[:12])
+
+
+def f12_sq(a):
+    return f12_mul(a, a)
+
+
+def f12_conj(a):
+    """Map w -> -w (the p^6 Frobenius on this modulus): negate odd
+    coefficients."""
+    return tuple((-v) % P if i & 1 else v for i, v in enumerate(a))
+
+
+def f12_pow(a, e: int):
+    out = f12_one()
+    while e:
+        if e & 1:
+            out = f12_mul(out, a)
+        a = f12_sq(a)
+        e >>= 1
+    return out
+
+
+def _poly_deg(p_):
+    d = len(p_) - 1
+    while d and p_[d] == 0:
+        d -= 1
+    return d
+
+
+def _poly_rounded_div(a, b):
+    dega, degb = _poly_deg(a), _poly_deg(b)
+    temp = list(a)
+    out = [0] * len(a)
+    inv_lead = pow(b[degb], -1, P)
+    for i in range(dega - degb, -1, -1):
+        c = temp[degb + i] * inv_lead % P
+        out[i] = (out[i] + c) % P
+        for j in range(degb + 1):
+            temp[j + i] = (temp[j + i] - c * b[j]) % P
+    return out[: _poly_deg(out) + 1]
+
+
+def f12_inv(a):
+    """Extended Euclid on coefficient polynomials modulo
+    w^12 - 2w^6 + 2 (the standard FQP inverse algorithm)."""
+    degree = 12
+    mod = [2, 0, 0, 0, 0, 0, (-2) % P, 0, 0, 0, 0, 0, 1]
+    lm, hm = [1] + [0] * degree, [0] * (degree + 1)
+    low = [v % P for v in a] + [0]
+    high = mod[:]
+    while _poly_deg(low):
+        r = _poly_rounded_div(high, low)
+        r += [0] * (degree + 1 - len(r))
+        nm = list(hm)
+        new = list(high)
+        for i in range(degree + 1):
+            for j in range(degree + 1 - i):
+                nm[i + j] = (nm[i + j] - lm[i] * r[j]) % P
+                new[i + j] = (new[i + j] - low[i] * r[j]) % P
+        lm, low, hm, high = nm, new, lm, low
+    if low[0] == 0:
+        raise ZeroDivisionError("f12 zero inverse")
+    inv0 = pow(low[0], -1, P)
+    return tuple(v * inv0 % P for v in lm[:degree])
+
+
+def _embed_f2(a) -> tuple:
+    """Fq2 (a0 + a1*u) -> Fq12 with u = w^6 - 1."""
+    c = [0] * 12
+    c[0] = (a[0] - a[1]) % P
+    c[6] = a[1] % P
+    return tuple(c)
+
+
+def _embed_fq(x: int) -> tuple:
+    c = [0] * 12
+    c[0] = x % P
+    return tuple(c)
+
+
+def _mul_by_w(a, k: int):
+    """a * w^k"""
+    t = [0] * (12 + k)
+    for i, v in enumerate(a):
+        t[i + k] = v
+    for i in range(len(t) - 1, 11, -1):
+        v = t[i]
+        if v:
+            t[i] = 0
+            t[i - 6] += 2 * v
+            t[i - 12] -= 2 * v
+    return tuple(v % P for v in t[:12])
+
+
+# -- curve points -------------------------------------------------------
+# G1 affine over Fq; G2 affine over Fq2; pairing points over Fq12.
+
+G1_GEN = (_G1X, _G1Y)
+G2_GEN = (_G2X, _G2Y)
+
+
+def g1_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - (pow(x, 3, P) + 4)) % P == 0
+
+
+def g2_is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return f2_sub(f2_sq(y), f2_add(f2_mul(f2_sq(x), x), _B2)) == F2_ZERO
+
+
+# Specialized G1/G2 ops (clearer than forcing one generic path).
+
+def g1_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        lam = 3 * x1 * x1 % P * _finv(2 * y1 % P) % P
+    else:
+        lam = (y2 - y1) * _finv((x2 - x1) % P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def g1_mul(pt, k: int):
+    acc = None
+    while k:
+        if k & 1:
+            acc = g1_add(acc, pt)
+        pt = g1_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def g1_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], (-pt[1]) % P)
+
+
+def g2_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if f2_add(y1, y2) == F2_ZERO:
+            return None
+        lam = f2_mul(
+            f2_mul(f2_sq(x1), (3, 0)), f2_inv(f2_mul(y1, (2, 0)))
+        )
+    else:
+        lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+    x3 = f2_sub(f2_sub(f2_sq(lam), x1), x2)
+    y3 = f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def g2_mul(pt, k: int):
+    acc = None
+    while k:
+        if k & 1:
+            acc = g2_add(acc, pt)
+        pt = g2_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def g2_neg(pt):
+    if pt is None:
+        return None
+    return (pt[0], f2_neg(pt[1]))
+
+
+# -- pairing -----------------------------------------------------------
+
+_W2_INV = None
+_W3_INV = None
+
+
+def _twist_g2(pt):
+    """Map a G2 point on the twist to E(Fq12): (x, y) -> (x/w^2, y/w^3).
+
+    The twist equation y^2 = x^3 + 4(u+1) maps onto E: y^2 = x^3 + 4
+    exactly because w^6 = u + 1 in this tower (u = w^6 - 1)."""
+    global _W2_INV, _W3_INV
+    if pt is None:
+        return None
+    if _W2_INV is None:
+        w = tuple([0, 1] + [0] * 10)
+        _W2_INV = f12_inv(f12_mul(w, w))
+        _W3_INV = f12_inv(f12_mul(f12_mul(w, w), w))
+    x = f12_mul(_embed_f2(pt[0]), _W2_INV)
+    y = f12_mul(_embed_f2(pt[1]), _W3_INV)
+    return (x, y)
+
+
+def _f12_add(a, b):
+    return tuple((x + y) % P for x, y in zip(a, b))
+
+
+def _f12_sub(a, b):
+    return tuple((x - y) % P for x, y in zip(a, b))
+
+
+def _f12_neg(a):
+    return tuple((-x) % P for x in a)
+
+
+def _e12_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if _f12_add(y1, y2) == tuple([0] * 12):
+            return None
+        lam = f12_mul(
+            f12_mul(f12_sq(x1), _embed_fq(3)),
+            f12_inv(f12_mul(y1, _embed_fq(2))),
+        )
+    else:
+        lam = f12_mul(_f12_sub(y2, y1), f12_inv(_f12_sub(x2, x1)))
+    x3 = _f12_sub(_f12_sub(f12_sq(lam), x1), x2)
+    y3 = _f12_sub(f12_mul(lam, _f12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def _line(p1, p2, t):
+    """Evaluate the line through p1,p2 (E(Fq12) points) at t."""
+    x1, y1 = p1
+    x2, y2 = p2
+    xt, yt = t
+    if x1 != x2:
+        m = f12_mul(_f12_sub(y2, y1), f12_inv(_f12_sub(x2, x1)))
+        return _f12_sub(
+            f12_mul(m, _f12_sub(xt, x1)), _f12_sub(yt, y1)
+        )
+    if y1 == y2:
+        m = f12_mul(
+            f12_mul(f12_sq(x1), _embed_fq(3)),
+            f12_inv(f12_mul(y1, _embed_fq(2))),
+        )
+        return _f12_sub(
+            f12_mul(m, _f12_sub(xt, x1)), _f12_sub(yt, y1)
+        )
+    return _f12_sub(xt, x1)
+
+
+def multi_miller_loop(pairs):
+    """Shared Miller loop over [(P in G1, Q in G2), ...]: all pairs'
+    line functions accumulate into ONE value (squarings shared), so a
+    product of n pairings costs n line-work but one loop and one final
+    exponentiation."""
+    prepped = []
+    for p_g1, q_g2 in pairs:
+        if p_g1 is None or q_g2 is None:
+            continue
+        prepped.append(
+            (
+                (_embed_fq(p_g1[0]), _embed_fq(p_g1[1])),
+                _twist_g2(q_g2),
+            )
+        )
+    acc = f12_one()
+    ts = [q for _, q in prepped]
+    for bit in bin(BLS_X)[3:]:
+        acc = f12_sq(acc)
+        for i, (p, q) in enumerate(prepped):
+            acc = f12_mul(acc, _line(ts[i], ts[i], p))
+            ts[i] = _e12_add(ts[i], ts[i])
+        if bit == "1":
+            for i, (p, q) in enumerate(prepped):
+                acc = f12_mul(acc, _line(ts[i], q, p))
+                ts[i] = _e12_add(ts[i], q)
+    # BLS parameter is negative: conjugate the accumulated value
+    return f12_conj(acc)
+
+
+def miller_loop(q_g2, p_g1):
+    return multi_miller_loop([(p_g1, q_g2)])
+
+
+_FINAL_EXP = (P**12 - 1) // R
+
+
+def final_exponentiation(f):
+    return f12_pow(f, _FINAL_EXP)
+
+
+def pairing(p_g1, q_g2):
+    return final_exponentiation(miller_loop(q_g2, p_g1))
+
+
+# -- serialization (ZCash-style compressed encodings) -------------------
+
+_FLAG_COMPRESSED = 0x80
+_FLAG_INFINITY = 0x40
+_FLAG_SIGN = 0x20
+
+
+def g1_to_bytes(pt) -> bytes:
+    if pt is None:
+        out = bytearray(48)
+        out[0] = _FLAG_COMPRESSED | _FLAG_INFINITY
+        return bytes(out)
+    x, y = pt
+    out = bytearray(x.to_bytes(48, "big"))
+    out[0] |= _FLAG_COMPRESSED
+    if y > (P - 1) // 2:
+        out[0] |= _FLAG_SIGN
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes):
+    if len(data) != 48 or not data[0] & _FLAG_COMPRESSED:
+        raise ValueError("bad G1 encoding")
+    if data[0] & _FLAG_INFINITY:
+        if any(data[1:]) or data[0] & ~(
+            _FLAG_COMPRESSED | _FLAG_INFINITY
+        ):
+            raise ValueError("bad G1 infinity encoding")
+        return None
+    x = int.from_bytes(
+        bytes([data[0] & 0x1F]) + data[1:], "big"
+    )
+    if x >= P:
+        raise ValueError("G1 x out of range")
+    y2 = (pow(x, 3, P) + 4) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("G1 x not on curve")
+    if bool(data[0] & _FLAG_SIGN) != (y > (P - 1) // 2):
+        y = P - y
+    pt = (x, y)
+    if g1_mul(pt, R) is not None:
+        raise ValueError("G1 point not in the r-torsion subgroup")
+    return pt
+
+
+def g2_to_bytes(pt) -> bytes:
+    if pt is None:
+        out = bytearray(96)
+        out[0] = _FLAG_COMPRESSED | _FLAG_INFINITY
+        return bytes(out)
+    (x0, x1), (y0, y1) = pt
+    out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    out[0] |= _FLAG_COMPRESSED
+    big = (y1 > (P - 1) // 2) if y1 else (y0 > (P - 1) // 2)
+    if big:
+        out[0] |= _FLAG_SIGN
+    return bytes(out)
+
+
+def g2_from_bytes(data: bytes):
+    if len(data) != 96 or not data[0] & _FLAG_COMPRESSED:
+        raise ValueError("bad G2 encoding")
+    if data[0] & _FLAG_INFINITY:
+        if any(data[1:]):
+            raise ValueError("bad G2 infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x out of range")
+    x = (x0, x1)
+    y2 = f2_add(f2_mul(f2_sq(x), x), _B2)
+    y = f2_sqrt(y2)
+    if y is None:
+        raise ValueError("G2 x not on curve")
+    y0, y1 = y
+    big = (y1 > (P - 1) // 2) if y1 else (y0 > (P - 1) // 2)
+    if bool(data[0] & _FLAG_SIGN) != big:
+        y = f2_neg(y)
+    pt = (x, y)
+    if g2_mul(pt, R) is not None:
+        raise ValueError("G2 point not in the r-torsion subgroup")
+    return pt
+
+
+# -- hashing to G1 ------------------------------------------------------
+
+DST = b"CMT_TPU_BLS_SIG_BLS12381G1_TAI_NUL_"
+
+
+def hash_to_g1(msg: bytes):
+    """Try-and-increment hash to the G1 r-torsion (see module
+    docstring for the deviation note)."""
+    ctr = 0
+    while True:
+        h = hashlib.sha256(DST + ctr.to_bytes(4, "big") + msg).digest()
+        h2 = hashlib.sha256(b"\x01" + h).digest()
+        x = int.from_bytes(h + h2[:16], "big") % P
+        y2 = (pow(x, 3, P) + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2:
+            if h2[16] & 1:
+                y = P - y
+            # clear the cofactor to land in the r-torsion
+            pt = g1_mul((x, y), H1)
+            if pt is not None:
+                return pt
+        ctr += 1
+
+
+# -- BLS signature scheme ----------------------------------------------
+
+class Bls12381PubKey(PubKey):
+    __slots__ = ("_bytes", "_pt")
+
+    def __init__(self, data: bytes):
+        if len(data) != PUB_KEY_SIZE:
+            raise ValueError(f"bls pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._pt = None
+
+    def _point(self):
+        if self._pt is None:
+            self._pt = g2_from_bytes(self._bytes)
+            if self._pt is None:
+                raise ValueError("bls pubkey is the identity")
+        return self._pt
+
+    def address(self) -> bytes:
+        """SHA256(pubkey)[:20] (key_bls12381.go Address via tmhash)."""
+        return hashlib.sha256(self._bytes).digest()[:20]
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """e(H(m), pk) == e(sig, g2) via one multi-pairing."""
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            s = g1_from_bytes(sig)
+            pk = self._point()
+        except ValueError:
+            return False
+        if s is None:
+            return False
+        f = multi_miller_loop(
+            [(hash_to_g1(msg), pk), (g1_neg(s), G2_GEN)]
+        )
+        return final_exponentiation(f) == f12_one()
+
+
+class Bls12381PrivKey(PrivKey):
+    __slots__ = ("_d",)
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIV_KEY_SIZE:
+            raise ValueError(f"bls privkey must be {PRIV_KEY_SIZE} bytes")
+        d = int.from_bytes(data, "big")
+        if not (1 <= d < R):
+            raise ValueError("bls privkey out of range")
+        self._d = d
+
+    def bytes(self) -> bytes:
+        return self._d.to_bytes(32, "big")
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def pub_key(self) -> Bls12381PubKey:
+        return Bls12381PubKey(g2_to_bytes(g2_mul(G2_GEN, self._d)))
+
+    def sign(self, msg: bytes) -> bytes:
+        return g1_to_bytes(g1_mul(hash_to_g1(msg), self._d))
+
+
+def gen_priv_key() -> Bls12381PrivKey:
+    while True:
+        raw = os.urandom(32)
+        d = int.from_bytes(raw, "big")
+        if 1 <= d < R:
+            return Bls12381PrivKey(raw)
+
+
+def priv_key_from_secret(secret: bytes) -> Bls12381PrivKey:
+    d = (
+        int.from_bytes(hashlib.sha512(secret).digest(), "big") % (R - 1)
+    ) + 1
+    return Bls12381PrivKey(d.to_bytes(32, "big"))
+
+
+# -- aggregation (key_bls12381.go:37-38 aggregate APIs) -----------------
+
+def aggregate_signatures(sigs: list[bytes]) -> bytes:
+    """Sum of G1 signature points."""
+    acc = None
+    for sig in sigs:
+        pt = g1_from_bytes(sig)
+        if pt is None:
+            raise ValueError("cannot aggregate the identity signature")
+        acc = g1_add(acc, pt)
+    return g1_to_bytes(acc)
+
+
+def aggregate_pub_keys(pubs: list[Bls12381PubKey]) -> Bls12381PubKey:
+    """Sum of G2 pubkey points (for same-message fast aggregate)."""
+    acc = None
+    for pk in pubs:
+        acc = g2_add(acc, pk._point())
+    return Bls12381PubKey(g2_to_bytes(acc))
+
+
+def aggregate_verify(
+    pubs: list[Bls12381PubKey], msgs: list[bytes], agg_sig: bytes
+) -> bool:
+    """prod_i e(H(m_i), pk_i) == e(aggsig, g2): n+1 Miller loops,
+    one final exponentiation."""
+    if len(pubs) != len(msgs) or not pubs:
+        return False
+    try:
+        s = g1_from_bytes(agg_sig)
+    except ValueError:
+        return False
+    if s is None:
+        return False
+    try:
+        pairs = [
+            (hash_to_g1(msg), pk._point())
+            for pk, msg in zip(pubs, msgs)
+        ]
+    except ValueError:
+        return False
+    pairs.append((g1_neg(s), G2_GEN))
+    f = multi_miller_loop(pairs)
+    return final_exponentiation(f) == f12_one()
+
+
+def fast_aggregate_verify(
+    pubs: list[Bls12381PubKey], msg: bytes, agg_sig: bytes
+) -> bool:
+    """Same-message aggregate: 2 Miller loops total."""
+    if not pubs:
+        return False
+    try:
+        agg_pk = aggregate_pub_keys(pubs)
+    except ValueError:
+        return False
+    return agg_pk.verify_signature(msg, agg_sig)
+
+
+__all__ = [
+    "Bls12381PrivKey",
+    "Bls12381PubKey",
+    "KEY_TYPE",
+    "PRIV_KEY_SIZE",
+    "PUB_KEY_SIZE",
+    "SIGNATURE_SIZE",
+    "aggregate_pub_keys",
+    "aggregate_signatures",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "gen_priv_key",
+    "pairing",
+    "priv_key_from_secret",
+]

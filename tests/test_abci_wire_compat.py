@@ -183,6 +183,22 @@ def _build_pool():
                 "CommitResponse",
                 _field("retain_height", 3, _F.TYPE_INT64),
             ),
+            _msg(
+                "ApplySnapshotChunkResponse",
+                _field("result", 1, _F.TYPE_INT32),
+                _field(
+                    "refetch_chunks",
+                    2,
+                    _F.TYPE_UINT32,
+                    label=_F.LABEL_REPEATED,
+                ),
+                _field(
+                    "reject_senders",
+                    3,
+                    _F.TYPE_STRING,
+                    label=_F.LABEL_REPEATED,
+                ),
+            ),
         ]
     )
     _POOL.Add(fd)
@@ -199,6 +215,7 @@ def _build_pool():
             "Misbehavior",
             "FinalizeBlockRequest",
             "CommitResponse",
+            "ApplySnapshotChunkResponse",
         )
     }
 
@@ -283,3 +300,22 @@ class TestUpstreamWireCompat:
         cours = codec.decode_msg(T.CommitResponse, cref.SerializeToString())
         assert cours.retain_height == 77
         assert codec.encode_msg(cours) == cref.SerializeToString()
+
+    def test_packed_repeated_scalars(self):
+        """proto3 serializes repeated uint32 PACKED (one
+        length-delimited field of concatenated varints); the codec
+        must decode protobuf's packed bytes and emit packed bytes
+        protobuf accepts (statesync chunk refetch depends on it)."""
+        ref = PB["ApplySnapshotChunkResponse"](
+            result=3, refetch_chunks=[1, 2, 300], reject_senders=["a", "b"]
+        )
+        ours = codec.decode_msg(
+            T.ApplySnapshotChunkResponse, ref.SerializeToString()
+        )
+        assert ours.refetch_chunks == (1, 2, 300)
+        assert ours.reject_senders == ("a", "b")
+        back = PB["ApplySnapshotChunkResponse"].FromString(
+            codec.encode_msg(ours)
+        )
+        assert back == ref
+        assert codec.encode_msg(ours) == ref.SerializeToString()

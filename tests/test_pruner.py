@@ -141,3 +141,28 @@ def test_node_prunes_behind_app_retain_height(tmp_path):
                 n.stop()
             except Exception:
                 pass
+
+
+def test_txindexer_prune_keeps_reindexed_hash():
+    """A tx re-indexed at a retained height must survive pruning of
+    its earlier occurrence: the result record is keyed by hash only,
+    so the prune walk must check the record's height before deleting
+    (state/txindex.py prune)."""
+    from cometbft_tpu.abci.types import ExecTxResult
+    from cometbft_tpu.state.txindex import TxIndexer
+    from cometbft_tpu.types.block import tx_hash
+    from cometbft_tpu.utils.db import MemDB
+
+    idx = TxIndexer(MemDB())
+    tx = b"same-bytes"
+    res = ExecTxResult(code=0)
+    idx.index(2, 0, tx, res)
+    idx.index(9, 0, tx, res)  # same hash, newer height wins the record
+    idx.prune(5)
+    rec = idx.get(tx_hash(tx))
+    assert rec is not None and rec["height"] == 9
+    # and a tx only at a pruned height is really gone
+    idx2 = TxIndexer(MemDB())
+    idx2.index(2, 0, b"old-only", res)
+    idx2.prune(5)
+    assert idx2.get(tx_hash(b"old-only")) is None

@@ -1,0 +1,308 @@
+"""Derive the BLS12-381 G2 SSWU 3-isogeny from first principles.
+
+RFC 9380's BLS12381G2_XMD:SHA-256_SSWU_RO_ suite maps into an
+isogenous curve E'': y^2 = x^3 + A''x + B'' over Fq2 (A'' = 240*I,
+B'' = 1012*(1+I), Z = -(2+I)) and then applies a degree-3 isogeny to
+the twist E': y^2 = x^3 + 4(1+I).  The RFC lists the isogeny's
+rational-map coefficients as opaque constants; this script DERIVES
+them instead (zero-egress environment — nothing to paste from):
+
+1. roots of the 3-division polynomial psi_3 of E'' in Fq2 give the
+   x-coordinates of order-3 points;
+2. for each root, Velu's formulas give the unique normalized
+   3-isogeny with that kernel and its codomain A_new/B_new;
+3. the root whose codomain is exactly (0, 4(1+I)) is the RFC kernel
+   (Velu-normalized isogenies are what Sage emits, which is how the
+   suite's constants were produced — see draft-irtf-cfrg-hash-to-curve
+   appendix and Wahby-Boneh 2019);
+4. the y-map of a normalized isogeny is y * phi'(x).
+
+Output: python source for the coefficient tables used by
+cometbft_tpu/crypto/bls_hash_to_g2.py, printed to stdout.
+
+Run: python tools/derive_g2_isogeny.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from cometbft_tpu.crypto.bls12381 import (  # noqa: E402
+    F2_ONE,
+    F2_ZERO,
+    P,
+    f2_add,
+    f2_inv,
+    f2_mul,
+    f2_mul_scalar,
+    f2_neg,
+    f2_sq,
+    f2_sub,
+)
+
+A2 = (0, 240)       # 240*I
+B2 = (1012, 1012)   # 1012*(1+I)
+TARGET_B = (4, 4)   # codomain constant 4*(1+I)
+
+
+# -- dense polynomial helpers over Fq2 (coefficient lists, low->high) --
+
+def pmul(a, b):
+    out = [F2_ZERO] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai == F2_ZERO:
+            continue
+        for j, bj in enumerate(b):
+            out[i + j] = f2_add(out[i + j], f2_mul(ai, bj))
+    return out
+
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    a = a + [F2_ZERO] * (n - len(a))
+    b = b + [F2_ZERO] * (n - len(b))
+    return [f2_add(x, y) for x, y in zip(a, b)]
+
+
+def psub(a, b):
+    n = max(len(a), len(b))
+    a = a + [F2_ZERO] * (n - len(a))
+    b = b + [F2_ZERO] * (n - len(b))
+    return [f2_sub(x, y) for x, y in zip(a, b)]
+
+
+def pscale(a, s):
+    return [f2_mul(x, s) for x in a]
+
+
+def ptrim(a):
+    while len(a) > 1 and a[-1] == F2_ZERO:
+        a = a[:-1]
+    return a
+
+
+def pmod(a, m):
+    """a mod m, m monic-ish (leading coeff inverted)."""
+    a = list(a)
+    dm = len(m) - 1
+    inv_lead = f2_inv(m[-1])
+    while len(a) - 1 >= dm and ptrim(a) != [F2_ZERO]:
+        a = ptrim(a)
+        if len(a) - 1 < dm:
+            break
+        c = f2_mul(a[-1], inv_lead)
+        shift = len(a) - 1 - dm
+        for i, mi in enumerate(m):
+            a[shift + i] = f2_sub(a[shift + i], f2_mul(c, mi))
+        a = a[:-1]
+    return ptrim(a)
+
+
+def pgcd(a, b):
+    a, b = ptrim(a), ptrim(b)
+    while b != [F2_ZERO]:
+        a, b = b, pmod(a, b)
+    # make monic
+    return pscale(a, f2_inv(a[-1]))
+
+
+def ppow_mod(base, e, m):
+    out = [F2_ONE]
+    base = pmod(base, m)
+    while e:
+        if e & 1:
+            out = pmod(pmul(out, base), m)
+        base = pmod(pmul(base, base), m)
+        e >>= 1
+    return out
+
+
+def peval(a, x):
+    acc = F2_ZERO
+    for c in reversed(a):
+        acc = f2_add(f2_mul(acc, x), c)
+    return acc
+
+
+def find_roots(poly):
+    """All roots of poly in Fq2 (Cantor-Zassenhaus, char != 2)."""
+    import random
+
+    q = P * P
+    poly = pscale(ptrim(poly), f2_inv(ptrim(poly)[-1]))
+    # keep only the part splitting over Fq2
+    xq = ppow_mod([F2_ZERO, F2_ONE], q, poly)
+    lin = pgcd(psub(xq, [F2_ZERO, F2_ONE]), poly)
+    roots = []
+
+    def split(f):
+        f = pscale(ptrim(f), f2_inv(ptrim(f)[-1]))
+        d = len(f) - 1
+        if d == 0:
+            return
+        if d == 1:
+            roots.append(f2_neg(f[0]))
+            return
+        while True:
+            r = (random.randrange(P), random.randrange(P))
+            h = ppow_mod(padd([F2_ZERO, F2_ONE], [r]), (q - 1) // 2, f)
+            g = pgcd(psub(h, [F2_ONE]), f)
+            if 0 < len(g) - 1 < d:
+                split(g)
+                split(pdiv_exact(f, g))
+                return
+
+    def pdiv_exact(a, b):
+        out = [F2_ZERO] * (len(a) - len(b) + 1)
+        a = list(a)
+        inv_lead = f2_inv(b[-1])
+        for i in range(len(a) - len(b), -1, -1):
+            c = f2_mul(a[len(b) - 1 + i], inv_lead)
+            out[i] = c
+            for j, bj in enumerate(b):
+                a[i + j] = f2_sub(a[i + j], f2_mul(c, bj))
+        return ptrim(out)
+
+    split(lin)
+    return roots
+
+
+def derive():
+    # psi_3 = 3x^4 + 6Ax^2 + 12Bx - A^2 for y^2 = x^3 + Ax + B
+    psi3 = [
+        f2_neg(f2_sq(A2)),
+        f2_mul_scalar(B2, 12),
+        f2_mul_scalar(A2, 6),
+        F2_ZERO,
+        (3, 0),
+    ]
+    roots = find_roots(psi3)
+    print(f"# psi_3 roots in Fq2: {len(roots)}", file=sys.stderr)
+    for x0 in roots:
+        # Velu, kernel {O, (x0, +-y0)}:
+        #   t = 2*(3 x0^2 + A); u = 4*(x0^3 + A x0 + B); w = u + x0 t
+        #   codomain: A_new = A - 5t, B_new = B - 7w
+        gx = f2_add(f2_mul_scalar(f2_sq(x0), 3), A2)
+        t = f2_add(gx, gx)
+        u = f2_mul_scalar(
+            f2_add(f2_add(f2_mul(f2_sq(x0), x0), f2_mul(A2, x0)), B2), 4
+        )
+        w = f2_add(u, f2_mul(x0, t))
+        a_new = f2_sub(A2, f2_mul_scalar(t, 5))
+        b_new = f2_sub(B2, f2_mul_scalar(w, 7))
+        print(f"# root {x0}: codomain A={a_new} B={b_new}", file=sys.stderr)
+        if a_new == F2_ZERO:
+            break
+    else:
+        raise SystemExit(
+            "no kernel maps to a j=0 curve: remembered A''/B'' wrong?"
+        )
+
+    # The Velu-normalized codomain is y^2 = x^3 + b_new; compose with
+    # the isomorphism (x, y) -> (s^2 x, s^3 y) where s^6 = 4(1+I)/b_new
+    # to land exactly on E'.  (Here b_new = 2916(1+I) = 729 * 4(1+I),
+    # so s = 1/3; the sign of s — equivalently composing with point
+    # negation — is the one freedom RFC vectors would pin down.)
+    ratio = f2_mul(TARGET_B, f2_inv(b_new))
+    assert ratio[1] == 0, f"non-rational scaling {ratio}"
+    for k in range(1, 10000):
+        if ratio[0] == pow(k, -6, P):
+            s = pow(k, -1, P)
+            break
+    else:
+        raise SystemExit("no small rational 6th root for the isomorphism")
+    s2 = (pow(s, 2, P), 0)
+    s3 = (pow(s, 3, P), 0)
+
+    # x-map: phi(x) = s^2 * [x (x-x0)^2 + t (x-x0) + u] / (x-x0)^2
+    h = [f2_neg(x0), F2_ONE]           # x - x0
+    h2 = pmul(h, h)
+    xnum_v = padd(padd(pmul([F2_ZERO, F2_ONE], h2), pscale(h, t)), [u])
+    # y-map: s^3 * y * phi_v'(x) = s^3 y (xnum_v' h - 2 xnum_v h') / h^3
+    dxnum = [f2_mul_scalar(c, i) for i, c in enumerate(xnum_v)][1:]
+    ynum_v = psub(pmul(dxnum, h), pscale(xnum_v, (2, 0)))
+    xnum = pscale(xnum_v, s2)
+    xden = h2
+    ynum = pscale(ynum_v, s3)
+    yden = pmul(h2, h)
+
+    # sanity: evaluate on a point of E'' and check the image is on E'
+    # (needs a point: find x with x^3+Ax+B square in Fq2)
+    from cometbft_tpu.crypto.bls12381 import f2_sqrt
+
+    x = (5, 3)
+    while True:
+        rhs = f2_add(f2_add(f2_mul(f2_sq(x), x), f2_mul(A2, x)), B2)
+        y = f2_sqrt(rhs)
+        if y is not None:
+            break
+        x = (x[0] + 1, x[1])
+    def ephi(pt):
+        if pt is None:
+            return None
+        xx, yy = pt
+        if peval(xden, xx) == F2_ZERO:
+            return None  # kernel -> identity
+        xo = f2_mul(peval(xnum, xx), f2_inv(peval(xden, xx)))
+        yo = f2_mul(yy, f2_mul(peval(ynum, xx), f2_inv(peval(yden, xx))))
+        return (xo, yo)
+
+    def epp_add(p1, p2):
+        """Affine addition on E'' (a != 0 so the module's a=0 Jacobian
+        formulas don't apply here)."""
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        (x1, y1), (x2, y2) = p1, p2
+        if x1 == x2:
+            if f2_add(y1, y2) == F2_ZERO:
+                return None
+            lam = f2_mul(
+                f2_add(f2_mul_scalar(f2_sq(x1), 3), A2),
+                f2_inv(f2_add(y1, y1)),
+            )
+        else:
+            lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+        x3 = f2_sub(f2_sub(f2_sq(lam), x1), x2)
+        return (x3, f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1))
+
+    def ep_add(p1, p2):
+        """Affine addition on E' (b = 4(1+I))."""
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        (x1, y1), (x2, y2) = p1, p2
+        if x1 == x2:
+            if f2_add(y1, y2) == F2_ZERO:
+                return None
+            lam = f2_mul(f2_mul_scalar(f2_sq(x1), 3), f2_inv(f2_add(y1, y1)))
+        else:
+            lam = f2_mul(f2_sub(y2, y1), f2_inv(f2_sub(x2, x1)))
+        x3 = f2_sub(f2_sub(f2_sq(lam), x1), x2)
+        return (x3, f2_sub(f2_mul(lam, f2_sub(x1, x3)), y1))
+
+    pt1 = (x, y)
+    img1 = ephi(pt1)
+    lhs = f2_sq(img1[1])
+    rhs = f2_add(f2_mul(f2_sq(img1[0]), img1[0]), (4, 4))
+    assert lhs == rhs, "image not on E': derivation bug"
+    # homomorphism: phi(P+P) == phi(P) + phi(P)
+    assert ephi(epp_add(pt1, pt1)) == ep_add(img1, img1), "not a homomorphism"
+    # kernel maps to identity: (x0, y0) has phi undefined (pole)
+    print("# image-on-curve + homomorphism checks passed", file=sys.stderr)
+
+    def fmt(coeffs, name):
+        rows = ",\n    ".join(f"({c[0]:#x}, {c[1]:#x})" for c in coeffs)
+        return f"{name} = (\n    {rows},\n)"
+
+    print("# Derived by tools/derive_g2_isogeny.py — do not edit by hand.")
+    print(fmt(xnum, "ISO3_XNUM"))
+    print(fmt(xden, "ISO3_XDEN"))
+    print(fmt(ynum, "ISO3_YNUM"))
+    print(fmt(yden, "ISO3_YDEN"))
+
+
+if __name__ == "__main__":
+    derive()
