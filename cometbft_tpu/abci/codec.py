@@ -11,8 +11,8 @@ integers are plain varints with 64-bit two's complement for negatives
 google.protobuf.Timestamp/Duration messages, ConsensusParams is the
 nested cometbft.types.v1.ConsensusParams message, and zero values are
 omitted — so external ABCI apps speaking the upstream protocol
-interoperate on the wire. Unsupported corners are documented inline
-(QueryResponse.proof_ops is never emitted).
+interoperate on the wire (including QueryResponse.proof_ops as the
+upstream ProofOps wrapper message).
 """
 
 from __future__ import annotations
@@ -195,6 +195,11 @@ _SPEC: dict[type, list] = {
         _f(4, "last_block_height", "int"),
         _f(5, "last_block_app_hash", "bytes"),
     ],
+    T.ProofOp: [
+        _f(1, "type", "str"),
+        _f(2, "key", "bytes"),
+        _f(3, "data", "bytes"),
+    ],
     T.QueryResponse: [
         _f(1, "code", "int"),
         # field 2 reserved upstream (data; use value)
@@ -203,7 +208,8 @@ _SPEC: dict[type, list] = {
         _f(5, "index", "int"),
         _f(6, "key", "bytes"),
         _f(7, "value", "bytes"),
-        # proof_ops (field 8) intentionally unsupported on the wire
+        # ProofOps wrapper message: repeated ProofOp ops = 1
+        _f(8, "proof_ops", "proofops"),
         _f(9, "height", "int"),
         _f(10, "codespace", "str"),
     ],
@@ -440,6 +446,12 @@ def encode_msg(obj) -> bytes:
         elif kind == "params":
             if v is not None:
                 w.message(no, _encode_params(v))
+        elif kind == "proofops":
+            if v:
+                inner = ProtoWriter()
+                for op in v:
+                    inner.message(1, encode_msg(op))
+                w.message(no, inner.finish())
         elif kind == "rep_bytes":
             for item in v:
                 w.bytes_(no, bytes(item))
@@ -533,6 +545,15 @@ def decode_msg(cls: type, raw: bytes):
                 kwargs[attr] = (
                     _decode_params(_as_bytes(vals[0])) if vals else None
                 )
+            elif kind == "proofops":
+                ops: tuple = ()
+                if vals:
+                    inner = ProtoReader(_as_bytes(vals[0])).to_dict()
+                    ops = tuple(
+                        decode_msg(T.ProofOp, _as_bytes(raw_op))
+                        for raw_op in inner.get(1, [])
+                    )
+                kwargs[attr] = ops
             elif kind == "rep_bytes":
                 kwargs[attr] = tuple(_as_bytes(v) for v in (vals or []))
             elif kind == "rep_str":

@@ -15,6 +15,8 @@ import base64
 import hashlib
 import json
 
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.merkle import kv_leaf as state_leaf
 from cometbft_tpu.abci.types import (
     Application,
     ApplySnapshotChunkRequest,
@@ -38,6 +40,7 @@ from cometbft_tpu.abci.types import (
     OfferSnapshotRequest,
     OfferSnapshotResponse,
     OfferSnapshotResult,
+    ProofOp,
     ProcessProposalRequest,
     ProcessProposalResponse,
     ProposalStatus,
@@ -97,15 +100,19 @@ class KVStoreApp(Application):
             ).encode(),
         )
 
+    def _state_leaves(self) -> list[bytes]:
+        """Deterministic leaf list: one length-prefixed k/v pair per
+        sorted key. The app hash is the RFC-6962 merkle root over
+        these, so /abci_query can serve inclusion proofs that a
+        proof-verifying light RPC client checks against the verified
+        header's app_hash (light/rpc.py)."""
+        return [
+            state_leaf(k.encode(), self._kv[k].encode())
+            for k in sorted(self._kv)
+        ]
+
     def _compute_hash(self) -> bytes:
-        h = hashlib.sha256()
-        h.update(self._height.to_bytes(8, "big"))
-        for k in sorted(self._kv):
-            h.update(k.encode())
-            h.update(b"\x00")
-            h.update(self._kv[k].encode())
-            h.update(b"\x01")
-        return h.digest()
+        return merkle.hash_from_byte_slices(self._state_leaves())
 
     # -- tx parsing ----------------------------------------------------
 
@@ -262,8 +269,25 @@ class KVStoreApp(Application):
             return QueryResponse(
                 code=0, log="does not exist", key=req.data, height=self._height
             )
+        proof_ops: tuple = ()
+        if req.prove:
+            keys = sorted(self._kv)
+            leaves = [
+                state_leaf(k.encode(), self._kv[k].encode()) for k in keys
+            ]
+            _, proofs = merkle.proofs_from_byte_slices(leaves)
+            proof_ops = (
+                ProofOp(
+                    type=merkle.KV_PROOF_OP_TYPE,
+                    key=req.data,
+                    data=merkle.proof_to_bytes(proofs[keys.index(key)]),
+                ),
+            )
         return QueryResponse(
-            key=req.data, value=value.encode(), height=self._height
+            key=req.data,
+            value=value.encode(),
+            height=self._height,
+            proof_ops=proof_ops,
         )
 
     # -- snapshots -----------------------------------------------------

@@ -191,6 +191,17 @@ class QueryRequest:
 
 
 @dataclass(frozen=True)
+class ProofOp:
+    """One step of a query proof chain (crypto/proof.proto ProofOp):
+    opaque to the node, interpreted by the proof-verifying light RPC
+    client against the verified header's app_hash."""
+
+    type: str = ""
+    key: bytes = b""
+    data: bytes = b""
+
+
+@dataclass(frozen=True)
 class QueryResponse:
     code: int = CODE_TYPE_OK
     log: str = ""

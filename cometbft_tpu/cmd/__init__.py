@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import sys
 import os
 import shutil
 import signal
@@ -214,6 +215,80 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """(light/cmd: cometbft light) — run a proof-verifying proxy.
+
+    Verifies everything it serves against the subjective root of trust
+    (--trusted-height/--trusted-hash) via the light client, with
+    witness cross-checking when --witness addresses are given."""
+    from cometbft_tpu.light.client import (
+        SEQUENTIAL,
+        SKIPPING,
+        Client,
+        TrustOptions,
+    )
+    from cometbft_tpu.light.proxy import Proxy
+    from cometbft_tpu.light.provider import HTTPProvider
+    from cometbft_tpu.light.rpc import VerifyingClient
+    from cometbft_tpu.light.store import LightStore
+    from cometbft_tpu.rpc.client import HTTPClient
+    from cometbft_tpu.utils.db import SQLiteDB
+
+    home = os.path.join(args.home, "light")
+    os.makedirs(home, exist_ok=True)
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [
+        HTTPProvider(args.chain_id, w)
+        for w in args.witness.split(",")
+        if w.strip()
+    ]
+    light = Client(
+        chain_id=args.chain_id,
+        trust_options=TrustOptions(
+            period_ns=int(args.trust_period * 1e9),
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        ),
+        primary=primary,
+        witnesses=witnesses,
+        trusted_store=LightStore(
+            SQLiteDB(os.path.join(home, "trust.db"))
+        ),
+        verification_mode=SEQUENTIAL if args.sequential else SKIPPING,
+    )
+    base = args.primary if "://" in args.primary else f"http://{args.primary}"
+    node = HTTPClient(base)
+    host_port = args.laddr.split("://")[-1]
+    host, _, port = host_port.rpartition(":")
+    if not host:  # no port given: "tcp://0.0.0.0" or bare host
+        host, port = host_port, ""
+    try:
+        port_no = int(port) if port else 8888
+    except ValueError:
+        print(f"invalid --laddr port: {port!r}", file=sys.stderr)
+        return 1
+    proxy = Proxy(
+        VerifyingClient(node, light),
+        host=host or "127.0.0.1",
+        port=port_no,
+    )
+    proxy.start()
+    print(f"light proxy listening on {proxy.port}")
+    stop = {"done": False}
+
+    def handle(signum, frame):
+        stop["done"] = True
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    import time as _time
+
+    while not stop["done"]:
+        _time.sleep(0.2)
+    proxy.stop()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -314,6 +389,25 @@ def main(argv: list[str] | None = None) -> int:
     ):
         p = sub.add_parser(name)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "light",
+        help="run a proof-verifying light proxy against a full node",
+    )
+    p.add_argument("chain_id")
+    p.add_argument("--primary", required=True,
+                   help="primary full-node RPC address")
+    p.add_argument("--witness", default="",
+                   help="comma-separated witness RPC addresses")
+    p.add_argument("--trusted-height", type=int, required=True)
+    p.add_argument("--trusted-hash", required=True,
+                   help="hex header hash at the trusted height")
+    p.add_argument("--trust-period", type=float, default=168 * 3600,
+                   help="trusting period in seconds")
+    p.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    p.add_argument("--sequential", action="store_true",
+                   help="sequential verification instead of skipping")
+    p.set_defaults(fn=cmd_light)
 
     p = sub.add_parser("testnet", help="generate a localnet")
     p.add_argument("--v", type=int, default=4)

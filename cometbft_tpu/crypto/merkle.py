@@ -152,3 +152,50 @@ def _trails_from_byte_slices(
     right_root.parent = root
     right_root.left = left_root
     return lefts + rights, root
+
+
+# -- key/value state proofs (the framework's query-proof format) --------
+#
+# The reference chains ics23/ProofOperators through crypto/merkle/
+# proof_op.go; this framework's native format is simpler: ONE ProofOp
+# whose data is a serialized inclusion Proof for the canonical
+# key/value leaf below, verified directly against the header app_hash.
+
+#: ProofOp.type for a simple-merkle k/v inclusion proof.
+KV_PROOF_OP_TYPE = "cmttpu:simple-merkle:v1"
+
+
+def kv_leaf(key: bytes, value: bytes) -> bytes:
+    """Canonical state leaf: uvarint-length-prefixed key then value.
+    Apps hash sorted leaves into their app_hash; the proof-verifying
+    RPC client rebuilds the leaf from the query response."""
+    from cometbft_tpu.utils.protoio import encode_uvarint
+
+    return (
+        encode_uvarint(len(key)) + key + encode_uvarint(len(value)) + value
+    )
+
+
+def proof_to_bytes(p: Proof) -> bytes:
+    from cometbft_tpu.utils.protoio import ProtoWriter
+
+    w = ProtoWriter()
+    w.varint(1, p.total)
+    w.varint(2, p.index)
+    w.bytes_(3, p.leaf_hash)
+    for aunt in p.aunts:
+        w.bytes_(4, aunt)
+    return w.finish()
+
+
+def proof_from_bytes(data: bytes) -> Proof:
+    from cometbft_tpu.utils.protoio import ProtoReader
+
+    f = ProtoReader(bytes(data)).to_dict()
+    total = int(f.get(1, [0])[0])
+    index = int(f.get(2, [0])[0])
+    leaf = bytes(f.get(3, [b""])[0])
+    aunts = [bytes(a) for a in f.get(4, [])]
+    if total < 0 or index < 0 or len(aunts) > Proof.MAX_AUNTS:
+        raise ValueError("malformed merkle proof")
+    return Proof(total=total, index=index, leaf_hash=leaf, aunts=aunts)

@@ -1,0 +1,85 @@
+"""Light proxy — a local JSON-RPC endpoint that serves verified
+answers (reference: light/proxy/proxy.go:27).
+
+`Proxy` runs a JSONRPCServer whose routes go through a
+:class:`~cometbft_tpu.light.rpc.VerifyingClient`, so anything an RPC
+consumer reads from it (query results, blocks, commits, validator
+sets) has been checked against the light client's verified header
+chain.  This is the reference's flagship trust-minimized deployment:
+point wallets/explorers at the proxy instead of a remote full node.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.light.client import LightClientError
+from cometbft_tpu.light.rpc import VerifyingClient
+from cometbft_tpu.rpc.jsonrpc import JSONRPCServer, RPCError
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+
+
+class Proxy(BaseService):
+    """(light/proxy/proxy.go Proxy)"""
+
+    def __init__(
+        self,
+        client: VerifyingClient,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        logger: Logger | None = None,
+    ):
+        super().__init__(
+            name="light-proxy",
+            logger=logger
+            or default_logger().with_fields(module="light-proxy"),
+        )
+        self.client = client
+        self._server = JSONRPCServer(
+            routes=self._routes(),
+            host=host,
+            port=port,
+            logger=self.logger,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def _wrap(self, fn):
+        def route(**params):
+            try:
+                return fn(**params)
+            except LightClientError as exc:
+                raise RPCError(-32000, "light client verification failed",
+                               str(exc)) from exc
+
+        return route
+
+    def _routes(self) -> dict:
+        c = self.client
+        return {
+            "status": self._wrap(lambda **_: c.status()),
+            "abci_query": self._wrap(c.abci_query),
+            "block": self._wrap(c.block),
+            "header": self._wrap(c.header),
+            "commit": self._wrap(c.commit),
+            "validators": self._wrap(c.validators),
+            "light_trusted": self._wrap(self._trusted),
+        }
+
+    def _trusted(self, **_) -> dict:
+        """Framework extra: the light client's current trusted head."""
+        lb = self.client.light.latest_trusted()
+        if lb is None:
+            raise RPCError(-32603, "no trusted state yet")
+        return {
+            "height": str(lb.height),
+            "hash": lb.signed_header.header.hash().hex(),
+        }
+
+    def on_start(self) -> None:
+        self._server.start()
+        self.logger.info("light proxy listening", port=self.port)
+
+    def on_stop(self) -> None:
+        self._server.stop()

@@ -711,15 +711,25 @@ class Environment:
                 prove=bool(prove),
             )
         )
-        return {
-            "response": {
-                "code": resp.code,
-                "log": resp.log,
-                "key": b64(resp.key) if resp.key else None,
-                "value": b64(resp.value) if resp.value else None,
-                "height": str(resp.height),
-            }
+        out = {
+            "code": resp.code,
+            "log": resp.log,
+            "key": b64(resp.key) if resp.key else None,
+            "value": b64(resp.value) if resp.value else None,
+            "height": str(resp.height),
         }
+        if resp.proof_ops:
+            out["proofOps"] = {
+                "ops": [
+                    {
+                        "type": op.type,
+                        "key": b64(op.key),
+                        "data": b64(op.data),
+                    }
+                    for op in resp.proof_ops
+                ]
+            }
+        return {"response": out}
 
     def abci_info(self) -> dict:
         resp = self.proxy_app.query.info(InfoRequest())

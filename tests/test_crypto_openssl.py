@@ -1,0 +1,125 @@
+"""Differential tests of the from-scratch ECDSA/secp256k1 and ed25519
+implementations against OpenSSL (via the `cryptography` package) —
+an independent oracle, unlike hand-copied vectors.
+
+Covers the advisor finding that consensus-adjacent crypto
+(crypto/secp256k1.py) shipped without known-answer coverage:
+cross-signing both directions, pubkey interop, RFC 6979 determinism,
+and the reference's low-S rule (secp256k1.go:118,130).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+cryptography = pytest.importorskip("cryptography")
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric import ed25519 as ossl_ed
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import secp256k1 as sk
+
+N = sk.N
+
+
+def _ossl_pub_from_ours(pk: sk.Secp256k1PubKey) -> ec.EllipticCurvePublicKey:
+    return ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), pk.bytes()
+    )
+
+
+class TestSecp256k1VsOpenSSL:
+    def test_our_signature_verifies_in_openssl(self):
+        priv = sk.priv_key_from_secret(b"interop-1")
+        msg = b"cross-implementation message"
+        sig = priv.sign(msg)
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        opub = _ossl_pub_from_ours(priv.pub_key())
+        # raises InvalidSignature on mismatch
+        opub.verify(
+            encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+        )
+
+    def test_openssl_signature_verifies_in_ours(self):
+        opriv = ec.derive_private_key(
+            int.from_bytes(hashlib.sha256(b"interop-2").digest(), "big")
+            % (N - 1)
+            + 1,
+            ec.SECP256K1(),
+        )
+        msg = b"signed by openssl"
+        der = opriv.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > N // 2:  # we enforce the reference's low-S rule
+            s = N - s
+        sig64 = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        raw_pub = opriv.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        ours = sk.Secp256k1PubKey(raw_pub)
+        assert ours.verify_signature(msg, sig64)
+        assert not ours.verify_signature(msg + b"x", sig64)
+
+    def test_pubkey_derivation_matches_openssl(self):
+        for seed in (b"a", b"b", b"c"):
+            priv = sk.priv_key_from_secret(seed)
+            opriv = ec.derive_private_key(priv._d, ec.SECP256K1())
+            raw = opriv.public_key().public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.CompressedPoint,
+            )
+            assert priv.pub_key().bytes() == raw
+
+    def test_rfc6979_determinism(self):
+        priv = sk.priv_key_from_secret(b"det")
+        assert priv.sign(b"m") == priv.sign(b"m")
+        assert priv.sign(b"m") != priv.sign(b"n")
+
+    def test_low_s_enforced(self):
+        priv = sk.priv_key_from_secret(b"lows")
+        pub = priv.pub_key()
+        msg = b"malleability"
+        sig = priv.sign(msg)
+        r = sig[:32]
+        s = int.from_bytes(sig[32:], "big")
+        assert s <= N // 2  # we always emit low-S
+        high = (N - s).to_bytes(32, "big")
+        assert not pub.verify_signature(msg, r + high)
+
+    def test_degenerate_signatures_rejected(self):
+        pub = sk.priv_key_from_secret(b"x").pub_key()
+        zero = b"\x00" * 32
+        assert not pub.verify_signature(b"m", zero + zero)
+        big = N.to_bytes(32, "big")
+        assert not pub.verify_signature(b"m", big + b"\x01".rjust(32, b"\x00"))
+        assert not pub.verify_signature(b"m", b"short")
+
+
+class TestEd25519VsOpenSSL:
+    def test_cross_verification_both_directions(self):
+        ours = ed.priv_key_from_secret(b"ed-interop")
+        opriv = ossl_ed.Ed25519PrivateKey.from_private_bytes(
+            ours.bytes()[:32]
+        )
+        opub_raw = opriv.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        assert ours.pub_key().bytes() == opub_raw
+        msg = b"ed25519 cross check"
+        # ours -> openssl
+        opriv.public_key().verify(ours.sign(msg), msg)
+        # openssl -> ours
+        assert ours.pub_key().verify_signature(msg, opriv.sign(msg))
+        with pytest.raises(InvalidSignature):
+            opriv.public_key().verify(ours.sign(msg), msg + b"!")

@@ -1,0 +1,222 @@
+"""Light proxy + proof-verifying RPC client e2e
+(reference: light/proxy/proxy.go, light/rpc/client.go).
+
+A real localnet serves JSON-RPC over HTTP; a light proxy in front of
+it answers `abci_query` only after checking the kvstore app's merkle
+proof against the light-client-verified header app_hash, and rejects
+tampered values/proofs."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.proxy import Proxy
+from cometbft_tpu.light.provider import HTTPProvider
+from cometbft_tpu.light.rpc import ProofError, VerifyingClient
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.rpc.client import HTTPClient
+from cometbft_tpu.rpc.jsonrpc import RPCError
+from cometbft_tpu.utils.db import MemDB
+
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+WEEK_NS = 100 * 365 * 24 * 3600 * 10**9
+CHAIN = "reactor-test-chain"
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """2-node localnet, node0 with an HTTP RPC server; one kvstore tx
+    committed; chain advanced a couple of blocks past it."""
+    tmp = tmp_path_factory.mktemp("lightproxy")
+
+    def configure(i, cfg):
+        if i == 0:
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+
+    nodes, privs, gen = make_localnet(tmp, 2, configure=configure)
+    for n in nodes:
+        n.start()
+    connect_star(nodes)
+    wait_all_height(nodes, 2)
+    rpc = HTTPClient(f"http://127.0.0.1:{nodes[0].rpc_server.port}")
+    rpc.broadcast_tx_sync(tx=b"proxykey=proxyval".hex())
+    deadline = time.monotonic() + 30
+    txh = None
+    while time.monotonic() < deadline:
+        resp = rpc.abci_query(data=b"proxykey".hex())["response"]
+        if resp.get("value"):
+            txh = int(resp["height"])
+            break
+        time.sleep(0.2)
+    assert txh is not None, "tx never committed"
+    wait_all_height(nodes, txh + 2)
+    yield nodes, rpc
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+def _light_for(nodes, rpc):
+    meta = nodes[0].block_store.load_block_meta(1)
+    return Client(
+        chain_id=CHAIN,
+        trust_options=TrustOptions(
+            period_ns=WEEK_NS, height=1, hash=meta.block_id.hash
+        ),
+        primary=HTTPProvider(
+            CHAIN, f"127.0.0.1:{nodes[0].rpc_server.port}"
+        ),
+        witnesses=[],
+        trusted_store=LightStore(MemDB()),
+    )
+
+
+class TestVerifyingClient:
+    def test_abci_query_with_verified_proof(self, net):
+        nodes, rpc = net
+        vc = VerifyingClient(rpc, _light_for(nodes, rpc))
+        out = vc.abci_query(data=b"proxykey".hex())
+        import base64
+
+        assert base64.b64decode(out["response"]["value"]) == b"proxyval"
+        assert out["verified_height"] >= 1
+
+    def test_absent_key_is_not_silently_trusted(self, net):
+        nodes, rpc = net
+        vc = VerifyingClient(rpc, _light_for(nodes, rpc))
+        with pytest.raises(ProofError):
+            vc.abci_query(data=b"missing-key".hex())
+
+    def test_tampered_value_rejected(self, net):
+        nodes, rpc = net
+
+        class Tamper:
+            def __getattr__(self, name):
+                real = getattr(rpc, name)
+
+                def call(**kw):
+                    out = real(**kw)
+                    if name == "abci_query":
+                        import base64
+
+                        out["response"]["value"] = base64.b64encode(
+                            b"evil"
+                        ).decode()
+                    return out
+
+                return call
+
+        vc = VerifyingClient(Tamper(), _light_for(nodes, rpc))
+        with pytest.raises(ProofError):
+            vc.abci_query(data=b"proxykey".hex())
+
+    def test_block_and_validators_verified(self, net):
+        nodes, rpc = net
+        vc = VerifyingClient(rpc, _light_for(nodes, rpc))
+        blk = vc.block(height=2)
+        assert int(blk["block"]["header"]["height"]) == 2
+        vals = vc.validators(height=2)
+        assert len(vals["validators"]) == 2
+        cm = vc.commit(height=2)
+        assert int(cm["signed_header"]["header"]["height"]) == 2
+
+
+class TestProxy:
+    def test_proxy_serves_verified_queries_over_http(self, net):
+        nodes, rpc = net
+        proxy = Proxy(VerifyingClient(rpc, _light_for(nodes, rpc)))
+        proxy.start()
+        try:
+            cli = HTTPClient(f"http://127.0.0.1:{proxy.port}")
+            out = cli.abci_query(data=b"proxykey".hex())
+            import base64
+
+            assert base64.b64decode(out["response"]["value"]) == b"proxyval"
+            trusted = cli.light_trusted()
+            assert int(trusted["height"]) >= 1
+            # absent key surfaces as a structured RPC error, not a 500
+            with pytest.raises(RPCError):
+                cli.abci_query(data=b"nope".hex())
+            st = cli.status()
+            assert st
+        finally:
+            proxy.stop()
+
+
+class TestReviewRegressions:
+    def test_empty_value_verifies_with_proof(self, net):
+        """A key set to the empty string is provable and must verify
+        (inclusion proof for kv_leaf(key, b'')), not read as absence."""
+        nodes, rpc = net
+        rpc.broadcast_tx_sync(tx=b"emptykey=".hex())
+        deadline = time.monotonic() + 30
+        h = None
+        while time.monotonic() < deadline:
+            resp = rpc.abci_query(data=b"emptykey".hex(), prove=True)[
+                "response"
+            ]
+            ops = (resp.get("proofOps") or {}).get("ops")
+            if ops:
+                h = int(resp["height"])
+                break
+            time.sleep(0.2)
+        assert h is not None, "empty-value tx never committed"
+        vc = VerifyingClient(rpc, _light_for(nodes, rpc))
+        out = vc.abci_query(data=b"emptykey".hex())
+        assert out["verified_height"] >= h
+
+    def test_tampered_commit_signatures_rejected(self, net):
+        nodes, rpc = net
+
+        class TamperCommit:
+            def __getattr__(self, name):
+                real = getattr(rpc, name)
+
+                def call(**kw):
+                    out = real(**kw)
+                    if name == "commit":
+                        for s in out["signed_header"]["commit"][
+                            "signatures"
+                        ]:
+                            if s.get("signature"):
+                                import base64
+
+                                s["signature"] = base64.b64encode(
+                                    b"\x01" * 64
+                                ).decode()
+                    return out
+
+                return call
+
+        vc = VerifyingClient(TamperCommit(), _light_for(nodes, rpc))
+        with pytest.raises(ProofError):
+            vc.commit(height=2)
+
+    def test_tampered_block_txs_rejected(self, net):
+        nodes, rpc = net
+
+        class TamperBlock:
+            def __getattr__(self, name):
+                real = getattr(rpc, name)
+
+                def call(**kw):
+                    out = real(**kw)
+                    if name == "block":
+                        import base64
+
+                        out["block"]["data"] = {
+                            "txs": [base64.b64encode(b"forged=1").decode()]
+                        }
+                    return out
+
+                return call
+
+        vc = VerifyingClient(TamperBlock(), _light_for(nodes, rpc))
+        with pytest.raises(ProofError):
+            vc.block(height=2)
