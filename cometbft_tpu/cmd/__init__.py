@@ -289,6 +289,36 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_load(args) -> int:
+    """(test/loadtime/cmd/load) — generate timestamped tx load."""
+    from cometbft_tpu.loadtime import Loader
+
+    loader = Loader(
+        endpoints=[e for e in args.endpoints.split(",") if e.strip()],
+        rate=args.rate,
+        size=args.size,
+        connections=args.connections,
+        broadcast=args.broadcast_method,
+    )
+    summary = loader.run(args.duration)
+    print(json.dumps(summary))
+    return 0 if summary["errors"] == 0 else 1
+
+
+def cmd_load_report(args) -> int:
+    """(test/loadtime/cmd/report) — latency stats from the block
+    store's timestamps."""
+    from cometbft_tpu.loadtime import report_from_home
+
+    reports = report_from_home(args.home)
+    if not reports:
+        print("no loadtime transactions found")
+        return 1
+    for rep in reports:
+        print(json.dumps(rep.as_dict()))
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -316,10 +346,20 @@ def cmd_testnet(args) -> int:
         NodeKey.load_or_generate(cfg.node_key_path)
         pvs.append(pv)
         configs.append(cfg)
+    from dataclasses import replace as _replace
+
+    from cometbft_tpu.types.params import ConsensusParams
+
+    base_params = ConsensusParams()
     gen = GenesisDoc(
         chain_id=chain_id,
         genesis_time_ns=now_ns(),
         validators=tuple(GenesisValidator(pv.pub_key, 1) for pv in pvs),
+        # PBTS from height 1, matching node.init_files (see its note)
+        consensus_params=_replace(
+            base_params,
+            feature=_replace(base_params.feature, pbts_enable_height=1),
+        ),
     )
     ids = [NodeKey.load(cfg.node_key_path).id() for cfg in configs]
     for i, cfg in enumerate(configs):
@@ -408,6 +448,22 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--sequential", action="store_true",
                    help="sequential verification instead of skipping")
     p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser("load", help="generate timestamped tx load")
+    p.add_argument("--endpoints", required=True,
+                   help="comma-separated RPC addresses")
+    p.add_argument("--rate", type=int, default=100, help="txs per second")
+    p.add_argument("--size", type=int, default=1024, help="tx bytes")
+    p.add_argument("--connections", type=int, default=1)
+    p.add_argument("--duration", type=float, default=60.0, help="seconds")
+    p.add_argument("--broadcast-method", default="broadcast_tx_sync")
+    p.set_defaults(fn=cmd_load)
+
+    p = sub.add_parser(
+        "load-report",
+        help="latency report from a node home's block store",
+    )
+    p.set_defaults(fn=cmd_load_report)
 
     p = sub.add_parser("testnet", help="generate a localnet")
     p.add_argument("--v", type=int, default=4)

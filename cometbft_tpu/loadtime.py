@@ -1,0 +1,319 @@
+"""Load generation + block-timestamp latency reporting
+(reference: test/loadtime/ — payload.proto, cmd/load, report/report.go).
+
+The generator broadcasts kvstore-compatible ``ltN=<hex payload>`` txs
+at a target rate across one or more connections; each payload embeds
+the experiment UUID, send-time, and enough padding to reach the
+requested tx size.  The reporter walks a (stopped or live) node's
+block store, decodes every loadtime tx, and computes per-experiment
+latency statistics from ``block.time - payload.time`` — the same
+methodology as the reference's report tool, so results are comparable
+with the QA baselines (BASELINE.md 400 tx/s saturation tables).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+
+_MAGIC = b"lt"
+
+
+@dataclass(frozen=True)
+class Payload:
+    """(loadtime/payload/payload.proto Payload)"""
+
+    id: bytes  # 16-byte experiment uuid
+    time_ns: int  # send time
+    connections: int
+    rate: int
+    size: int
+    padding: bytes = b""
+
+    def encode(self) -> bytes:
+        w = ProtoWriter()
+        w.bytes_(1, self.id)
+        w.varint(2, self.time_ns)
+        w.varint(3, self.connections)
+        w.varint(4, self.rate)
+        w.varint(5, self.size)
+        if self.padding:
+            w.bytes_(6, self.padding)
+        return w.finish()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Payload":
+        f = ProtoReader(bytes(raw)).to_dict()
+
+        def want_bytes(no: int) -> bytes:
+            v = f.get(no, [b""])[0]
+            # a varint where bytes belong would make bytes(huge_int)
+            # allocate gigabytes — reject crafted txs with ValueError
+            # so report scans survive adversarial chains
+            if not isinstance(v, (bytes, bytearray)):
+                raise ValueError(f"payload field {no} is not bytes")
+            return bytes(v)
+
+        def want_int(no: int) -> int:
+            v = f.get(no, [0])[0]
+            if not isinstance(v, int):
+                raise ValueError(f"payload field {no} is not a varint")
+            return v
+
+        return cls(
+            id=want_bytes(1),
+            time_ns=want_int(2),
+            connections=want_int(3),
+            rate=want_int(4),
+            size=want_int(5),
+            padding=want_bytes(6),
+        )
+
+
+def make_tx(
+    experiment_id: bytes,
+    seq: int,
+    rate: int,
+    connections: int,
+    size: int,
+    now_ns: int | None = None,
+) -> bytes:
+    """A kvstore-valid ``ltN=<hex>`` tx of at least ``size`` bytes
+    (exactly ``size`` when the minimum envelope fits)."""
+    now = time.time_ns() if now_ns is None else now_ns
+    base = Payload(
+        id=experiment_id,
+        time_ns=now,
+        connections=connections,
+        rate=rate,
+        size=size,
+    )
+    key = b"%s%d" % (_MAGIC, seq)
+    overhead = len(key) + 1 + 2 * len(base.encode())
+    pad = max(0, (size - overhead) // 2)
+    tx = key + b"=" + Payload(
+        id=base.id,
+        time_ns=base.time_ns,
+        connections=base.connections,
+        rate=base.rate,
+        size=base.size,
+        padding=b"\x00" * pad,
+    ).encode().hex().encode()
+    return tx
+
+
+def parse_tx(tx: bytes) -> Payload | None:
+    """Inverse of make_tx; None for non-loadtime txs."""
+    if not tx.startswith(_MAGIC):
+        return None
+    _, sep, value = tx.partition(b"=")
+    if not sep:
+        return None
+    try:
+        return Payload.decode(bytes.fromhex(value.decode()))
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+class Loader:
+    """Rate-controlled tx broadcaster (loadtime/cmd/load)."""
+
+    def __init__(
+        self,
+        endpoints: list[str],
+        rate: int,
+        size: int = 1024,
+        connections: int = 1,
+        broadcast: str = "broadcast_tx_sync",
+    ):
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        self.clients = [
+            HTTPClient(e if "://" in e else f"http://{e}")
+            for e in endpoints
+        ]
+        self.rate = rate
+        self.size = size
+        self.connections = connections
+        self.broadcast = broadcast
+        self.experiment_id = uuid.uuid4().bytes
+        self.sent = 0
+        self.errors = 0
+        self._seq = 0
+        self._mtx = threading.Lock()
+
+    def _next_seq(self) -> int:
+        with self._mtx:
+            self._seq += 1
+            return self._seq
+
+    def run(self, duration_s: float) -> dict:
+        """Blocks for the experiment duration; returns summary."""
+        stop = time.monotonic() + duration_s
+        threads = []
+        base_rate, extra = divmod(self.rate, self.connections)
+        for c in range(self.connections):
+            # distribute the remainder so the aggregate equals the
+            # requested rate exactly (the payload stamps that rate and
+            # reports compare against it)
+            conn_rate = base_rate + (1 if c < extra else 0)
+            if conn_rate == 0:
+                continue
+            t = threading.Thread(
+                target=self._conn_loop,
+                args=(self.clients[c % len(self.clients)],
+                      conn_rate, stop),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        return {
+            "experiment_id": self.experiment_id.hex(),
+            "sent": self.sent,
+            "errors": self.errors,
+            "rate": self.rate,
+            "size": self.size,
+            "connections": self.connections,
+        }
+
+    def _conn_loop(self, client, rate: int, stop: float) -> None:
+        interval = 1.0 / rate
+        next_send = time.monotonic()
+        while time.monotonic() < stop:
+            tx = make_tx(
+                self.experiment_id,
+                self._next_seq(),
+                self.rate,
+                self.connections,
+                self.size,
+            )
+            try:
+                resp = getattr(client, self.broadcast)(tx=tx.hex())
+                accepted = int((resp or {}).get("code", 0)) == 0
+                with self._mtx:
+                    if accepted:
+                        self.sent += 1
+                    else:
+                        self.errors += 1
+            except Exception:  # noqa: BLE001 — node overloaded/down
+                with self._mtx:
+                    self.errors += 1
+            next_send += interval
+            delay = next_send - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                next_send = time.monotonic()  # fell behind: don't burst
+
+
+@dataclass
+class ExperimentReport:
+    """(report/report.go Report)"""
+
+    experiment_id: str
+    connections: int = 0
+    rate: int = 0
+    size: int = 0
+    count: int = 0
+    min_ns: int = 0
+    max_ns: int = 0
+    sum_ns: int = 0
+    _sq_sum: float = 0.0
+    negative: int = 0  # txs whose block time precedes the send time
+    latencies: list = field(default_factory=list)
+
+    def add(self, latency_ns: int) -> None:
+        if latency_ns < 0:
+            self.negative += 1
+            return
+        if self.count == 0 or latency_ns < self.min_ns:
+            self.min_ns = latency_ns
+        if latency_ns > self.max_ns:
+            self.max_ns = latency_ns
+        self.count += 1
+        self.sum_ns += latency_ns
+        self._sq_sum += float(latency_ns) ** 2
+        self.latencies.append(latency_ns)
+
+    @property
+    def avg_ns(self) -> float:
+        return self.sum_ns / self.count if self.count else 0.0
+
+    @property
+    def stddev_ns(self) -> float:
+        if self.count < 2:
+            return 0.0
+        mean = self.avg_ns
+        var = self._sq_sum / self.count - mean * mean
+        return math.sqrt(max(var, 0.0))
+
+    def percentile_ns(self, p: float) -> int:
+        if not self.latencies:
+            return 0
+        xs = sorted(self.latencies)
+        return xs[min(len(xs) - 1, int(len(xs) * p))]
+
+    def as_dict(self) -> dict:
+        return {
+            "experiment_id": self.experiment_id,
+            "connections": self.connections,
+            "rate": self.rate,
+            "size": self.size,
+            "count": self.count,
+            "negative": self.negative,
+            "min_s": self.min_ns / 1e9,
+            "avg_s": self.avg_ns / 1e9,
+            "p50_s": self.percentile_ns(0.50) / 1e9,
+            "p95_s": self.percentile_ns(0.95) / 1e9,
+            "max_s": self.max_ns / 1e9,
+            "stddev_s": self.stddev_ns / 1e9,
+        }
+
+
+def report_from_block_store(block_store) -> list[ExperimentReport]:
+    """Walk committed blocks, decode loadtime txs, aggregate per
+    experiment (report/report.go GenerateFromBlockStore)."""
+    reports: dict[str, ExperimentReport] = {}
+    base = max(1, block_store.base())
+    for h in range(base, block_store.height() + 1):
+        block = block_store.load_block(h)
+        if block is None:
+            continue
+        btime = block.header.time_ns
+        for tx in block.data.txs:
+            p = parse_tx(bytes(tx))
+            if p is None:
+                continue
+            rep = reports.get(p.id.hex())
+            if rep is None:
+                rep = reports[p.id.hex()] = ExperimentReport(
+                    experiment_id=p.id.hex(),
+                    connections=p.connections,
+                    rate=p.rate,
+                    size=p.size,
+                )
+            rep.add(btime - p.time_ns)
+    return list(reports.values())
+
+
+def report_from_home(home: str) -> list[ExperimentReport]:
+    """Open a node home's block store read-only and report."""
+    from cometbft_tpu.config import Config, default_config
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.utils.db import open_db
+
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = Config.load(home) if os.path.exists(cfg_path) else default_config(home)
+    db = open_db("blockstore", cfg.base.db_backend, cfg.db_dir)
+    try:
+        return report_from_block_store(BlockStore(db))
+    finally:
+        db.close()

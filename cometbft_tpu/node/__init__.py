@@ -77,10 +77,26 @@ def init_files(config: Config, chain_id: str = "") -> GenesisDoc:
     gen_path = config.genesis_path
     if os.path.exists(gen_path):
         return GenesisDoc.from_file(gen_path)
+    from dataclasses import replace as _replace
+
+    from cometbft_tpu.types.params import ConsensusParams
+
+    base_params = ConsensusParams()
     gen = GenesisDoc(
         chain_id=chain_id or f"test-chain-{os.urandom(3).hex()}",
         genesis_time_ns=now_ns(),
         validators=(GenesisValidator(pv.pub_key, 10),),
+        # Proposer-based timestamps from height 1: block time is the
+        # proposer's clock (bounded by synchrony params) instead of
+        # the previous round's vote median, so block timestamps track
+        # real time tightly — which also makes load-report latencies
+        # meaningful.  (The reference leaves PBTS opt-in,
+        # FeatureParams.PbtsEnableHeight; new chains here get the
+        # modern behavior by default.)
+        consensus_params=_replace(
+            base_params,
+            feature=_replace(base_params.feature, pbts_enable_height=1),
+        ),
     )
     gen.save_as(gen_path)
     config.save()

@@ -2,14 +2,21 @@
 
 - ``HTTPClient``: JSON-RPC over HTTP via urllib (rpc/client/http);
 - ``LocalClient``: direct calls into an Environment, no network
-  (rpc/client/local) — the embedding-friendly client.
+  (rpc/client/local) — the embedding-friendly client;
+- ``WSClient``: JSON-RPC over a WebSocket with live event
+  subscriptions (rpc/client/http WSEvents).
 
 Both expose the route names as methods via ``call``.
 """
 
 from __future__ import annotations
 
+import base64
 import json
+import os
+import queue
+import socket
+import threading
 import urllib.request
 
 from cometbft_tpu.rpc.jsonrpc import RPCError
@@ -82,4 +89,202 @@ class LocalClient:
         return call
 
 
-__all__ = ["HTTPClient", "LocalClient"]
+__all__ = ["HTTPClient", "LocalClient", "WSClient", "WSSubscription"]
+
+
+class WSSubscription:
+    """One active query subscription on a WSClient
+    (rpc/client/http WSEvents subscription channel)."""
+
+    def __init__(self, query: str):
+        self.query = query
+        self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=1024)
+        self.closed = False
+
+    def next(self, timeout: float | None = None) -> dict:
+        """Next event payload: {"query", "data", "events"}; raises
+        TimeoutError when nothing arrives in time."""
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"no event within {timeout}s") from None
+        if item is None:
+            raise ConnectionError("websocket closed")
+        return item
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.next(timeout=None)
+            except ConnectionError:
+                return
+
+
+class WSClient:
+    """JSON-RPC over a WebSocket with event subscriptions
+    (reference: rpc/client/http/http.go WSEvents + rpc/jsonrpc/client/
+    ws_client.go).  Wire format matches our server: text frames of
+    JSON-RPC objects; subscription events arrive with id == -1 and a
+    result.query naming the subscription."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.timeout = timeout
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\n"
+                f"Host: {host}:{port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        self._rfile = self._sock.makefile("rb")
+        status = self._rfile.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade refused: {status!r}")
+        accept = None
+        while True:
+            line = self._rfile.readline().strip()
+            if not line:
+                break
+            name, _, value = line.partition(b":")
+            if name.strip().lower() == b"sec-websocket-accept":
+                accept = value.strip().decode()
+        from cometbft_tpu.rpc.jsonrpc import ws_accept_key
+
+        if accept != ws_accept_key(key):
+            raise ConnectionError("bad websocket accept key")
+        self._sock.settimeout(None)
+        self._next_id = 0
+        self._pending: dict[int, queue.Queue] = {}
+        self._subs: dict[str, WSSubscription] = {}
+        self._mtx = threading.Lock()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- framing (client frames are masked per RFC 6455) ----------------
+
+    def _send_frame(self, payload: bytes) -> None:
+        import struct as _struct
+
+        header = bytes([0x81])  # FIN | text
+        n = len(payload)
+        mask = os.urandom(4)
+        if n < 126:
+            header += bytes([0x80 | n])
+        elif n < 1 << 16:
+            header += bytes([0x80 | 126]) + _struct.pack(">H", n)
+        else:
+            header += bytes([0x80 | 127]) + _struct.pack(">Q", n)
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        with self._mtx:
+            self._sock.sendall(header + mask + masked)
+
+    def _read_loop(self) -> None:
+        from cometbft_tpu.rpc.jsonrpc import ws_read_frame
+
+        try:
+            while not self._closed:
+                frame = ws_read_frame(self._rfile)
+                if frame is None:
+                    break
+                opcode, payload = frame
+                if opcode != 0x1:
+                    continue
+                try:
+                    msg = json.loads(payload)
+                except ValueError:
+                    continue
+                self._route(msg)
+        except Exception:  # noqa: BLE001 — socket torn down
+            pass
+        finally:
+            self._shutdown()
+
+    def _route(self, msg: dict) -> None:
+        msg_id = msg.get("id")
+        result = msg.get("result") or {}
+        if msg_id == -1 and isinstance(result, dict) and "query" in result:
+            sub = self._subs.get(result["query"])
+            if sub is not None:
+                try:
+                    sub._queue.put_nowait(result)
+                except queue.Full:
+                    pass  # slow consumer: drop (server buffers too)
+            return
+        q = self._pending.pop(msg_id, None)
+        if q is not None:
+            q.put(msg)
+
+    def _shutdown(self) -> None:
+        self._closed = True
+        for sub in self._subs.values():
+            while True:
+                try:
+                    sub._queue.put_nowait(None)
+                    break
+                except queue.Full:
+                    # evict one event so the close sentinel always
+                    # lands — a full queue must not hide the shutdown
+                    try:
+                        sub._queue.get_nowait()
+                    except queue.Empty:
+                        pass
+        for q in self._pending.values():
+            q.put(None)
+
+    # -- calls -----------------------------------------------------------
+
+    def call(self, method: str, **params):
+        if self._closed:
+            raise ConnectionError("websocket client closed")
+        waiter: queue.Queue = queue.Queue(maxsize=1)
+        with self._mtx:
+            self._next_id += 1
+            req_id = self._next_id
+            self._pending[req_id] = waiter
+        self._send_frame(
+            json.dumps(
+                {
+                    "jsonrpc": "2.0",
+                    "id": req_id,
+                    "method": method,
+                    "params": params,
+                }
+            ).encode()
+        )
+        try:
+            msg = waiter.get(timeout=self.timeout)
+        except queue.Empty:
+            self._pending.pop(req_id, None)
+            raise TimeoutError(f"no response to {method}") from None
+        if msg is None:
+            raise ConnectionError("websocket closed mid-call")
+        if msg.get("error"):
+            err = msg["error"]
+            raise RPCError(
+                err.get("code", -32603),
+                err.get("message", "unknown"),
+                err.get("data", ""),
+            )
+        return msg.get("result")
+
+    def subscribe(self, query: str) -> WSSubscription:
+        sub = WSSubscription(query)
+        self._subs[query] = sub
+        self.call("subscribe", query=query)
+        return sub
+
+    def unsubscribe(self, query: str) -> None:
+        self._subs.pop(query, None)
+        self.call("unsubscribe", query=query)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

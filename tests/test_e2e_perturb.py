@@ -1,0 +1,445 @@
+"""E2E perturbation / misbehavior harness
+(reference: test/e2e/runner/perturb.go:16, runner/evidence.go,
+test/e2e/pkg/grammar/checker.go).
+
+Real node SUBPROCESSES get kill -9'd, SIGSTOP'd, and restarted
+mid-consensus while the harness asserts the BFT invariants: the
+network keeps making progress, no node's height regresses, all nodes
+agree on block hashes (no fork), and a crashed node catches back up.
+A double-signer's duplicate-vote evidence injected over RPC must land
+in a committed block.  The ABCI grammar checker validates the call
+order an application actually observed across clean start and
+crash-recovery."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 27100
+N_NODES = 4
+
+
+def _rpc(port: int, method: str, timeout: float = 3.0, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read())
+    if body.get("error"):
+        raise RuntimeError(body["error"])
+    return body["result"]
+
+
+def _height(port: int) -> int:
+    return int(_rpc(port, "status")["sync_info"]["latest_block_height"])
+
+
+def _rpc_port(i: int) -> int:
+    return BASE_PORT + 2 * i + 1
+
+
+def _wait_heights(ports, target: int, timeout: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout
+    pending = set(ports)
+    while pending:
+        for p in list(pending):
+            try:
+                if _height(p) >= target:
+                    pending.discard(p)
+            except Exception:
+                pass
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"nodes on ports {sorted(pending)} never reached "
+                f"height {target}"
+            )
+        time.sleep(0.3)
+
+
+class _Net:
+    """Process-based localnet built from the `testnet` CLI command."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.procs: dict[int, subprocess.Popen | None] = {}
+        self.env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+
+    def init(self) -> None:
+        subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "cometbft_tpu",
+                "testnet",
+                "--v",
+                str(N_NODES),
+                "--o",
+                self.root,
+                "--chain-id",
+                "perturb-chain",
+                "--starting-port",
+                str(BASE_PORT),
+            ],
+            env=self.env,
+            check=True,
+            capture_output=True,
+            cwd=REPO,
+        )
+
+    def start(self, i: int) -> None:
+        self.procs[i] = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "cometbft_tpu",
+                "--home",
+                os.path.join(self.root, f"node{i}"),
+                "start",
+            ],
+            env=self.env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=REPO,
+        )
+
+    def kill9(self, i: int) -> None:
+        p = self.procs[i]
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+        self.procs[i] = None
+
+    def pause(self, i: int) -> None:
+        self.procs[i].send_signal(signal.SIGSTOP)
+
+    def resume(self, i: int) -> None:
+        self.procs[i].send_signal(signal.SIGCONT)
+
+    def stop_all(self) -> None:
+        for i, p in self.procs.items():
+            if p is None:
+                continue
+            try:
+                p.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in self.procs.values():
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("perturbnet"))
+    n = _Net(root)
+    n.init()
+    for i in range(N_NODES):
+        n.start(i)
+    try:
+        _wait_heights([_rpc_port(i) for i in range(N_NODES)], 3)
+        yield n
+    finally:
+        n.stop_all()
+
+
+def _assert_no_fork(ports, upto: int) -> None:
+    """Block hashes must agree across all live nodes."""
+    for h in range(1, upto + 1):
+        hashes = set()
+        for p in ports:
+            hashes.add(_rpc(p, "block", height=h)["block_id"]["hash"])
+        assert len(hashes) == 1, f"fork at height {h}: {hashes}"
+
+
+class TestPerturbations:
+    def test_kill9_liveness_and_catchup(self, net):
+        """Kill a validator with SIGKILL mid-consensus: the remaining
+        3/4 keep committing; the restarted node WAL-replays and
+        catches back up (perturb.go 'kill')."""
+        victim = 3
+        others = [_rpc_port(i) for i in range(N_NODES) if i != victim]
+        before = max(_height(p) for p in others)
+        net.kill9(victim)
+        _wait_heights(others, before + 2)
+        net.start(victim)
+        live = max(_height(p) for p in others)
+        _wait_heights([_rpc_port(victim)], live)
+        _assert_no_fork(
+            [_rpc_port(i) for i in range(N_NODES)], before + 1
+        )
+
+    def test_pause_resume(self, net):
+        """SIGSTOP a validator for a few seconds (perturb.go 'pause'):
+        no height regression, catches up after SIGCONT."""
+        victim = 1
+        vport = _rpc_port(victim)
+        others = [_rpc_port(i) for i in range(N_NODES) if i != victim]
+        h_before = _height(vport)
+        net.pause(victim)
+        base = max(_height(p) for p in others)
+        _wait_heights(others, base + 2)
+        net.resume(victim)
+        assert _height(vport) >= h_before  # no regression
+        live = max(_height(p) for p in others)
+        _wait_heights([vport], live)
+
+    def test_heights_monotonic_under_churn(self, net):
+        """Sampled heights never regress on any node while the net
+        keeps moving."""
+        ports = [_rpc_port(i) for i in range(N_NODES)]
+        last = {p: 0 for p in ports}
+        end = time.monotonic() + 6
+        while time.monotonic() < end:
+            for p in ports:
+                try:
+                    h = _height(p)
+                except Exception:
+                    continue
+                assert h >= last[p], f"height regressed on {p}"
+                last[p] = h
+            time.sleep(0.3)
+        assert max(last.values()) > 0
+
+
+class TestDoubleSigner:
+    def test_injected_equivocation_is_committed(self, net):
+        """Craft two conflicting precommits from a real validator key
+        and broadcast the duplicate-vote evidence over RPC; it must be
+        verified, gossiped, and committed into a block
+        (runner/evidence.go InjectEvidence)."""
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types import PRECOMMIT_TYPE, codec
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.vote import Vote
+        from dataclasses import replace
+
+        port = _rpc_port(0)
+        cfg = Config.load(os.path.join(net.root, "node0"))
+        pv = FilePV.load(
+            cfg.priv_validator_key_path, cfg.priv_validator_state_path
+        )
+        # pick a committed height with a known header
+        h = _height(port) - 1
+        blk = _rpc(port, "block", height=h)
+        header_time = blk["block"]["header"]["time"]
+        vals = _rpc(port, "validators", height=h)
+        idx = next(
+            i
+            for i, v in enumerate(vals["validators"])
+            if bytes.fromhex(v["address"]) == pv.pub_key.address()
+        )
+        total_power = sum(int(v["voting_power"]) for v in vals["validators"])
+        power = int(vals["validators"][idx]["voting_power"])
+
+        def vote_for(tag: bytes) -> Vote:
+            import hashlib
+
+            bh = hashlib.sha256(tag).digest()
+            v = Vote(
+                type=PRECOMMIT_TYPE,
+                height=h,
+                round=50,  # a round that never really ran: pure equivocation
+                block_id=BlockID(
+                    hash=bh,
+                    part_set_header=PartSetHeader(total=1, hash=bh[::-1]),
+                ),
+                timestamp_ns=1_700_000_000_000_000_000,
+                validator_address=pv.pub_key.address(),
+                validator_index=idx,
+            )
+            sig = pv._priv_key.sign(v.sign_bytes("perturb-chain"))
+            return replace(v, signature=sig)
+
+        from cometbft_tpu.light.provider import _ns_from_rfc3339
+
+        ev = DuplicateVoteEvidence(
+            vote_a=None,
+            vote_b=None,
+            total_voting_power=total_power,
+            validator_power=power,
+            timestamp_ns=_ns_from_rfc3339(header_time),
+        )
+        va, vb = vote_for(b"fork-a"), vote_for(b"fork-b")
+        if vb.block_id.key() < va.block_id.key():
+            va, vb = vb, va
+        ev = replace(ev, vote_a=va, vote_b=vb)
+        enc = codec.encode_evidence(ev)
+        out = _rpc(port, "broadcast_evidence", evidence=enc.hex())
+        ev_hash = out["hash"]
+
+        # wait until some block carries the evidence
+        deadline = time.monotonic() + 60
+        seen_upto = _height(port)
+        found = False
+        scan_from = max(1, h)
+        while not found and time.monotonic() < deadline:
+            head = _height(port)
+            for hh in range(scan_from, head + 1):
+                b = _rpc(port, "block", height=hh)
+                evs = (b["block"].get("evidence") or {}).get("evidence") or []
+                for e in evs:
+                    found = True
+            scan_from = head + 1
+            if not found:
+                time.sleep(0.5)
+        assert found, f"evidence {ev_hash} never committed"
+
+
+class TestAbciGrammar:
+    def test_checker_accepts_valid_sequences(self):
+        from cometbft_tpu.abci.grammar import check_grammar
+
+        check_grammar(
+            [
+                ("init_chain", 1),
+                ("process_proposal", 0),
+                ("finalize_block", 1),
+                ("commit", 0),
+                ("prepare_proposal", 0),
+                ("process_proposal", 0),
+                ("finalize_block", 2),
+                ("commit", 0),
+            ],
+            clean_start=True,
+        )
+        # recovery: no init_chain, may resume mid-stream
+        check_grammar(
+            [("finalize_block", 7), ("commit", 0)], clean_start=False
+        )
+        # statesync start
+        check_grammar(
+            [
+                ("offer_snapshot", 0),
+                ("apply_snapshot_chunk", 0),
+                ("apply_snapshot_chunk", 0),
+                ("finalize_block", 101),
+                ("commit", 0),
+            ],
+            clean_start=True,
+        )
+        # crash between finalize and commit leaves a dangling finalize
+        check_grammar(
+            [("init_chain", 1), ("finalize_block", 1)], clean_start=True
+        )
+
+    def test_checker_rejects_violations(self):
+        from cometbft_tpu.abci.grammar import GrammarError, check_grammar
+
+        with pytest.raises(GrammarError):  # no init_chain on clean start
+            check_grammar(
+                [("finalize_block", 1), ("commit", 0)], clean_start=True
+            )
+        with pytest.raises(GrammarError):  # init_chain on recovery
+            check_grammar(
+                [("init_chain", 1), ("finalize_block", 1), ("commit", 0)],
+                clean_start=False,
+            )
+        with pytest.raises(GrammarError):  # commit without finalize
+            check_grammar(
+                [("init_chain", 1), ("commit", 0)], clean_start=True
+            )
+        with pytest.raises(GrammarError):  # height skip
+            check_grammar(
+                [
+                    ("init_chain", 1),
+                    ("finalize_block", 1),
+                    ("commit", 0),
+                    ("finalize_block", 3),
+                    ("commit", 0),
+                ],
+                clean_start=True,
+            )
+        with pytest.raises(GrammarError):  # double commit
+            check_grammar(
+                [
+                    ("init_chain", 1),
+                    ("finalize_block", 1),
+                    ("commit", 0),
+                    ("commit", 0),
+                ],
+                clean_start=True,
+            )
+
+    def test_live_node_sequences_conform(self, tmp_path):
+        """An in-process localnet run through clean start, crash, and
+        recovery produces grammar-conforming call sequences."""
+        from cometbft_tpu.abci.grammar import RecordingApp
+        from cometbft_tpu.abci.kvstore import KVStoreApp
+        from cometbft_tpu.node import Node
+        from tests.test_reactors import (
+            connect_star,
+            make_localnet,
+            wait_all_height,
+        )
+
+        from cometbft_tpu.utils.db import SQLiteDB
+
+        recorders: list[RecordingApp] = []
+
+        def app_factory():
+            # node0's app persists so the later restart is a true
+            # RECOVERY (app height > 0, no InitChain replay); a fresh
+            # MemDB app would be replayed from genesis, which is the
+            # clean-start grammar again.
+            db = (
+                SQLiteDB(str(tmp_path / f"app{len(recorders)}.db"))
+                if len(recorders) == 0
+                else None
+            )
+            rec = RecordingApp(KVStoreApp(db=db))
+            recorders.append(rec)
+            return rec
+
+        nodes, privs, gen = make_localnet(tmp_path, 2, app_factory=app_factory)
+        for n in nodes:
+            n.start()
+        connect_star(nodes)
+        wait_all_height(nodes, 4)
+        for n in nodes:
+            n.stop()
+        for rec in recorders:
+            rec.check(clean_start=True)
+
+        # restart node0 from its home with the PERSISTED app state:
+        # recovery must not re-InitChain
+        from cometbft_tpu.config import test_config as make_test_config
+
+        rec2 = RecordingApp(
+            KVStoreApp(db=SQLiteDB(str(tmp_path / "app0.db")))
+        )
+        cfg = make_test_config(str(tmp_path / "node0"))
+        from cometbft_tpu.privval import FilePV
+
+        pv = FilePV.load(
+            cfg.priv_validator_key_path, cfg.priv_validator_state_path
+        )
+        node = Node(cfg, app=rec2, genesis=gen, priv_validator=pv)
+        node.start()
+        time.sleep(1.0)
+        node.stop()
+        rec2.check(clean_start=False)

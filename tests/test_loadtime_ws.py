@@ -1,0 +1,207 @@
+"""Loadtime generator/report + WebSocket subscription client
+(reference: test/loadtime/, rpc/client/http WSEvents)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+import pytest
+
+from cometbft_tpu import loadtime
+from cometbft_tpu.rpc.client import HTTPClient, WSClient
+
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+
+class TestPayload:
+    def test_roundtrip(self):
+        eid = uuid.uuid4().bytes
+        tx = loadtime.make_tx(eid, 7, rate=200, connections=2, size=512)
+        assert len(tx) >= 500  # close to requested size
+        assert tx.count(b"=") == 1  # kvstore-valid
+        p = loadtime.parse_tx(tx)
+        assert p is not None
+        assert p.id == eid and p.rate == 200 and p.connections == 2
+        assert p.size == 512
+        assert abs(p.time_ns - time.time_ns()) < 60 * 10**9
+
+    def test_parse_rejects_foreign_txs(self):
+        assert loadtime.parse_tx(b"key=value") is None
+        assert loadtime.parse_tx(b"lt1=nothex!") is None
+        assert loadtime.parse_tx(b"noequals") is None
+
+    def test_report_math(self):
+        rep = loadtime.ExperimentReport(experiment_id="x")
+        for ms in (10, 20, 30, 40):
+            rep.add(ms * 10**6)
+        rep.add(-5)  # block time before send time: counted, not crashed
+        assert rep.count == 4 and rep.negative == 1
+        assert rep.min_ns == 10 * 10**6 and rep.max_ns == 40 * 10**6
+        assert rep.avg_ns == 25 * 10**6
+        assert 10**6 < rep.stddev_ns < 20 * 10**6
+        d = rep.as_dict()
+        assert d["p50_s"] >= d["min_s"] and d["p95_s"] <= d["max_s"]
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """PBTS-enabled localnet: with proposer-based timestamps the block
+    carrying a tx is stamped AFTER the proposer reaped it, so load
+    latencies are strictly positive.  (Under legacy time, block N's
+    time is the median of round N-1's votes — a tx landing in the very
+    next block can show a small negative latency; the report counts
+    those rather than hiding them.)"""
+    tmp = tmp_path_factory.mktemp("loadnet")
+
+    def configure(i, cfg):
+        if i == 0:
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+
+    from dataclasses import replace
+
+    from cometbft_tpu.types.params import ConsensusParams
+
+    base = ConsensusParams()
+    params = replace(
+        base, feature=replace(base.feature, pbts_enable_height=1)
+    )
+    nodes, privs, gen = make_localnet(
+        tmp, 2, configure=configure, consensus_params=params
+    )
+    for n in nodes:
+        n.start()
+    connect_star(nodes)
+    wait_all_height(nodes, 2)
+    yield nodes
+    for n in nodes:
+        try:
+            n.stop()
+        except Exception:
+            pass
+
+
+class TestWSClient:
+    def test_call_and_subscribe_new_block(self, net):
+        port = net[0].rpc_server.port
+        ws = WSClient("127.0.0.1", port)
+        try:
+            st = ws.call("status")
+            assert int(st["sync_info"]["latest_block_height"]) >= 1
+            sub = ws.subscribe("tm.event = 'NewBlock'")
+            ev = sub.next(timeout=30)
+            assert ev["query"] == "tm.event = 'NewBlock'"
+            assert ev["data"]["type"].startswith("EventDataNewBlock")
+            h1 = int(ev["data"]["value"]["block"]["header"]["height"])
+            ev2 = sub.next(timeout=30)
+            h2 = int(ev2["data"]["value"]["block"]["header"]["height"])
+            assert h2 == h1 + 1
+            ws.unsubscribe("tm.event = 'NewBlock'")
+        finally:
+            ws.close()
+
+    def test_tx_event_subscription(self, net):
+        port = net[0].rpc_server.port
+        http = HTTPClient(f"http://127.0.0.1:{port}")
+        ws = WSClient("127.0.0.1", port)
+        try:
+            sub = ws.subscribe("tm.event = 'Tx'")
+            http.broadcast_tx_sync(tx=b"wskey=wsval".hex())
+            ev = sub.next(timeout=30)
+            assert ev["data"]["type"] == "EventDataTx"
+        finally:
+            ws.close()
+
+    def test_error_response_raises(self, net):
+        from cometbft_tpu.rpc.jsonrpc import RPCError
+
+        ws = WSClient("127.0.0.1", net[0].rpc_server.port)
+        try:
+            with pytest.raises(RPCError):
+                ws.call("no_such_method")
+        finally:
+            ws.close()
+
+
+class TestLoadtimeE2E:
+    def test_load_then_report(self, net):
+        """Run a short load against a live localnet, then produce the
+        latency report from the block store — the reference's
+        load -> report pipeline."""
+        port = net[0].rpc_server.port
+        loader = loadtime.Loader(
+            [f"127.0.0.1:{port}"], rate=16, size=256, connections=2
+        )
+        summary = loader.run(2.5)
+        assert summary["sent"] > 10, summary
+        # let the last txs commit (small test blocks drain slowly)
+        deadline = time.monotonic() + 90
+        reports = []
+        while time.monotonic() < deadline:
+            reports = loadtime.report_from_block_store(net[0].block_store)
+            if reports and reports[0].count >= summary["sent"]:
+                break
+            time.sleep(0.5)
+        assert reports, "no loadtime txs found in blocks"
+        rep = reports[0]
+        assert rep.experiment_id == summary["experiment_id"]
+        assert rep.count == summary["sent"]
+        assert rep.rate == 16 and rep.connections == 2 and rep.size == 256
+        d = rep.as_dict()
+        assert 0 < d["min_s"] <= d["p50_s"] <= d["max_s"] < 60
+        assert rep.negative == 0
+
+
+class TestReviewRegressions:
+    def test_payload_decode_rejects_crafted_varint_bytes(self):
+        """A varint in a bytes-typed position must raise ValueError,
+        not allocate gigabytes (report-tool DoS via one cheap tx)."""
+        from cometbft_tpu.utils.protoio import ProtoWriter
+
+        w = ProtoWriter()
+        w.varint(1, 2**62)  # field 1 should be bytes
+        crafted = b"lt1=" + w.finish().hex().encode()
+        assert loadtime.parse_tx(crafted) is None
+
+    def test_grammar_allows_statesync_retry(self):
+        from cometbft_tpu.abci.grammar import check_grammar
+
+        check_grammar(
+            [
+                ("offer_snapshot", 0),
+                ("apply_snapshot_chunk", 0),
+                ("offer_snapshot", 0),
+                ("apply_snapshot_chunk", 0),
+                ("finalize_block", 101),
+                ("commit", 0),
+            ],
+            clean_start=True,
+        )
+
+    def test_loader_rate_distribution_exact(self):
+        loader = loadtime.Loader(["127.0.0.1:1"], rate=100, connections=3)
+        base, extra = divmod(loader.rate, loader.connections)
+        rates = [base + (1 if c < extra else 0)
+                 for c in range(loader.connections)]
+        assert sum(rates) == 100
+
+    def test_ws_close_sentinel_survives_full_queue(self, net):
+        from cometbft_tpu.rpc.client import WSClient
+
+        ws = WSClient("127.0.0.1", net[0].rpc_server.port)
+        sub = ws.subscribe("tm.event = 'NewBlock'")
+        sub.next(timeout=30)
+        # fill the consumer queue artificially, then close underneath
+        import queue as _q
+
+        while True:
+            try:
+                sub._queue.put_nowait({"stuffed": True})
+            except _q.Full:
+                break
+        ws.close()
+        ws._shutdown()
+        # drain: the sentinel must surface as ConnectionError promptly
+        with pytest.raises((ConnectionError, TimeoutError)):
+            for _ in range(2000):
+                sub.next(timeout=0.01)

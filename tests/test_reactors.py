@@ -27,16 +27,24 @@ GENESIS_TIME = 1_700_000_000_000_000_000
 CHAIN = "reactor-test-chain"
 
 
-def make_localnet(tmp_path, n: int, app_factory=KVStoreApp, configure=None):
+def make_localnet(tmp_path, n: int, app_factory=KVStoreApp, configure=None,
+                  consensus_params=None):
     """n validator nodes sharing one genesis, each with its own home.
-    ``configure(i, cfg)`` may mutate each node's config pre-construction."""
+    ``configure(i, cfg)`` may mutate each node's config pre-construction;
+    ``consensus_params`` overrides the genesis defaults (e.g. PBTS)."""
     privs = [
         FilePV(ed.priv_key_from_secret(b"net-val%d" % i)) for i in range(n)
     ]
+    kwargs = (
+        {"consensus_params": consensus_params}
+        if consensus_params is not None
+        else {}
+    )
     gen = GenesisDoc(
         chain_id=CHAIN,
         genesis_time_ns=GENESIS_TIME,
         validators=tuple(GenesisValidator(pv.pub_key, 10) for pv in privs),
+        **kwargs,
     )
     nodes = []
     for i, pv in enumerate(privs):
