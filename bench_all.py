@@ -78,6 +78,51 @@ def make_commit_fixture(nvals: int):
     return vals, commit, bid
 
 
+def make_mixed_commit_fixture(n_ed: int, n_bls: int):
+    """A commit signed by n_ed ed25519 + n_bls bls12_381 validators
+    (BASELINE config 5's mega-commit shape)."""
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    keys = [
+        ed.priv_key_from_secret(b"med%d" % i) for i in range(n_ed)
+    ] + [
+        bls.priv_key_from_secret(b"mbls%d" % i) for i in range(n_bls)
+    ]
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    h = bytes(range(32))
+    bid = BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+    sigs = []
+    for i, k in enumerate(ordered):
+        ts = 1_700_000_000_000_000_000 + i
+        msg = canonical.vote_sign_bytes(
+            CHAIN_ID, canonical.PRECOMMIT_TYPE, 1, 0, bid, ts
+        )
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=k.pub_key().address(),
+                timestamp_ns=ts,
+                signature=k.sign(msg),
+            )
+        )
+    commit = Commit(height=1, round=0, block_id=bid, signatures=tuple(sigs))
+    return vals, commit, bid
+
+
 def timed(fn, warmups: int = 1, iters: int = 3) -> float:
     for _ in range(warmups):
         fn()
@@ -213,6 +258,38 @@ def main() -> None:
     )
     n5 = 16 if on_cpu else 256
     stream_config("blocksync_replay_1kval", vals1k, commit1k, n5, 1000)
+
+    # ---- config 5: mixed ed25519 + bls12381 mega-commit --------------
+    # One commit whose validators mix both key types; verify_commit's
+    # per-key-type grouping sends ed25519 votes to the batch kernel and
+    # BLS votes through the RLC multi-pairing (one shared Miller loop).
+    # The BLS plane is host-side Python (tower pairing,
+    # crypto/bls12381.py), so this measures the real deliverable — no
+    # extrapolation: ONE full verification is timed.
+    total_mixed = 100 if on_cpu else 10_000
+    n_bls = min(
+        total_mixed,
+        int(os.environ.get("CMT_BENCH_BLS_N", "16" if on_cpu else "1000")),
+    )
+    n_ed = total_mixed - n_bls
+    t0 = time.time()
+    vals_mixed, commit_mixed, bid_mixed = make_mixed_commit_fixture(
+        n_ed, n_bls
+    )
+    log(
+        f"mixed fixture ({n_ed} ed25519 + {n_bls} bls) "
+        f"in {time.time() - t0:.1f}s"
+    )
+    t0 = time.perf_counter()
+    validation.verify_commit(
+        CHAIN_ID, vals_mixed, bid_mixed, 1, commit_mixed
+    )
+    dt = time.perf_counter() - t0
+    record(
+        "mixed_megacommit", dt * 1e3, "ms",
+        n_ed25519=n_ed, n_bls=n_bls,
+        sigs_per_sec=round((n_ed + n_bls) / dt, 1),
+    )
 
     with open(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),

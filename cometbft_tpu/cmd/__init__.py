@@ -319,6 +319,162 @@ def cmd_load_report(args) -> int:
     return 0
 
 
+def cmd_compact_db(args) -> int:
+    """(commands/compact.go) — reclaim storage in every chain store."""
+    from cometbft_tpu.utils.db import open_db
+
+    cfg = _load_config(args.home)
+    if cfg.base.db_backend == "memdb":
+        print("memdb backend: nothing to compact")
+        return 0
+    for name in ("blockstore", "state", "evidence", "tx_index"):
+        path = os.path.join(cfg.db_dir, f"{name}.db")
+        if not os.path.exists(path):
+            continue
+        before = os.path.getsize(path)
+        db = open_db(name, cfg.base.db_backend, cfg.db_dir)
+        try:
+            db.compact()
+        finally:
+            db.close()
+        after = os.path.getsize(path)
+        print(f"{name}: {before} -> {after} bytes")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """(commands/reindex_event.go) — replay stored blocks + ABCI
+    results through the configured indexers for [start, end]."""
+    from cometbft_tpu.state import Store as StateStore
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.utils.db import open_db
+
+    cfg = _load_config(args.home)
+    if cfg.tx_index.indexer == "null":
+        print("indexer = \"null\": nothing to reindex")
+        return 1
+    backend = cfg.base.db_backend
+    block_db = open_db("blockstore", backend, cfg.db_dir)
+    state_db = open_db("state", backend, cfg.db_dir)
+    from cometbft_tpu.state.sink_psql import build_indexers
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    gen = GenesisDoc.from_file(cfg.genesis_path)
+    tx_indexer, block_indexer, closer = build_indexers(cfg, gen.chain_id)
+    try:
+        block_store = BlockStore(block_db)
+        state_store = StateStore(state_db)
+        base, head = block_store.base(), block_store.height()
+        start = args.start_height or base
+        end = args.end_height or head
+        if start < base or end > head or start > end:
+            print(
+                f"height range [{start}, {end}] outside stored "
+                f"[{base}, {head}]",
+                file=sys.stderr,
+            )
+            return 1
+        n_txs = 0
+        for height in range(start, end + 1):
+            block = block_store.load_block(height)
+            resp = state_store.load_finalize_block_response(height)
+            if block is None or resp is None:
+                print(f"missing block/results at {height}", file=sys.stderr)
+                return 1
+            block_indexer.index(height, resp.events)
+            for i, tx in enumerate(block.data.txs):
+                result = resp.tx_results[i]
+                tx_indexer.index(height, i, bytes(tx), result)
+                n_txs += 1
+        print(f"reindexed heights [{start}, {end}]: {n_txs} txs")
+        return 0
+    finally:
+        block_db.close()
+        state_db.close()
+        closer()
+
+
+def cmd_confix(args) -> int:
+    """(internal/confix) — normalize/migrate config.toml: keep every
+    value the operator set, add missing keys at current defaults,
+    drop unknown keys. --dry-run prints the result instead of
+    writing; a .bak of the original is kept otherwise."""
+    path = os.path.join(args.home, "config", "config.toml")
+    if not os.path.exists(path):
+        print(f"no config at {path}", file=sys.stderr)
+        return 1
+    cfg = Config.load(args.home)  # parses + validates known keys
+    new_toml = cfg.to_toml()
+    if args.dry_run:
+        print(new_toml)
+        return 0
+    with open(path, encoding="utf-8") as f:
+        old = f.read()
+    if old == new_toml:
+        print("config already normalized")
+        return 0
+    with open(path + ".bak", "w", encoding="utf-8") as f:
+        f.write(old)
+    cfg.save()
+    print(f"rewrote {path} (backup at {path}.bak)")
+    return 0
+
+
+def cmd_debug_kill(args) -> int:
+    """(commands/debug/kill.go) — collect a diagnostic archive from a
+    running node, trigger its SIGUSR1 stack dump, then SIGKILL it."""
+    import tarfile
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    cfg = _load_config(args.home)
+    pid = args.pid
+    tmp = tempfile.mkdtemp(prefix="cmt-debug-")
+
+    def save(name: str, data: bytes) -> None:
+        with open(os.path.join(tmp, name), "wb") as f:
+            f.write(data)
+
+    # 1. live RPC state if reachable (status/net_info/consensus)
+    if args.rpc_laddr:
+        base = args.rpc_laddr.split("://")[-1]
+        for route in ("status", "net_info", "dump_consensus_state"):
+            try:
+                with urllib.request.urlopen(
+                    f"http://{base}/{route}", timeout=3
+                ) as resp:
+                    save(f"{route}.json", resp.read())
+            except Exception as exc:  # noqa: BLE001
+                save(f"{route}.err", repr(exc).encode())
+    # 2. stack dump via SIGUSR1 (diagnostics.install_stack_dump_signal)
+    dump_path = os.path.join(cfg.db_dir, "stacks.dump")
+    try:
+        os.kill(pid, signal.SIGUSR1)
+        _time.sleep(1.0)
+        if os.path.exists(dump_path):
+            with open(dump_path, "rb") as f:
+                save("stacks.dump", f.read())
+    except ProcessLookupError:
+        save("kill.err", b"process not running")
+    # 3. config + genesis
+    for name in ("config.toml", "genesis.json"):
+        p = os.path.join(args.home, "config", name)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                save(name, f.read())
+    out = args.output or f"cometbft-debug-{pid}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(tmp, arcname="debug")
+    # 4. kill
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    print(f"wrote {out}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -448,6 +604,35 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--sequential", action="store_true",
                    help="sequential verification instead of skipping")
     p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser("compact-db", help="reclaim storage in the stores")
+    p.set_defaults(fn=cmd_compact_db)
+
+    p = sub.add_parser(
+        "reindex-event",
+        help="re-index stored blocks' events over a height range",
+    )
+    p.add_argument("--start-height", type=int, default=0)
+    p.add_argument("--end-height", type=int, default=0)
+    p.set_defaults(fn=cmd_reindex_event)
+
+    p = sub.add_parser(
+        "confix", help="normalize config.toml to the current schema"
+    )
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_confix)
+
+    p = sub.add_parser(
+        "debug",
+        help="debugging tools (kill: archive diagnostics then SIGKILL)",
+    )
+    dsub = p.add_subparsers(dest="debug_command")
+    dk = dsub.add_parser("kill")
+    dk.add_argument("pid", type=int)
+    dk.add_argument("--output", default="")
+    dk.add_argument("--rpc-laddr", default="",
+                    help="node RPC to snapshot (host:port)")
+    dk.set_defaults(fn=cmd_debug_kill)
 
     p = sub.add_parser("load", help="generate timestamped tx load")
     p.add_argument("--endpoints", required=True,

@@ -18,10 +18,7 @@ from cometbft_tpu.blocksync import BlocksyncReactor
 from cometbft_tpu.consensus.reactor import ConsensusReactor
 from cometbft_tpu.rpc import Environment, JSONRPCServer
 from cometbft_tpu.state.txindex import (
-    BlockIndexer,
     IndexerService,
-    NullIndexer,
-    TxIndexer,
 )
 from cometbft_tpu.statesync import StatesyncReactor
 from cometbft_tpu.evidence import EvidenceReactor, Pool as EvidencePool
@@ -178,14 +175,13 @@ class Node(BaseService):
 
         # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()
-        if config.tx_index.indexer == "kv":
-            self.indexer_db = open_db("tx_index", backend, db_dir)
-            self.tx_indexer = TxIndexer(self.indexer_db)
-            self.block_indexer = BlockIndexer(self.indexer_db)
-        else:
-            self.indexer_db = None
-            self.tx_indexer = NullIndexer()
-            self.block_indexer = NullIndexer()
+        from cometbft_tpu.state.sink_psql import build_indexers
+
+        (
+            self.tx_indexer,
+            self.block_indexer,
+            self._indexer_closer,
+        ) = build_indexers(config, self.genesis.chain_id)
         self.indexer_service = IndexerService(
             self.tx_indexer,
             self.block_indexer,
@@ -544,6 +540,33 @@ class Node(BaseService):
         """(node/node.go:580 OnStart)"""
         if self.metrics_server is not None:
             self.metrics_server.start()
+        # pprof-analog diagnostics server + SIGUSR1 stack dumps
+        # (node.go:589 startPprofServer); failures here must never
+        # take the node down — it is an optional debug plane
+        self.diagnostics_server = None
+        if self.config.rpc.is_pprof_enabled():
+            try:
+                from cometbft_tpu.utils.diagnostics import (
+                    DiagnosticsServer,
+                    install_stack_dump_signal,
+                )
+
+                self.diagnostics_server = DiagnosticsServer(
+                    self.config.rpc.pprof_laddr,
+                    logger=self.logger.with_fields(module="pprof"),
+                )
+                self.diagnostics_server.start()
+            except Exception as exc:  # noqa: BLE001 — e.g. port in use
+                self.diagnostics_server = None
+                self.logger.error(
+                    "diagnostics server failed to start", err=repr(exc)
+                )
+            try:
+                install_stack_dump_signal(
+                    os.path.join(self.config.db_dir, "stacks.dump")
+                )
+            except (ValueError, OSError):
+                pass  # non-main thread or read-only home: diagnostics only
         if self.privval_listener is not None:
             # the external signer must be reachable before consensus
             # needs a signature (node.go waits for the remote signer)
@@ -642,6 +665,7 @@ class Node(BaseService):
             self.proxy_app,
             self.privval_listener,
             self.metrics_server,
+            getattr(self, "diagnostics_server", None),
         )
         for svc in services:
             if svc is None:
@@ -654,8 +678,10 @@ class Node(BaseService):
         self.block_store_db.close()
         self.state_db.close()
         self.evidence_db.close()
-        if self.indexer_db is not None:
-            self.indexer_db.close()
+        try:
+            self._indexer_closer()
+        except Exception as exc:  # noqa: BLE001 — best-effort teardown
+            self.logger.error("error closing indexer", err=repr(exc))
 
     # -- convenience -----------------------------------------------------
 

@@ -1,0 +1,344 @@
+"""PostgreSQL event sink
+(reference: state/indexer/sink/psql/psql.go + schema.sql).
+
+Writes blocks, tx results, events, and attributes into relational
+tables so operators can query consensus data with SQL — the
+reference's "psql" indexer option.  The sink speaks plain DB-API 2.0
+through an injected connection factory, so any driver works
+(psycopg2/pg8000 in production, sqlite3 in tests); SQL is generated
+per paramstyle and the DDL has a sqlite dialect for test
+environments without a postgres server.
+
+Like the reference, the psql sink is WRITE-ONLY: ``search``/``get``
+raise, and the node's /tx_search & /block_search report indexing
+disabled when it is selected (backport.go "search is not supported").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from datetime import datetime, timezone
+
+from cometbft_tpu.types.block import tx_hash as _tx_hash
+
+_SCHEMA_PG = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      BIGSERIAL PRIMARY KEY,
+  height     BIGINT NOT NULL,
+  chain_id   VARCHAR NOT NULL,
+  created_at TIMESTAMPTZ NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      BIGSERIAL PRIMARY KEY,
+  block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+  index      INTEGER NOT NULL,
+  created_at TIMESTAMPTZ NOT NULL,
+  tx_hash    VARCHAR NOT NULL,
+  tx_result  BYTEA NOT NULL,
+  UNIQUE (block_id, index)
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    BIGSERIAL PRIMARY KEY,
+  block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+  type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      BIGINT NOT NULL REFERENCES events(rowid),
+  key           VARCHAR NOT NULL,
+  composite_key VARCHAR NOT NULL,
+  value         VARCHAR NULL,
+  UNIQUE (event_id, key)
+);
+"""
+
+_SCHEMA_SQLITE = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     INTEGER NOT NULL,
+  chain_id   TEXT NOT NULL,
+  created_at TEXT NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   INTEGER NOT NULL REFERENCES blocks(rowid),
+  "index"    INTEGER NOT NULL,
+  created_at TEXT NOT NULL,
+  tx_hash    TEXT NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, "index")
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id INTEGER NOT NULL REFERENCES blocks(rowid),
+  tx_id    INTEGER NULL REFERENCES tx_results(rowid),
+  type     TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      INTEGER NOT NULL REFERENCES events(rowid),
+  key           TEXT NOT NULL,
+  composite_key TEXT NOT NULL,
+  value         TEXT NULL,
+  UNIQUE (event_id, key)
+);
+"""
+
+
+class PsqlSinkError(Exception):
+    pass
+
+
+class PsqlEventSink:
+    """(psql.go EventSink) — one sink instance serves both the tx and
+    block indexer slots via .tx_indexer() / .block_indexer() views."""
+
+    def __init__(self, connect, chain_id: str, dialect: str = "postgres"):
+        """``connect``: zero-arg factory returning a DB-API
+        connection.  ``dialect``: 'postgres' (%s placeholders,
+        BIGSERIAL) or 'sqlite' (? placeholders, AUTOINCREMENT)."""
+        if dialect not in ("postgres", "sqlite"):
+            raise PsqlSinkError(f"unknown dialect {dialect!r}")
+        self.chain_id = chain_id
+        self.dialect = dialect
+        self._conn = connect()
+        self._mtx = threading.Lock()
+        self._ph = "%s" if dialect == "postgres" else "?"
+        self._index_quoted = '"index"' if dialect == "sqlite" else "index"
+
+    # -- schema ----------------------------------------------------------
+
+    def ensure_schema(self) -> None:
+        ddl = _SCHEMA_PG if self.dialect == "postgres" else _SCHEMA_SQLITE
+        with self._mtx:
+            cur = self._conn.cursor()
+            for stmt in ddl.split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+            self._conn.commit()
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _now() -> str:
+        return datetime.now(timezone.utc).isoformat()
+
+    def _insert_returning(self, cur, sql: str, params) -> int:
+        if self.dialect == "postgres":
+            cur.execute(sql + " RETURNING rowid", params)
+            return int(cur.fetchone()[0])
+        cur.execute(sql, params)
+        return int(cur.lastrowid)
+
+    def _block_rowid(self, cur, height: int) -> int:
+        cur.execute(
+            f"SELECT rowid FROM blocks WHERE height = {self._ph} "
+            f"AND chain_id = {self._ph}",
+            (height, self.chain_id),
+        )
+        row = cur.fetchone()
+        if row is None:
+            raise PsqlSinkError(
+                f"no block row for height {height} — index the block "
+                "event before its txs (indexer service ordering)"
+            )
+        return int(row[0])
+
+    def _insert_events(self, cur, block_rowid: int, tx_rowid, events) -> None:
+        for ev in events or ():
+            ev_id = self._insert_returning(
+                cur,
+                f"INSERT INTO events (block_id, tx_id, type) "
+                f"VALUES ({self._ph}, {self._ph}, {self._ph})",
+                (block_rowid, tx_rowid, ev.type),
+            )
+            for attr in ev.attributes:
+                if not getattr(attr, "index", True):
+                    continue  # only indexed attributes are recorded
+                cur.execute(
+                    f"INSERT INTO attributes "
+                    f"(event_id, key, composite_key, value) "
+                    f"VALUES ({self._ph}, {self._ph}, {self._ph}, {self._ph})",
+                    (ev_id, attr.key, f"{ev.type}.{attr.key}", attr.value),
+                )
+
+    # -- EventSink surface ----------------------------------------------
+
+    def index_block_events(self, height: int, events) -> None:
+        """(psql.go IndexBlockEvents) — idempotent: WAL replay after a
+        crash re-delivers blocks, and a duplicate height must not
+        poison the indexer service."""
+        with self._mtx:
+            cur = self._conn.cursor()
+            try:
+                cur.execute(
+                    f"SELECT rowid FROM blocks WHERE height = {self._ph} "
+                    f"AND chain_id = {self._ph}",
+                    (height, self.chain_id),
+                )
+                if cur.fetchone() is not None:
+                    return  # already indexed
+                block_id = self._insert_returning(
+                    cur,
+                    f"INSERT INTO blocks (height, chain_id, created_at) "
+                    f"VALUES ({self._ph}, {self._ph}, {self._ph})",
+                    (height, self.chain_id, self._now()),
+                )
+                self._insert_events(cur, block_id, None, events)
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def index_tx_events(
+        self, height: int, index: int, tx: bytes, result
+    ) -> None:
+        """(psql.go IndexTxEvents)"""
+        from cometbft_tpu.abci import codec as _codec
+
+        with self._mtx:
+            cur = self._conn.cursor()
+            try:
+                block_id = self._block_rowid(cur, height)
+                cur.execute(
+                    f"SELECT rowid FROM tx_results WHERE block_id = "
+                    f"{self._ph} AND {self._index_quoted} = {self._ph}",
+                    (block_id, index),
+                )
+                if cur.fetchone() is not None:
+                    return  # replayed tx: already indexed
+                tx_id = self._insert_returning(
+                    cur,
+                    f"INSERT INTO tx_results "
+                    f"(block_id, {self._index_quoted}, created_at, "
+                    f"tx_hash, tx_result) VALUES "
+                    f"({self._ph}, {self._ph}, {self._ph}, {self._ph}, "
+                    f"{self._ph})",
+                    (
+                        block_id,
+                        index,
+                        self._now(),
+                        _tx_hash(tx).hex().upper(),
+                        _codec.encode_msg(result),
+                    ),
+                )
+                self._insert_events(cur, block_id, tx_id, result.events)
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def close(self) -> None:
+        with self._mtx:
+            self._conn.close()
+
+    # -- indexer-slot adapters -------------------------------------------
+
+    def tx_indexer(self) -> "_TxView":
+        return _TxView(self)
+
+    def block_indexer(self) -> "_BlockView":
+        return _BlockView(self)
+
+
+class _TxView:
+    """Plugs the sink into the node's tx-indexer slot."""
+
+    def __init__(self, sink: PsqlEventSink):
+        self.sink = sink
+
+    def index(self, height, index, tx, result) -> None:
+        self.sink.index_tx_events(height, index, tx, result)
+
+    def get(self, hash_: bytes):
+        raise PsqlSinkError("psql sink does not support get (use SQL)")
+
+    def search(self, query, limit: int = 100):
+        raise PsqlSinkError("psql sink does not support search (use SQL)")
+
+    def prune(self, retain_height: int) -> None:
+        """The reference psql sink never prunes — SQL retention is the
+        operator's policy (pruner skips sinks without real pruning)."""
+
+
+class _BlockView:
+    def __init__(self, sink: PsqlEventSink):
+        self.sink = sink
+
+    def index(self, height, events) -> None:
+        self.sink.index_block_events(height, events)
+
+    def search(self, query, limit: int = 100):
+        raise PsqlSinkError("psql sink does not support search (use SQL)")
+
+    def prune(self, retain_height: int) -> None:
+        pass
+
+
+def connect_from_dsn(dsn: str):
+    """Resolve a DSN to a DB-API connection factory using whichever
+    postgres driver is installed (psycopg2, pg8000); raises
+    PsqlSinkError with guidance when none is available."""
+    try:
+        import psycopg2  # type: ignore
+
+        return lambda: psycopg2.connect(dsn)
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi  # type: ignore
+
+        # pg8000 has no DSN parser — split the URL into kwargs
+        from urllib.parse import urlparse
+
+        u = urlparse(dsn)
+        kwargs = {
+            "user": u.username or "postgres",
+            "host": u.hostname or "localhost",
+            "port": u.port or 5432,
+            "database": (u.path or "/").lstrip("/") or "postgres",
+        }
+        if u.password:
+            kwargs["password"] = u.password
+        return lambda: pg8000.dbapi.connect(**kwargs)
+    except ImportError:
+        pass
+    raise PsqlSinkError(
+        "indexer = \"psql\" needs a postgres DB-API driver "
+        "(psycopg2 or pg8000) importable in this environment"
+    )
+
+
+def build_indexers(config, chain_id: str):
+    """Shared indexer selection for the node and `reindex-event`
+    (single source of truth for the kv/psql/null dispatch).
+
+    Returns (tx_indexer, block_indexer, closer) — call ``closer()``
+    when done (closes the kv DB or the psql connection)."""
+    from cometbft_tpu.state.txindex import (
+        BlockIndexer,
+        NullIndexer,
+        TxIndexer,
+    )
+    from cometbft_tpu.utils.db import open_db
+
+    kind = config.tx_index.indexer
+    if kind == "kv":
+        db = open_db("tx_index", config.base.db_backend, config.db_dir)
+        return TxIndexer(db), BlockIndexer(db), db.close
+    if kind == "psql":
+        sink = PsqlEventSink(
+            connect_from_dsn(config.tx_index.psql_conn), chain_id
+        )
+        sink.ensure_schema()
+        return sink.tx_indexer(), sink.block_indexer(), sink.close
+    return NullIndexer(), NullIndexer(), (lambda: None)
+
+
+__all__ = [
+    "PsqlEventSink",
+    "PsqlSinkError",
+    "connect_from_dsn",
+]

@@ -52,6 +52,10 @@ class DB(abc.ABC):
     @abc.abstractmethod
     def close(self) -> None: ...
 
+    def compact(self) -> None:
+        """Reclaim space (cometbft-db Compact; `compact-db` command).
+        Default: nothing to do."""
+
     def has(self, key: bytes) -> bool:
         return self.get(key) is not None
 
@@ -222,6 +226,13 @@ class SQLiteDB(DB):
                         " ON CONFLICT(k) DO UPDATE SET v = excluded.v",
                         (key, bytes(value)),
                     )
+
+    def compact(self) -> None:
+        """VACUUM: rebuild the file, reclaiming deleted-row space
+        (what goleveldb compaction does for the reference)."""
+        conn = self._conn()
+        conn.commit()
+        conn.execute("VACUUM")
 
     def close(self) -> None:
         with self._conns_mtx:

@@ -1,0 +1,223 @@
+"""Runtime diagnostics — the pprof analog
+(reference: node/node.go:589 startPprofServer + net/http/pprof).
+
+A tiny HTTP server (config [rpc] pprof_laddr) exposing what a
+Python/JAX node can usefully dump:
+
+  /debug/stacks    every thread's current stack (goroutine-dump analog)
+  /debug/threads   thread table with names/daemon flags
+  /debug/gc        gc counters + top object types by count
+  /debug/profile?seconds=N   cProfile of the whole process for N
+                   seconds (pprof-style CPU profile, pstats text)
+  /debug/jax/start_trace?dir=...  start the XLA device profiler
+  /debug/jax/stop_trace           stop it (trace viewable in
+                   TensorBoard/XProf — the TPU-side profiler hook)
+  /debug/jax/memory               per-device live-buffer stats
+
+``install_stack_dump_signal`` registers SIGUSR1 to append all stacks
+to <home>/data/stacks.dump — crash forensics for `debug kill`
+(cmd/cometbft/commands/debug/kill.go sends SIGABRT for the same
+purpose)."""
+
+from __future__ import annotations
+
+import faulthandler
+import gc
+import io
+import json
+import signal
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+
+
+def format_stacks() -> str:
+    """All thread stacks, named (runtime.Stack analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame)
+        )
+    return "\n".join(out) + "\n"
+
+
+def gc_summary(top: int = 20) -> dict:
+    counts: dict[str, int] = {}
+    for obj in gc.get_objects():
+        name = type(obj).__name__
+        counts[name] = counts.get(name, 0) + 1
+    return {
+        "collections": gc.get_count(),
+        "threshold": gc.get_threshold(),
+        "top_types": sorted(
+            counts.items(), key=lambda kv: -kv[1]
+        )[:top],
+    }
+
+
+def cpu_profile(seconds: float, interval: float = 0.01) -> str:
+    """Statistical whole-process profile: sample every thread's stack
+    at ``interval`` for ``seconds`` and aggregate frame hit counts.
+    (cProfile only instruments its own thread — useless from an HTTP
+    handler; sampling sys._current_frames sees consensus/verify/p2p
+    threads too, pprof-style.)"""
+    import time
+
+    seconds = max(0.05, min(seconds, 120.0))
+    counts: dict[str, int] = {}
+    samples = 0
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame)
+            for fr in stack[-12:]:
+                key = f"{fr.filename}:{fr.lineno} {fr.name}"
+                counts[key] = counts.get(key, 0) + 1
+            if stack:
+                leaf = stack[-1]
+                key = f"LEAF {leaf.filename}:{leaf.lineno} {leaf.name} [{names.get(tid, tid)}]"
+                counts[key] = counts.get(key, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    buf = io.StringIO()
+    buf.write(
+        f"statistical profile: {samples} samples over {seconds}s "
+        f"({interval*1e3:.0f}ms interval)\n\nhits  frame\n"
+    )
+    for key, n in sorted(counts.items(), key=lambda kv: -kv[1])[:80]:
+        buf.write(f"{n:5d}  {key}\n")
+    return buf.getvalue()
+
+
+class DiagnosticsServer(BaseService):
+    """(node.go startPprofServer)"""
+
+    def __init__(self, addr: str, logger: Logger | None = None):
+        super().__init__(
+            name="diagnostics",
+            logger=logger or default_logger().with_fields(module="pprof"),
+        )
+        host_port = addr.split("://")[-1]
+        host, _, port = host_port.rpartition(":")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                outer.logger.debug("pprof " + (fmt % args))
+
+            def _send(self, body: str, ctype="text/plain", status=200):
+                raw = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                params = dict(parse_qsl(url.query))
+                try:
+                    self._route(url.path, params)
+                except Exception as exc:  # noqa: BLE001
+                    self._send(f"error: {exc!r}\n", status=500)
+
+            def _route(self, path: str, params: dict):
+                if path == "/debug/stacks":
+                    self._send(format_stacks())
+                elif path == "/debug/threads":
+                    rows = [
+                        {
+                            "name": t.name,
+                            "ident": t.ident,
+                            "daemon": t.daemon,
+                            "alive": t.is_alive(),
+                        }
+                        for t in threading.enumerate()
+                    ]
+                    self._send(json.dumps(rows), "application/json")
+                elif path == "/debug/gc":
+                    self._send(
+                        json.dumps(gc_summary()), "application/json"
+                    )
+                elif path == "/debug/profile":
+                    secs = float(params.get("seconds", "5"))
+                    self._send(cpu_profile(secs))
+                elif path == "/debug/jax/start_trace":
+                    import jax
+
+                    trace_dir = params.get("dir", "/tmp/jax-trace")
+                    jax.profiler.start_trace(trace_dir)
+                    self._send(f"tracing to {trace_dir}\n")
+                elif path == "/debug/jax/stop_trace":
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    self._send("trace stopped\n")
+                elif path == "/debug/jax/memory":
+                    import jax
+
+                    stats = []
+                    for dev in jax.devices():
+                        try:
+                            stats.append(
+                                {
+                                    "device": str(dev),
+                                    **(dev.memory_stats() or {}),
+                                }
+                            )
+                        except Exception:  # noqa: BLE001
+                            stats.append({"device": str(dev)})
+                    self._send(json.dumps(stats), "application/json")
+                else:
+                    self._send(
+                        "routes: /debug/{stacks,threads,gc,profile,"
+                        "jax/start_trace,jax/stop_trace,jax/memory}\n",
+                        status=404,
+                    )
+
+        self._httpd = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port or 0)), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+
+    def on_start(self) -> None:
+        threading.Thread(
+            target=self._httpd.serve_forever,
+            name="diagnostics-http",
+            daemon=True,
+        ).start()
+        self.logger.info("diagnostics server listening", port=self.port)
+
+    def on_stop(self) -> None:
+        self._httpd.shutdown()
+
+
+def install_stack_dump_signal(dump_path: str) -> None:
+    """SIGUSR1 → append all thread stacks to ``dump_path`` (the
+    `debug kill` handshake; also useful on wedged nodes)."""
+    f = open(dump_path, "a")  # noqa: SIM115 — lives for the process
+    faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+
+
+__all__ = [
+    "DiagnosticsServer",
+    "cpu_profile",
+    "format_stacks",
+    "gc_summary",
+    "install_stack_dump_signal",
+]

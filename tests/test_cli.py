@@ -148,3 +148,178 @@ def test_inspect_serves_stores(tmp_path):
             c.call("broadcast_tx_sync", tx="aGk=")
     finally:
         insp.stop()
+
+
+class TestOpsCommands:
+    """compact-db, reindex-event, confix, debug kill
+    (commands/compact.go, reindex_event.go, internal/confix,
+    commands/debug/kill.go)."""
+
+    def _grown_home(self, tmp_path):
+        """A stopped node home with a few blocks committed."""
+        import time
+
+        from cometbft_tpu.config import test_config
+        from cometbft_tpu.node import Node, init_files
+        from cometbft_tpu.privval import FilePV
+
+        home = str(tmp_path / "opsnode")
+        cfg = test_config(home)
+        cfg.base.db_backend = "sqlite"
+        cfg.ensure_dirs()
+        gen = init_files(cfg, chain_id="ops-chain")
+        pv = FilePV.load(
+            cfg.priv_validator_key_path, cfg.priv_validator_state_path
+        )
+        node = Node(cfg, genesis=gen, priv_validator=pv)
+        node.start()
+        deadline = time.monotonic() + 60
+        while node.block_store.height() < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        # commit one tx so reindex has something to chew on
+        from cometbft_tpu.abci.types import CheckTxRequest
+
+        node.mempool.check_tx(b"opskey=opsval")
+        while True:
+            found = any(
+                b"opskey=opsval" in [bytes(t) for t in
+                                     node.block_store.load_block(h).data.txs]
+                for h in range(1, node.block_store.height() + 1)
+                if node.block_store.load_block(h)
+            )
+            if found:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        node.stop()
+        cfg.save()
+        return home
+
+    def test_compact_reindex_confix(self, tmp_path, capsys):
+        from cometbft_tpu.cmd import main
+
+        home = self._grown_home(tmp_path)
+
+        assert main(["--home", home, "compact-db"]) == 0
+        out = capsys.readouterr().out
+        assert "blockstore:" in out
+
+        assert main(["--home", home, "reindex-event"]) == 0
+        out = capsys.readouterr().out
+        assert "reindexed heights" in out
+
+        # reindex must actually rebuild the tx index: wipe it first
+        import os
+
+        os.remove(os.path.join(home, "data", "tx_index.db"))
+        assert main(["--home", home, "reindex-event"]) == 0
+        capsys.readouterr()
+        from cometbft_tpu.state.txindex import TxIndexer
+        from cometbft_tpu.types.block import tx_hash
+        from cometbft_tpu.utils.db import open_db
+
+        db = open_db("tx_index", "sqlite", os.path.join(home, "data"))
+        try:
+            rec = TxIndexer(db).get(tx_hash(b"opskey=opsval"))
+            assert rec is not None
+        finally:
+            db.close()
+
+        # bad range errors cleanly
+        assert main(
+            ["--home", home, "reindex-event", "--start-height", "9999"]
+        ) == 1
+        capsys.readouterr()
+
+        # confix: strip a key + add junk, then normalize
+        cfg_path = os.path.join(home, "config", "config.toml")
+        with open(cfg_path, encoding="utf-8") as f:
+            body = f.read()
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            f.write(body + "\n# trailing operator comment\n")
+        assert main(["--home", home, "confix", "--dry-run"]) == 0
+        dry = capsys.readouterr().out
+        assert "[rpc]" in dry
+        assert main(["--home", home, "confix"]) == 0
+        capsys.readouterr()
+        assert os.path.exists(cfg_path + ".bak")
+        from cometbft_tpu.config import Config
+
+        Config.load(home)  # normalized file parses
+
+    def test_debug_kill_archives_and_kills(self, tmp_path, capsys):
+        import signal
+        import subprocess
+        import sys
+        import tarfile
+        import time
+
+        import os
+
+        REPO = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+        home = str(tmp_path / "dbgnode")
+        subprocess.run(
+            [sys.executable, "-m", "cometbft_tpu", "--home", home,
+             "init", "--chain-id", "dbg-chain"],
+            env=env, check=True, capture_output=True, cwd=REPO,
+        )
+        # enable the diagnostics/pprof plane so SIGUSR1 dumping works
+        cfg_path = os.path.join(home, "config", "config.toml")
+        with open(cfg_path, encoding="utf-8") as f:
+            body = f.read()
+        body = body.replace(
+            'pprof_laddr = ""', 'pprof_laddr = "tcp://127.0.0.1:0"'
+        )
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            f.write(body)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "--home", home,
+             "start", "--rpc.laddr", "tcp://127.0.0.1:28972",
+             "--p2p.laddr", "tcp://127.0.0.1:28971"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, cwd=REPO,
+        )
+        try:
+            import urllib.request
+
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    urllib.request.urlopen(
+                        "http://127.0.0.1:28972/status", timeout=2
+                    )
+                    break
+                except Exception:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.3)
+            out_path = str(tmp_path / "debug.tar.gz")
+            from cometbft_tpu.cmd import main
+
+            assert main(
+                ["--home", home, "debug", "kill", str(proc.pid),
+                 "--output", out_path,
+                 "--rpc-laddr", "127.0.0.1:28972"]
+            ) == 0
+            capsys.readouterr()
+            # process is dead
+            deadline = time.monotonic() + 10
+            while proc.poll() is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+            with tarfile.open(out_path) as tar:
+                names = tar.getnames()
+            assert any("status.json" in n for n in names)
+            assert any("config.toml" in n for n in names)
+            assert any("stacks.dump" in n for n in names)
+        finally:
+            if proc.poll() is None:
+                proc.kill()

@@ -1,0 +1,184 @@
+"""psql event sink (reference: state/indexer/sink/psql) — exercised
+through DB-API with sqlite (no postgres server in CI; the SQL layer
+is shared, placeholders/DDL differ per dialect)."""
+
+from __future__ import annotations
+
+import sqlite3
+
+import pytest
+
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+from cometbft_tpu.state.sink_psql import PsqlEventSink, PsqlSinkError
+from cometbft_tpu.types.block import tx_hash
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    path = str(tmp_path / "sink.db")
+    s = PsqlEventSink(
+        lambda: sqlite3.connect(path, check_same_thread=False),
+        chain_id="sink-chain",
+        dialect="sqlite",
+    )
+    s.ensure_schema()
+    yield s
+    s.close()
+
+
+def _ev(type_, **attrs):
+    return Event(
+        type=type_,
+        attributes=tuple(
+            EventAttribute(key=k, value=v, index=True)
+            for k, v in attrs.items()
+        ),
+    )
+
+
+def _q(sink, sql, *params):
+    cur = sink._conn.cursor()
+    cur.execute(sql, params)
+    return cur.fetchall()
+
+
+class TestPsqlSink:
+    def test_block_and_tx_rows(self, sink):
+        sink.index_block_events(
+            5, [_ev("begin_block", proposer="aa")]
+        )
+        res = ExecTxResult(
+            code=0, events=(_ev("transfer", sender="s1", amount="7"),)
+        )
+        sink.index_tx_events(5, 0, b"tx-bytes", res)
+
+        rows = _q(sink, "SELECT height, chain_id FROM blocks")
+        assert rows == [(5, "sink-chain")]
+        rows = _q(
+            sink,
+            'SELECT block_id, "index", tx_hash FROM tx_results',
+        )
+        assert len(rows) == 1
+        assert rows[0][1] == 0
+        assert rows[0][2] == tx_hash(b"tx-bytes").hex().upper()
+        # events: one block event (tx_id NULL), one tx event
+        rows = _q(
+            sink,
+            "SELECT type, tx_id IS NULL FROM events ORDER BY rowid",
+        )
+        assert rows == [("begin_block", 1), ("transfer", 0)]
+        # attributes joined through composite keys
+        rows = _q(
+            sink,
+            "SELECT composite_key, value FROM attributes "
+            "ORDER BY composite_key",
+        )
+        assert ("transfer.amount", "7") in rows
+        assert ("transfer.sender", "s1") in rows
+        assert ("begin_block.proposer", "aa") in rows
+
+    def test_sql_join_finds_tx_by_event(self, sink):
+        """The operator query psql exists for: find txs via SQL."""
+        sink.index_block_events(1, [])
+        res = ExecTxResult(events=(_ev("transfer", sender="alice"),))
+        sink.index_tx_events(1, 0, b"needle", res)
+        rows = _q(
+            sink,
+            "SELECT t.tx_hash FROM tx_results t "
+            "JOIN events e ON e.tx_id = t.rowid "
+            "JOIN attributes a ON a.event_id = e.rowid "
+            "WHERE a.composite_key = 'transfer.sender' AND a.value = ?",
+            "alice",
+        )
+        assert rows == [(tx_hash(b"needle").hex().upper(),)]
+
+    def test_tx_before_block_is_an_error(self, sink):
+        with pytest.raises(PsqlSinkError):
+            sink.index_tx_events(9, 0, b"x", ExecTxResult())
+
+    def test_replay_is_idempotent(self, sink):
+        sink.index_block_events(2, [_ev("eb", k="v")])
+        res = ExecTxResult(events=(_ev("t", a="1"),))
+        sink.index_tx_events(2, 0, b"tx", res)
+        # crash-replay re-delivers both
+        sink.index_block_events(2, [_ev("eb", k="v")])
+        sink.index_tx_events(2, 0, b"tx", res)
+        assert _q(sink, "SELECT COUNT(*) FROM blocks") == [(1,)]
+        assert _q(sink, "SELECT COUNT(*) FROM tx_results") == [(1,)]
+        assert _q(sink, "SELECT COUNT(*) FROM events") == [(2,)]
+
+    def test_unindexed_attributes_skipped(self, sink):
+        sink.index_block_events(3, [])
+        ev = Event(
+            type="mixed",
+            attributes=(
+                EventAttribute(key="yes", value="1", index=True),
+                EventAttribute(key="no", value="2", index=False),
+            ),
+        )
+        sink.index_tx_events(3, 0, b"t3", ExecTxResult(events=(ev,)))
+        rows = _q(sink, "SELECT key FROM attributes")
+        assert rows == [("yes",)]
+
+    def test_search_unsupported(self, sink):
+        with pytest.raises(PsqlSinkError):
+            sink.tx_indexer().search("tx.height = 1")
+        with pytest.raises(PsqlSinkError):
+            sink.block_indexer().search("block.height = 1")
+        with pytest.raises(PsqlSinkError):
+            sink.tx_indexer().get(b"\x00" * 32)
+        # prune is a no-op, not an error (the background pruner calls it)
+        sink.tx_indexer().prune(10)
+        sink.block_indexer().prune(10)
+
+    def test_indexer_service_end_to_end(self, sink):
+        """Drive the sink through the real IndexerService event flow."""
+        import time
+
+        from cometbft_tpu.state.txindex import IndexerService
+        from cometbft_tpu.types.event_bus import (
+            EventBus,
+            EventDataNewBlock,
+            EventDataTx,
+        )
+
+        class FakeBlock:
+            class header:
+                height = 7
+
+        from cometbft_tpu.abci.types import FinalizeBlockResponse
+
+        bus = EventBus()
+        bus.start()
+        svc = IndexerService(
+            sink.tx_indexer(), sink.block_indexer(), bus
+        )
+        svc.start()
+        try:
+            bus.publish_new_block(
+                EventDataNewBlock(
+                    block=FakeBlock,
+                    block_id=None,
+                    result_finalize_block=FinalizeBlockResponse(
+                        events=(_ev("fb", x="y"),)
+                    ),
+                )
+            )
+            bus.publish_tx(
+                EventDataTx(
+                    height=7,
+                    index=0,
+                    tx=b"svc-tx",
+                    result=ExecTxResult(events=(_ev("t", k="v"),)),
+                )
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if _q(sink, "SELECT COUNT(*) FROM tx_results") == [(1,)]:
+                    break
+                time.sleep(0.05)
+            assert _q(sink, "SELECT height FROM blocks") == [(7,)]
+            assert _q(sink, "SELECT COUNT(*) FROM tx_results") == [(1,)]
+        finally:
+            svc.stop()
+            bus.stop()
