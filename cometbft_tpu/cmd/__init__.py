@@ -356,7 +356,7 @@ def cmd_reindex_event(args) -> int:
     backend = cfg.base.db_backend
     block_db = open_db("blockstore", backend, cfg.db_dir)
     state_db = open_db("state", backend, cfg.db_dir)
-    from cometbft_tpu.state.sink_psql import build_indexers
+    from cometbft_tpu.state.txindex import build_indexers
     from cometbft_tpu.types.genesis import GenesisDoc
 
     gen = GenesisDoc.from_file(cfg.genesis_path)
@@ -466,6 +466,7 @@ def cmd_debug_kill(args) -> int:
     out = args.output or f"cometbft-debug-{pid}.tar.gz"
     with tarfile.open(out, "w:gz") as tar:
         tar.add(tmp, arcname="debug")
+    shutil.rmtree(tmp, ignore_errors=True)
     # 4. kill
     try:
         os.kill(pid, signal.SIGKILL)

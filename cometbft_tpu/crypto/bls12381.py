@@ -839,9 +839,16 @@ class Bls12381PubKey(PubKey):
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         """e(pk, H(m)) == e(g1, sig) via one 2-pair loop
-        (key_bls12381.go:176-191, min-PK check)."""
+        (key_bls12381.go:176-191, min-PK check); routed through the
+        native C++ backend when built (crypto/bls_native.py)."""
         if len(sig) != SIGNATURE_SIZE:
             return False
+        from cometbft_tpu.crypto import bls_native
+
+        if bls_native.available():
+            return bls_native.verify(
+                self._bytes, _digest_msg(msg), bytes(sig)
+            )
         try:
             s = g2_from_bytes(sig)
             pk = self._point()
@@ -878,6 +885,10 @@ class Bls12381PrivKey(PrivKey):
 
     def sign(self, msg: bytes) -> bytes:
         """[d] H(m) in G2, compressed (key_bls12381.go:108-118)."""
+        from cometbft_tpu.crypto import bls_native
+
+        if bls_native.available():
+            return bls_native.sign(self.bytes(), _digest_msg(msg))
         return g2_to_bytes(g2_mul(hash_to_g2(_digest_msg(msg)), self._d))
 
 
@@ -929,6 +940,16 @@ def aggregate_verify(
     shared Miller loop, one final exponentiation."""
     if len(pubs) != len(msgs) or not pubs:
         return False
+    if len(agg_sig) != SIGNATURE_SIZE:
+        return False
+    from cometbft_tpu.crypto import bls_native
+
+    if bls_native.available():
+        return bls_native.aggregate_verify(
+            [pk.bytes() for pk in pubs],
+            [_digest_msg(m) for m in msgs],
+            bytes(agg_sig),
+        )
     try:
         s = g2_from_bytes(agg_sig)
     except ValueError:
@@ -989,6 +1010,23 @@ class BlsBatchVerifier:
         n = len(self._items)
         if n == 0:
             return False, []
+        from cometbft_tpu.crypto import bls_native
+
+        if bls_native.available():
+            weights = [os.urandom(15) + b"\x01" for _ in range(n)]
+            ok = bls_native.batch_verify(
+                [pk.bytes() for pk, _, _ in self._items],
+                [_digest_msg(m) for _, m, _ in self._items],
+                [s for _, _, s in self._items],
+                weights,
+            )
+            if ok:
+                return True, [True] * n
+            results = [
+                pk.verify_signature(msg, sig)
+                for pk, msg, sig in self._items
+            ]
+            return all(results), results
         F2 = _Fq2Ops
         try:
             weights = [

@@ -1,0 +1,185 @@
+"""Loader for the native (C++) BLS12-381 backend — the framework's
+blst-equivalent (native/bls/bls12381.cpp; reference dependency:
+supranational/blst via cgo, SURVEY.md §2.9).
+
+Builds the shared library on first use when a C++ toolchain is
+available (g++ -O2, ~10s, cached in native/build/) and exposes it via
+ctypes.  Callers go through :mod:`cometbft_tpu.crypto.bls12381`,
+which routes hot operations here and falls back to its pure-Python
+tower implementation when the toolchain or library is unavailable
+(CMT_TPU_NO_NATIVE_BLS=1 forces the fallback; the differential test
+suite pins native == python byte-for-byte)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "bls", "bls12381.cpp")
+_OUT = os.path.join(_REPO, "native", "build", "libcmtbls.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    try:
+        proc = subprocess.run(
+            [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                _SRC, "-o", _OUT + ".tmp",
+            ],
+            capture_output=True,
+            timeout=300,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        return False
+    os.replace(_OUT + ".tmp", _OUT)
+    return True
+
+
+def load():
+    """The ctypes library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("CMT_TPU_NO_NATIVE_BLS"):
+            return None
+        if not os.path.exists(_OUT) and os.path.exists(_SRC):
+            if not _build():
+                return None
+        if not os.path.exists(_OUT):
+            return None
+        try:
+            lib = ctypes.CDLL(_OUT)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)  # noqa: F841
+        lib.cmt_bls_init.restype = ctypes.c_int
+        for name, args in (
+            ("cmt_bls_pubkey_validate", [ctypes.c_char_p]),
+            (
+                "cmt_bls_verify",
+                [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                 ctypes.c_char_p],
+            ),
+            (
+                "cmt_bls_aggregate_verify",
+                [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+                 ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p],
+            ),
+            (
+                "cmt_bls_batch_verify",
+                [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+                 ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p,
+                 ctypes.c_char_p],
+            ),
+            (
+                "cmt_bls_sign",
+                [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                 ctypes.c_char_p],
+            ),
+            ("cmt_bls_sk_to_pk", [ctypes.c_char_p, ctypes.c_char_p]),
+            (
+                "cmt_bls_hash_to_g2_compressed",
+                [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p],
+            ),
+        ):
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = ctypes.c_int
+        lib.cmt_bls_init()
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- thin typed wrappers (bytes in/out) ---------------------------------
+
+def verify(pk96: bytes, msg: bytes, sig96: bytes) -> bool:
+    lib = load()
+    return lib.cmt_bls_verify(pk96, msg, len(msg), sig96) == 1
+
+
+def aggregate_verify(
+    pks: list[bytes], msgs: list[bytes], sig96: bytes
+) -> bool:
+    lib = load()
+    n = len(pks)
+    lens = (ctypes.c_size_t * n)(*[len(m) for m in msgs])
+    return (
+        lib.cmt_bls_aggregate_verify(
+            n, b"".join(pks), b"".join(msgs), lens, sig96
+        )
+        == 1
+    )
+
+
+def batch_verify(
+    pks: list[bytes],
+    msgs: list[bytes],
+    sigs: list[bytes],
+    weights16: list[bytes],
+) -> bool:
+    lib = load()
+    n = len(pks)
+    lens = (ctypes.c_size_t * n)(*[len(m) for m in msgs])
+    return (
+        lib.cmt_bls_batch_verify(
+            n,
+            b"".join(pks),
+            b"".join(msgs),
+            lens,
+            b"".join(sigs),
+            b"".join(weights16),
+        )
+        == 1
+    )
+
+
+def sign(sk32: bytes, msg: bytes) -> bytes:
+    lib = load()
+    out = ctypes.create_string_buffer(96)
+    lib.cmt_bls_sign(sk32, msg, len(msg), out)
+    return out.raw
+
+
+def sk_to_pk(sk32: bytes) -> bytes:
+    lib = load()
+    out = ctypes.create_string_buffer(96)
+    lib.cmt_bls_sk_to_pk(sk32, out)
+    return out.raw
+
+
+def hash_to_g2_compressed(msg: bytes) -> bytes:
+    lib = load()
+    out = ctypes.create_string_buffer(96)
+    lib.cmt_bls_hash_to_g2_compressed(msg, len(msg), out)
+    return out.raw
+
+
+__all__ = [
+    "aggregate_verify",
+    "available",
+    "batch_verify",
+    "hash_to_g2_compressed",
+    "load",
+    "sign",
+    "sk_to_pk",
+    "verify",
+]

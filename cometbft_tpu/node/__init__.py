@@ -175,7 +175,7 @@ class Node(BaseService):
 
         # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()
-        from cometbft_tpu.state.sink_psql import build_indexers
+        from cometbft_tpu.state.txindex import build_indexers
 
         (
             self.tx_indexer,
@@ -540,15 +540,27 @@ class Node(BaseService):
         """(node/node.go:580 OnStart)"""
         if self.metrics_server is not None:
             self.metrics_server.start()
-        # pprof-analog diagnostics server + SIGUSR1 stack dumps
-        # (node.go:589 startPprofServer); failures here must never
-        # take the node down — it is an optional debug plane
+        # pprof-analog diagnostics plane (node.go:589 startPprofServer);
+        # failures here must never take the node down — it is an
+        # optional debug feature.  The SIGUSR1 stack-dump handler is
+        # registered UNCONDITIONALLY: `debug kill` depends on it, and
+        # SIGUSR1's default disposition would otherwise terminate the
+        # process mid-diagnosis.
         self.diagnostics_server = None
+        try:
+            from cometbft_tpu.utils.diagnostics import (
+                install_stack_dump_signal,
+            )
+
+            install_stack_dump_signal(
+                os.path.join(self.config.db_dir, "stacks.dump")
+            )
+        except Exception:  # noqa: BLE001 — non-main thread / RO home
+            pass
         if self.config.rpc.is_pprof_enabled():
             try:
                 from cometbft_tpu.utils.diagnostics import (
                     DiagnosticsServer,
-                    install_stack_dump_signal,
                 )
 
                 self.diagnostics_server = DiagnosticsServer(
@@ -561,12 +573,6 @@ class Node(BaseService):
                 self.logger.error(
                     "diagnostics server failed to start", err=repr(exc)
                 )
-            try:
-                install_stack_dump_signal(
-                    os.path.join(self.config.db_dir, "stacks.dump")
-                )
-            except (ValueError, OSError):
-                pass  # non-main thread or read-only home: diagnostics only
         if self.privval_listener is not None:
             # the external signer must be reachable before consensus
             # needs a signature (node.go waits for the remote signer)

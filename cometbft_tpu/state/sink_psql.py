@@ -154,13 +154,28 @@ class PsqlEventSink:
                 f"VALUES ({self._ph}, {self._ph}, {self._ph})",
                 (block_rowid, tx_rowid, ev.type),
             )
+            # ABCI allows repeated keys within one event; the schema's
+            # UNIQUE (event_id, key) (kept for reference parity) would
+            # otherwise roll back the whole block's indexing — ignore
+            # conflicts so the first occurrence wins instead.
+            if self.dialect == "postgres":
+                sql = (
+                    f"INSERT INTO attributes "
+                    f"(event_id, key, composite_key, value) VALUES "
+                    f"({self._ph}, {self._ph}, {self._ph}, {self._ph}) "
+                    f"ON CONFLICT DO NOTHING"
+                )
+            else:
+                sql = (
+                    f"INSERT OR IGNORE INTO attributes "
+                    f"(event_id, key, composite_key, value) VALUES "
+                    f"({self._ph}, {self._ph}, {self._ph}, {self._ph})"
+                )
             for attr in ev.attributes:
                 if not getattr(attr, "index", True):
                     continue  # only indexed attributes are recorded
                 cur.execute(
-                    f"INSERT INTO attributes "
-                    f"(event_id, key, composite_key, value) "
-                    f"VALUES ({self._ph}, {self._ph}, {self._ph}, {self._ph})",
+                    sql,
                     (ev_id, attr.key, f"{ev.type}.{attr.key}", attr.value),
                 )
 
@@ -309,32 +324,6 @@ def connect_from_dsn(dsn: str):
         "indexer = \"psql\" needs a postgres DB-API driver "
         "(psycopg2 or pg8000) importable in this environment"
     )
-
-
-def build_indexers(config, chain_id: str):
-    """Shared indexer selection for the node and `reindex-event`
-    (single source of truth for the kv/psql/null dispatch).
-
-    Returns (tx_indexer, block_indexer, closer) — call ``closer()``
-    when done (closes the kv DB or the psql connection)."""
-    from cometbft_tpu.state.txindex import (
-        BlockIndexer,
-        NullIndexer,
-        TxIndexer,
-    )
-    from cometbft_tpu.utils.db import open_db
-
-    kind = config.tx_index.indexer
-    if kind == "kv":
-        db = open_db("tx_index", config.base.db_backend, config.db_dir)
-        return TxIndexer(db), BlockIndexer(db), db.close
-    if kind == "psql":
-        sink = PsqlEventSink(
-            connect_from_dsn(config.tx_index.psql_conn), chain_id
-        )
-        sink.ensure_schema()
-        return sink.tx_indexer(), sink.block_indexer(), sink.close
-    return NullIndexer(), NullIndexer(), (lambda: None)
 
 
 __all__ = [

@@ -348,9 +348,36 @@ class IndexerService(BaseService):
         self.tx_indexer.index(data.height, data.index, data.tx, data.result)
 
 
+def build_indexers(config, chain_id: str):
+    """Shared indexer selection for the node and `reindex-event`
+    (single source of truth for the kv/psql/null dispatch).
+
+    Returns (tx_indexer, block_indexer, closer) — call ``closer()``
+    when done (closes the kv DB or the psql connection)."""
+    from cometbft_tpu.utils.db import open_db
+
+    kind = config.tx_index.indexer
+    if kind == "kv":
+        db = open_db("tx_index", config.base.db_backend, config.db_dir)
+        return TxIndexer(db), BlockIndexer(db), db.close
+    if kind == "psql":
+        from cometbft_tpu.state.sink_psql import (
+            PsqlEventSink,
+            connect_from_dsn,
+        )
+
+        sink = PsqlEventSink(
+            connect_from_dsn(config.tx_index.psql_conn), chain_id
+        )
+        sink.ensure_schema()
+        return sink.tx_indexer(), sink.block_indexer(), sink.close
+    return NullIndexer(), NullIndexer(), (lambda: None)
+
+
 __all__ = [
     "BlockIndexer",
     "IndexerService",
+    "build_indexers",
     "NullIndexer",
     "TxIndexer",
 ]

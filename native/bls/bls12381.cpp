@@ -1,0 +1,1610 @@
+// BLS12-381 host-side native backend — the framework's blst-equivalent
+// (reference dependency: supranational/blst via cgo, SURVEY.md §2.9;
+// reference API surface: crypto/bls12381/key_bls12381.go).
+//
+// Same algorithms as the differentially-tested Python implementation in
+// cometbft_tpu/crypto/bls12381.py (which tests/test_bls.py pins against
+// a naive dense-polynomial oracle):
+//   - Fq: 6x64-bit Montgomery arithmetic (CIOS multiplication)
+//   - tower Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3 - (1+u)),
+//     Fq12 = Fq6[w]/(w^2 - v)
+//   - optimal-ate Miller loop over affine twist points with Montgomery
+//     batch inversion across pairs per step, sparse w^0/w^3/w^5 lines
+//   - final exponentiation: easy part then the x-chain hard part via
+//     3*(p^4-p^2+1)/r = (x-1)^2 (x+p) (x^2+p^2-1) + 3
+//   - subgroup checks: x-chain for G1, psi eigenvalue for G2
+//   - RFC 9380 hash-to-G2: expand_message_xmd(SHA-256), SSWU onto the
+//     3-isogenous curve, derived isogeny (tools/derive_g2_isogeny.py),
+//     psi-based cofactor clearing
+//
+// Exposed as a small C ABI consumed through ctypes by
+// cometbft_tpu/crypto/bls_native.py; min-PK shape (G1 uncompressed
+// 96-byte pubkeys, G2 compressed 96-byte signatures).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 bls12381.cpp -o libcmtbls.so
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+typedef uint8_t u8;
+
+// ----------------------------------------------------------------- fp
+// little-endian 6x64 limbs; values kept in Montgomery form (R = 2^384)
+
+struct fp { u64 l[6]; };
+
+static const u64 P_LIMBS[6] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL,
+    0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL,
+};
+
+static fp FP_ZERO, FP_ONE /*montgomery R*/, FP_R2;
+static u64 P_INV; // -p^{-1} mod 2^64
+
+static inline int fp_cmp_raw(const u64 a[6], const u64 b[6]) {
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static inline void fp_sub_raw(u64 out[6], const u64 a[6], const u64 b[6]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a[i] - b[i] - borrow;
+        out[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+static inline void fp_add(fp &out, const fp &a, const fp &b) {
+    u128 carry = 0;
+    u64 t[6];
+    for (int i = 0; i < 6; i++) {
+        u128 s = (u128)a.l[i] + b.l[i] + carry;
+        t[i] = (u64)s;
+        carry = s >> 64;
+    }
+    if (carry || fp_cmp_raw(t, P_LIMBS) >= 0) fp_sub_raw(out.l, t, P_LIMBS);
+    else memcpy(out.l, t, sizeof t);
+}
+
+static inline void fp_sub(fp &out, const fp &a, const fp &b) {
+    if (fp_cmp_raw(a.l, b.l) >= 0) {
+        fp_sub_raw(out.l, a.l, b.l);
+    } else {
+        u64 t[6];
+        fp_sub_raw(t, b.l, a.l);
+        fp_sub_raw(out.l, P_LIMBS, t);
+    }
+}
+
+static inline void fp_neg(fp &out, const fp &a) {
+    bool zero = true;
+    for (int i = 0; i < 6; i++) if (a.l[i]) { zero = false; break; }
+    if (zero) { out = a; return; }
+    fp_sub_raw(out.l, P_LIMBS, a.l);
+}
+
+static inline bool fp_is_zero(const fp &a) {
+    for (int i = 0; i < 6; i++) if (a.l[i]) return false;
+    return true;
+}
+
+// CIOS Montgomery multiplication
+static void fp_mul(fp &out, const fp &a, const fp &b) {
+    u64 t[8] = {0};
+    for (int i = 0; i < 6; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 6; j++) {
+            u128 s = (u128)t[j] + (u128)a.l[i] * b.l[j] + carry;
+            t[j] = (u64)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[6] + carry;
+        t[6] = (u64)s;
+        t[7] = (u64)(s >> 64);
+        u64 m = t[0] * P_INV;
+        carry = ((u128)t[0] + (u128)m * P_LIMBS[0]) >> 64;
+        for (int j = 1; j < 6; j++) {
+            u128 s2 = (u128)t[j] + (u128)m * P_LIMBS[j] + carry;
+            t[j - 1] = (u64)s2;
+            carry = s2 >> 64;
+        }
+        s = (u128)t[6] + carry;
+        t[5] = (u64)s;
+        t[6] = t[7] + (u64)(s >> 64);
+        t[7] = 0;
+    }
+    if (t[6] || fp_cmp_raw(t, P_LIMBS) >= 0) fp_sub_raw(out.l, t, P_LIMBS);
+    else memcpy(out.l, t, 6 * sizeof(u64));
+}
+
+static inline void fp_sqr(fp &out, const fp &a) { fp_mul(out, a, a); }
+
+// from/to big-endian 48-byte strings (standard serialization)
+static bool fp_from_be(fp &out, const u8 in[48]) {
+    u64 raw[6];
+    for (int i = 0; i < 6; i++) {
+        u64 w = 0;
+        for (int j = 0; j < 8; j++) w = (w << 8) | in[(5 - i) * 8 + j];
+        raw[i] = w;
+    }
+    if (fp_cmp_raw(raw, P_LIMBS) >= 0) return false;
+    fp tmp;
+    memcpy(tmp.l, raw, sizeof raw);
+    fp_mul(out, tmp, FP_R2); // to Montgomery form
+    return true;
+}
+
+static void fp_to_be(u8 out[48], const fp &a) {
+    fp one_inv; // from Montgomery: multiply by 1
+    fp one;
+    memset(one.l, 0, sizeof one.l);
+    one.l[0] = 1;
+    fp_mul(one_inv, a, one);
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+            out[(5 - i) * 8 + j] = (u8)(one_inv.l[i] >> (8 * (7 - j)));
+}
+
+// generic exponentiation by big-endian bit scan of a raw 6-limb exponent
+static void fp_pow_raw(fp &out, const fp &base, const u64 e[6]) {
+    fp acc = FP_ONE, b = base;
+    for (int i = 0; i < 384; i++) {
+        int limb = i / 64, bit = i % 64;
+        if ((e[limb] >> bit) & 1) fp_mul(acc, acc, b);
+        fp_sqr(b, b);
+    }
+    out = acc;
+}
+
+static void fp_inv(fp &out, const fp &a) {
+    u64 e[6];
+    memcpy(e, P_LIMBS, sizeof e);
+    e[0] -= 2; // p - 2 (p is odd, no borrow)
+    fp_pow_raw(out, a, e);
+}
+
+static bool fp_sqrt(fp &out, const fp &a) {
+    // p ≡ 3 mod 4: sqrt = a^((p+1)/4)
+    u64 e[6];
+    u128 carry = 1;
+    for (int i = 0; i < 6; i++) {
+        u128 s = (u128)P_LIMBS[i] + (i == 0 ? 1 : 0);
+        (void)carry;
+        e[i] = (u64)s;
+        if (i == 0 && s >> 64) { /* impossible: p+1 fits */ }
+    }
+    // shift right by 2
+    for (int i = 0; i < 6; i++) {
+        e[i] = (e[i] >> 2) | (i < 5 ? (e[i + 1] << 62) : 0);
+    }
+    fp cand;
+    fp_pow_raw(cand, a, e);
+    fp chk;
+    fp_sqr(chk, cand);
+    if (memcmp(chk.l, a.l, sizeof chk.l) != 0) return false;
+    out = cand;
+    return true;
+}
+
+static bool fp_eq(const fp &a, const fp &b) {
+    return memcmp(a.l, b.l, sizeof a.l) == 0;
+}
+
+// is the canonical integer odd? (exit Montgomery first)
+static bool fp_is_odd(const fp &a) {
+    u8 be[48];
+    fp_to_be(be, a);
+    return be[47] & 1;
+}
+
+// lexicographic "largest" flag: a > (p-1)/2
+static bool fp_lex_larger(const fp &a) {
+    u8 be[48];
+    fp_to_be(be, a);
+    u64 raw[6];
+    for (int i = 0; i < 6; i++) {
+        u64 w = 0;
+        for (int j = 0; j < 8; j++) w = (w << 8) | be[(5 - i) * 8 + j];
+        raw[i] = w;
+    }
+    // compare 2a vs p: a > (p-1)/2 iff 2a > p-1 iff 2a >= p+1 iff 2a > p
+    u64 dbl[6];
+    u64 top = 0;
+    for (int i = 0; i < 6; i++) {
+        u64 nt = raw[i] >> 63;
+        dbl[i] = (raw[i] << 1) | top;
+        top = nt;
+    }
+    if (top) return true;
+    return fp_cmp_raw(dbl, P_LIMBS) > 0;
+}
+
+// ---------------------------------------------------------------- fp2
+
+struct fp2 { fp c0, c1; };
+
+static fp2 FP2_ZERO, FP2_ONE;
+
+static inline void fp2_add(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp_add(o.c0, a.c0, b.c0);
+    fp_add(o.c1, a.c1, b.c1);
+}
+static inline void fp2_sub(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp_sub(o.c0, a.c0, b.c0);
+    fp_sub(o.c1, a.c1, b.c1);
+}
+static inline void fp2_neg(fp2 &o, const fp2 &a) {
+    fp_neg(o.c0, a.c0);
+    fp_neg(o.c1, a.c1);
+}
+static inline void fp2_conj(fp2 &o, const fp2 &a) {
+    o.c0 = a.c0;
+    fp_neg(o.c1, a.c1);
+}
+static void fp2_mul(fp2 &o, const fp2 &a, const fp2 &b) {
+    fp t0, t1, s0, s1, m;
+    fp_mul(t0, a.c0, b.c0);
+    fp_mul(t1, a.c1, b.c1);
+    fp_add(s0, a.c0, a.c1);
+    fp_add(s1, b.c0, b.c1);
+    fp_mul(m, s0, s1);
+    fp2 r;
+    fp_sub(r.c0, t0, t1);
+    fp_sub(m, m, t0);
+    fp_sub(r.c1, m, t1);
+    o = r;
+}
+static void fp2_sqr(fp2 &o, const fp2 &a) {
+    // (a0+a1)(a0-a1) + 2 a0 a1 u
+    fp s, d, m;
+    fp_add(s, a.c0, a.c1);
+    fp_sub(d, a.c0, a.c1);
+    fp_mul(m, a.c0, a.c1);
+    fp2 r;
+    fp_mul(r.c0, s, d);
+    fp_add(r.c1, m, m);
+    o = r;
+}
+static inline void fp2_mul_xi(fp2 &o, const fp2 &a) {
+    // * (1 + u)
+    fp t0, t1;
+    fp_sub(t0, a.c0, a.c1);
+    fp_add(t1, a.c0, a.c1);
+    o.c0 = t0;
+    o.c1 = t1;
+}
+static void fp2_inv(fp2 &o, const fp2 &a) {
+    fp n, t, inv;
+    fp_sqr(n, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(n, n, t);
+    fp_inv(inv, n);
+    fp2 r;
+    fp_mul(r.c0, a.c0, inv);
+    fp_mul(t, a.c1, inv);
+    fp_neg(r.c1, t);
+    o = r;
+}
+static inline bool fp2_is_zero(const fp2 &a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+static inline bool fp2_eq(const fp2 &a, const fp2 &b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+static void fp2_scale(fp2 &o, const fp2 &a, const fp &s) {
+    fp_mul(o.c0, a.c0, s);
+    fp_mul(o.c1, a.c1, s);
+}
+
+// sqrt in fp2, complex method (matches python f2_sqrt)
+static bool fp2_sqrt(fp2 &o, const fp2 &a) {
+    if (fp2_is_zero(a)) { o = FP2_ZERO; return true; }
+    if (fp_is_zero(a.c1)) {
+        fp c;
+        if (fp_sqrt(c, a.c0)) {
+            o.c0 = c;
+            o.c1 = FP_ZERO.l[0] ? FP_ZERO : FP_ZERO, o.c1 = FP_ZERO;
+            o.c1 = FP_ZERO;
+            return true;
+        }
+        fp na;
+        fp_neg(na, a.c0);
+        if (fp_sqrt(c, na)) {
+            o.c0 = FP_ZERO;
+            o.c1 = c;
+            return true;
+        }
+        return false;
+    }
+    fp alpha, t, s;
+    fp_sqr(alpha, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(alpha, alpha, t); // norm
+    if (!fp_sqrt(s, alpha)) return false;
+    fp two_inv, delta, x0;
+    // 1/2 = (p+1)/2 mod p: compute via fp_inv of 2
+    fp two = FP_ONE;
+    fp_add(two, FP_ONE, FP_ONE);
+    fp_inv(two_inv, two);
+    fp_add(delta, a.c0, s);
+    fp_mul(delta, delta, two_inv);
+    if (!fp_sqrt(x0, delta)) {
+        fp_sub(delta, a.c0, s);
+        fp_mul(delta, delta, two_inv);
+        if (!fp_sqrt(x0, delta)) return false;
+    }
+    fp x0_dbl, x0_inv;
+    fp_add(x0_dbl, x0, x0);
+    fp_inv(x0_inv, x0_dbl);
+    fp2 cand;
+    cand.c0 = x0;
+    fp_mul(cand.c1, a.c1, x0_inv);
+    fp2 chk;
+    fp2_sqr(chk, cand);
+    if (!fp2_eq(chk, a)) return false;
+    o = cand;
+    return true;
+}
+
+// ---------------------------------------------------------------- fp6
+
+struct fp6 { fp2 c0, c1, c2; };
+
+static void fp6_add(fp6 &o, const fp6 &a, const fp6 &b) {
+    fp2_add(o.c0, a.c0, b.c0);
+    fp2_add(o.c1, a.c1, b.c1);
+    fp2_add(o.c2, a.c2, b.c2);
+}
+static void fp6_sub(fp6 &o, const fp6 &a, const fp6 &b) {
+    fp2_sub(o.c0, a.c0, b.c0);
+    fp2_sub(o.c1, a.c1, b.c1);
+    fp2_sub(o.c2, a.c2, b.c2);
+}
+static void fp6_neg(fp6 &o, const fp6 &a) {
+    fp2_neg(o.c0, a.c0);
+    fp2_neg(o.c1, a.c1);
+    fp2_neg(o.c2, a.c2);
+}
+static void fp6_mul(fp6 &o, const fp6 &a, const fp6 &b) {
+    fp2 t0, t1, t2, s, u, v;
+    fp2_mul(t0, a.c0, b.c0);
+    fp2_mul(t1, a.c1, b.c1);
+    fp2_mul(t2, a.c2, b.c2);
+    fp6 r;
+    // c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    fp2_add(s, a.c1, a.c2);
+    fp2_add(u, b.c1, b.c2);
+    fp2_mul(v, s, u);
+    fp2_sub(v, v, t1);
+    fp2_sub(v, v, t2);
+    fp2_mul_xi(v, v);
+    fp2_add(r.c0, t0, v);
+    // c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    fp2_add(s, a.c0, a.c1);
+    fp2_add(u, b.c0, b.c1);
+    fp2_mul(v, s, u);
+    fp2_sub(v, v, t0);
+    fp2_sub(v, v, t1);
+    fp2 xt2;
+    fp2_mul_xi(xt2, t2);
+    fp2_add(r.c1, v, xt2);
+    // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    fp2_add(s, a.c0, a.c2);
+    fp2_add(u, b.c0, b.c2);
+    fp2_mul(v, s, u);
+    fp2_sub(v, v, t0);
+    fp2_sub(v, v, t2);
+    fp2_add(r.c2, v, t1);
+    o = r;
+}
+static void fp6_mul_v(fp6 &o, const fp6 &a) {
+    fp6 r;
+    fp2_mul_xi(r.c0, a.c2);
+    r.c1 = a.c0;
+    r.c2 = a.c1;
+    o = r;
+}
+static void fp6_scale2(fp6 &o, const fp6 &a, const fp2 &s) {
+    fp2_mul(o.c0, a.c0, s);
+    fp2_mul(o.c1, a.c1, s);
+    fp2_mul(o.c2, a.c2, s);
+}
+static void fp6_inv(fp6 &o, const fp6 &a) {
+    fp2 c0, c1, c2, t, u;
+    fp2_sqr(c0, a.c0);
+    fp2_mul(t, a.c1, a.c2);
+    fp2_mul_xi(t, t);
+    fp2_sub(c0, c0, t);
+    fp2_sqr(c1, a.c2);
+    fp2_mul_xi(c1, c1);
+    fp2_mul(t, a.c0, a.c1);
+    fp2_sub(c1, c1, t);
+    fp2_sqr(c2, a.c1);
+    fp2_mul(t, a.c0, a.c2);
+    fp2_sub(c2, c2, t);
+    fp2_mul(t, a.c2, c1);
+    fp2_mul(u, a.c1, c2);
+    fp2_add(t, t, u);
+    fp2_mul_xi(t, t);
+    fp2_mul(u, a.c0, c0);
+    fp2_add(t, t, u);
+    fp2 ti;
+    fp2_inv(ti, t);
+    fp2_mul(o.c0, c0, ti);
+    fp2_mul(o.c1, c1, ti);
+    fp2_mul(o.c2, c2, ti);
+}
+
+// --------------------------------------------------------------- fp12
+
+struct fp12 { fp6 c0, c1; };
+
+static fp12 FP12_ONE;
+
+static void fp12_mul(fp12 &o, const fp12 &a, const fp12 &b) {
+    fp6 t0, t1, s, u, v;
+    fp6_mul(t0, a.c0, b.c0);
+    fp6_mul(t1, a.c1, b.c1);
+    fp6_add(s, a.c0, a.c1);
+    fp6_add(u, b.c0, b.c1);
+    fp6_mul(v, s, u);
+    fp6_sub(v, v, t0);
+    fp6_sub(v, v, t1);
+    fp12 r;
+    fp6 vt1;
+    fp6_mul_v(vt1, t1);
+    fp6_add(r.c0, t0, vt1);
+    r.c1 = v;
+    o = r;
+}
+static void fp12_sqr(fp12 &o, const fp12 &a) {
+    fp6 t, s, u, v;
+    fp6_mul(t, a.c0, a.c1);
+    fp6_add(s, a.c0, a.c1);
+    fp6_mul_v(u, a.c1);
+    fp6_add(u, a.c0, u);
+    fp6_mul(v, s, u);
+    fp6_sub(v, v, t);
+    fp6 vt;
+    fp6_mul_v(vt, t);
+    fp6_sub(v, v, vt);
+    fp12 r;
+    r.c0 = v;
+    fp6_add(r.c1, t, t);
+    o = r;
+}
+static void fp12_conj(fp12 &o, const fp12 &a) {
+    o.c0 = a.c0;
+    fp6_neg(o.c1, a.c1);
+}
+static void fp12_inv(fp12 &o, const fp12 &a) {
+    fp6 t, u;
+    fp6_mul(t, a.c0, a.c0);
+    fp6_mul(u, a.c1, a.c1);
+    fp6_mul_v(u, u);
+    fp6_sub(t, t, u);
+    fp6_inv(t, t);
+    fp12 r;
+    fp6_mul(r.c0, a.c0, t);
+    fp6_mul(u, a.c1, t);
+    fp6_neg(r.c1, u);
+    o = r;
+}
+static bool fp12_is_one(const fp12 &a) {
+    if (!fp2_eq(a.c0.c0, FP2_ONE)) return false;
+    const fp2 *zs[5] = {&a.c0.c1, &a.c0.c2, &a.c1.c0, &a.c1.c1, &a.c1.c2};
+    for (auto z : zs) if (!fp2_is_zero(*z)) return false;
+    return true;
+}
+
+// Frobenius constants (computed at init from xi powers)
+static fp2 F6C1, F6C2, F12C, PSI_CX, PSI_CY;
+
+static void fp2_pow_raw(fp2 &o, const fp2 &a, const u64 *e, int limbs) {
+    fp2 acc = FP2_ONE, b = a;
+    for (int i = 0; i < limbs * 64; i++) {
+        if ((e[i / 64] >> (i % 64)) & 1) fp2_mul(acc, acc, b);
+        fp2_sqr(b, b);
+    }
+    o = acc;
+}
+
+static void fp6_frob(fp6 &o, const fp6 &a) {
+    fp2 t;
+    fp2_conj(o.c0, a.c0);
+    fp2_conj(t, a.c1);
+    fp2_mul(o.c1, t, F6C1);
+    fp2_conj(t, a.c2);
+    fp2_mul(o.c2, t, F6C2);
+}
+static void fp12_frob(fp12 &o, const fp12 &a) {
+    fp6 t;
+    fp6_frob(o.c0, a.c0);
+    fp6_frob(t, a.c1);
+    fp6_scale2(o.c1, t, F12C);
+}
+static void fp12_frob2(fp12 &o, const fp12 &a) {
+    fp12 t;
+    fp12_frob(t, a);
+    fp12_frob(o, t);
+}
+
+// ------------------------------------------------------------- curves
+
+struct g1a { fp x, y; bool inf; };
+struct g2a { fp2 x, y; bool inf; };
+struct g1j { fp x, y, z; };
+struct g2j { fp2 x, y, z; };
+
+static fp FP_B1;   // 4
+static fp2 FP2_B2; // 4(1+u)
+static g1a G1_GEN;
+static g2a G2_GEN;
+
+static const u64 BLS_X = 0xd201000000010000ULL; // |x|; parameter is -x
+
+// generic jacobian over a templated field — macro-free duplication
+#define DEFJAC(FN, FT, JT, AT)                                            \
+static void FN##_dbl(JT &o, const JT &p) {                                \
+    if (FT##_is_zero(p.z) || FT##_is_zero(p.y)) {                         \
+        o.x = o.y = p.x; o.z = p.z;                                       \
+        FT##_sub(o.z, o.z, o.z); /* zero */                               \
+        o.x = p.x; o.y = p.y;                                             \
+        return;                                                           \
+    }                                                                     \
+    FT A, B, C, D, E, F2_, t;                                             \
+    FT##_sqr(A, p.x); FT##_sqr(B, p.y); FT##_sqr(C, B);                   \
+    FT##_add(t, p.x, B); FT##_sqr(t, t); FT##_sub(t, t, A);               \
+    FT##_sub(t, t, C); FT##_add(D, t, t);                                 \
+    FT##_add(E, A, A); FT##_add(E, E, A);                                 \
+    FT##_sqr(F2_, E);                                                     \
+    JT r;                                                                 \
+    FT##_sub(r.x, F2_, D); FT##_sub(r.x, r.x, D);                         \
+    FT C8;                                                                \
+    FT##_add(C8, C, C); FT##_add(C8, C8, C8); FT##_add(C8, C8, C8);       \
+    FT##_sub(t, D, r.x); FT##_mul(t, E, t); FT##_sub(r.y, t, C8);         \
+    FT##_add(t, p.y, p.y); FT##_mul(r.z, t, p.z);                         \
+    o = r;                                                                \
+}                                                                         \
+static void FN##_add(JT &o, const JT &p, const JT &q) {                   \
+    if (FT##_is_zero(p.z)) { o = q; return; }                             \
+    if (FT##_is_zero(q.z)) { o = p; return; }                             \
+    FT z1z1, z2z2, u1, u2, s1, s2, h, rr, t;                              \
+    FT##_sqr(z1z1, p.z); FT##_sqr(z2z2, q.z);                             \
+    FT##_mul(u1, p.x, z2z2); FT##_mul(u2, q.x, z1z1);                     \
+    FT##_mul(t, p.y, q.z); FT##_mul(s1, t, z2z2);                         \
+    FT##_mul(t, q.y, p.z); FT##_mul(s2, t, z1z1);                         \
+    FT##_sub(h, u2, u1); FT##_sub(rr, s2, s1);                            \
+    if (FT##_is_zero(h)) {                                                \
+        if (FT##_is_zero(rr)) { FN##_dbl(o, p); return; }                 \
+        o.x = p.x; o.y = p.y; FT##_sub(o.z, p.z, p.z); return;            \
+    }                                                                     \
+    FT hh, hhh, v;                                                        \
+    FT##_sqr(hh, h); FT##_mul(hhh, h, hh); FT##_mul(v, u1, hh);           \
+    JT r;                                                                 \
+    FT##_sqr(t, rr); FT##_sub(t, t, hhh);                                 \
+    FT##_sub(t, t, v); FT##_sub(r.x, t, v);                               \
+    FT##_sub(t, v, r.x); FT##_mul(t, rr, t);                              \
+    FT s1h;                                                               \
+    FT##_mul(s1h, s1, hhh); FT##_sub(r.y, t, s1h);                        \
+    FT##_mul(t, p.z, q.z); FT##_mul(r.z, t, h);                           \
+    o = r;                                                                \
+}
+
+DEFJAC(g1j, fp, g1j, g1a)
+DEFJAC(g2j, fp2, g2j, g2a)
+
+static void g1j_from_affine(g1j &o, const g1a &a) {
+    if (a.inf) { o.x = FP_ONE; o.y = FP_ONE; memset(o.z.l, 0, sizeof o.z.l); return; }
+    o.x = a.x; o.y = a.y; o.z = FP_ONE;
+}
+static void g2j_from_affine(g2j &o, const g2a &a) {
+    if (a.inf) { o.x = FP2_ONE; o.y = FP2_ONE; o.z = FP2_ZERO; return; }
+    o.x = a.x; o.y = a.y; o.z = FP2_ONE;
+}
+static void g1j_to_affine(g1a &o, const g1j &p) {
+    if (fp_is_zero(p.z)) { o.inf = true; return; }
+    fp zi, zi2, zi3;
+    fp_inv(zi, p.z);
+    fp_sqr(zi2, zi);
+    fp_mul(zi3, zi2, zi);
+    fp_mul(o.x, p.x, zi2);
+    fp_mul(o.y, p.y, zi3);
+    o.inf = false;
+}
+static void g2j_to_affine(g2a &o, const g2j &p) {
+    if (fp2_is_zero(p.z)) { o.inf = true; return; }
+    fp2 zi, zi2, zi3;
+    fp2_inv(zi, p.z);
+    fp2_sqr(zi2, zi);
+    fp2_mul(zi3, zi2, zi);
+    fp2_mul(o.x, p.x, zi2);
+    fp2_mul(o.y, p.y, zi3);
+    o.inf = false;
+}
+
+// scalar mult by big-endian 32-byte scalar
+static void g1j_mul_be(g1j &o, const g1j &p, const u8 *k, size_t klen) {
+    g1j acc;
+    acc.x = FP_ONE; acc.y = FP_ONE; memset(acc.z.l, 0, sizeof acc.z.l);
+    for (size_t i = 0; i < klen; i++) {
+        for (int b = 7; b >= 0; b--) {
+            g1j_dbl(acc, acc);
+            if ((k[i] >> b) & 1) g1j_add(acc, acc, p);
+        }
+    }
+    o = acc;
+}
+static void g2j_mul_be(g2j &o, const g2j &p, const u8 *k, size_t klen) {
+    g2j acc;
+    acc.x = FP2_ONE; acc.y = FP2_ONE; acc.z = FP2_ZERO;
+    for (size_t i = 0; i < klen; i++) {
+        for (int b = 7; b >= 0; b--) {
+            g2j_dbl(acc, acc);
+            if ((k[i] >> b) & 1) g2j_add(acc, acc, p);
+        }
+    }
+    o = acc;
+}
+static void g2j_mul_u64(g2j &o, const g2j &p, u64 k) {
+    u8 be[8];
+    for (int i = 0; i < 8; i++) be[i] = (u8)(k >> (8 * (7 - i)));
+    g2j_mul_be(o, p, be, 8);
+}
+static void g1j_mul_u64(g1j &o, const g1j &p, u64 k) {
+    u8 be[8];
+    for (int i = 0; i < 8; i++) be[i] = (u8)(k >> (8 * (7 - i)));
+    g1j_mul_be(o, p, be, 8);
+}
+
+static bool g1_on_curve(const g1a &p) {
+    if (p.inf) return true;
+    fp y2, x3;
+    fp_sqr(y2, p.y);
+    fp_sqr(x3, p.x);
+    fp_mul(x3, x3, p.x);
+    fp_add(x3, x3, FP_B1);
+    return fp_eq(y2, x3);
+}
+static bool g2_on_curve(const g2a &p) {
+    if (p.inf) return true;
+    fp2 y2, x3;
+    fp2_sqr(y2, p.y);
+    fp2_sqr(x3, p.x);
+    fp2_mul(x3, x3, p.x);
+    fp2_add(x3, x3, FP2_B2);
+    return fp2_eq(y2, x3);
+}
+
+// G1 subgroup: [x^2]([x^2]P - P) + P == O
+static bool g1_in_subgroup(const g1a &p) {
+    if (p.inf) return true;
+    g1j j, u, w, z;
+    g1j_from_affine(j, p);
+    g1j_mul_u64(u, j, BLS_X);
+    g1j_mul_u64(u, u, BLS_X);
+    g1j nj = j;
+    fp_neg(nj.y, j.y);
+    g1j_add(w, u, nj);
+    g1j_mul_u64(z, w, BLS_X);
+    g1j_mul_u64(z, z, BLS_X);
+    g1j_add(z, z, j);
+    return fp_is_zero(z.z);
+}
+
+// psi endomorphism on the twist
+static void g2_psi(g2a &o, const g2a &p) {
+    if (p.inf) { o.inf = true; return; }
+    fp2 cx, cy;
+    fp2_conj(cx, p.x);
+    fp2_conj(cy, p.y);
+    fp2_mul(o.x, cx, PSI_CX);
+    fp2_mul(o.y, cy, PSI_CY);
+    o.inf = false;
+}
+// G2 subgroup: psi(Q) == [x]Q (x negative: compare with -[|x|]Q)
+static bool g2_in_subgroup(const g2a &p) {
+    if (p.inf) return true;
+    g2a ps;
+    g2_psi(ps, p);
+    g2j j, m;
+    g2j_from_affine(j, p);
+    g2j_mul_u64(m, j, BLS_X);
+    g2a ma;
+    g2j_to_affine(ma, m);
+    if (ma.inf) return ps.inf;
+    fp2 negy;
+    fp2_neg(negy, ma.y);
+    return !ps.inf && fp2_eq(ps.x, ma.x) && fp2_eq(ps.y, negy);
+}
+
+// ------------------------------------------------------------ pairing
+// affine Miller loop with batch inversion; sparse lines at w^0,w^3,w^5
+
+struct pair_pq { g1a p; g2a q; };
+
+static void fp12_mul_sparse(fp12 &f, const fp2 &s0, const fp2 &s4,
+                            const fp2 &s5) {
+    fp12 l;
+    l.c0.c0 = s0;
+    l.c0.c1 = FP2_ZERO;
+    l.c0.c2 = FP2_ZERO;
+    l.c1.c0 = FP2_ZERO;
+    l.c1.c1 = s4;
+    l.c1.c2 = s5;
+    fp12_mul(f, f, l);
+}
+
+static void batch_inv_fp2(std::vector<fp2> &vals) {
+    size_t n = vals.size();
+    if (!n) return;
+    std::vector<fp2> prefix(n + 1);
+    prefix[0] = FP2_ONE;
+    for (size_t i = 0; i < n; i++) fp2_mul(prefix[i + 1], prefix[i], vals[i]);
+    fp2 inv_all;
+    fp2_inv(inv_all, prefix[n]);
+    for (size_t i = n; i-- > 0;) {
+        fp2 out;
+        fp2_mul(out, prefix[i], inv_all);
+        fp2_mul(inv_all, inv_all, vals[i]);
+        vals[i] = out;
+    }
+}
+
+static void miller_loop(fp12 &out, const std::vector<pair_pq> &pairs) {
+    std::vector<g2a> ts;
+    std::vector<fp2> xiy; // xi * yP per pair
+    std::vector<const pair_pq *> live;
+    for (auto &pq : pairs) {
+        if (pq.p.inf || pq.q.inf) continue;
+        live.push_back(&pq);
+        ts.push_back(pq.q);
+        fp2 t;
+        t.c0 = pq.p.y;
+        t.c1 = FP_ZERO;
+        memset(t.c1.l, 0, sizeof t.c1.l);
+        fp2 x;
+        fp2_mul_xi(x, t);
+        xiy.push_back(x);
+    }
+    fp12 acc = FP12_ONE;
+    size_t n = live.size();
+    if (!n) { out = acc; return; }
+    // bits of BLS_X below the MSB, high to low
+    int msb = 63;
+    while (!((BLS_X >> msb) & 1)) msb--;
+    std::vector<fp2> denoms(n);
+    for (int bit = msb - 1; bit >= 0; bit--) {
+        fp12_sqr(acc, acc);
+        // doubling step
+        for (size_t i = 0; i < n; i++) fp2_add(denoms[i], ts[i].y, ts[i].y);
+        batch_inv_fp2(denoms);
+        for (size_t i = 0; i < n; i++) {
+            fp2 xsq, lam, t, s4, s5;
+            fp2_sqr(xsq, ts[i].x);
+            fp2 three_xsq;
+            fp2_add(three_xsq, xsq, xsq);
+            fp2_add(three_xsq, three_xsq, xsq);
+            fp2_mul(lam, three_xsq, denoms[i]);
+            fp2_mul(t, lam, ts[i].x);
+            fp2_sub(s4, t, ts[i].y);
+            fp2 lamxp;
+            fp2 xp2;
+            xp2.c0 = live[i]->p.x;
+            memset(xp2.c1.l, 0, sizeof xp2.c1.l);
+            fp2_mul(lamxp, lam, xp2);
+            fp2_neg(s5, lamxp);
+            fp12_mul_sparse(acc, xiy[i], s4, s5);
+            fp2 x3, y3;
+            fp2_sqr(x3, lam);
+            fp2_sub(x3, x3, ts[i].x);
+            fp2_sub(x3, x3, ts[i].x);
+            fp2_sub(t, ts[i].x, x3);
+            fp2_mul(t, lam, t);
+            fp2_sub(y3, t, ts[i].y);
+            ts[i].x = x3;
+            ts[i].y = y3;
+        }
+        if ((BLS_X >> bit) & 1) {
+            for (size_t i = 0; i < n; i++)
+                fp2_sub(denoms[i], ts[i].x, live[i]->q.x);
+            batch_inv_fp2(denoms);
+            for (size_t i = 0; i < n; i++) {
+                fp2 lam, t, s4, s5;
+                fp2_sub(t, ts[i].y, live[i]->q.y);
+                fp2_mul(lam, t, denoms[i]);
+                fp2_mul(t, lam, ts[i].x);
+                fp2_sub(s4, t, ts[i].y);
+                fp2 xp2, lamxp;
+                xp2.c0 = live[i]->p.x;
+                memset(xp2.c1.l, 0, sizeof xp2.c1.l);
+                fp2_mul(lamxp, lam, xp2);
+                fp2_neg(s5, lamxp);
+                fp12_mul_sparse(acc, xiy[i], s4, s5);
+                fp2 x3, y3;
+                fp2_sqr(x3, lam);
+                fp2_sub(x3, x3, ts[i].x);
+                fp2_sub(x3, x3, live[i]->q.x);
+                fp2_sub(t, ts[i].x, x3);
+                fp2_mul(t, lam, t);
+                fp2_sub(y3, t, ts[i].y);
+                ts[i].x = x3;
+                ts[i].y = y3;
+            }
+        }
+    }
+    fp12_conj(out, acc); // negative x
+}
+
+static void fp12_pow_x(fp12 &o, const fp12 &f) {
+    // f^|x| then conjugate (cyclotomic inverse)
+    fp12 acc = FP12_ONE, base = f;
+    u64 e = BLS_X;
+    while (e) {
+        if (e & 1) fp12_mul(acc, acc, base);
+        e >>= 1;
+        if (e) fp12_sqr(base, base);
+    }
+    fp12_conj(o, acc);
+}
+
+static void final_exp(fp12 &o, const fp12 &fin) {
+    fp12 f, t, inv;
+    // easy: f^(p^6-1), then ^(p^2+1)
+    fp12_conj(t, fin);
+    fp12_inv(inv, fin);
+    fp12_mul(f, t, inv);
+    fp12_frob2(t, f);
+    fp12_mul(f, t, f);
+    // hard: x-chain
+    fp12 a, b, c, d, cx, cxx, fr, fr2, cj;
+    fp12_pow_x(a, f);
+    fp12_conj(cj, f);
+    fp12_mul(a, a, cj);          // f^(x-1)
+    fp12_pow_x(b, a);
+    fp12_conj(cj, a);
+    fp12_mul(b, b, cj);          // a^(x-1)
+    fp12_pow_x(c, b);
+    fp12_frob(fr, b);
+    fp12_mul(c, c, fr);          // b^(x+p)
+    fp12_pow_x(cx, c);
+    fp12_pow_x(cxx, cx);
+    fp12_frob2(fr2, c);
+    fp12_mul(d, cxx, fr2);
+    fp12_conj(cj, c);
+    fp12_mul(d, d, cj);          // c^(x^2+p^2-1)
+    fp12 f2;
+    fp12_sqr(f2, f);
+    fp12_mul(f2, f2, f);
+    fp12_mul(o, d, f2);          // * f^3
+}
+
+static bool pairing_product_is_one(const std::vector<pair_pq> &pairs) {
+    fp12 m, r;
+    miller_loop(m, pairs);
+    final_exp(r, m);
+    return fp12_is_one(r);
+}
+
+// ----------------------------------------------------- serialization
+
+static bool g1_from_uncompressed(g1a &o, const u8 in[96]) {
+    if (in[0] & 0x40) {
+        for (int i = 0; i < 96; i++)
+            if ((i == 0 && in[i] != 0x40) || (i > 0 && in[i])) return false;
+        o.inf = true;
+        return true;
+    }
+    if (in[0] & 0xE0) return false; // compression/sign bits unexpected
+    if (!fp_from_be(o.x, in) || !fp_from_be(o.y, in + 48)) return false;
+    o.inf = false;
+    if (!g1_on_curve(o)) return false;
+    if (!g1_in_subgroup(o)) return false;
+    return true;
+}
+
+static bool g2_from_compressed(g2a &o, const u8 in[96]) {
+    if (!(in[0] & 0x80)) return false;
+    if (in[0] & 0x40) {
+        for (int i = 1; i < 96; i++) if (in[i]) return false;
+        o.inf = true;
+        return true;
+    }
+    u8 x1be[48];
+    memcpy(x1be, in, 48);
+    x1be[0] &= 0x1F;
+    if (!fp_from_be(o.x.c1, x1be) || !fp_from_be(o.x.c0, in + 48))
+        return false;
+    fp2 y2;
+    fp2_sqr(y2, o.x);
+    fp2_mul(y2, y2, o.x);
+    fp2_add(y2, y2, FP2_B2);
+    if (!fp2_sqrt(o.y, y2)) return false;
+    bool big = fp_is_zero(o.y.c1) ? fp_lex_larger(o.y.c0)
+                                  : fp_lex_larger(o.y.c1);
+    bool want_big = (in[0] & 0x20) != 0;
+    if (big != want_big) fp2_neg(o.y, o.y);
+    o.inf = false;
+    if (!g2_in_subgroup(o)) return false;
+    return true;
+}
+
+static void g2_to_compressed(u8 out[96], const g2a &p) {
+    if (p.inf) {
+        memset(out, 0, 96);
+        out[0] = 0xC0;
+        return;
+    }
+    fp_to_be(out, p.x.c1);
+    fp_to_be(out + 48, p.x.c0);
+    out[0] |= 0x80;
+    bool big = fp_is_zero(p.y.c1) ? fp_lex_larger(p.y.c0)
+                                  : fp_lex_larger(p.y.c1);
+    if (big) out[0] |= 0x20;
+}
+
+static void g1_to_uncompressed(u8 out[96], const g1a &p) {
+    if (p.inf) { memset(out, 0, 96); out[0] = 0x40; return; }
+    fp_to_be(out, p.x);
+    fp_to_be(out + 48, p.y);
+}
+
+// ------------------------------------------------------------ sha256
+
+struct sha256_ctx { uint32_t h[8]; u8 buf[64]; u64 len; size_t fill; };
+
+static const uint32_t K256[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2,
+};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha256_block(sha256_ctx &c, const u8 *p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^
+                      (w[i - 15] >> 3);
+        uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^
+                      (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = c.h[0], b = c.h[1], cc = c.h[2], d = c.h[3], e = c.h[4],
+             f = c.h[5], g = c.h[6], h = c.h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K256[i] + w[i];
+        uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+        uint32_t mj = (a & b) ^ (a & cc) ^ (b & cc);
+        uint32_t t2 = S0 + mj;
+        h = g; g = f; f = e; e = d + t1;
+        d = cc; cc = b; b = a; a = t1 + t2;
+    }
+    c.h[0] += a; c.h[1] += b; c.h[2] += cc; c.h[3] += d;
+    c.h[4] += e; c.h[5] += f; c.h[6] += g; c.h[7] += h;
+}
+
+static void sha256_init(sha256_ctx &c) {
+    static const uint32_t iv[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    memcpy(c.h, iv, sizeof iv);
+    c.len = 0;
+    c.fill = 0;
+}
+static void sha256_update(sha256_ctx &c, const u8 *p, size_t n) {
+    c.len += n;
+    while (n) {
+        size_t take = 64 - c.fill;
+        if (take > n) take = n;
+        memcpy(c.buf + c.fill, p, take);
+        c.fill += take;
+        p += take;
+        n -= take;
+        if (c.fill == 64) {
+            sha256_block(c, c.buf);
+            c.fill = 0;
+        }
+    }
+}
+static void sha256_final(sha256_ctx &c, u8 out[32]) {
+    u64 bits = c.len * 8;
+    u8 pad = 0x80;
+    sha256_update(c, &pad, 1);
+    u8 z = 0;
+    while (c.fill != 56) sha256_update(c, &z, 1);
+    u8 lenbe[8];
+    for (int i = 0; i < 8; i++) lenbe[i] = (u8)(bits >> (8 * (7 - i)));
+    sha256_update(c, lenbe, 8);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 4; j++)
+            out[4 * i + j] = (u8)(c.h[i] >> (8 * (3 - j)));
+}
+
+static void sha256(u8 out[32], const u8 *p, size_t n) {
+    sha256_ctx c;
+    sha256_init(c);
+    sha256_update(c, p, n);
+    sha256_final(c, out);
+}
+
+// --------------------------------------------------- RFC 9380 to G2
+
+static const char DST[] = "BLS_SIG_BLS12381G1_XMD:SHA-256_SSWU_RO_NUL_";
+#define DST_LEN (sizeof(DST) - 1)
+
+static void expand_message_xmd(u8 *out, size_t len_out, const u8 *msg,
+                               size_t msg_len) {
+    u8 b0[32], bi[32];
+    sha256_ctx c;
+    sha256_init(c);
+    u8 zpad[64] = {0};
+    sha256_update(c, zpad, 64);
+    sha256_update(c, msg, msg_len);
+    u8 l2[2] = {(u8)(len_out >> 8), (u8)len_out};
+    sha256_update(c, l2, 2);
+    u8 zero = 0;
+    sha256_update(c, &zero, 1);
+    sha256_update(c, (const u8 *)DST, DST_LEN);
+    u8 dlen = (u8)DST_LEN;
+    sha256_update(c, &dlen, 1);
+    sha256_final(c, b0);
+    size_t ell = (len_out + 31) / 32;
+    u8 prev[32];
+    for (size_t i = 1; i <= ell; i++) {
+        sha256_init(c);
+        if (i == 1) {
+            sha256_update(c, b0, 32);
+        } else {
+            u8 x[32];
+            for (int j = 0; j < 32; j++) x[j] = b0[j] ^ prev[j];
+            sha256_update(c, x, 32);
+        }
+        u8 ib = (u8)i;
+        sha256_update(c, &ib, 1);
+        sha256_update(c, (const u8 *)DST, DST_LEN);
+        sha256_update(c, &dlen, 1);
+        sha256_final(c, bi);
+        memcpy(prev, bi, 32);
+        size_t off = (i - 1) * 32;
+        size_t take = len_out - off < 32 ? len_out - off : 32;
+        memcpy(out + off, bi, take);
+    }
+}
+
+// reduce a 64-byte big-endian integer mod p into Montgomery form:
+// split as hi*2^256 + lo; both halves fit 6 limbs after conversion
+static void fp_from_be64_mod(fp &out, const u8 in[64]) {
+    // process byte-by-byte: out = out*256 + b
+    fp acc;
+    memset(acc.l, 0, sizeof acc.l);
+    fp c256;
+    memset(c256.l, 0, sizeof c256.l);
+    c256.l[0] = 256;
+    fp mont256;
+    fp_mul(mont256, c256, FP_R2); // montgomery form of 256
+    // acc is kept in montgomery form; per-byte: acc = acc*256 + b
+    for (int i = 0; i < 64; i++) {
+        fp_mul(acc, acc, mont256);
+        fp bmont;
+        memset(bmont.l, 0, sizeof bmont.l);
+        bmont.l[0] = in[i];
+        fp bm;
+        fp_mul(bm, bmont, FP_R2);
+        fp_add(acc, acc, bm);
+    }
+    out = acc;
+}
+
+// SSWU constants + iso3 tables (initialized in init())
+static fp2 SSWU_A, SSWU_B, SSWU_Z;
+static fp2 ISO_XNUM[4], ISO_XDEN[3], ISO_YNUM[4], ISO_YDEN[4];
+
+static int fp2_sgn0(const fp2 &a) {
+    // parity of first nonzero coordinate (RFC 9380 m=2)
+    u8 be[48];
+    fp_to_be(be, a.c0);
+    bool c0_zero = true;
+    for (int i = 0; i < 48; i++) if (be[i]) { c0_zero = false; break; }
+    if (!c0_zero || (be[47] & 1)) return be[47] & 1;
+    u8 be1[48];
+    fp_to_be(be1, a.c1);
+    return be1[47] & 1;
+}
+
+static bool fp2_is_square(const fp2 &a) {
+    // Legendre on the norm
+    fp n, t;
+    fp_sqr(n, a.c0);
+    fp_sqr(t, a.c1);
+    fp_add(n, n, t);
+    // n^((p-1)/2) != p-1
+    u64 e[6];
+    memcpy(e, P_LIMBS, sizeof e);
+    // (p-1)/2
+    e[0] -= 1;
+    for (int i = 0; i < 6; i++)
+        e[i] = (e[i] >> 1) | (i < 5 ? (e[i + 1] << 63) : 0);
+    fp r;
+    fp_pow_raw(r, n, e);
+    fp neg_one;
+    fp_neg(neg_one, FP_ONE);
+    return !fp_eq(r, neg_one);
+}
+
+static void sswu_map(g2a &o, const fp2 &u) {
+    fp2 u2, zu2, tv1, x1, gx, nboa, t;
+    fp2_sqr(u2, u);
+    fp2_mul(zu2, SSWU_Z, u2);
+    fp2_sqr(tv1, zu2);
+    fp2_add(tv1, tv1, zu2);
+    fp2 ainv, nb;
+    fp2_inv(ainv, SSWU_A);
+    fp2_neg(nb, SSWU_B);
+    fp2_mul(nboa, nb, ainv);
+    if (fp2_is_zero(tv1)) {
+        fp2 za;
+        fp2_mul(za, SSWU_Z, SSWU_A);
+        fp2_inv(t, za);
+        fp2_mul(x1, SSWU_B, t);
+    } else {
+        fp2 ti;
+        fp2_inv(ti, tv1);
+        fp2_add(ti, ti, FP2_ONE);
+        fp2_mul(x1, nboa, ti);
+    }
+    fp2 x = x1;
+    fp2_sqr(gx, x);
+    fp2_mul(gx, gx, x);
+    fp2_mul(t, SSWU_A, x);
+    fp2_add(gx, gx, t);
+    fp2_add(gx, gx, SSWU_B);
+    if (!fp2_is_square(gx)) {
+        fp2_mul(x, zu2, x1);
+        fp2_sqr(gx, x);
+        fp2_mul(gx, gx, x);
+        fp2_mul(t, SSWU_A, x);
+        fp2_add(gx, gx, t);
+        fp2_add(gx, gx, SSWU_B);
+    }
+    fp2 y;
+    fp2_sqrt(y, gx); // gx is square here by construction
+    if (fp2_sgn0(u) != fp2_sgn0(y)) fp2_neg(y, y);
+    o.x = x;
+    o.y = y;
+    o.inf = false;
+}
+
+static void iso3_eval(g2a &o, const g2a &p) {
+    if (p.inf) { o.inf = true; return; }
+    fp2 xn = ISO_XNUM[3], xd = ISO_XDEN[2], yn = ISO_YNUM[3],
+        yd = ISO_YDEN[3];
+    for (int i = 2; i >= 0; i--) {
+        fp2_mul(xn, xn, p.x);
+        fp2_add(xn, xn, ISO_XNUM[i]);
+        fp2_mul(yn, yn, p.x);
+        fp2_add(yn, yn, ISO_YNUM[i]);
+        fp2_mul(yd, yd, p.x);
+        fp2_add(yd, yd, ISO_YDEN[i]);
+        if (i >= 1) {
+            fp2_mul(xd, xd, p.x);
+            fp2_add(xd, xd, ISO_XDEN[i - 1]);
+        }
+    }
+    if (fp2_is_zero(xd)) { o.inf = true; return; }
+    fp2 xdi, ydi;
+    fp2_inv(xdi, xd);
+    fp2_inv(ydi, yd);
+    fp2_mul(o.x, xn, xdi);
+    fp2 yr;
+    fp2_mul(yr, yn, ydi);
+    fp2_mul(o.y, p.y, yr);
+    o.inf = false;
+}
+
+static void clear_cofactor(g2a &o, const g2a &p) {
+    // [x^2-x-1]P + [x-1]psi(P) + psi^2(2P), x = -BLS_X
+    if (p.inf) { o.inf = true; return; }
+    g2j jp, t1, t2, t3, acc;
+    g2j_from_affine(jp, p);
+    // x^2 - x - 1 with x = -|x|: equals |x|^2 + |x| - 1 (positive)
+    // compute as [|x|][|x|]P + [|x|]P - P
+    g2j xP, xxP;
+    g2j_mul_u64(xP, jp, BLS_X);
+    g2j_mul_u64(xxP, xP, BLS_X);
+    g2j_add(t1, xxP, xP);
+    g2j njp = jp;
+    fp2_neg(njp.y, jp.y);
+    g2j_add(t1, t1, njp);
+    // [x-1]psi(P) with x-1 = -(|x|+1): -([|x|]psi + psi)
+    g2a psiP;
+    g2_psi(psiP, p);
+    g2j jpsi, xpsi;
+    g2j_from_affine(jpsi, psiP);
+    g2j_mul_u64(xpsi, jpsi, BLS_X);
+    g2j_add(t2, xpsi, jpsi);
+    fp2_neg(t2.y, t2.y);
+    // psi^2(2P)
+    g2j twoP;
+    g2j_dbl(twoP, jp);
+    g2a twoPa, psi2a;
+    g2j_to_affine(twoPa, twoP);
+    g2_psi(psi2a, twoPa);
+    g2_psi(psi2a, psi2a);
+    g2j_from_affine(t3, psi2a);
+    g2j_add(acc, t1, t2);
+    g2j_add(acc, acc, t3);
+    g2j_to_affine(o, acc);
+}
+
+static void hash_to_g2(g2a &o, const u8 *msg, size_t msg_len) {
+    u8 buf[256];
+    expand_message_xmd(buf, 256, msg, msg_len);
+    fp2 u0, u1;
+    fp_from_be64_mod(u0.c0, buf);
+    fp_from_be64_mod(u0.c1, buf + 64);
+    fp_from_be64_mod(u1.c0, buf + 128);
+    fp_from_be64_mod(u1.c1, buf + 192);
+    g2a q0, q1, q0i, q1i;
+    sswu_map(q0, u0);
+    sswu_map(q1, u1);
+    iso3_eval(q0i, q0);
+    iso3_eval(q1i, q1);
+    g2j j0, j1, s;
+    g2j_from_affine(j0, q0i);
+    g2j_from_affine(j1, q1i);
+    g2j_add(s, j0, j1);
+    g2a sa;
+    g2j_to_affine(sa, s);
+    clear_cofactor(o, sa);
+}
+
+// --------------------------------------------------------------- init
+
+static bool fp_from_hex(fp &out, const char *hex) {
+    u8 be[48] = {0};
+    size_t n = strlen(hex);
+    for (size_t i = 0; i < n; i++) {
+        char ch = hex[n - 1 - i];
+        u8 v = ch <= '9' ? ch - '0' : (ch | 32) - 'a' + 10;
+        be[47 - i / 2] |= (i % 2) ? (v << 4) : v;
+    }
+    return fp_from_be(out, be);
+}
+
+static void fp2_from_hex(fp2 &o, const char *h0, const char *h1) {
+    fp_from_hex(o.c0, h0);
+    fp_from_hex(o.c1, h1);
+}
+
+static bool INITED = false;
+
+extern "C" int cmt_bls_init(void) {
+    if (INITED) return 0;
+    // P_INV = -p^{-1} mod 2^64 via Newton
+    u64 inv = 1;
+    for (int i = 0; i < 63; i++) inv *= 2 - P_LIMBS[0] * inv;
+    P_INV = ~inv + 1;
+    memset(FP_ZERO.l, 0, sizeof FP_ZERO.l);
+    // R mod p: start from 1, double 384 times with conditional subtract
+    u64 r[6] = {1, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 384; i++) {
+        u64 top = 0;
+        for (int j = 0; j < 6; j++) {
+            u64 nt = r[j] >> 63;
+            r[j] = (r[j] << 1) | top;
+            top = nt;
+        }
+        if (top || fp_cmp_raw(r, P_LIMBS) >= 0) fp_sub_raw(r, r, P_LIMBS);
+    }
+    memcpy(FP_ONE.l, r, sizeof r);
+    // R2 = R doubled 384 more times
+    for (int i = 0; i < 384; i++) {
+        u64 top = 0;
+        for (int j = 0; j < 6; j++) {
+            u64 nt = r[j] >> 63;
+            r[j] = (r[j] << 1) | top;
+            top = nt;
+        }
+        if (top || fp_cmp_raw(r, P_LIMBS) >= 0) fp_sub_raw(r, r, P_LIMBS);
+    }
+    memcpy(FP_R2.l, r, sizeof r);
+    FP2_ZERO.c0 = FP_ZERO;
+    FP2_ZERO.c1 = FP_ZERO;
+    FP2_ONE.c0 = FP_ONE;
+    FP2_ONE.c1 = FP_ZERO;
+    FP12_ONE.c0.c0 = FP2_ONE;
+    FP12_ONE.c0.c1 = FP2_ZERO;
+    FP12_ONE.c0.c2 = FP2_ZERO;
+    FP12_ONE.c1.c0 = FP2_ZERO;
+    FP12_ONE.c1.c1 = FP2_ZERO;
+    FP12_ONE.c1.c2 = FP2_ZERO;
+    // curve constants
+    fp four;
+    fp_add(four, FP_ONE, FP_ONE);
+    fp_add(four, four, four);
+    FP_B1 = four;
+    FP2_B2.c0 = four;
+    FP2_B2.c1 = four;
+    // frobenius constants: xi^((p-1)/3), xi^((p-1)/6), xi^-((p-1)/3),
+    // xi^-((p-1)/2) — computed by exponentiating xi with raw exponents
+    fp2 xi;
+    xi.c0 = FP_ONE;
+    xi.c1 = FP_ONE;
+    u64 e[6];
+    // (p-1)
+    memcpy(e, P_LIMBS, sizeof e);
+    e[0] -= 1;
+    // divide by 3: long division over limbs, MSB first
+    {
+        u64 q3[6] = {0};
+        u128 rem = 0;
+        for (int i = 5; i >= 0; i--) {
+            u128 cur = (rem << 64) | e[i];
+            q3[i] = (u64)(cur / 3);
+            rem = cur % 3;
+        }
+        fp2_pow_raw(F6C1, xi, q3, 6);
+        fp2_sqr(F6C2, F6C1);
+        // (p-1)/6 = q3/2
+        u64 q6[6];
+        for (int i = 0; i < 6; i++)
+            q6[i] = (q3[i] >> 1) | (i < 5 ? (q3[i + 1] << 63) : 0);
+        fp2_pow_raw(F12C, xi, q6, 6);
+        // psi constants: inverses of xi^((p-1)/3) and xi^((p-1)/2)
+        fp2_inv(PSI_CX, F6C1);
+        u64 q2[6];
+        for (int i = 0; i < 6; i++)
+            q2[i] = (e[i] >> 1) | (i < 5 ? (e[i + 1] << 63) : 0);
+        fp2 half;
+        fp2_pow_raw(half, xi, q2, 6);
+        fp2_inv(PSI_CY, half);
+    }
+    // generators
+    fp_from_hex(G1_GEN.x,
+        "17f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb");
+    fp_from_hex(G1_GEN.y,
+        "08b3f481e3aaa0f1a09e30ed741d8ae4fcf5e095d5d00af600db18cb2c04b3ed"
+        "d03cc744a2888ae40caa232946c5e7e1");
+    G1_GEN.inf = false;
+    fp2_from_hex(G2_GEN.x,
+        "024aa2b2f08f0a91260805272dc51051c6e47ad4fa403b02b4510b647ae3d177"
+        "0bac0326a805bbefd48056c8c121bdb8",
+        "13e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+        "334cf11213945d57e5ac7d055d042b7e");
+    fp2_from_hex(G2_GEN.y,
+        "0ce5d527727d6e118cc9cdc6da2e351aadfd9baa8cbdd3a76d429a69516"
+        "0d12c923ac9cc3baca289e193548608b82801",
+        "0606c4a02ea734cc32acd2b02bc28b99cb3e287e85a763af267492ab572e99ab"
+        "3f370d275cec1da1aaa9075ff05f79be");
+    G2_GEN.inf = false;
+    // SSWU + iso3
+    memset(SSWU_A.c0.l, 0, sizeof SSWU_A.c0.l);
+    {
+        fp t240;
+        memset(t240.l, 0, sizeof t240.l);
+        t240.l[0] = 240;
+        fp_mul(SSWU_A.c1, t240, FP_R2);
+        SSWU_A.c0 = FP_ZERO;
+        fp t1012;
+        memset(t1012.l, 0, sizeof t1012.l);
+        t1012.l[0] = 1012;
+        fp m1012;
+        fp_mul(m1012, t1012, FP_R2);
+        SSWU_B.c0 = m1012;
+        SSWU_B.c1 = m1012;
+        fp two;
+        fp_add(two, FP_ONE, FP_ONE);
+        fp_neg(SSWU_Z.c0, two);
+        fp_neg(SSWU_Z.c1, FP_ONE);
+    }
+    fp2_from_hex(ISO_XNUM[0],
+        "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d"
+        "5c2638e343d9c71c6238aaaaaaaa97d6",
+        "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d"
+        "5c2638e343d9c71c6238aaaaaaaa97d6");
+    fp2_from_hex(ISO_XNUM[1],
+        "0",
+        "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a418"
+        "1472aaa9cb8d555526a9ffffffffc71a");
+    fp2_from_hex(ISO_XNUM[2],
+        "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a418"
+        "1472aaa9cb8d555526a9ffffffffc71e",
+        "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c"
+        "0a395554e5c6aaaa9354ffffffffe38d");
+    fp2_from_hex(ISO_XNUM[3],
+        "171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b8575"
+        "7098e38d0f671c7188e2aaaaaaaa5ed1",
+        "0");
+    fp2_from_hex(ISO_XDEN[0],
+        "0",
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffaa63");
+    fp2_from_hex(ISO_XDEN[1],
+        "c",
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffaa9f");
+    fp2_from_hex(ISO_XDEN[2], "1", "0");
+    fp2_from_hex(ISO_YNUM[0],
+        "04d0ca6dbecbd55ef176e62b3bde9b4454f9a5b05305ae2371ec98c879891123"
+        "221fda12b88ad097a72f38e38e38d3a5",
+        "04d0ca6dbecbd55ef176e62b3bde9b4454f9a5b05305ae2371ec98c879891123"
+        "221fda12b88ad097a72f38e38e38d3a5");
+    fp2_from_hex(ISO_YNUM[1],
+        "0",
+        "1439b899baf1b35b8fc02d1bfb73bf5231b21e4af64b0e94de7b4e7d31a614c6"
+        "c285c71b6d7a38e357c65555555512ed");
+    fp2_from_hex(ISO_YNUM[2],
+        "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c"
+        "0a395554e5c6aaaa9354ffffffffe38f",
+        "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a418"
+        "1472aaa9cb8d555526a9ffffffffc71c");
+    fp2_from_hex(ISO_YNUM[3],
+        "07b47715fe12eefe4f24a3785fca9206ee5c3c4d51a2b038b6475ada5c0e81d1"
+        "d032f6845a77b425d84b8e38e38e1f9b",
+        "0");
+    fp2_from_hex(ISO_YDEN[0],
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffa8fb",
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffa8fb");
+    fp2_from_hex(ISO_YDEN[1],
+        "0",
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffa9d3");
+    fp2_from_hex(ISO_YDEN[2],
+        "12",
+        "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+        "1eabfffeb153ffffb9feffffffffaa99");
+    fp2_from_hex(ISO_YDEN[3], "1", "0");
+    INITED = true;
+    return 0;
+}
+
+// ------------------------------------------------------------- C API
+// return codes: 1 = valid/true, 0 = invalid, -1 = malformed input
+
+extern "C" int cmt_bls_pubkey_validate(const u8 pk[96]) {
+    cmt_bls_init();
+    g1a p;
+    if (!g1_from_uncompressed(p, pk)) return -1;
+    if (p.inf) return 0; // identity pubkey is invalid
+    return 1;
+}
+
+extern "C" int cmt_bls_verify(const u8 pk[96], const u8 *msg,
+                              size_t msg_len, const u8 sig[96]) {
+    cmt_bls_init();
+    g1a p;
+    if (!g1_from_uncompressed(p, pk) || p.inf) return 0;
+    g2a s;
+    if (!g2_from_compressed(s, sig) || s.inf) return 0;
+    g2a h;
+    hash_to_g2(h, msg, msg_len);
+    std::vector<pair_pq> pairs(2);
+    pairs[0].p = p;
+    pairs[0].q = h;
+    pairs[1].p = G1_GEN;
+    fp_neg(pairs[1].p.y, G1_GEN.y);
+    pairs[1].q = s;
+    return pairing_product_is_one(pairs) ? 1 : 0;
+}
+
+extern "C" int cmt_bls_aggregate_verify(size_t n, const u8 *pks,
+                                        const u8 *msgs,
+                                        const size_t *msg_lens,
+                                        const u8 sig[96]) {
+    cmt_bls_init();
+    if (!n) return 0;
+    g2a s;
+    if (!g2_from_compressed(s, sig) || s.inf) return 0;
+    std::vector<pair_pq> pairs(n + 1);
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        g1a p;
+        if (!g1_from_uncompressed(p, pks + 96 * i) || p.inf) return 0;
+        pairs[i].p = p;
+        hash_to_g2(pairs[i].q, msgs + off, msg_lens[i]);
+        off += msg_lens[i];
+    }
+    pairs[n].p = G1_GEN;
+    fp_neg(pairs[n].p.y, G1_GEN.y);
+    pairs[n].q = s;
+    return pairing_product_is_one(pairs) ? 1 : 0;
+}
+
+// Batch verify independent triples with caller-supplied 16-byte
+// random weights: e(sum[z_i]pk_i-paired...) — RLC check, 1 = all valid
+extern "C" int cmt_bls_batch_verify(size_t n, const u8 *pks,
+                                    const u8 *msgs,
+                                    const size_t *msg_lens,
+                                    const u8 *sigs,
+                                    const u8 *weights16) {
+    cmt_bls_init();
+    if (!n) return 0;
+    std::vector<pair_pq> pairs(n + 1);
+    g2j sig_acc;
+    sig_acc.x = FP2_ONE;
+    sig_acc.y = FP2_ONE;
+    sig_acc.z = FP2_ZERO;
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        g1a p;
+        if (!g1_from_uncompressed(p, pks + 96 * i) || p.inf) return 0;
+        g2a s;
+        if (!g2_from_compressed(s, sigs + 96 * i) || s.inf) return 0;
+        g1j jp, wj;
+        g1j_from_affine(jp, p);
+        g1j_mul_be(wj, jp, weights16 + 16 * i, 16);
+        g1j_to_affine(pairs[i].p, wj);
+        hash_to_g2(pairs[i].q, msgs + off, msg_lens[i]);
+        off += msg_lens[i];
+        g2j js, ws;
+        g2j_from_affine(js, s);
+        g2j_mul_be(ws, js, weights16 + 16 * i, 16);
+        g2j_add(sig_acc, sig_acc, ws);
+    }
+    pairs[n].p = G1_GEN;
+    fp_neg(pairs[n].p.y, G1_GEN.y);
+    g2j_to_affine(pairs[n].q, sig_acc);
+    return pairing_product_is_one(pairs) ? 1 : 0;
+}
+
+extern "C" int cmt_bls_sign(const u8 sk32[32], const u8 *msg,
+                            size_t msg_len, u8 out_sig[96]) {
+    cmt_bls_init();
+    g2a h;
+    hash_to_g2(h, msg, msg_len);
+    g2j jh, r;
+    g2j_from_affine(jh, h);
+    g2j_mul_be(r, jh, sk32, 32);
+    g2a ra;
+    g2j_to_affine(ra, r);
+    g2_to_compressed(out_sig, ra);
+    return 1;
+}
+
+extern "C" int cmt_bls_sk_to_pk(const u8 sk32[32], u8 out_pk[96]) {
+    cmt_bls_init();
+    g1j g, r;
+    g1j_from_affine(g, G1_GEN);
+    g1j_mul_be(r, g, sk32, 32);
+    g1a ra;
+    g1j_to_affine(ra, r);
+    g1_to_uncompressed(out_pk, ra);
+    return 1;
+}
+
+extern "C" int cmt_bls_hash_to_g2_compressed(const u8 *msg, size_t len,
+                                             u8 out[96]) {
+    cmt_bls_init();
+    g2a h;
+    hash_to_g2(h, msg, len);
+    g2_to_compressed(out, h);
+    return 1;
+}
