@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass, replace
 
 from cometbft_tpu.config import ConsensusConfig
@@ -142,7 +144,7 @@ class ConsensusState(BaseService):
 
         # round state (round_state.go RoundState) — guarded by _rs_mtx for
         # readers (gossip, RPC); written only by the receive routine.
-        self._rs_mtx = threading.RLock()
+        self._rs_mtx = cmtsync.RMutex()
         self.height = 0
         self.round = 0
         self.step = STEP_NEW_HEIGHT

@@ -3,7 +3,7 @@
 This module is the semantic ground truth for the TPU batch-verify kernel
 (cometbft_tpu/ops): the kernel's precomputed tables are generated from it
 and its verify() defines the accept/reject behavior the kernel must match
-bit-for-bit (differential fuzzing in tests/test_ed25519_kernel.py).
+bit-for-bit (differential fuzzing in tests/test_ops_kernel.py).
 
 Semantics: **ZIP-215** (matching the reference's curve25519-voi-backed
 verifier, crypto/ed25519/ed25519.go:39):

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import threading
 
+from cometbft_tpu.utils import sync as cmtsync
+
 from cometbft_tpu.state import State
 from cometbft_tpu.types import codec
 from cometbft_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
@@ -62,7 +64,7 @@ class Pool:
         self.state_store = state_store
         self.block_store = block_store
         self.logger = logger or default_logger().with_fields(module="evidence")
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         # conflicting vote pairs reported by consensus, turned into
         # evidence at the next Update when block time/val set are known
         self._consensus_buffer: list[tuple[Vote, Vote]] = []

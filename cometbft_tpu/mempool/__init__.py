@@ -10,6 +10,8 @@ txs are re-checked against the new app state (recheck).
 from __future__ import annotations
 
 import threading
+
+from cometbft_tpu.utils import sync as cmtsync
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable
@@ -53,7 +55,7 @@ class TxCache:
 
     def __init__(self, size: int):
         self._size = size
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._map: OrderedDict[bytes, None] = OrderedDict()
 
     def push(self, tx: bytes) -> bool:
@@ -147,7 +149,7 @@ class CListMempool:
         self._recheck_enabled = recheck
         self.cache = TxCache(cache_size) if cache_size > 0 else NopTxCache()
 
-        self._mtx = threading.RLock()  # the consensus Lock()/Unlock()
+        self._mtx = cmtsync.RMutex()  # the consensus Lock()/Unlock()
         self._txs: OrderedDict[bytes, _MempoolTx] = OrderedDict()
         self._txs_bytes = 0
         self._seq = 0  # next arrival sequence number

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from cometbft_tpu.utils import sync as cmtsync
 import time
 
 from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
@@ -57,7 +59,7 @@ class Switch(BaseService):
         self._dialing: set[str] = set()
         self._reconnecting: set[str] = set()
         self._persistent_addrs: dict[str, NetAddress] = {}
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self.addr_book = None  # set by node wiring when PEX is enabled
         from cometbft_tpu.metrics import P2PMetrics
 

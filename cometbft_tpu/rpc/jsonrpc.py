@@ -248,10 +248,21 @@ class JSONRPCServer(BaseService):
                 req_id, error=RPCError(ERR_INVALID_PARAMS, str(exc))
             )
         except Exception as exc:  # noqa: BLE001 — handler bug or bad state
+            # correlation id in both the log line and the client error
+            # (internal/rpctrace: operators grep logs by the id a
+            # caller reports instead of guessing among errors)
+            import uuid as _uuid
+
+            trace_id = _uuid.uuid4().hex[:16]
             self.logger.error("rpc handler error", method=method,
-                              err=repr(exc))
+                              err=repr(exc), trace_id=trace_id)
             return make_response(
-                req_id, error=RPCError(ERR_INTERNAL, str(exc))
+                req_id,
+                error=RPCError(
+                    ERR_INTERNAL,
+                    f"internal error (trace {trace_id})",
+                    str(exc),
+                ),
             )
 
     # -- websocket session (ws_handler.go wsConnection) -------------------

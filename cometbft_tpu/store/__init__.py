@@ -9,7 +9,7 @@ precommits this node itself observed, which may differ in round).
 
 from __future__ import annotations
 
-import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 from cometbft_tpu.types import codec
 from cometbft_tpu.types.block import Block, Commit
@@ -46,7 +46,7 @@ class BlockStore:
 
     def __init__(self, db: DB):
         self._db = db
-        self._mtx = threading.RLock()
+        self._mtx = cmtsync.RMutex()
         self._base, self._height = self._load_state()
 
     # -- range ---------------------------------------------------------

@@ -9,7 +9,7 @@ read it.
 
 from __future__ import annotations
 
-import threading
+from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass
 
 from cometbft_tpu.types.block import (
@@ -65,7 +65,7 @@ class VoteSet:
         self.signed_msg_type = signed_msg_type
         self.val_set = val_set
         self.extensions_enabled = extensions_enabled
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         n = len(val_set)
         self._votes_bit_array = BitArray(n)
         self._votes: list[Vote | None] = [None] * n

@@ -1,0 +1,175 @@
+"""Deadlock-detecting locks + thread-leak checking — the framework's
+analog of the reference's race/deadlock tooling (SURVEY.md §5:
+`go test -race` CI-wide, the `deadlock` build tag swapping
+cmtsync.Mutex for go-deadlock, and fortytw2/leaktest).
+
+CPython's GIL rules out Go-style data races on single attributes, but
+lock-ordering deadlocks and leaked threads are just as real here.  Two
+tools, both zero-cost when disabled:
+
+- ``Mutex()`` / ``RMutex()``: factory returning a plain
+  threading.Lock/RLock normally; with ``CMT_TPU_DEADLOCK=1`` (the
+  build-tag analog — tests.mk:61 in the reference) every acquire gets
+  a watchdog timeout (CMT_TPU_DEADLOCK_TIMEOUT seconds, default 30):
+  on expiry it dumps every thread's stack and raises
+  PotentialDeadlock instead of hanging the node forever.  Core
+  components (consensus, mempool, switch, evidence, stores) create
+  their locks through this seam.
+- ``assert_no_thread_leaks()``: leaktest-style context manager for
+  tests — snapshots live threads on entry and fails if new non-daemon
+  threads survive exit (after a grace period for teardown races).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+_ENABLED = bool(os.environ.get("CMT_TPU_DEADLOCK"))
+_TIMEOUT = float(os.environ.get("CMT_TPU_DEADLOCK_TIMEOUT", "30"))
+
+
+class PotentialDeadlock(Exception):
+    """An acquire exceeded the deadlock watchdog timeout."""
+
+
+def _dump_all_stacks() -> str:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(
+            line.rstrip() for line in traceback.format_stack(frame)
+        )
+    return "\n".join(out)
+
+
+class _WatchdogLock:
+    """Lock wrapper that refuses to block forever (go-deadlock's
+    DeadlockTimeout behavior)."""
+
+    __slots__ = ("_lock", "_timeout", "_owner_stack")
+
+    def __init__(self, inner, timeout: float):
+        self._lock = inner
+        self._timeout = timeout
+        self._owner_stack = ""
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking:
+            ok = self._lock.acquire(False)
+            if ok:
+                self._remember()
+            return ok
+        limit = self._timeout if timeout in (-1, None) else min(
+            timeout, self._timeout
+        )
+        ok = self._lock.acquire(True, limit)
+        if not ok:
+            dump = _dump_all_stacks()
+            sys.stderr.write(
+                f"POTENTIAL DEADLOCK: lock held for > {limit}s\n"
+                f"last acquirer:\n{self._owner_stack}\n"
+                f"all threads:\n{dump}\n"
+            )
+            raise PotentialDeadlock(
+                f"could not acquire lock within {limit}s "
+                f"(last acquired at:\n{self._owner_stack})"
+            )
+        self._remember()
+        return True
+
+    def _remember(self) -> None:
+        self._owner_stack = "".join(traceback.format_stack(limit=6)[:-1])
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self._lock, "locked", None)
+        if fn is not None:  # Lock always; RLock only on Python >= 3.14
+            return fn()
+        if self._lock._is_owned():
+            return True
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def __getattr__(self, name: str):
+        # threading.Condition probes the lock for _is_owned /
+        # _release_save / _acquire_restore and falls back to generic
+        # (non-reentrant-safe) versions on AttributeError.  Forward
+        # them when the inner lock provides them (RLock) so
+        # Condition(RMutex()) keeps correct ownership semantics —
+        # the generic fallback's acquire(False) probe succeeds
+        # REENTRANTLY on an owned RLock and concludes it is unheld.
+        if name in ("_is_owned", "_release_save", "_acquire_restore"):
+            return getattr(self._lock, name)
+        raise AttributeError(name)
+
+
+def Mutex():
+    """threading.Lock, or the watchdog wrapper under CMT_TPU_DEADLOCK."""
+    lock = threading.Lock()
+    return _WatchdogLock(lock, _TIMEOUT) if _ENABLED else lock
+
+
+def RMutex():
+    """threading.RLock, or the watchdog wrapper under CMT_TPU_DEADLOCK."""
+    lock = threading.RLock()
+    return _WatchdogLock(lock, _TIMEOUT) if _ENABLED else lock
+
+
+class assert_no_thread_leaks:
+    """(leaktest analog) fail if the body leaks non-daemon threads.
+
+    with assert_no_thread_leaks(grace=2.0):
+        svc = SomeService(); svc.start(); svc.stop()
+    """
+
+    def __init__(self, grace: float = 2.0):
+        self.grace = grace
+
+    def __enter__(self):
+        self._before = set(threading.enumerate())
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        deadline = time.monotonic() + self.grace
+        while True:
+            leaked = [
+                t
+                for t in threading.enumerate()
+                if t not in self._before
+                and t.is_alive()
+                and not t.daemon
+            ]
+            if not leaked:
+                return False
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    "leaked non-daemon threads: "
+                    + ", ".join(t.name for t in leaked)
+                )
+            time.sleep(0.05)
+
+
+__all__ = [
+    "Mutex",
+    "PotentialDeadlock",
+    "RMutex",
+    "assert_no_thread_leaks",
+]

@@ -68,8 +68,12 @@ class TestAbciGrpc:
                 n.start()
             connect_star(nodes)
             wait_all_height(nodes, 3)
-            # the external app actually executed blocks
-            assert ext_app._height >= 3
+            # the external app actually executed blocks (the store
+            # height leads the app's commit by a beat — poll briefly)
+            deadline = time.monotonic() + 30
+            while ext_app._height < 3:
+                assert time.monotonic() < deadline, ext_app._height
+                time.sleep(0.1)
         finally:
             for n in nodes:
                 try:

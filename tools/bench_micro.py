@@ -1,0 +1,237 @@
+"""Micro-benchmark harness for the host-side subsystems
+(BASELINE.md "Benchmark harnesses with no published values":
+crypto/ed25519/bench_test.go, merkle/tmhash bench_test.go,
+mempool/bench_test.go + cache_bench_test.go, store/bench_test.go,
+txindex kv_bench_test.go, pubsub query/bench_test.go,
+pex/bench_test.go).
+
+Prints one JSON line per benchmark and writes BENCH_MICRO.json.
+These are the CPU planes — the device plane is bench.py/bench_all.py.
+
+    python tools/bench_micro.py            # all
+    python tools/bench_micro.py mempool    # name filter
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS: list[dict] = []
+
+
+def bench(name: str, fn, n_ops: int, repeats: int = 3) -> None:
+    if len(sys.argv) > 1 and sys.argv[1] not in name:
+        return
+    fn()  # warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    row = {
+        "bench": name,
+        "ops": n_ops,
+        "ns_per_op": round(best / n_ops * 1e9, 1),
+        "ops_per_sec": round(n_ops / best, 1),
+    }
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def main() -> None:
+    import numpy as np
+
+    rng = np.random.RandomState(11)
+
+    # ---- crypto: ed25519 sign/verify/batch (bench_test.go:14-50) -----
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    priv = ed.priv_key_from_secret(b"bench")
+    pub = priv.pub_key()
+    msg = rng.bytes(120)
+    sig = priv.sign(msg)
+    bench("crypto/ed25519/sign", lambda: [priv.sign(msg) for _ in range(64)], 64)
+    bench(
+        "crypto/ed25519/verify_single",
+        lambda: [pub.verify_signature(msg, sig) for _ in range(64)],
+        64,
+    )
+    msgs64 = [rng.bytes(120) for _ in range(64)]
+    sigs64 = [priv.sign(m) for m in msgs64]
+
+    def batch64():
+        bv = ed.CpuBatchVerifier()
+        for m, s in zip(msgs64, sigs64):
+            bv.add(pub, m, s)
+        ok, _ = bv.verify()
+        assert ok
+
+    bench("crypto/ed25519/cpu_batch_verify_64", batch64, 64)
+
+    # ---- merkle + tmhash (merkle/bench_test.go) ----------------------
+    from cometbft_tpu.crypto import merkle, tmhash
+
+    items = [rng.bytes(64) for _ in range(1024)]
+    bench(
+        "crypto/merkle/root_1024x64B",
+        lambda: merkle.hash_from_byte_slices(items),
+        1024,
+    )
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    bench(
+        "crypto/merkle/verify_proof",
+        lambda: [
+            proofs[i].verify(root, items[i]) for i in range(0, 1024, 8)
+        ],
+        128,
+    )
+    blob = rng.bytes(1024)
+    bench(
+        "crypto/tmhash/sum_1KB",
+        lambda: [tmhash.sum256(blob) for _ in range(1000)],
+        1000,
+    )
+
+    # ---- mempool CheckTx + cache (mempool/bench_test.go) -------------
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+    from cometbft_tpu.mempool import CListMempool, TxCache
+    from cometbft_tpu.proxy import AppConns, local_client_creator
+
+    proxy = AppConns(local_client_creator(KVStoreApp()))
+    proxy.start()
+    mp = CListMempool(proxy.mempool, height=1)
+    txs = [b"k%d=v%d" % (i, i) for i in range(2000)]
+
+    def checktx():
+        for tx in txs:
+            mp.check_tx(tx)
+        mp.flush()
+
+    bench("mempool/check_tx_2000", checktx, 2000)
+    cache = TxCache(10_000)
+
+    def cache_push():
+        for tx in txs:
+            cache.push(tx)
+        for tx in txs:
+            cache.push(tx)  # hit path
+
+    bench("mempool/cache_push_4000", cache_push, 4000)
+    proxy.stop()
+
+    # ---- block store (store/bench_test.go) ---------------------------
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.utils.db import MemDB
+    from tests.helpers import make_block_id, make_commit, make_val_set
+
+    from cometbft_tpu.types.block import Block, Data, Header
+    from cometbft_tpu.types.params import BLOCK_PART_SIZE_BYTES
+
+    vals, keys = make_val_set(4)
+    bid = make_block_id()
+    commit = make_commit(vals, keys, bid)
+    header = Header(
+        chain_id="bench", height=1, validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        proposer_address=vals.validators[0].address,
+    )
+    block = Block(
+        header=header,
+        data=Data(txs=tuple(rng.bytes(256) for _ in range(64))),
+        last_commit=commit,
+    )
+    def save_load():
+        store = BlockStore(MemDB())
+        for h in range(1, 33):
+            blk = Block(
+                header=Header(
+                    chain_id="bench", height=h,
+                    validators_hash=vals.hash(),
+                    next_validators_hash=vals.hash(),
+                    proposer_address=vals.validators[0].address,
+                ),
+                data=block.data,
+                last_commit=commit,
+            )
+            ps = blk.make_part_set(BLOCK_PART_SIZE_BYTES)
+            store.save_block(blk, ps, commit)
+            store.load_block(h)
+
+    bench("store/save_load_32_blocks_64tx", save_load, 64)
+
+    # ---- tx indexer (txindex/kv_bench_test.go) -----------------------
+    from cometbft_tpu.abci.types import ExecTxResult
+    from cometbft_tpu.state.txindex import TxIndexer
+
+    idx = TxIndexer(MemDB())
+
+    def index_txs():
+        for i, tx in enumerate(txs[:500]):
+            idx.index(1, i, tx, ExecTxResult(code=0))
+
+    bench("txindex/index_500", index_txs, 500)
+
+    # ---- pubsub query DSL (pubsub/query/bench_test.go) ---------------
+    from cometbft_tpu.utils.pubsub import Query
+
+    q = Query.parse(
+        "tm.event = 'Tx' AND tx.height > 5 AND transfer.amount > 100"
+    )
+    events = {
+        "tm.event": ["Tx"],
+        "tx.height": ["12"],
+        "transfer.amount": ["250"],
+    }
+    bench(
+        "pubsub/query_match",
+        lambda: [q.matches(events) for _ in range(10_000)],
+        10_000,
+    )
+    bench(
+        "pubsub/query_parse",
+        lambda: [
+            Query.parse("tm.event = 'NewBlock' AND block.height > 1")
+            for _ in range(2000)
+        ],
+        2000,
+    )
+
+    # ---- pex addrbook (pex/bench_test.go) ----------------------------
+    from cometbft_tpu.p2p.netaddr import NetAddress
+    from cometbft_tpu.p2p.pex.addrbook import AddrBook
+
+    book = AddrBook(file_path="", strict=False)
+    addrs = [
+        NetAddress(
+            id=("%040x" % i),
+            host=f"10.{i >> 8 & 255}.{i & 255}.{(i * 7) % 255 + 1}",
+            port=26656,
+        )
+        for i in range(1000)
+    ]
+    src = NetAddress(id="b" * 40, host="1.2.3.4", port=26656)
+
+    def book_ops():
+        for a in addrs:
+            book.add_address(a, src)
+        for _ in range(1000):
+            book.pick_address(30)
+
+    bench("pex/addrbook_add_pick_1000", book_ops, 2000)
+
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_MICRO.json",
+    )
+    with open(out, "w") as f:
+        json.dump({"results": RESULTS}, f, indent=1)
+    print(f"wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

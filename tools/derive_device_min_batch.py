@@ -1,0 +1,109 @@
+"""Measure the CPU-vs-device batch-verify crossover and recommend
+DEVICE_MIN_BATCH (VERDICT r2 weak #6: the constant was never validated
+against measurement).
+
+Runs the REAL paths — ed25519.CpuBatchVerifier vs
+ops.ed25519_verify.verify_arrays — at growing batch sizes and reports
+the smallest batch where the device path wins end-to-end (transfers,
+packing, and link round trips included).  Run on the target hardware:
+
+    python tools/derive_device_min_batch.py
+
+and wire the printed value via CMT_TPU_DEVICE_MIN_BATCH or update
+ops/ed25519_verify.DEVICE_MIN_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main() -> None:
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops.ed25519_verify import verify_arrays
+
+    rng = np.random.RandomState(3)
+    priv = ed.gen_priv_key()
+    pub = priv.pub_key()
+    pub_b = np.frombuffer(pub.bytes(), dtype=np.uint8)
+
+    sizes = [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192]
+    rows = []
+    crossover = None
+    # prepare the largest fixture once; slice per size
+    nmax = sizes[-1]
+    msgs = [
+        rng.randint(0, 256, size=120, dtype=np.uint8).tobytes()
+        for _ in range(nmax)
+    ]
+    print("signing fixture...", file=sys.stderr)
+    sigs_all = np.stack(
+        [np.frombuffer(priv.sign(m), dtype=np.uint8) for m in msgs]
+    )
+    pubs_all = np.tile(pub_b, (nmax, 1))
+
+    for n in sizes:
+        pubs, sigs, ms = pubs_all[:n], sigs_all[:n], msgs[:n]
+
+        def cpu_run():
+            bv = ed.CpuBatchVerifier()
+            for m, s in zip(ms, sigs):
+                bv.add(pub, m, s.tobytes())
+            ok, _ = bv.verify()
+            assert ok
+
+        def dev_run():
+            assert bool(verify_arrays(pubs, sigs, ms).all())
+
+        dev_run()  # compile/warm this shape
+        t_cpu = min(
+            (lambda: (lambda t0: (cpu_run(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            ))()
+            for _ in range(3)
+        )
+        t_dev = min(
+            (lambda: (lambda t0: (dev_run(), time.perf_counter() - t0)[1])(
+                time.perf_counter()
+            ))()
+            for _ in range(3)
+        )
+        winner = "device" if t_dev < t_cpu else "cpu"
+        rows.append(
+            {
+                "batch": n,
+                "cpu_ms": round(t_cpu * 1e3, 2),
+                "device_ms": round(t_dev * 1e3, 2),
+                "winner": winner,
+            }
+        )
+        print(json.dumps(rows[-1]), file=sys.stderr)
+        if winner == "device" and crossover is None:
+            crossover = n
+        if winner == "cpu":
+            crossover = None  # must win from here on up
+
+    print(
+        json.dumps(
+            {
+                "recommended_device_min_batch": crossover or nmax * 2,
+                "note": (
+                    "device never won at measured sizes; keep CPU"
+                    if crossover is None
+                    else "smallest batch where the device path wins "
+                    "end-to-end, stable through the largest measured"
+                ),
+                "rows": rows,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
