@@ -1,0 +1,33 @@
+# Test/bench entry points (reference analog: tests.mk / Makefile).
+# The driver and CI call pytest directly; these targets document the
+# supported modes.
+
+PY ?= python
+
+.PHONY: test test-deadlock test-e2e bench bench-all bench-micro lint
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# go-deadlock build-tag analog (tests.mk:61): every core mutex gets a
+# watchdog that dumps stacks and raises instead of hanging.
+test-deadlock:
+	CMT_TPU_DEADLOCK=1 CMT_TPU_DEADLOCK_TIMEOUT=60 \
+		$(PY) -m pytest tests/ -x -q
+
+# subprocess perturbation/misbehavior harness only (test/e2e analog)
+test-e2e:
+	$(PY) -m pytest tests/test_e2e_perturb.py tests/test_light_proxy.py -q
+
+bench:
+	$(PY) bench.py
+
+bench-all:
+	$(PY) bench_all.py
+
+bench-micro:
+	$(PY) tools/bench_micro.py
+
+native:
+	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \
+		-o native/build/libcmtbls.so

@@ -1,0 +1,147 @@
+"""Proposer-based timestamps (reference: internal/consensus/
+pbts_test.go, types/vote.go IsTimely, state/validation.go block-time
+rules).
+
+PBTS replaces vote-median block time with the proposer's clock bounded
+by SynchronyParams; a proposal stamped outside
+[t - precision, t + precision + message_delay] of its receive time is
+NOT timely and honest validators prevote nil."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.types.params import ConsensusParams
+
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+
+def _pbts_params(precision_ns=505_000_000, message_delay_ns=15_000_000_000):
+    base = ConsensusParams()
+    return replace(
+        base,
+        feature=replace(base.feature, pbts_enable_height=1),
+        synchrony=replace(
+            base.synchrony,
+            precision_ns=precision_ns,
+            message_delay_ns=message_delay_ns,
+        ),
+    )
+
+
+class TestTimelinessGate:
+    """Unit-level: the consensus state's timeliness verdict."""
+
+    def _cs_with_proposal(self, ts_offset_ns: int, recv_offset_ns: int = 0):
+        """A minimal consensus-state stand-in carrying just what
+        _proposal_is_timely reads."""
+        from cometbft_tpu.consensus.state import ConsensusState
+        from cometbft_tpu.utils.time import now_ns
+
+        class FakeProposal:
+            timestamp_ns = now_ns() + ts_offset_ns
+
+        class FakeState:
+            consensus_params = _pbts_params()
+
+        cs = object.__new__(ConsensusState)
+        cs.proposal = FakeProposal
+        cs.state = FakeState
+        cs._proposal_recv_time_ns = now_ns() + recv_offset_ns
+        return cs
+
+    def test_fresh_proposal_is_timely(self):
+        assert self._cs_with_proposal(0)._proposal_is_timely()
+
+    def test_future_stamped_proposal_rejected(self):
+        # stamped 2s in the future: recv < t - precision
+        cs = self._cs_with_proposal(ts_offset_ns=2_000_000_000)
+        assert not cs._proposal_is_timely()
+
+    def test_stale_proposal_rejected(self):
+        # stamped 20s in the past: recv > t + precision + message_delay
+        cs = self._cs_with_proposal(ts_offset_ns=-20_000_000_000)
+        assert not cs._proposal_is_timely()
+
+    def test_precision_bound_is_inclusive(self):
+        cs = self._cs_with_proposal(0)
+        sp = cs.state.consensus_params.synchrony
+        t = cs.proposal.timestamp_ns
+        cs._proposal_recv_time_ns = t - sp.precision_ns
+        assert cs._proposal_is_timely()
+        cs._proposal_recv_time_ns = t + sp.precision_ns + sp.message_delay_ns
+        assert cs._proposal_is_timely()
+        cs._proposal_recv_time_ns = t - sp.precision_ns - 1
+        assert not cs._proposal_is_timely()
+
+
+class TestPbtsBlockTimeRules:
+    """Block-time validation under PBTS: strictly monotonic, and the
+    proposer stamps real time (state/validation.go)."""
+
+    def test_localnet_block_times_track_wall_clock(self, tmp_path):
+        nodes, privs, gen = make_localnet(
+            tmp_path, 2, consensus_params=_pbts_params()
+        )
+        for n in nodes:
+            n.start()
+        try:
+            connect_star(nodes)
+            wait_all_height(nodes, 5)
+            bs = nodes[0].block_store
+            times = [
+                bs.load_block(h).header.time_ns
+                for h in range(2, bs.height() + 1)
+            ]
+            # strictly increasing
+            assert all(b > a for a, b in zip(times, times[1:]))
+            # PBTS: head block stamped by the proposer's clock — within
+            # seconds of wall clock, not drifting behind (legacy median
+            # time lags by one commit round)
+            assert abs(time.time_ns() - times[-1]) < 10 * 10**9
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+    def test_non_monotonic_pbts_block_rejected(self, tmp_path):
+        """validate_block under PBTS refuses time_ns <= parent time."""
+        from cometbft_tpu.state.execution import InvalidBlockError
+
+        nodes, privs, gen = make_localnet(
+            tmp_path, 1, consensus_params=_pbts_params()
+        )
+        node = nodes[0]
+        node.start()
+        try:
+            deadline = time.monotonic() + 60
+            while node.block_store.height() < 3:
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+            node.consensus.stop()  # freeze; stores stay open
+            import dataclasses
+
+            from cometbft_tpu.state.execution import validate_block
+
+            state = node.state_store.load()
+            h = state.last_block_height
+            commit = node.block_store.load_seen_commit(h)
+            good = node.block_exec.create_proposal_block(
+                h + 1, state, commit, state.validators.validators[0].address
+            )
+            validate_block(state, good)  # proposer-stamped: accepted
+            bad = dataclasses.replace(
+                good,
+                header=dataclasses.replace(
+                    good.header, time_ns=state.last_block_time_ns
+                ),
+            )
+            with pytest.raises(InvalidBlockError):
+                validate_block(state, bad)
+        finally:
+            node.stop()
