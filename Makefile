@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-deadlock test-e2e bench bench-all bench-micro lint
+.PHONY: test test-deadlock test-e2e bench bench-all bench-micro native
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -150,6 +150,33 @@ def _compiled(batch: int, bucket: int):
     return fn
 
 
+_chunked_cache: dict[tuple[int, int, int], object] = {}
+
+
+def _compiled_chunked(batch: int, bucket: int, chunk: int):
+    """One jit program that processes (F, batch) in ``chunk``-wide
+    slices via lax.map: the working set stays small (the >8k memory
+    cliff never hits) while the whole batch costs ONE dispatch and
+    ONE result fetch — the winning trade on a high-RTT tunneled
+    backend where every launch/fetch pays ~70ms."""
+    key = (batch, bucket, chunk)
+    fn = _chunked_cache.get(key)
+    if fn is None:
+        nblocks = (64 + bucket + 17 + 127) // 128
+        k = batch // chunk
+
+        def run(buf):
+            chunks = buf.reshape(buf.shape[0], k, chunk).transpose(1, 0, 2)
+            out = jax.lax.map(
+                lambda c: verify_kernel_packed(c, bucket, nblocks), chunks
+            )
+            return out.reshape(batch)
+
+        fn = jax.jit(run)
+        _chunked_cache[key] = fn
+    return fn
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
 
@@ -197,12 +224,37 @@ def _dispatch(pub, sig, msgs, start, end):
 
 def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     """Enqueue verification launches without waiting: returns a list of
-    (device_array, chunk_len) pairs. Batches over MAX_LAUNCH split into
-    several launches, all dispatched before any result is awaited, so
-    transfers and host packing overlap device compute. Call
-    ``np.asarray`` on the parts (or use verify_stream) to synchronize.
-    Each device array is pow2-padded — slice to its chunk_len."""
+    (device_array, chunk_len) pairs.  Batches over MAX_LAUNCH go out
+    as ONE chunked launch (lax.map over MAX_LAUNCH-wide slices inside
+    a single XLA program — bounded working set, single dispatch);
+    CMT_TPU_MULTI_LAUNCH=1 restores the multi-launch split for
+    comparison.  Call ``np.asarray`` on the parts (or use
+    verify_stream) to synchronize.  Each device array is pow2/chunk
+    padded — slice to its chunk_len."""
     n = len(msgs)
+    homogeneous = n > MAX_LAUNCH and not os.environ.get(
+        "CMT_TPU_MULTI_LAUNCH"
+    )
+    if homogeneous:
+        # one outlier message would force the WHOLE batch to its
+        # length bucket (SHA blocks + transfer scale with the bucket);
+        # only take the single-launch path when every message shares
+        # the bucket, else fall back to per-chunk bucketing below
+        longest = max(len(m) for m in msgs)
+        bucket_all = next((b for b in _BUCKETS if b >= longest), None)
+        smallest = next(
+            (b for b in _BUCKETS if b >= min(len(m) for m in msgs)), None
+        )
+        homogeneous = bucket_all is not None and bucket_all == smallest
+    if homogeneous:
+        packed, bucket = pack_inputs(pub, sig, msgs)
+        batch = packed.shape[-1]
+        if batch % MAX_LAUNCH:  # pad columns to a whole chunk count
+            pad = MAX_LAUNCH - batch % MAX_LAUNCH
+            packed = np.pad(packed, [(0, 0), (0, pad)])
+            batch += pad
+        fn = _compiled_chunked(batch, bucket, MAX_LAUNCH)
+        return [(fn(jax.device_put(packed)), n)]
     parts = []
     for start in range(0, max(n, 1), MAX_LAUNCH):
         end = min(start + MAX_LAUNCH, n)

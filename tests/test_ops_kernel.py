@@ -239,3 +239,63 @@ class TestChunkedLaunches:
             oracle.append(ok)
         _, results = bv.verify()
         assert results == oracle
+
+
+def test_chunked_single_launch_matches_multi_launch(monkeypatch):
+    """Batches beyond MAX_LAUNCH go out as ONE lax.map-chunked launch;
+    verdicts must match the multi-launch path bit-for-bit, including
+    invalid signatures planted on both sides of every chunk boundary
+    and a non-multiple-of-chunk tail."""
+    import os
+
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519_verify as EV
+
+    monkeypatch.setattr(EV, "MAX_LAUNCH", 64)
+    n = 200  # 3 full chunks of 64 + a 8-wide tail after pow2 padding
+    rng = np.random.RandomState(5)
+    priv = ed.priv_key_from_secret(b"chunked")
+    pub_b = np.frombuffer(priv.pub_key().bytes(), dtype=np.uint8)
+    msgs = [rng.bytes(100) for _ in range(n)]
+    sigs = np.stack(
+        [np.frombuffer(priv.sign(m), dtype=np.uint8) for m in msgs]
+    )
+    bad = {0, 63, 64, 127, 128, 199}
+    for i in bad:
+        sigs[i, 3] ^= 0xFF
+    pubs = np.tile(pub_b, (n, 1))
+
+    out_chunked = EV.verify_arrays(pubs, sigs, msgs)
+    monkeypatch.setenv("CMT_TPU_MULTI_LAUNCH", "1")
+    out_multi = EV.verify_arrays(pubs, sigs, msgs)
+    assert out_chunked.shape == out_multi.shape == (n,)
+    assert (out_chunked == out_multi).all()
+    for i in range(n):
+        assert out_chunked[i] == (i not in bad), i
+
+
+def test_mixed_bucket_batch_falls_back_to_per_chunk_bucketing(monkeypatch):
+    """One oversized message must not drag the whole batch to its
+    length bucket: mixed-bucket batches use the multi-launch path
+    where each chunk buckets independently."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519_verify as EV
+
+    monkeypatch.setattr(EV, "MAX_LAUNCH", 64)
+    n = 130
+    rng = np.random.RandomState(9)
+    priv = ed.priv_key_from_secret(b"mixed")
+    pub_b = np.frombuffer(priv.pub_key().bytes(), dtype=np.uint8)
+    msgs = [rng.bytes(100) for _ in range(n - 1)] + [rng.bytes(400)]
+    sigs = np.stack(
+        [np.frombuffer(priv.sign(m), dtype=np.uint8) for m in msgs]
+    )
+    pubs = np.tile(pub_b, (n, 1))
+    parts = EV.verify_arrays_async(pubs, sigs, msgs)
+    assert len(parts) > 1  # multi-launch, not one global-bucket launch
+    out = EV._finish(parts)
+    assert out.shape == (n,) and bool(out.all())
