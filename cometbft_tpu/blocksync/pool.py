@@ -50,6 +50,7 @@ class _Requester:
         self.height = height
         self.peer_id = peer_id
         self.block: Block | None = None
+        self.ext_votes = None  # extended precommit votes, when carried
         self.request_time = time.monotonic()
 
 
@@ -167,7 +168,8 @@ class BlockPool:
 
     # -- block arrival (pool.go AddBlock) --------------------------------
 
-    def add_block(self, peer_id: str, block: Block, size: int) -> bool:
+    def add_block(self, peer_id: str, block: Block, size: int,
+                  ext_votes=None) -> bool:
         with self._mtx:
             req = self._requesters.get(block.header.height)
             if req is None or req.peer_id != peer_id:
@@ -176,6 +178,7 @@ class BlockPool:
             if req.block is not None:
                 return False
             req.block = block
+            req.ext_votes = ext_votes
             peer = self._peers.get(peer_id)
             if peer is not None:
                 peer.num_pending = max(0, peer.num_pending - 1)
@@ -191,6 +194,14 @@ class BlockPool:
                 req.request_time = 0.0
 
     # -- the sync loop's view (pool.go PeekTwoBlocks/PopRequest) ---------
+
+    def first_extended_votes(self):
+        """Extended votes carried with the first (pool.height) block's
+        response, if the serving peer had them (pool.go analog of the
+        ExtendedCommit ferried in bcproto BlockResponse)."""
+        with self._mtx:
+            req = self._requesters.get(self.height)
+            return req.ext_votes if req else None
 
     def peek_two_blocks(self) -> tuple[Block | None, Block | None]:
         with self._mtx:

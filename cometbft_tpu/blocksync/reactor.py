@@ -59,9 +59,15 @@ def encode_no_block_response(height: int) -> bytes:
     return w.finish()
 
 
-def encode_block_response(block) -> bytes:
+def encode_block_response(block, ext_votes_blob: bytes | None = None) -> bytes:
     m = ProtoWriter()
     m.message(1, codec.encode_block(block))
+    if ext_votes_blob:
+        # field 2 mirrors bcproto BlockResponse.ext_commit: the
+        # precommit votes (with extensions) for this block, so a node
+        # syncing through an extension-enabled height can later
+        # propose with a populated local_last_commit
+        m.message(2, ext_votes_blob)
     w = ProtoWriter()
     w.message(_F_BLOCK_RESPONSE, m.finish())
     return w.finish()
@@ -92,7 +98,12 @@ def decode_bs_message(data: bytes):
         return ("no_block", int(m.get(1, [0])[0]))
     if _F_BLOCK_RESPONSE in f:
         m = ProtoReader(bytes(f[_F_BLOCK_RESPONSE][0])).to_dict()
-        return ("block", codec.decode_block(bytes(m[1][0])))
+        ext_votes = None
+        if 2 in m:
+            from cometbft_tpu.store import BlockStore
+
+            ext_votes = BlockStore.decode_extended_votes(bytes(m[2][0]))
+        return ("block", codec.decode_block(bytes(m[1][0])), ext_votes)
     if _F_STATUS_REQUEST in f:
         return ("status_request",)
     if _F_STATUS_RESPONSE in f:
@@ -197,7 +208,10 @@ class BlocksyncReactor(Reactor):
             self._respond_to_block_request(env.src, msg[1])
         elif kind == "block":
             block = msg[1]
-            self.pool.add_block(env.src.id, block, len(env.message))
+            self.pool.add_block(
+                env.src.id, block, len(env.message),
+                ext_votes=msg[2] if len(msg) > 2 else None,
+            )
         elif kind == "no_block":
             self.pool.no_block(env.src.id, msg[1])
         elif kind == "status_request":
@@ -216,7 +230,8 @@ class BlocksyncReactor(Reactor):
         if block is None:
             peer.try_send(BLOCKSYNC_CHANNEL, encode_no_block_response(height))
             return
-        peer.send(BLOCKSYNC_CHANNEL, encode_block_response(block))
+        blob = self.block_store.load_seen_extended_votes_raw(height)
+        peer.send(BLOCKSYNC_CHANNEL, encode_block_response(block, blob))
 
     # -- pool callbacks ---------------------------------------------------
 
@@ -297,7 +312,29 @@ class BlocksyncReactor(Reactor):
                     self._on_pool_error(pid, "sent invalid block")
             return False
         if self.block_store.height() < first.header.height:
-            self.block_store.save_block(first, first_parts, second.last_commit)
+            ext = None
+            if self.state.consensus_params.vote_extensions_enabled(
+                first.header.height
+            ):
+                ext = self.pool.first_extended_votes()
+                if ext is None:
+                    # without the extended votes this node could never
+                    # propose height+1 (the reference panics on the
+                    # missing extended commit) — re-request from a
+                    # peer that has them
+                    self.logger.error(
+                        "peer served extension-enabled block without "
+                        "extended votes",
+                        height=first.header.height,
+                    )
+                    pid = self.pool.redo_request(first.header.height)
+                    if pid:
+                        self._on_pool_error(pid, "missing extended votes")
+                    return False
+            self.block_store.save_block(
+                first, first_parts, second.last_commit,
+                extended_votes=ext,
+            )
         self.state = self.block_exec.apply_block(
             self.state, first_id, first,
             syncing_to_height=self.pool.max_peer_height(),

@@ -543,11 +543,39 @@ class ConsensusState(BaseService):
                         "cannot propose without last commit", height=height
                     )
                     return
+            extended_votes = None
+            if (
+                height > self.state.initial_height
+                and self.state.consensus_params.vote_extensions_enabled(
+                    height - 1
+                )
+            ):
+                if self.last_commit is not None:
+                    extended_votes = self.last_commit.votes()
+                else:
+                    extended_votes = (
+                        self.block_store.load_seen_extended_votes(
+                            height - 1
+                        )
+                    )
+                if extended_votes is None:
+                    # the reference PANICS here (execution.go: an
+                    # extension-enabled height without a stored
+                    # extended commit is a bug or a blocksync gap);
+                    # refuse to propose rather than silently hand the
+                    # app local_last_commit=None
+                    self.logger.error(
+                        "missing extended votes for enabled height; "
+                        "refusing to propose",
+                        height=height,
+                    )
+                    return
             block = self.block_exec.create_proposal_block(
                 height,
                 self.state,
                 last_commit,
                 self.priv_validator.address,
+                extended_votes=extended_votes,
             )
             parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
 
@@ -917,7 +945,16 @@ class ConsensusState(BaseService):
 
         if self.block_store.height() < block.header.height:
             seen_commit = precommits.make_commit()
-            self.block_store.save_block(block, parts, seen_commit)
+            extended = None
+            if self.state.consensus_params.vote_extensions_enabled(height):
+                # keep the precommits WITH extensions — atomically with
+                # the block, so a crash can't strand a stored block
+                # whose extensions the height+1 proposer then silently
+                # lacks (store.go SaveBlockWithExtendedCommit)
+                extended = precommits.votes()
+            self.block_store.save_block(
+                block, parts, seen_commit, extended_votes=extended
+            )
         # Height boundary: the block is durably stored; a crash after this
         # replays from handshake, not the WAL (wal.go EndHeightMessage).
         self.wal.write_end_height(height)

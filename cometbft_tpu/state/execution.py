@@ -146,6 +146,60 @@ def build_last_commit_info(block: Block, store) -> CommitInfo:
     return CommitInfo(round=block.last_commit.round, votes=tuple(votes))
 
 
+def extended_commit_info(last_commit: Commit, votes, last_vals: ValidatorSet):
+    """ExtendedCommitInfo for PrepareProposal (execution.go
+    buildExtendedCommitInfoFromStore): per last-validator entry with
+    its vote extension + extension signature; absent validators get
+    empty entries so indices align.  Flags mirror MakeCommit's rules:
+    a precommit for a block OTHER than the decided one counts ABSENT
+    (its extension never passed the decided-block quorum), and nil
+    precommits never carry extensions to the app (their extensions are
+    not signature-verified — ABCI contract: extension only with
+    flag=COMMIT)."""
+    from cometbft_tpu.abci.types import ExtendedCommitInfo, ExtendedVoteInfo
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+        BLOCK_ID_FLAG_NIL,
+    )
+
+    decided = last_commit.block_id
+    infos = []
+    for i in range(len(last_vals)):
+        val = last_vals.get_by_index(i)
+        vote = votes[i] if votes is not None and i < len(votes) else None
+        if vote is None or (
+            not vote.block_id.is_nil() and vote.block_id != decided
+        ):
+            infos.append(
+                ExtendedVoteInfo(
+                    validator_address=val.address,
+                    validator_power=val.voting_power,
+                    block_id_flag=BLOCK_ID_FLAG_ABSENT,
+                )
+            )
+            continue
+        if vote.block_id.is_nil():
+            infos.append(
+                ExtendedVoteInfo(
+                    validator_address=val.address,
+                    validator_power=val.voting_power,
+                    block_id_flag=BLOCK_ID_FLAG_NIL,
+                )
+            )
+            continue
+        infos.append(
+            ExtendedVoteInfo(
+                validator_address=val.address,
+                validator_power=val.voting_power,
+                vote_extension=vote.extension,
+                extension_signature=vote.extension_signature,
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            )
+        )
+    return ExtendedCommitInfo(round=last_commit.round, votes=tuple(infos))
+
+
 def evidence_to_misbehavior(ev_list, state: State, store) -> tuple[Misbehavior, ...]:
     """(types/evidence.go Evidence.ABCI)"""
     out = []
@@ -341,8 +395,15 @@ class BlockExecutor:
         state: State,
         last_commit: Commit | None,
         proposer_address: bytes,
+        extended_votes=None,
     ) -> Block:
-        """Reap mempool + PrepareProposal (state/execution.go:113)."""
+        """Reap mempool + PrepareProposal (state/execution.go:113).
+
+        ``extended_votes``: last height's precommit Votes including
+        their vote extensions (index-aligned with last_validators);
+        when given, PrepareProposal receives them as local_last_commit
+        so the app can act on the extensions it collected
+        (execution.go buildExtendedCommitInfoFromStore)."""
         max_bytes = state.consensus_params.block.max_bytes
         if max_bytes == -1:
             max_bytes = 104857600
@@ -361,10 +422,15 @@ class BlockExecutor:
         else:
             time_ns = median_time(last_commit, state.last_validators)
 
+        local_last_commit = None
+        if extended_votes is not None and last_commit is not None:
+            local_last_commit = extended_commit_info(
+                last_commit, extended_votes, state.last_validators
+            )
         req = PrepareProposalRequest(
             max_tx_bytes=data_limit,
             txs=tuple(txs),
-            local_last_commit=None,
+            local_last_commit=local_last_commit,
             misbehavior=evidence_to_misbehavior(evidence, state, None),
             height=height,
             time_ns=time_ns,

@@ -24,6 +24,7 @@ _META = b"H:"
 _PART = b"P:"
 _COMMIT = b"C:"
 _SEEN_COMMIT = b"SC:"
+_SEEN_EXT_VOTES = b"SEV:"
 _EXT_COMMIT = b"EC:"
 _HASH = b"BH:"
 _STATE_KEY = b"blockStore"
@@ -119,10 +120,15 @@ class BlockStore:
     # -- saves ---------------------------------------------------------
 
     def save_block(
-        self, block: Block, part_set: PartSet, seen_commit: Commit
+        self, block: Block, part_set: PartSet, seen_commit: Commit,
+        extended_votes=None,
     ) -> None:
-        """Atomically persist block parts + meta + commits
-        (store/store.go SaveBlock)."""
+        """Atomically persist block parts + meta + commits — and, when
+        given, the precommit votes with their vote extensions IN THE
+        SAME BATCH (store/store.go SaveBlock /
+        SaveBlockWithExtendedCommit: a crash between the two writes
+        would silently lose the extensions the height+1 proposer
+        needs)."""
         if block is None or not part_set.is_complete():
             raise BlockStoreError("cannot save incomplete block")
         height = block.header.height
@@ -138,6 +144,13 @@ class BlockStore:
                 (_HASH + block.hash(), height.to_bytes(8, "big")),
                 (_hkey(_SEEN_COMMIT, height), codec.encode_commit(seen_commit)),
             ]
+            if extended_votes is not None:
+                ops.append(
+                    (
+                        _hkey(_SEEN_EXT_VOTES, height),
+                        self._encode_extended_votes(extended_votes),
+                    )
+                )
             for i in range(part_set.header.total):
                 part = part_set.get_part(i)
                 ops.append((_pkey(height, i), codec.encode_part(part)))
@@ -162,6 +175,50 @@ class BlockStore:
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self._db.set(_hkey(_SEEN_COMMIT, height), codec.encode_commit(commit))
 
+    @staticmethod
+    def _encode_extended_votes(votes) -> bytes:
+        """Length-prefixed Vote encodings; absent votes are empty
+        entries so validator-index alignment survives."""
+        from cometbft_tpu.utils.protoio import length_prefixed
+
+        return b"".join(
+            length_prefixed(v.encode() if v is not None else b"")
+            for v in votes
+        )
+
+    def save_seen_extended_votes(self, height: int, votes) -> None:
+        """Persist the precommit votes WITH their vote extensions for
+        ``height`` (blocksync's path; consensus saves them atomically
+        inside save_block)."""
+        self._db.set(
+            _hkey(_SEEN_EXT_VOTES, height),
+            self._encode_extended_votes(votes),
+        )
+
+    @staticmethod
+    def decode_extended_votes(raw: bytes):
+        """Inverse of _encode_extended_votes (also used to decode the
+        blob ferried in blocksync block responses)."""
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.utils.protoio import read_length_prefixed
+
+        votes, off, raw = [], 0, bytes(raw)
+        while off < len(raw):
+            payload, off = read_length_prefixed(raw, off)
+            votes.append(Vote.decode(payload) if payload else None)
+        return votes
+
+    def load_seen_extended_votes_raw(self, height: int) -> bytes | None:
+        raw = self._db.get(_hkey(_SEEN_EXT_VOTES, height))
+        return bytes(raw) if raw is not None else None
+
+    def load_seen_extended_votes(self, height: int):
+        """Inverse of save_seen_extended_votes; None when unset."""
+        raw = self.load_seen_extended_votes_raw(height)
+        if raw is None:
+            return None
+        return self.decode_extended_votes(raw)
+
     # -- pruning -------------------------------------------------------
 
     def prune_last_block(self) -> None:
@@ -177,6 +234,7 @@ class BlockStore:
                 (_hkey(_COMMIT, h), None),
                 (_hkey(_COMMIT, h - 1), None),
                 (_hkey(_SEEN_COMMIT, h), None),
+                (_hkey(_SEEN_EXT_VOTES, h), None),
             ]
             if meta is not None:
                 ops.append((_HASH + meta.block_id.hash, None))
@@ -213,6 +271,7 @@ class BlockStore:
                 ops.append((_HASH + meta.block_id.hash, None))
                 ops.append((_hkey(_COMMIT, h), None))
                 ops.append((_hkey(_SEEN_COMMIT, h), None))
+                ops.append((_hkey(_SEEN_EXT_VOTES, h), None))
                 for i in range(meta.block_id.part_set_header.total):
                     ops.append((_pkey(h, i), None))
                 pruned += 1

@@ -151,18 +151,28 @@ class VoteSet:
             vote.sign_bytes(self.chain_id), vote.signature
         ):
             raise VoteSetError("invalid vote signature")
-        if (
+        ext_slot = (
             self.extensions_enabled
             and self.signed_msg_type == PRECOMMIT_TYPE
             and not vote.is_nil()
+        )
+        if not ext_slot:
+            # extensions ride ONLY non-nil precommits (vote.go
+            # ValidateBasic): a nil/prevote extension is never
+            # signature-checked, so accepting one would hand the app
+            # attacker-controlled unverified bytes downstream
+            if vote.extension or vote.extension_signature:
+                raise VoteSetError(
+                    "vote extension on a nil vote or prevote"
+                )
+            return
+        if not vote.extension_signature:
+            raise VoteSetError("missing vote extension signature")
+        if not pub_key.verify_signature(
+            vote.extension_sign_bytes(self.chain_id),
+            vote.extension_signature,
         ):
-            if not vote.extension_signature:
-                raise VoteSetError("missing vote extension signature")
-            if not pub_key.verify_signature(
-                vote.extension_sign_bytes(self.chain_id),
-                vote.extension_signature,
-            ):
-                raise VoteSetError("invalid vote extension signature")
+            raise VoteSetError("invalid vote extension signature")
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """A peer claims +2/3 for block_id (anti-entropy, vote_set.go:

@@ -1,0 +1,260 @@
+"""Vote extensions end-to-end (reference: ABCI 2.0 ExtendVote/
+VerifyVoteExtension flow, execution.go buildExtendedCommitInfoFromStore,
+store.go SaveBlockWithExtendedCommit).
+
+With FeatureParams.vote_extensions_enable_height set, every precommit
+carries an app-supplied extension (signed separately); the NEXT
+height's proposer must replay the collected extensions into
+PrepareProposal's local_last_commit."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApp
+from cometbft_tpu.abci.types import (
+    ExtendVoteRequest,
+    ExtendVoteResponse,
+    PrepareProposalRequest,
+    VerifyStatus,
+    VerifyVoteExtensionRequest,
+    VerifyVoteExtensionResponse,
+)
+from cometbft_tpu.types.params import ConsensusParams
+
+from tests.test_reactors import connect_star, make_localnet, wait_all_height
+
+
+class ExtensionApp(KVStoreApp):
+    """kvstore + vote extensions: extends with b'ext@<height>', verifies
+    strictly, and records the local_last_commit it sees in
+    PrepareProposal."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen_commits = []  # ExtendedCommitInfo per PrepareProposal
+        self.verified = 0
+        self._ext_mtx = threading.Lock()
+
+    def extend_vote(self, req: ExtendVoteRequest) -> ExtendVoteResponse:
+        return ExtendVoteResponse(
+            vote_extension=b"ext@%d" % req.height
+        )
+
+    def verify_vote_extension(
+        self, req: VerifyVoteExtensionRequest
+    ) -> VerifyVoteExtensionResponse:
+        ok = req.vote_extension == b"ext@%d" % req.height
+        with self._ext_mtx:
+            self.verified += 1
+        return VerifyVoteExtensionResponse(
+            status=VerifyStatus.ACCEPT if ok else VerifyStatus.REJECT
+        )
+
+    def prepare_proposal(self, req: PrepareProposalRequest):
+        if req.local_last_commit is not None:
+            with self._ext_mtx:
+                self.seen_commits.append(
+                    (req.height, req.local_last_commit)
+                )
+        return super().prepare_proposal(req)
+
+
+def _ext_params():
+    base = ConsensusParams()
+    return replace(
+        base,
+        feature=replace(base.feature, vote_extensions_enable_height=1),
+    )
+
+
+def test_extensions_flow_back_into_prepare_proposal(tmp_path):
+    apps: list[ExtensionApp] = []
+
+    def app_factory():
+        app = ExtensionApp()
+        apps.append(app)
+        return app
+
+    nodes, privs, gen = make_localnet(
+        tmp_path, 2, app_factory=app_factory,
+        consensus_params=_ext_params(),
+    )
+    for n in nodes:
+        n.start()
+    try:
+        connect_star(nodes)
+        wait_all_height(nodes, 6)
+
+        # every committed precommit carries a verified extension
+        bs = nodes[0].block_store
+        for h in range(1, 5):
+            votes = bs.load_seen_extended_votes(h)
+            assert votes is not None, f"no extended votes stored at {h}"
+            present = [v for v in votes if v is not None]
+            assert present, h
+            for v in present:
+                assert v.extension == b"ext@%d" % h
+                assert v.extension_signature, "extension not signed"
+
+        # some proposer replayed them into PrepareProposal
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            seen = [c for app in apps for c in app.seen_commits]
+            if seen:
+                break
+            time.sleep(0.2)
+        assert seen, "no PrepareProposal ever carried local_last_commit"
+        height, info = seen[-1]
+        commit_votes = [
+            v for v in info.votes if v.vote_extension
+        ]
+        assert commit_votes, "local_last_commit carried no extensions"
+        for v in commit_votes:
+            assert v.vote_extension == b"ext@%d" % (height - 1)
+            assert v.extension_signature
+            assert v.validator_power > 0
+
+        # peers really verified incoming extensions
+        assert any(app.verified > 0 for app in apps)
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def test_extension_signature_verified_on_receive(tmp_path):
+    """A vote whose extension signature is junk must be rejected by
+    the receiving consensus state (vote.go VerifyExtension analog)."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types import PRECOMMIT_TYPE
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from tests.helpers import CHAIN_ID, make_block_id
+
+    key = ed.priv_key_from_secret(b"extsig")
+    vals = ValidatorSet([Validator(key.pub_key(), 10)])
+    bid = make_block_id()
+    vote = Vote(
+        type=PRECOMMIT_TYPE,
+        height=5,
+        round=0,
+        block_id=bid,
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=key.pub_key().address(),
+        validator_index=0,
+        extension=b"payload",
+    )
+    sig = key.sign(vote.sign_bytes(CHAIN_ID))
+    ext_sig = key.sign(vote.extension_sign_bytes(CHAIN_ID))
+    good = replace(vote, signature=sig, extension_signature=ext_sig)
+    assert key.pub_key().verify_signature(
+        good.extension_sign_bytes(CHAIN_ID), good.extension_signature
+    )
+    bad = replace(vote, signature=sig, extension_signature=b"\x01" * 64)
+    assert not key.pub_key().verify_signature(
+        bad.extension_sign_bytes(CHAIN_ID), bad.extension_signature
+    )
+
+
+def test_blocksync_carries_extended_votes(tmp_path):
+    """A node that blocksyncs through extension-enabled heights must
+    receive and store the extended votes (bcproto BlockResponse
+    ext_commit analog) — otherwise it could never propose."""
+    apps: list[ExtensionApp] = []
+
+    def app_factory():
+        app = ExtensionApp()
+        apps.append(app)
+        return app
+
+    def configure(i, cfg):
+        if i == 3:
+            cfg.base.block_sync = True
+
+    nodes, privs, gen = make_localnet(
+        tmp_path, 4, app_factory=app_factory,
+        consensus_params=_ext_params(), configure=configure,
+    )
+    # grow the chain with the first three validators (3/4 power)
+    for n in nodes[:3]:
+        n.start()
+    try:
+        connect_star(nodes[:3])
+        wait_all_height(nodes[:3], 5)
+        # freeze the chain (2/4 power can't commit) so the joiner can
+        # fully catch up instead of chasing a moving head
+        nodes[2].consensus.stop()
+        frozen = max(n.block_store.height() for n in nodes[:2])
+        # late joiner blocksyncs from the others
+        nodes[3].start()
+        from cometbft_tpu.p2p.netaddr import NetAddress
+
+        for other in nodes[:3]:
+            addr = other.transport.listen_addr
+            nodes[3].switch.dial_peer_with_address(
+                NetAddress(id=addr.id, host=addr.host, port=addr.port),
+                persistent=True,
+            )
+        deadline = time.monotonic() + 60
+        synced = nodes[3].block_store
+        while synced.height() < frozen - 1:
+            assert time.monotonic() < deadline, synced.height()
+            time.sleep(0.2)
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            ok = all(
+                synced.load_seen_extended_votes(h) is not None
+                for h in range(2, 5)
+            )
+            time.sleep(0.2)
+        assert ok, "blocksynced node lacks extended votes"
+        votes = synced.load_seen_extended_votes(3)
+        present = [v for v in votes if v is not None]
+        assert present and all(
+            v.extension == b"ext@3" for v in present
+        )
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def test_nil_vote_with_extension_rejected():
+    """Extensions ride only non-nil precommits; a nil precommit
+    carrying unverified extension bytes must be refused by the vote
+    set (ABCI contract / vote.go ValidateBasic)."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types import PRECOMMIT_TYPE
+    from cometbft_tpu.types.block import BlockID
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet, VoteSetError
+    from tests.helpers import CHAIN_ID
+
+    key = ed.priv_key_from_secret(b"nilguard")
+    vals = ValidatorSet([Validator(key.pub_key(), 10)])
+    vs = VoteSet(
+        CHAIN_ID, 4, 0, PRECOMMIT_TYPE, vals, extensions_enabled=True
+    )
+    vote = Vote(
+        type=PRECOMMIT_TYPE,
+        height=4,
+        round=0,
+        block_id=BlockID(),  # nil
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=key.pub_key().address(),
+        validator_index=0,
+        extension=b"smuggled",
+    )
+    signed = replace(vote, signature=key.sign(vote.sign_bytes(CHAIN_ID)))
+    with pytest.raises(VoteSetError, match="extension"):
+        vs.add_vote(signed)
