@@ -317,19 +317,28 @@ class BlocksyncReactor(Reactor):
                 first.header.height
             ):
                 ext = self.pool.first_extended_votes()
+                if ext is not None and not self._extended_votes_valid(
+                    first, first_id, ext
+                ):
+                    # fabricated blob: the peer is malicious — drop it
+                    pid = self.pool.redo_request(first.header.height)
+                    if pid:
+                        self._on_pool_error(pid, "invalid extended votes")
+                    return False
                 if ext is None:
                     # without the extended votes this node could never
                     # propose height+1 (the reference panics on the
-                    # missing extended commit) — re-request from a
-                    # peer that has them
+                    # missing extended commit).  The peer may simply be
+                    # an honest pre-upgrade node whose store lacks
+                    # them, so rotate to another peer WITHOUT banning;
+                    # if no peer ever serves them, sync stalls loudly
+                    # rather than silently breaking future proposals.
                     self.logger.error(
                         "peer served extension-enabled block without "
-                        "extended votes",
+                        "extended votes; retrying elsewhere",
                         height=first.header.height,
                     )
-                    pid = self.pool.redo_request(first.header.height)
-                    if pid:
-                        self._on_pool_error(pid, "missing extended votes")
+                    self.pool.redo_request(first.header.height)
                     return False
             self.block_store.save_block(
                 first, first_parts, second.last_commit,
@@ -340,6 +349,49 @@ class BlocksyncReactor(Reactor):
             syncing_to_height=self.pool.max_peer_height(),
         )
         self.pool.pop_request()
+        return True
+
+    def _extended_votes_valid(self, block, block_id, votes) -> bool:
+        """A blocksync peer's ferried extended votes are UNTRUSTED:
+        every present vote must be a precommit for THIS block at this
+        height by the right validator, with valid vote AND extension
+        signatures — otherwise a malicious peer could plant
+        never-verified extension bytes that a later PrepareProposal
+        hands to the application."""
+        from cometbft_tpu.types import PRECOMMIT_TYPE
+
+        vals = self.state.validators
+        if len(votes) != len(vals):
+            return False
+        chain_id = self.state.chain_id
+        for i, vote in enumerate(votes):
+            if vote is None:
+                continue
+            val = vals.get_by_index(i)
+            if (
+                vote.type != PRECOMMIT_TYPE
+                or vote.height != block.header.height
+                or vote.validator_index != i
+                or vote.validator_address != val.address
+            ):
+                return False
+            if not vote.block_id.is_nil() and vote.block_id != block_id:
+                return False
+            if not val.pub_key.verify_signature(
+                vote.sign_bytes(chain_id), vote.signature
+            ):
+                return False
+            if vote.block_id.is_nil():
+                if vote.extension or vote.extension_signature:
+                    return False
+                continue
+            if not vote.extension_signature:
+                return False
+            if not val.pub_key.verify_signature(
+                vote.extension_sign_bytes(chain_id),
+                vote.extension_signature,
+            ):
+                return False
         return True
 
     def _maybe_switch_to_consensus(self) -> bool:

@@ -858,7 +858,28 @@ class ConsensusReactor(Reactor):
                 missing = have.sub(prs.catchup_commit)
                 index, ok = missing.pick_random(self._rng)
                 if ok:
-                    vote = vote_from_commit(commit, index)
+                    vote = None
+                    if self.consensus.state.consensus_params.\
+                            vote_extensions_enabled(prs.height):
+                        # a reconstructed commit-sig vote has no
+                        # extension signature and a VE-enabled receiver
+                        # rightly rejects it — serve the stored FULL
+                        # precommit (saved atomically with the block)
+                        ext = block_store.load_seen_extended_votes(
+                            prs.height
+                        )
+                        if ext is not None and index < len(ext):
+                            cand = ext[index]
+                            # the seen (extended) round can differ from
+                            # the canonical commit round the peer's
+                            # catchup set was built for
+                            if (
+                                cand is not None
+                                and cand.round == commit.round
+                            ):
+                                vote = cand
+                    if vote is None:
+                        vote = vote_from_commit(commit, index)
                     if vote is not None:
                         msg = VoteMessage(vote=vote)
                         if peer.send(VOTE_CHANNEL, encode_message(msg)):

@@ -317,3 +317,38 @@ def report_from_home(home: str) -> list[ExperimentReport]:
         return report_from_block_store(BlockStore(db))
     finally:
         db.close()
+
+
+def block_interval_stats(block_store, last_n: int = 100) -> dict:
+    """Block-production statistics over the last ``last_n`` blocks
+    (test/e2e/runner/benchmark.go: mean/stddev/min/max block interval
+    plus tx throughput) — the e2e benchmark mode's output."""
+    head = block_store.height()
+    base = max(block_store.base(), head - last_n + 1)
+    metas = []
+    txns = 0
+    for h in range(base, head + 1):
+        meta = block_store.load_block_meta(h)
+        if meta is None:
+            continue
+        metas.append(meta.header.time_ns)
+        txns += meta.num_txs
+    if len(metas) < 2:
+        return {"blocks": len(metas), "error": "not enough blocks"}
+    intervals = [b - a for a, b in zip(metas, metas[1:])]
+    mean = sum(intervals) / len(intervals)
+    var = sum((x - mean) ** 2 for x in intervals) / len(intervals)
+    span_s = (metas[-1] - metas[0]) / 1e9
+    return {
+        "blocks": len(metas),
+        "from_height": base,
+        "to_height": head,
+        "mean_interval_s": round(mean / 1e9, 4),
+        "stddev_interval_s": round(math.sqrt(var) / 1e9, 4),
+        "min_interval_s": round(min(intervals) / 1e9, 4),
+        "max_interval_s": round(max(intervals) / 1e9, 4),
+        "blocks_per_min": round(60 * (len(metas) - 1) / span_s, 1)
+        if span_s > 0
+        else 0.0,
+        "txns_per_sec": round(txns / span_s, 1) if span_s > 0 else 0.0,
+    }

@@ -155,13 +155,26 @@ static void fp_to_be(u8 out[48], const fp &a) {
 
 // generic exponentiation by big-endian bit scan of a raw 6-limb exponent
 static void fp_pow_raw(fp &out, const fp &base, const u64 e[6]) {
-    fp acc = FP_ONE, b = base;
-    for (int i = 0; i < 384; i++) {
-        int limb = i / 64, bit = i % 64;
-        if ((e[limb] >> bit) & 1) fp_mul(acc, acc, b);
-        fp_sqr(b, b);
+    // fixed 4-bit windows, MSB-first: 384 squarings + <=96 muls
+    fp table[16];
+    table[0] = FP_ONE;
+    table[1] = base;
+    for (int i = 2; i < 16; i++) fp_mul(table[i], table[i - 1], base);
+    fp acc = FP_ONE;
+    bool started = false;
+    for (int w = 95; w >= 0; w--) {
+        int limb = w / 16, off = (w % 16) * 4;
+        u64 nib = (e[limb] >> off) & 0xF;
+        if (started) {
+            fp_sqr(acc, acc); fp_sqr(acc, acc);
+            fp_sqr(acc, acc); fp_sqr(acc, acc);
+        }
+        if (nib) {
+            if (started) fp_mul(acc, acc, table[nib]);
+            else { acc = table[nib]; started = true; }
+        }
     }
-    out = acc;
+    out = acc;  // acc is FP_ONE when the exponent was zero
 }
 
 static void fp_inv(fp &out, const fp &a) {
@@ -631,25 +644,36 @@ static void g2j_to_affine(g2a &o, const g2j &p) {
     o.inf = false;
 }
 
-// scalar mult by big-endian 32-byte scalar
+// scalar mult by big-endian scalar — fixed 4-bit windows: the
+// doublings are shared per nibble and table lookups replace half the
+// adds of plain double-and-add
 static void g1j_mul_be(g1j &o, const g1j &p, const u8 *k, size_t klen) {
-    g1j acc;
-    acc.x = FP_ONE; acc.y = FP_ONE; memset(acc.z.l, 0, sizeof acc.z.l);
+    g1j table[16];
+    table[0].x = FP_ONE; table[0].y = FP_ONE;
+    memset(table[0].z.l, 0, sizeof table[0].z.l);
+    table[1] = p;
+    for (int i = 2; i < 16; i++) g1j_add(table[i], table[i - 1], p);
+    g1j acc = table[0];
     for (size_t i = 0; i < klen; i++) {
-        for (int b = 7; b >= 0; b--) {
-            g1j_dbl(acc, acc);
-            if ((k[i] >> b) & 1) g1j_add(acc, acc, p);
+        for (int half = 0; half < 2; half++) {
+            for (int d = 0; d < 4; d++) g1j_dbl(acc, acc);
+            u8 nib = half ? (k[i] & 0xF) : (k[i] >> 4);
+            if (nib) g1j_add(acc, acc, table[nib]);
         }
     }
     o = acc;
 }
 static void g2j_mul_be(g2j &o, const g2j &p, const u8 *k, size_t klen) {
-    g2j acc;
-    acc.x = FP2_ONE; acc.y = FP2_ONE; acc.z = FP2_ZERO;
+    g2j table[16];
+    table[0].x = FP2_ONE; table[0].y = FP2_ONE; table[0].z = FP2_ZERO;
+    table[1] = p;
+    for (int i = 2; i < 16; i++) g2j_add(table[i], table[i - 1], p);
+    g2j acc = table[0];
     for (size_t i = 0; i < klen; i++) {
-        for (int b = 7; b >= 0; b--) {
-            g2j_dbl(acc, acc);
-            if ((k[i] >> b) & 1) g2j_add(acc, acc, p);
+        for (int half = 0; half < 2; half++) {
+            for (int d = 0; d < 4; d++) g2j_dbl(acc, acc);
+            u8 nib = half ? (k[i] & 0xF) : (k[i] >> 4);
+            if (nib) g2j_add(acc, acc, table[nib]);
         }
     }
     o = acc;

@@ -293,7 +293,7 @@ class TestDoubleSigner:
         ev_hash = out["hash"]
 
         # wait until some block carries the evidence
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         seen_upto = _height(port)
         found = False
         scan_from = max(1, h)
@@ -443,3 +443,29 @@ class TestAbciGrammar:
         time.sleep(1.0)
         node.stop()
         rec2.check(clean_start=False)
+
+
+class TestBenchmarkMode:
+    def test_block_interval_stats_over_live_net(self, net):
+        """e2e benchmark mode (runner/benchmark.go): block-interval
+        statistics over the running subprocess net, read offline from
+        a node home's block store via the loadtime reporter."""
+        ports = [_rpc_port(i) for i in range(N_NODES)]
+        base = max(_height(p) for p in ports)
+        _wait_heights(ports, base + 5)
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.loadtime import block_interval_stats
+        from cometbft_tpu.utils.db import open_db
+
+        cfg = Config.load(os.path.join(net.root, "node0"))
+        db = open_db("blockstore", cfg.base.db_backend, cfg.db_dir)
+        try:
+            stats = block_interval_stats(BlockStore(db), last_n=50)
+        finally:
+            db.close()
+        assert stats["blocks"] >= 5
+        assert 0 < stats["mean_interval_s"] < 30
+        assert stats["min_interval_s"] <= stats["mean_interval_s"]
+        assert stats["mean_interval_s"] <= stats["max_interval_s"]
+        assert stats["blocks_per_min"] > 0

@@ -258,3 +258,74 @@ def test_nil_vote_with_extension_rejected():
     signed = replace(vote, signature=key.sign(vote.sign_bytes(CHAIN_ID)))
     with pytest.raises(VoteSetError, match="extension"):
         vs.add_vote(signed)
+
+
+def test_blocksync_rejects_fabricated_extended_votes(tmp_path):
+    """A malicious peer's ferried ext blob (junk extensions, wrong
+    signer, missing extension signature) must fail verification before
+    it can be persisted (blocksync/reactor.py _extended_votes_valid)."""
+    from types import SimpleNamespace
+
+    from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.types import PRECOMMIT_TYPE
+    from cometbft_tpu.types.block import BlockID, PartSetHeader
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import Vote
+    from tests.helpers import CHAIN_ID
+
+    keys = [ed.priv_key_from_secret(b"bsv%d" % i) for i in range(2)]
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+    ordered = [
+        {k.pub_key().address(): k for k in keys}[v.address]
+        for v in vals.validators
+    ]
+    h = bytes(range(32))
+    bid = BlockID(hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1]))
+    block = SimpleNamespace(header=SimpleNamespace(height=7))
+
+    def mk_vote(i, key, ext=b"e", tamper=None):
+        v = Vote(
+            type=PRECOMMIT_TYPE, height=7, round=0, block_id=bid,
+            timestamp_ns=1_700_000_000_000_000_000,
+            validator_address=key.pub_key().address(),
+            validator_index=i, extension=ext,
+        )
+        sig = key.sign(v.sign_bytes(CHAIN_ID))
+        ext_sig = key.sign(v.extension_sign_bytes(CHAIN_ID))
+        if tamper == "ext_sig":
+            ext_sig = b"\x01" * 64
+        if tamper == "no_ext_sig":
+            ext_sig = b""
+        return replace(v, signature=sig, extension_signature=ext_sig)
+
+    fake = SimpleNamespace(
+        state=SimpleNamespace(validators=vals, chain_id=CHAIN_ID)
+    )
+    check = BlocksyncReactor._extended_votes_valid
+    good = [mk_vote(i, k) for i, k in enumerate(ordered)]
+    assert check(fake, block, bid, good)
+    assert check(fake, block, bid, [good[0], None])  # absent slot ok
+
+    assert not check(fake, block, bid, [good[0]])  # wrong length
+    bad = [good[0], mk_vote(1, ordered[1], tamper="ext_sig")]
+    assert not check(fake, block, bid, bad)  # junk extension signature
+    bad = [good[0], mk_vote(1, ordered[1], tamper="no_ext_sig")]
+    assert not check(fake, block, bid, bad)  # unsigned extension
+    bad = [good[0], mk_vote(1, ordered[0])]  # wrong signer for slot
+    assert not check(fake, block, bid, bad)
+    wrong_bid = BlockID(hash=h[::-1],
+                        part_set_header=PartSetHeader(total=1, hash=h))
+    v = Vote(
+        type=PRECOMMIT_TYPE, height=7, round=0, block_id=wrong_bid,
+        timestamp_ns=1, validator_address=ordered[1].pub_key().address(),
+        validator_index=1, extension=b"e",
+    )
+    v = replace(
+        v,
+        signature=ordered[1].sign(v.sign_bytes(CHAIN_ID)),
+        extension_signature=ordered[1].sign(
+            v.extension_sign_bytes(CHAIN_ID)
+        ),
+    )
+    assert not check(fake, block, bid, [good[0], v])  # other block
