@@ -251,12 +251,13 @@ def main() -> None:
             n_commits_run=n_commits, n_commits_modeled=modeled,
         )
 
-    n4 = 64 if on_cpu else 1024
+    # full modeled counts on the accelerator — nothing extrapolated
+    n4 = 64 if on_cpu else 10_000
     stream_config("light_sync_150val", vals150, commit150, n4, 10_000)
     vals1k, commit1k, bid1k = make_commit_fixture(
         128 if on_cpu else 1000
     )
-    n5 = 16 if on_cpu else 256
+    n5 = 16 if on_cpu else 1000
     stream_config("blocksync_replay_1kval", vals1k, commit1k, n5, 1000)
 
     # ---- config 5: mixed ed25519 + bls12381 mega-commit --------------
