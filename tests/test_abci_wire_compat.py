@@ -319,3 +319,174 @@ class TestUpstreamWireCompat:
         )
         assert back == ref
         assert codec.encode_msg(ours) == ref.SerializeToString()
+
+
+def _build_pool2():
+    """Second descriptor pool: statesync + proposal surfaces incl. the
+    nested ConsensusParams message tree (params.proto)."""
+    pool = descriptor_pool.DescriptorPool()
+    fd = descriptor_pb2.FileDescriptorProto(
+        name="abci_compat2.proto", package="compat2.abci", syntax="proto3"
+    )
+
+    def msg(name, *fields):
+        m = descriptor_pb2.DescriptorProto(name=name)
+        m.field.extend(fields)
+        return m
+
+    def fld(name, number, ftype, label=_F.LABEL_OPTIONAL, type_name=None):
+        f = _F(name=name, number=number, type=ftype, label=label)
+        if type_name:
+            f.type_name = type_name
+        return f
+
+    T_MSG = _F.TYPE_MESSAGE
+    fd.message_type.extend(
+        [
+            msg(
+                "Duration",
+                fld("seconds", 1, _F.TYPE_INT64),
+                fld("nanos", 2, _F.TYPE_INT32),
+            ),
+            msg("Int64Value", fld("value", 1, _F.TYPE_INT64)),
+            msg(
+                "BlockParams",
+                fld("max_bytes", 1, _F.TYPE_INT64),
+                fld("max_gas", 2, _F.TYPE_INT64),
+            ),
+            msg(
+                "EvidenceParams",
+                fld("max_age_num_blocks", 1, _F.TYPE_INT64),
+                fld("max_age_duration", 2, T_MSG,
+                    type_name=".compat2.abci.Duration"),
+                fld("max_bytes", 3, _F.TYPE_INT64),
+            ),
+            msg(
+                "ValidatorParams",
+                fld("pub_key_types", 1, _F.TYPE_STRING,
+                    _F.LABEL_REPEATED),
+            ),
+            msg(
+                "SynchronyParams",
+                fld("precision", 1, T_MSG,
+                    type_name=".compat2.abci.Duration"),
+                fld("message_delay", 2, T_MSG,
+                    type_name=".compat2.abci.Duration"),
+            ),
+            msg(
+                "FeatureParams",
+                fld("vote_extensions_enable_height", 1, T_MSG,
+                    type_name=".compat2.abci.Int64Value"),
+                fld("pbts_enable_height", 2, T_MSG,
+                    type_name=".compat2.abci.Int64Value"),
+            ),
+            msg(
+                "ConsensusParams",
+                fld("block", 1, T_MSG,
+                    type_name=".compat2.abci.BlockParams"),
+                fld("evidence", 2, T_MSG,
+                    type_name=".compat2.abci.EvidenceParams"),
+                fld("validator", 3, T_MSG,
+                    type_name=".compat2.abci.ValidatorParams"),
+                fld("synchrony", 6, T_MSG,
+                    type_name=".compat2.abci.SynchronyParams"),
+                fld("feature", 7, T_MSG,
+                    type_name=".compat2.abci.FeatureParams"),
+            ),
+            msg(
+                "Snapshot",
+                fld("height", 1, _F.TYPE_UINT64),
+                fld("format", 2, _F.TYPE_UINT32),
+                fld("chunks", 3, _F.TYPE_UINT32),
+                fld("hash", 4, _F.TYPE_BYTES),
+                fld("metadata", 5, _F.TYPE_BYTES),
+            ),
+            msg(
+                "OfferSnapshotRequest",
+                fld("snapshot", 1, T_MSG,
+                    type_name=".compat2.abci.Snapshot"),
+                fld("app_hash", 2, _F.TYPE_BYTES),
+            ),
+            msg(
+                "LoadSnapshotChunkRequest",
+                fld("height", 1, _F.TYPE_UINT64),
+                fld("format", 2, _F.TYPE_UINT32),
+                fld("chunk", 3, _F.TYPE_UINT32),
+            ),
+        ]
+    )
+    pool.Add(fd)
+    return {
+        m: message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"compat2.abci.{m}")
+        )
+        for m in (
+            "ConsensusParams",
+            "Snapshot",
+            "OfferSnapshotRequest",
+            "LoadSnapshotChunkRequest",
+        )
+    }
+
+
+PB2 = _build_pool2()
+
+
+class TestParamsAndSnapshotWireCompat:
+    def test_consensus_params_nested_tree(self):
+        """ConsensusParams as protobuf emits it — nested Duration and
+        Int64Value wrappers included — decodes into our params, and
+        our encoding parses back identically."""
+        from cometbft_tpu.abci import codec as C
+
+        ref = PB2["ConsensusParams"]()
+        ref.block.max_bytes = 4 * 1024 * 1024
+        ref.block.max_gas = -1
+        ref.evidence.max_age_num_blocks = 100000
+        ref.evidence.max_age_duration.seconds = 172800
+        ref.evidence.max_bytes = 1048576
+        ref.validator.pub_key_types.append("ed25519")
+        ref.validator.pub_key_types.append("bls12_381")
+        ref.synchrony.precision.nanos = 505000000
+        ref.synchrony.message_delay.seconds = 15
+        ref.feature.vote_extensions_enable_height.value = 10
+        ref.feature.pbts_enable_height.value = 1
+
+        ours = C._decode_params(ref.SerializeToString())
+        assert ours.block.max_bytes == 4 * 1024 * 1024
+        assert ours.block.max_gas == -1
+        assert ours.evidence.max_age_num_blocks == 100000
+        assert ours.evidence.max_age_duration_ns == 172800 * 10**9
+        assert ours.validator.pub_key_types == ("ed25519", "bls12_381")
+        assert ours.synchrony.precision_ns == 505000000
+        assert ours.synchrony.message_delay_ns == 15 * 10**9
+        assert ours.feature.vote_extensions_enable_height == 10
+        assert ours.feature.pbts_enable_height == 1
+
+        back = PB2["ConsensusParams"].FromString(C._encode_params(ours))
+        assert back == ref
+
+    def test_snapshot_messages(self):
+        ref = PB2["OfferSnapshotRequest"]()
+        ref.snapshot.height = 77
+        ref.snapshot.format = 1
+        ref.snapshot.chunks = 9
+        ref.snapshot.hash = b"\xaa" * 32
+        ref.snapshot.metadata = b"meta"
+        ref.app_hash = b"\xbb" * 32
+        ours = codec.decode_msg(
+            T.OfferSnapshotRequest, ref.SerializeToString()
+        )
+        assert ours.snapshot.height == 77
+        assert ours.snapshot.chunks == 9
+        assert ours.app_hash == b"\xbb" * 32
+        assert PB2["OfferSnapshotRequest"].FromString(
+            codec.encode_msg(ours)
+        ) == ref
+
+        ref2 = PB2["LoadSnapshotChunkRequest"](height=5, format=1, chunk=3)
+        ours2 = codec.decode_msg(
+            T.LoadSnapshotChunkRequest, ref2.SerializeToString()
+        )
+        assert (ours2.height, ours2.format, ours2.chunk) == (5, 1, 3)
+        assert codec.encode_msg(ours2) == ref2.SerializeToString()
