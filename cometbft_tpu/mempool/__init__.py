@@ -180,6 +180,12 @@ class CListMempool:
         with self._mtx:
             return tx_hash(tx) in self._txs
 
+    def get_tx_by_hash(self, hash_: bytes) -> bytes | None:
+        """(mempool.go GetTxByHash — the /unconfirmed_tx RPC)."""
+        with self._mtx:
+            mt = self._txs.get(hash_)
+            return bytes(mt.tx) if mt is not None else None
+
     # -- CheckTx path --------------------------------------------------
 
     def check_tx(self, tx: bytes, sender: str = "") -> CheckTxResponse:

@@ -146,8 +146,12 @@ class Environment:
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
+            # reference names (routes.go:61-63) + explicit aliases
+            routes["dial_seeds"] = self.unsafe_dial_seeds
+            routes["dial_peers"] = self.unsafe_dial_peers
             routes["unsafe_dial_seeds"] = self.unsafe_dial_seeds
             routes["unsafe_dial_peers"] = self.unsafe_dial_peers
+            routes["unsafe_flush_mempool"] = self.unsafe_flush_mempool
         return routes
 
     def ws_routes(self) -> dict:
@@ -572,6 +576,15 @@ class Environment:
             "txs": [b64(tx) for tx in txs],
         }
 
+    def unconfirmed_tx(self, hash=None) -> dict:
+        """One mempool tx by hash (rpc/core/mempool.go UnconfirmedTx,
+        routes.go:40)."""
+        h = _to_bytes(hash, "hash")
+        tx = self.mempool.get_tx_by_hash(h)
+        if tx is None:
+            raise RPCError(-32603, f"tx {h.hex()} not found in mempool")
+        return {"tx": b64(tx)}
+
     def num_unconfirmed_txs(self) -> dict:
         return {
             "n_txs": str(self.mempool.size()),
@@ -611,6 +624,11 @@ class Environment:
             "gas_wanted": str(res.gas_wanted),
             "gas_used": str(res.gas_used),
         }
+
+    def unsafe_flush_mempool(self) -> dict:
+        """(mempool.go UnsafeFlushMempool) — drop every pending tx."""
+        self.mempool.flush()
+        return {}
 
     def unsafe_dial_seeds(self, seeds=None) -> dict:
         """(rpc/core/net.go:50 UnsafeDialSeeds)"""

@@ -277,3 +277,41 @@ class TestNewRoutes:
                     n.stop()
                 except Exception:
                     pass
+
+
+class TestMempoolRoutes:
+    """unconfirmed_tx by hash + unsafe_flush_mempool
+    (rpc/core/mempool.go, routes.go:40,63)."""
+
+    def test_unconfirmed_tx_and_flush(self, net):
+        from cometbft_tpu.rpc.jsonrpc import RPCError
+        from cometbft_tpu.types.block import tx_hash
+
+        node = net[0]
+        env = node.rpc_env
+        node.mempool.check_tx(b"zzpending=1")
+        h = tx_hash(b"zzpending=1")
+        out = env.unconfirmed_tx(hash=h.hex())
+        import base64
+
+        assert base64.b64decode(out["tx"]) == b"zzpending=1"
+        with pytest.raises(RPCError):
+            env.unconfirmed_tx(hash=(b"\x00" * 32).hex())
+        env.unsafe_flush_mempool()
+        assert node.mempool.size() == 0
+        with pytest.raises(RPCError):
+            env.unconfirmed_tx(hash=h.hex())
+
+    def test_unsafe_route_names_match_reference(self, net):
+        env = net[0].rpc_env
+        was = env.unsafe
+        try:
+            env.unsafe = True
+            routes = env.routes()
+            for name in ("dial_seeds", "dial_peers",
+                         "unsafe_flush_mempool"):
+                assert name in routes, name
+            env.unsafe = False
+            assert "dial_seeds" not in env.routes()
+        finally:
+            env.unsafe = was
