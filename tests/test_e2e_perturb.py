@@ -469,3 +469,103 @@ class TestBenchmarkMode:
         assert stats["min_interval_s"] <= stats["mean_interval_s"]
         assert stats["mean_interval_s"] <= stats["max_interval_s"]
         assert stats["blocks_per_min"] > 0
+
+
+class TestLiveByzantine:
+    def test_live_equivocation_detected_and_committed(self, tmp_path):
+        """An ACTIVE double-signer (no manual evidence injection): the
+        byzantine validator's conflicting precommit reaches an honest
+        node's vote set, the conflict is reported to the evidence
+        pool, converted to DuplicateVoteEvidence, and committed into a
+        block (byzantine_test.go's detection path end to end)."""
+        from dataclasses import replace as dc_replace
+
+        from cometbft_tpu.consensus.messages import VoteMessage
+        from cometbft_tpu.types import PRECOMMIT_TYPE
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.event_bus import EVENT_VOTE, Query
+        from tests.test_reactors import (
+            connect_star,
+            make_localnet,
+            wait_all_height,
+        )
+
+        nodes, privs, gen = make_localnet(tmp_path, 4)
+        for n in nodes:
+            n.start()
+        try:
+            connect_star(nodes)
+            wait_all_height(nodes, 2)
+            byz_priv = privs[3]
+            byz_addr = byz_priv.pub_key.address()
+
+            # watch honest node0 for a precommit from the byzantine
+            # validator, then hand node0 a CONFLICTING precommit for
+            # the same (height, round) signed by the same key
+            sub = nodes[0].event_bus.subscribe(
+                "byz-test", Query.parse("tm.event = 'Vote'"), capacity=512
+            )
+            injected = None
+            deadline = time.monotonic() + 60
+            while injected is None:
+                assert time.monotonic() < deadline, "no byz precommit seen"
+                try:
+                    msg = sub.next(timeout=1.0)
+                except TimeoutError:
+                    continue
+                vote = msg.data.vote
+                if (
+                    vote.type == PRECOMMIT_TYPE
+                    and vote.validator_address == byz_addr
+                    and not vote.block_id.is_nil()
+                ):
+                    fake_hash = bytes(
+                        b ^ 0xFF for b in vote.block_id.hash
+                    )
+                    evil = dc_replace(
+                        vote,
+                        block_id=BlockID(
+                            hash=fake_hash,
+                            part_set_header=PartSetHeader(
+                                total=1, hash=fake_hash[::-1]
+                            ),
+                        ),
+                        signature=b"",
+                    )
+                    evil = dc_replace(
+                        evil,
+                        signature=byz_priv._priv_key.sign(
+                            evil.sign_bytes(gen.chain_id)
+                        ),
+                    )
+                    nodes[0].consensus.send_peer_msg(
+                        VoteMessage(evil), "byz-peer"
+                    )
+                    injected = (vote.height, vote.round)
+            nodes[0].event_bus.unsubscribe_all("byz-test")
+
+            # the equivocation must surface as committed evidence
+            found = None
+            deadline = time.monotonic() + 90
+            scan_from = 1
+            while found is None:
+                assert time.monotonic() < deadline, "evidence never committed"
+                head = nodes[0].block_store.height()
+                for h in range(scan_from, head + 1):
+                    block = nodes[0].block_store.load_block(h)
+                    if block is None:
+                        continue
+                    for ev in block.evidence:
+                        found = (h, ev)
+                scan_from = head + 1
+                time.sleep(0.3)
+            h, ev = found
+            assert ev.vote_a.validator_address == byz_addr
+            assert ev.vote_a.height == injected[0]
+            assert ev.vote_a.block_id.key() != ev.vote_b.block_id.key()
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
