@@ -678,16 +678,27 @@ static void g2j_mul_be(g2j &o, const g2j &p, const u8 *k, size_t klen) {
     }
     o = acc;
 }
-static void g2j_mul_u64(g2j &o, const g2j &p, u64 k) {
-    u8 be[8];
-    for (int i = 0; i < 8; i++) be[i] = (u8)(k >> (8 * (7 - i)));
-    g2j_mul_be(o, p, be, 8);
+// 64-bit scalars here are the sparse BLS parameter (Hamming weight 6)
+// or similar: plain MSB-first double-and-add beats a windowed table.
+// One definition per group via the same trick DEFJAC uses.
+#define DEF_MUL_U64(FN, FT, JT)                                           \
+static void FN##_mul_u64(JT &o, const JT &p, u64 k) {                     \
+    JT acc = p;                                                           \
+    FT##_sub(acc.z, acc.z, acc.z); /* identity: z = 0 */                  \
+    if (k) {                                                              \
+        int msb = 63;                                                     \
+        while (!((k >> msb) & 1)) msb--;                                  \
+        acc = p;                                                          \
+        for (int b = msb - 1; b >= 0; b--) {                              \
+            FN##_dbl(acc, acc);                                           \
+            if ((k >> b) & 1) FN##_add(acc, acc, p);                      \
+        }                                                                 \
+    }                                                                     \
+    o = acc;                                                              \
 }
-static void g1j_mul_u64(g1j &o, const g1j &p, u64 k) {
-    u8 be[8];
-    for (int i = 0; i < 8; i++) be[i] = (u8)(k >> (8 * (7 - i)));
-    g1j_mul_be(o, p, be, 8);
-}
+DEF_MUL_U64(g2j, fp2, g2j)
+DEF_MUL_U64(g1j, fp, g1j)
+#undef DEF_MUL_U64
 
 static bool g1_on_curve(const g1a &p) {
     if (p.inf) return true;
