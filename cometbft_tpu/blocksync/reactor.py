@@ -122,6 +122,7 @@ class BlocksyncReactor(Reactor):
         block_store,
         block_sync: bool,
         consensus_reactor=None,  # for SwitchToConsensus
+        local_addr=b"",  # bytes | Callable[[], bytes] (lazy resolver)
         logger: Logger | None = None,
     ):
         super().__init__(
@@ -130,6 +131,7 @@ class BlocksyncReactor(Reactor):
         )
         self.initial_state = state
         self.state = state
+        self.local_addr = local_addr
         self.block_exec = block_exec
         self.block_store = block_store
         self.block_sync = threading.Event()
@@ -394,8 +396,38 @@ class BlocksyncReactor(Reactor):
                 return False
         return True
 
+    def _local_node_blocks_the_chain(self) -> bool:
+        """(reactor.go:509 localNodeBlocksTheChain) — with >= 1/3 of
+        the voting power, the chain cannot have advanced without this
+        node, so waiting on peers to sync from is a deadlock."""
+        if not self.local_addr:
+            return False
+        try:
+            addr = (
+                self.local_addr()
+                if callable(self.local_addr)
+                else self.local_addr
+            )
+        except Exception:  # noqa: BLE001 — resolver failure
+            return False
+        if not addr:
+            return False
+        _, val = self.state.validators.get_by_address(addr)
+        if val is None:
+            return False
+        # integer arithmetic: float total/3 misclassifies at int64
+        # voting-power scale (reference uses total/3 integer division)
+        total = self.state.validators.total_voting_power()
+        return 3 * val.voting_power >= total
+
     def _maybe_switch_to_consensus(self) -> bool:
         """(reactor.go poolRoutine switch check)"""
+        if self._local_node_blocks_the_chain():
+            self.logger.info(
+                "own voting power blocks the chain: switching to consensus"
+            )
+            self._switch_now()
+            return True
         if not self.pool.is_caught_up():
             self._caught_up_since = None
             return False
@@ -409,10 +441,13 @@ class BlocksyncReactor(Reactor):
             height=self.pool.height,
             blocks_synced=self.pool.blocks_synced,
         )
+        self._switch_now()
+        return True
+
+    def _switch_now(self) -> None:
         self.block_sync.clear()
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(self.state)
-        return True
 
 
 __all__ = [

@@ -52,8 +52,8 @@ def cmd_start(args) -> int:
         cfg.rpc.laddr = args.rpc_laddr
     if args.persistent_peers:
         cfg.p2p.persistent_peers = args.persistent_peers
-    if args.block_sync:
-        cfg.base.block_sync = True
+    if args.block_sync is not None:
+        cfg.base.block_sync = args.block_sync
     node = Node(cfg)
     node.start()
     stop = {"done": False}
@@ -557,7 +557,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
     p.add_argument("--p2p.persistent_peers", dest="persistent_peers",
                    default="")
-    p.add_argument("--block_sync", action="store_true")
+    p.add_argument(
+        "--block_sync",
+        action=argparse.BooleanOptionalAction,
+        default=None,
+        help="force block sync on/off (--no-block_sync for "
+        "consensus-only startup)",
+    )
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("unsafe-reset-all", help="wipe data, keep keys")

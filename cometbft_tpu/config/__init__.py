@@ -75,7 +75,7 @@ class BaseConfig:
     filter_peers: bool = False
     # start in blocksync mode: catch up from peers before joining
     # consensus (config/config.go BlockSyncMode)
-    block_sync: bool = False
+    block_sync: bool = True
 
 
 @dataclass

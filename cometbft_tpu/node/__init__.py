@@ -318,9 +318,19 @@ class Node(BaseService):
         )
 
         # 11. p2p: reactors → transport → switch (setup.go:404-473)
+        # Block sync is ON by default (the reference has no off switch
+        # in v1): a restarted or wiped node must catch up from peers
+        # BEFORE consensus signs anything.  The blocksync reactor
+        # switches to consensus immediately when this node's own
+        # voting power blocks the chain (node can't be behind a chain
+        # that cannot progress without it — reactor.go
+        # localNodeBlocksTheChain), which covers the sole-validator
+        # case.  config.base.block_sync=False is the test/embedding
+        # escape hatch for consensus-only startup.
+        self.block_sync_enabled = config.base.block_sync
         self.consensus_reactor = ConsensusReactor(
             self.consensus,
-            wait_sync=config.base.block_sync or config.statesync.enable,
+            wait_sync=self.block_sync_enabled or config.statesync.enable,
             logger=self.logger.with_fields(module="consensus-reactor"),
         )
         self.blocksync_reactor = BlocksyncReactor(
@@ -330,9 +340,14 @@ class Node(BaseService):
             # statesync owns the bootstrap when enabled; it hands off to
             # blocksync via start_sync on completion (node.go blockSync
             # && !stateSync)
-            block_sync=config.base.block_sync
+            block_sync=self.block_sync_enabled
             and not config.statesync.enable,
             consensus_reactor=self.consensus_reactor,
+            # lazily resolved: a remote signer's address is unknown
+            # until the external process dials in after start, and
+            # resolving too early would BLOCK the pool routine for the
+            # whole accept timeout — probe the listener first
+            local_addr=self._make_local_addr_resolver(priv_validator),
             logger=self.logger.with_fields(module="blocksync"),
         )
         self.mempool_reactor = MempoolReactor(
@@ -477,6 +492,22 @@ class Node(BaseService):
                 logger=self.logger.with_fields(module="rpc"),
             )
 
+    def _make_local_addr_resolver(self, priv_validator):
+        """bytes | zero-arg callable for the blocksync reactor's
+        blocks-the-chain check; returns b"" while a remote signer has
+        not dialed in yet (wait_for_signer(0) probe) so the pool
+        routine never blocks on address resolution."""
+        if priv_validator is None:
+            return b""
+        listener = self.privval_listener
+
+        def resolve() -> bytes:
+            if listener is not None and not listener.wait_for_signer(0):
+                return b""
+            return priv_validator.address
+
+        return resolve
+
     def _make_state_provider(self, config, genesis, providers):
         """Light-client-verified state provider (stateprovider.go:39)."""
         from cometbft_tpu.light import Client as LightClient, LightStore
@@ -527,10 +558,10 @@ class Node(BaseService):
         self.logger.info(
             "state sync complete", height=state.last_block_height
         )
-        if self.config.base.block_sync:
+        if self.block_sync_enabled:
             self.blocksync_reactor.start_sync(state)
         else:
-            # operator chose consensus-only catch-up (node.go: blockSync
+            # sole validator: nothing to sync from (node.go: blockSync
             # && !stateSync gate applies post-statesync too)
             self.consensus_reactor.switch_to_consensus(state)
 

@@ -40,6 +40,9 @@ def make_node(tmp_path, n_stub_validators=0, backend="memdb", app=None):
     keys the test controls (common_test.go validatorStub pattern)."""
     cfg = make_test_config(str(tmp_path))
     cfg.base.db_backend = backend
+    # stub validators have no real peers to blocksync from; start in
+    # consensus directly (the embedding escape hatch)
+    cfg.base.block_sync = False
     cfg.ensure_dirs()
     priv = FilePV(
         ed.priv_key_from_secret(b"v0"),

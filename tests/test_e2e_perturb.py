@@ -107,20 +107,23 @@ class _Net:
         )
 
     def start(self, i: int) -> None:
-        self.procs[i] = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "cometbft_tpu",
-                "--home",
-                os.path.join(self.root, f"node{i}"),
-                "start",
-            ],
-            env=self.env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            cwd=REPO,
-        )
+        with open(
+            os.path.join(self.root, f"node{i}.log"), "ab", buffering=0
+        ) as log:  # the child keeps its own duplicate of the fd
+            self.procs[i] = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "cometbft_tpu",
+                    "--home",
+                    os.path.join(self.root, f"node{i}"),
+                    "start",
+                ],
+                env=self.env,
+                stdout=subprocess.DEVNULL,
+                stderr=log,
+                cwd=REPO,
+            )
 
     def kill9(self, i: int) -> None:
         p = self.procs[i]
@@ -569,3 +572,33 @@ class TestLiveByzantine:
                     n.stop()
                 except Exception:
                     pass
+
+
+class TestRotatingNode:
+    def test_wipe_and_resync_twice(self, net):
+        """The QA rotating-node shape (BASELINE.md: full nodes
+        repeatedly wiped and re-synced while the chain runs): kill a
+        validator, `unsafe-reset-all` its data, restart, and require a
+        full blocksync back to the live head — twice."""
+        victim = 2
+        vport = _rpc_port(victim)
+        others = [_rpc_port(i) for i in range(N_NODES) if i != victim]
+        for cycle in range(2):
+            net.kill9(victim)
+            subprocess.run(
+                [sys.executable, "-m", "cometbft_tpu", "--home",
+                 os.path.join(net.root, f"node{victim}"),
+                 "unsafe-reset-all"],
+                env=net.env, check=True, capture_output=True, cwd=REPO,
+            )
+            # chain keeps moving while the node is gone
+            base = max(_height(p) for p in others)
+            _wait_heights(others, base + 2)
+            net.start(victim)
+            live = max(_height(p) for p in others)
+            _wait_heights([vport], live, timeout=180)
+            # resynced node agrees on a sampled block hash
+            h = min(live, base + 1)
+            want = _rpc(others[0], "block", height=h)["block_id"]["hash"]
+            got = _rpc(vport, "block", height=h)["block_id"]["hash"]
+            assert want == got, f"cycle {cycle}: divergent block at {h}"
