@@ -87,7 +87,10 @@ def cmd_reset_all(args) -> int:
 
 
 def cmd_reset_state(args) -> int:
-    """(commands/reset.go ResetStateCmd) — wipe chain stores only."""
+    """(commands/reset.go ResetStateCmd) — wipe chain stores AND the
+    consensus WAL, but keep keys and the privval last-sign state (the
+    safe validator-rotation path: CheckHRS keeps refusing re-signs of
+    old heights)."""
     cfg = _load_config(args.home)
     for name in ("blockstore", "state", "evidence", "tx_index"):
         for suffix in (".db", ".sqlite", ""):
@@ -96,6 +99,14 @@ def cmd_reset_state(args) -> int:
                 shutil.rmtree(path)
             elif os.path.exists(path):
                 os.remove(path)
+    # remove the WAL itself; only rmtree the parent when it is the
+    # WAL's dedicated directory (a custom flat wal_file must not take
+    # its siblings — e.g. priv_validator_state.json — with it)
+    if os.path.exists(cfg.wal_path):
+        os.remove(cfg.wal_path)
+    wal_dir = os.path.dirname(cfg.wal_path)
+    if os.path.basename(wal_dir) == "cs.wal" and os.path.isdir(wal_dir):
+        shutil.rmtree(wal_dir)
     print("Reset chain state")
     return 0
 

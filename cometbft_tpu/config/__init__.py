@@ -76,6 +76,10 @@ class BaseConfig:
     # start in blocksync mode: catch up from peers before joining
     # consensus (config/config.go BlockSyncMode)
     block_sync: bool = True
+    # builtin-kvstore app only: take a state snapshot every N heights
+    # so peers can statesync from this node (the reference e2e app's
+    # snapshot_interval manifest setting; 0 disables)
+    builtin_app_snapshot_interval: int = 0
 
 
 @dataclass
@@ -184,6 +188,8 @@ class ConsensusConfig:
     create_empty_blocks_interval_ns: int = 0
     peer_gossip_sleep_duration_ns: int = 100 * 10**6
     peer_query_maj23_sleep_duration_ns: int = 2 * 10**9
+    # refuse to join consensus when our own signature appears in the
+    # last N seen commits (config.go DoubleSignCheckHeight; 0 = off)
     double_sign_check_height: int = 0
 
     def propose_timeout_ns(self, round_: int) -> int:

@@ -243,6 +243,7 @@ class ConsensusState(BaseService):
     # -- lifecycle -------------------------------------------------------
 
     def on_start(self) -> None:
+        self._check_double_signing_risk()
         self._ticker.start()
         self._catchup_replay()
         self._thread = threading.Thread(
@@ -250,6 +251,34 @@ class ConsensusState(BaseService):
         )
         self._thread.start()
         self._schedule_round_0()
+
+    def _check_double_signing_risk(self) -> None:
+        """(state.go:2643 checkDoubleSigningRisk) — with
+        double_sign_check_height set, REFUSE to join consensus if our
+        own signature appears in any of the last N seen commits: a
+        validator whose sign-state was reset (unsafe-reset-all, restored
+        backup) would otherwise re-sign heights it already signed."""
+        n = getattr(self.config, "double_sign_check_height", 0)
+        if (
+            n <= 0
+            or self.priv_validator is None
+            or self.block_store is None
+        ):
+            return
+        height = self.block_store.height()
+        addr = self.priv_validator.address
+        for i in range(1, min(n, height) + 1):
+            commit = self.block_store.load_seen_commit(height - i + 1)
+            if commit is None:
+                continue
+            for sig in commit.signatures:
+                if sig.is_commit() and sig.validator_address == addr:
+                    raise ConsensusError(
+                        f"own signature found in seen commit at height "
+                        f"{height - i + 1}; refusing to join consensus "
+                        "(double-signing risk — wait "
+                        f"{n} blocks or restore priv_validator_state)"
+                    )
 
     def on_stop(self) -> None:
         self._queue.put(("quit", None))

@@ -102,10 +102,13 @@ def init_files(config: Config, chain_id: str = "") -> GenesisDoc:
 
 def default_app(config: Config) -> Application:
     """Resolve config.base.proxy_app to a builtin app (node/setup.go
-    DefaultNewNode's kvstore shortcut)."""
+    DefaultNewNode's kvstore shortcut); builtin_app_snapshot_interval
+    makes the kvstore serve statesync snapshots."""
     name = config.base.proxy_app
     if name == "kvstore":
-        return KVStoreApp()
+        return KVStoreApp(
+            snapshot_interval=config.base.builtin_app_snapshot_interval
+        )
     if name == "noop":
         return Application()
     raise NodeError(f"unknown builtin app {name!r}")
@@ -568,7 +571,22 @@ class Node(BaseService):
     # -- lifecycle -------------------------------------------------------
 
     def on_start(self) -> None:
-        """(node/node.go:580 OnStart)"""
+        """(node/node.go:580 OnStart) — on ANY startup failure (e.g.
+        the double-signing-risk refusal) already-started services are
+        unwound before re-raising, so an embedder is not left with
+        bound sockets and orphan threads it cannot stop."""
+        try:
+            self._start_services()
+        except BaseException:
+            try:
+                self.on_stop()
+            except Exception as exc:  # noqa: BLE001 — best-effort unwind
+                self.logger.error(
+                    "error unwinding failed start", err=repr(exc)
+                )
+            raise
+
+    def _start_services(self) -> None:
         if self.metrics_server is not None:
             self.metrics_server.start()
         # pprof-analog diagnostics plane (node.go:589 startPprofServer);

@@ -98,16 +98,25 @@ class LightClientStateProvider(StateProvider):
             app_hash=nxt.header.app_hash,
         )
 
-    def _verified(self, height: int, retries: int = 20):
-        """Verify via light client, waiting briefly for heights that
-        the chain hasn't produced yet (stateprovider.go retry loop)."""
+    def _verified(self, height: int, retries: int = 60):
+        """Verify via light client, waiting briefly for heights the
+        chain hasn't produced yet (stateprovider.go retry loop).
+        ONLY not-found errors retry — a hard verification failure
+        (bad trust hash, conflicting header) must fail fast, not burn
+        the whole retry window."""
+        from cometbft_tpu.light.provider import ProviderError
+
         last_err = None
         for _ in range(retries):
             try:
                 return self.lc.verify_light_block_at_height(height)
-            except Exception as exc:  # noqa: BLE001 — height may not exist yet
+            except ProviderError as exc:  # height may not exist yet
                 last_err = exc
-                time.sleep(0.25)
+                time.sleep(0.5)
+            except Exception as exc:  # noqa: BLE001 — verification failed
+                raise StateProviderError(
+                    f"could not verify header {height}: {exc}"
+                ) from exc
         raise StateProviderError(
             f"could not verify header {height}: {last_err}"
         )

@@ -22,7 +22,10 @@ from cometbft_tpu.abci.types import (
     OfferSnapshotResult,
     Snapshot as ABCISnapshot,
 )
-from cometbft_tpu.statesync.stateprovider import StateProvider
+from cometbft_tpu.statesync.stateprovider import (
+    StateProvider,
+    StateProviderError,
+)
 from cometbft_tpu.utils.log import Logger, default_logger
 
 CHUNK_TIMEOUT = 10.0        # config chunk_request_timeout
@@ -220,6 +223,18 @@ class Syncer:
                     err=str(exc),
                 )
                 self.pool.reject(snapshot)
+            except StateProviderError as exc:
+                # transient provider trouble (e.g. header H+1 races
+                # the chain head, a primary briefly unreachable) must
+                # not abort the whole sync — reject THIS snapshot and
+                # try the next-best (syncer.go treats provider errors
+                # per-snapshot the same way)
+                self.logger.error(
+                    "state provider failed for snapshot",
+                    height=snapshot.height,
+                    err=str(exc),
+                )
+                self.pool.reject(snapshot)
 
     def _sync_one(self, snapshot: Snapshot):
         """(syncer.go:234 Sync)"""
@@ -240,6 +255,13 @@ class Syncer:
         )
         if resp.result != OfferSnapshotResult.ACCEPT:
             raise SnapshotRejectedError(f"app returned {resp.result!r}")
+
+        # fetch the bootstrap state + commit BEFORE restoring chunks
+        # (syncer.go:294): a provider failure must reject the snapshot
+        # while the app is still untouched — after restore there is no
+        # clean way to offer a different snapshot to the app
+        state = self.state_provider.state(snapshot.height)
+        commit = self.state_provider.commit(snapshot.height)
 
         with self._mtx:
             self._chunk_queue = ChunkQueue(snapshot)
@@ -262,8 +284,6 @@ class Syncer:
                 f"!= snapshot {snapshot.height}"
             )
 
-        state = self.state_provider.state(snapshot.height)
-        commit = self.state_provider.commit(snapshot.height)
         self.logger.info(
             "snapshot restored and verified", height=snapshot.height
         )

@@ -373,3 +373,47 @@ class TestPrivvalIntegration:
             node.config.priv_validator_state_path,
         )
         assert pv.height >= 2  # last-sign-state persisted
+
+
+def test_double_sign_risk_check_refuses_after_state_reset(tmp_path):
+    """(state.go:2643 checkDoubleSigningRisk) with
+    double_sign_check_height set, a validator whose sign-state was
+    wiped refuses to join consensus while its own signature is visible
+    in recent seen commits."""
+    import json
+
+    from cometbft_tpu.consensus.state import ConsensusError
+
+    node, stubs = make_node(
+        tmp_path, n_stub_validators=0, backend="sqlite"
+    )
+    node.config.consensus.double_sign_check_height = 10
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while node.height() < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    finally:
+        node.stop()
+
+    # wipe the privval sign-state (the unsafe-reset-all hazard)
+    with open(node.config.priv_validator_state_path, "w") as f:
+        json.dump({"height": "0", "round": 0, "step": 0}, f)
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.privval import FilePV
+
+    pv = FilePV.load(
+        node.config.priv_validator_key_path,
+        node.config.priv_validator_state_path,
+    )
+    node2 = Node(
+        node.config, genesis=node.genesis, priv_validator=pv
+    )
+    with pytest.raises(ConsensusError, match="double-signing risk"):
+        node2.start()
+    # the guard is opt-in: knob off, the node starts fine
+    node.config.consensus.double_sign_check_height = 0
+    node3 = Node(node.config, genesis=node.genesis, priv_validator=pv)
+    node3.start()
+    node3.stop()

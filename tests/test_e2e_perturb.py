@@ -585,10 +585,14 @@ class TestRotatingNode:
         others = [_rpc_port(i) for i in range(N_NODES) if i != victim]
         for cycle in range(2):
             net.kill9(victim)
+            # reset-state (NOT unsafe-reset-all): stores + WAL wiped,
+            # privval sign-state KEPT, so CheckHRS keeps refusing
+            # re-signs of old heights no matter how racy the
+            # blocksync->consensus switch is
             subprocess.run(
                 [sys.executable, "-m", "cometbft_tpu", "--home",
                  os.path.join(net.root, f"node{victim}"),
-                 "unsafe-reset-all"],
+                 "reset-state"],
                 env=net.env, check=True, capture_output=True, cwd=REPO,
             )
             # chain keeps moving while the node is gone
@@ -602,3 +606,109 @@ class TestRotatingNode:
             want = _rpc(others[0], "block", height=h)["block_id"]["hash"]
             got = _rpc(vport, "block", height=h)["block_id"]["hash"]
             assert want == got, f"cycle {cycle}: divergent block at {h}"
+
+
+class TestStatesyncRotation:
+    def test_wiped_node_restores_via_statesync(self, tmp_path):
+        """A wiped node configured for statesync restores from a peer
+        snapshot (earliest stored block proves no genesis blocksync),
+        then follows the live chain (QA rotating-node, statesync
+        flavor)."""
+        root = str(tmp_path / "ssnet")
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+        base_port = 27300
+        subprocess.run(
+            [sys.executable, "-m", "cometbft_tpu", "testnet", "--v", "4",
+             "--o", root, "--chain-id", "ssrot-chain",
+             "--starting-port", str(base_port)],
+            env=env, check=True, capture_output=True, cwd=REPO,
+        )
+        for i in range(4):
+            cfgp = os.path.join(root, f"node{i}", "config", "config.toml")
+            with open(cfgp, encoding="utf-8") as f:
+                body = f.read()
+            body = body.replace(
+                "builtin_app_snapshot_interval = 0",
+                "builtin_app_snapshot_interval = 3",
+            )
+            with open(cfgp, "w", encoding="utf-8") as f:
+                f.write(body)
+        procs = {}
+
+        def rpc_port(i):
+            return base_port + 2 * i + 1
+
+        def start(i):
+            with open(os.path.join(root, f"node{i}.log"), "ab") as log:
+                procs[i] = subprocess.Popen(
+                    [sys.executable, "-m", "cometbft_tpu", "--home",
+                     os.path.join(root, f"node{i}"), "start"],
+                    env=env, stdout=subprocess.DEVNULL, stderr=log,
+                    cwd=REPO,
+                )
+
+        try:
+            for i in range(4):
+                start(i)
+            # generous: the suite runs several 4-node subprocess nets
+            # back-to-back on one core
+            _wait_heights([rpc_port(i) for i in range(4)], 8,
+                          timeout=240)
+            victim = 3
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait(timeout=10)
+            subprocess.run(
+                [sys.executable, "-m", "cometbft_tpu", "--home",
+                 os.path.join(root, f"node{victim}"), "reset-state"],
+                env=env, check=True, capture_output=True, cwd=REPO,
+            )
+            trust_hash = _rpc(rpc_port(0), "block", height=2)[
+                "block_id"]["hash"]
+            cfgp = os.path.join(root, f"node{victim}", "config",
+                                "config.toml")
+            with open(cfgp, encoding="utf-8") as f:
+                body = f.read()
+            body = body.replace(
+                "[statesync]\nenable = false",
+                "[statesync]\nenable = true",
+            ).replace(
+                "rpc_servers = []",
+                f'rpc_servers = ["127.0.0.1:{rpc_port(0)}", '
+                f'"127.0.0.1:{rpc_port(1)}"]',
+            ).replace(
+                "trust_height = 0", "trust_height = 2"
+            ).replace(
+                'trust_hash = ""', f'trust_hash = "{trust_hash}"'
+            )
+            with open(cfgp, "w", encoding="utf-8") as f:
+                f.write(body)
+            others = [rpc_port(i) for i in range(3)]
+            base = max(_height(p) for p in others)
+            start(victim)
+            _wait_heights([rpc_port(victim)], base + 2, timeout=300)
+            st = _rpc(rpc_port(victim), "status")["sync_info"]
+            assert int(st["earliest_block_height"]) > 1, (
+                "node blocksynced from genesis instead of statesyncing"
+            )
+            h = base + 1
+            hashes = {
+                _rpc(rpc_port(i), "block", height=h)["block_id"]["hash"]
+                for i in range(4)
+            }
+            assert len(hashes) == 1, hashes
+        finally:
+            for p in procs.values():
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
