@@ -24,6 +24,7 @@ from cometbft_tpu.types.block import BlockID
 from cometbft_tpu.types.event_bus import (
     EVENT_COMPLETE_PROPOSAL,
     EVENT_NEW_ROUND,
+    EVENT_VOTE,
     query_for_event,
 )
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
@@ -417,3 +418,297 @@ def test_double_sign_risk_check_refuses_after_state_reset(tmp_path):
     node3 = Node(node.config, genesis=node.genesis, priv_validator=pv)
     node3.start()
     node3.stop()
+
+
+class TestLockSafety:
+    """Tendermint locking rules (reference state_test.go
+    TestStateLock_*): once a validator precommits (locks) a block, it
+    must not prevote a different block in a later round unless the
+    proposal carries a valid POL round."""
+
+    def _wait_vote(self, bus, addr, height, round_, vtype, timeout=20):
+        sub = bus.subscribe("lock-watch", query_for_event(EVENT_VOTE))
+        try:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    ev = sub.next(timeout=0.5)
+                except TimeoutError:
+                    continue
+                v = ev.data.vote
+                if (
+                    v.validator_address == addr
+                    and v.height == height
+                    and v.round == round_
+                    and v.type == vtype
+                ):
+                    return v
+            raise AssertionError(
+                f"no vote h={height} r={round_} t={vtype} from us"
+            )
+        finally:
+            bus.unsubscribe_all("lock-watch")
+
+    def test_stays_locked_without_pol(self, tmp_path):
+        node, stubs = make_node(tmp_path, n_stub_validators=3)
+        node.start()
+        try:
+            cs = node.consensus
+            bus = node.event_bus
+            chain_id = cs.state.chain_id
+            our_addr = cs.priv_validator.address
+            val_set = cs.state.validators
+            stub_by_addr = {pv.address: pv for pv in stubs}
+
+            def stub_indices():
+                out = {}
+                for pv in stubs:
+                    idx, _ = val_set.get_by_address(pv.address)
+                    out[pv.address] = (pv, idx)
+                return out
+
+            sidx = stub_indices()
+
+            def send_stub_votes(vt, h, r, block_id):
+                for pv, idx in sidx.values():
+                    vote = Vote(
+                        type=vt, height=h, round=r, block_id=block_id,
+                        timestamp_ns=max(
+                            now_ns(), cs.state.last_block_time_ns + 1
+                        ),
+                        validator_address=pv.address,
+                        validator_index=idx,
+                    )
+                    cs.send_peer_msg(
+                        VoteMessage(pv.sign_vote(chain_id, vote)),
+                        "stub-peer",
+                    )
+
+            def propose_as(pv, h, r, block, parts, pol_round=-1):
+                block_id = BlockID(block.hash(), parts.header)
+                prop = Proposal(
+                    height=h, round=r, pol_round=pol_round,
+                    block_id=block_id,
+                    timestamp_ns=block.header.time_ns,
+                )
+                prop = pv.sign_proposal(chain_id, prop)
+                cs.send_peer_msg(ProposalMessage(prop), "stub-peer")
+                for i in range(parts.header.total):
+                    cs.send_peer_msg(
+                        BlockPartMessage(h, r, parts.get_part(i)),
+                        "stub-peer",
+                    )
+                return block_id
+
+            # --- round 0: get a proposal B in front of the node ------
+            deadline = time.time() + 20
+            while cs.round_state()["height"] != 1:
+                assert time.time() < deadline
+                time.sleep(0.05)
+            rs = cs.round_state()
+            proposer0 = rs["validators"].get_proposer().address
+            if proposer0 == our_addr:
+                # node proposes on its own; wait for it
+                deadline = time.time() + 20
+                while cs.round_state()["proposal"] is None:
+                    assert time.time() < deadline
+                    time.sleep(0.05)
+                b_id = cs.round_state()["proposal"].block_id
+            else:
+                pv = stub_by_addr[proposer0]
+                block = node.block_exec.create_proposal_block(
+                    1, cs.state, None, proposer0
+                )
+                parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+                b_id = propose_as(pv, 1, 0, block, parts)
+
+            # stubs prevote B -> node locks B and precommits it
+            send_stub_votes(PREVOTE_TYPE, 1, 0, b_id)
+            our_pc = self._wait_vote(
+                bus, our_addr, 1, 0, PRECOMMIT_TYPE
+            )
+            assert our_pc.block_id.hash == b_id.hash, "did not lock B"
+            rs = cs.round_state()
+            assert rs["locked_round"] == 0
+            assert rs["locked_block"].hash() == b_id.hash
+
+            # stubs precommit NIL -> no decision -> round 1
+            send_stub_votes(PRECOMMIT_TYPE, 1, 0, BlockID())
+            deadline = time.time() + 30
+            while cs.round_state()["round"] < 1:
+                assert time.time() < deadline, "never reached round 1"
+                time.sleep(0.05)
+
+            # --- round 1: different proposal, NO POL -----------------
+            rs = cs.round_state()
+            proposer1 = rs["validators"].get_proposer().address
+            if proposer1 == our_addr:
+                # a locked proposer must re-propose its LOCKED block
+                deadline = time.time() + 20
+                while True:
+                    prop = cs.round_state()["proposal"]
+                    if prop is not None:
+                        break
+                    assert time.time() < deadline
+                    time.sleep(0.05)
+                assert prop.block_id.hash == b_id.hash, (
+                    "locked proposer proposed a different block"
+                )
+            else:
+                pv = stub_by_addr[proposer1]
+                # a DIFFERENT block: different proposer address changes
+                # the header, hence the hash
+                block2 = node.block_exec.create_proposal_block(
+                    1, cs.state, None, proposer1
+                )
+                parts2 = block2.make_part_set(BLOCK_PART_SIZE_BYTES)
+                b2_id = propose_as(pv, 1, 1, block2, parts2, pol_round=-1)
+                assert b2_id.hash != b_id.hash
+                our_pv = self._wait_vote(
+                    bus, our_addr, 1, 1, PREVOTE_TYPE
+                )
+                assert our_pv.block_id.is_nil(), (
+                    "prevoted a conflicting block while locked and "
+                    "the proposal carried no POL"
+                )
+                rs = cs.round_state()
+                assert rs["locked_round"] == 0
+                assert rs["locked_block"].hash() == b_id.hash
+        finally:
+            node.stop()
+
+    def test_relocks_with_valid_pol(self, tmp_path):
+        """A proposal carrying a valid POL round (+2/3 prevotes for
+        the new block at pol_round >= locked_round) DOES override the
+        lock (state_test.go TestStateLock_POLRelock)."""
+        node, stubs = make_node(tmp_path, n_stub_validators=3)
+        node.start()
+        try:
+            cs = node.consensus
+            bus = node.event_bus
+            chain_id = cs.state.chain_id
+            our_addr = cs.priv_validator.address
+            val_set = cs.state.validators
+            stub_by_addr = {pv.address: pv for pv in stubs}
+            sidx = {}
+            for pv in stubs:
+                idx, _ = val_set.get_by_address(pv.address)
+                sidx[pv.address] = (pv, idx)
+
+            def send_stub_votes(vt, h, r, block_id):
+                for pv, idx in sidx.values():
+                    vote = Vote(
+                        type=vt, height=h, round=r, block_id=block_id,
+                        timestamp_ns=max(
+                            now_ns(), cs.state.last_block_time_ns + 1
+                        ),
+                        validator_address=pv.address,
+                        validator_index=idx,
+                    )
+                    cs.send_peer_msg(
+                        VoteMessage(pv.sign_vote(chain_id, vote)),
+                        "stub-peer",
+                    )
+
+            def propose_as(pv, h, r, block, parts, pol_round=-1):
+                block_id = BlockID(block.hash(), parts.header)
+                prop = Proposal(
+                    height=h, round=r, pol_round=pol_round,
+                    block_id=block_id,
+                    timestamp_ns=block.header.time_ns,
+                )
+                prop = pv.sign_proposal(chain_id, prop)
+                cs.send_peer_msg(ProposalMessage(prop), "stub-peer")
+                for i in range(parts.header.total):
+                    cs.send_peer_msg(
+                        BlockPartMessage(h, r, parts.get_part(i)),
+                        "stub-peer",
+                    )
+                return block_id
+
+            deadline = time.time() + 20
+            while cs.round_state()["height"] != 1:
+                assert time.time() < deadline
+                time.sleep(0.05)
+            rs = cs.round_state()
+
+            # round 0: lock on B (ours or a stub's, whoever proposes)
+            proposer0 = rs["validators"].get_proposer().address
+            if proposer0 == our_addr:
+                deadline = time.time() + 20
+                while cs.round_state()["proposal"] is None:
+                    assert time.time() < deadline
+                    time.sleep(0.05)
+                b_id = cs.round_state()["proposal"].block_id
+            else:
+                block = node.block_exec.create_proposal_block(
+                    1, cs.state, None, proposer0
+                )
+                parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+                b_id = propose_as(
+                    stub_by_addr[proposer0], 1, 0, block, parts
+                )
+            send_stub_votes(PREVOTE_TYPE, 1, 0, b_id)
+            pc = TestLockSafety._wait_vote(
+                self, bus, our_addr, 1, 0, PRECOMMIT_TYPE
+            )
+            assert pc.block_id.hash == b_id.hash
+            send_stub_votes(PRECOMMIT_TYPE, 1, 0, BlockID())
+            deadline = time.time() + 30
+            while cs.round_state()["round"] < 1:
+                assert time.time() < deadline
+                time.sleep(0.05)
+
+            # advance past any round where WE propose (we would
+            # re-propose our locked B); stop at a stub-proposed round
+            while True:
+                rs = cs.round_state()
+                r = rs["round"]
+                proposer = rs["validators"].get_proposer().address
+                if proposer != our_addr:
+                    break
+                # nil the whole round to move on
+                send_stub_votes(PREVOTE_TYPE, 1, r, BlockID())
+                send_stub_votes(PRECOMMIT_TYPE, 1, r, BlockID())
+                deadline = time.time() + 30
+                while cs.round_state()["round"] <= r:
+                    assert time.time() < deadline
+                    time.sleep(0.05)
+
+            # POL round: B2 proposed + stub POL prevotes for B2
+            rs = cs.round_state()
+            pol_r = rs["round"]
+            proposer1 = rs["validators"].get_proposer().address
+            block2 = node.block_exec.create_proposal_block(
+                1, cs.state, None, proposer1
+            )
+            parts2 = block2.make_part_set(BLOCK_PART_SIZE_BYTES)
+            b2_id = propose_as(
+                stub_by_addr[proposer1], 1, pol_r, block2, parts2
+            )
+            assert b2_id.hash != b_id.hash
+            send_stub_votes(PREVOTE_TYPE, 1, pol_r, b2_id)  # the POL
+            send_stub_votes(PRECOMMIT_TYPE, 1, pol_r, BlockID())
+            deadline = time.time() + 30
+            while cs.round_state()["round"] <= pol_r:
+                assert time.time() < deadline
+                time.sleep(0.05)
+
+            # next round: B2 re-proposed WITH pol_round -> relock
+            rs = cs.round_state()
+            next_r = rs["round"]
+            proposer2 = rs["validators"].get_proposer().address
+            if proposer2 == our_addr:
+                pytest.skip("our node proposes the post-POL round")
+            propose_as(
+                stub_by_addr[proposer2], 1, next_r, block2, parts2,
+                pol_round=pol_r,
+            )
+            our_pv = TestLockSafety._wait_vote(
+                self, bus, our_addr, 1, next_r, PREVOTE_TYPE
+            )
+            assert our_pv.block_id.hash == b2_id.hash, (
+                "did not follow a valid POL past the lock"
+            )
+        finally:
+            node.stop()
