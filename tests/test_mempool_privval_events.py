@@ -363,3 +363,55 @@ def test_mempool_gauges_track_shrinkage():
     assert gauge("size") == 1
     mp.flush()
     assert gauge("size") == 0
+
+
+def test_recheck_evicts_now_invalid_txs():
+    """After a block, remaining txs are re-run through CheckTx with
+    type=RECHECK; ones the app now rejects are evicted, drop from the
+    gauges, and leave the cache (clist_mempool.go recheckTxs)."""
+    from cometbft_tpu.abci.types import (
+        CHECK_TX_TYPE_RECHECK,
+        Application,
+        CheckTxRequest,
+        CheckTxResponse,
+    )
+    from cometbft_tpu.mempool import CListMempool
+    from cometbft_tpu.proxy import AppConns, local_client_creator
+
+    class MoodyApp(Application):
+        def __init__(self):
+            self.reject = set()
+            self.recheck_types = []
+
+        def check_tx(self, req: CheckTxRequest) -> CheckTxResponse:
+            if req.type == CHECK_TX_TYPE_RECHECK:
+                self.recheck_types.append(req.tx)
+            return CheckTxResponse(
+                code=1 if bytes(req.tx) in self.reject else 0
+            )
+
+    app = MoodyApp()
+    proxy = AppConns(local_client_creator(app))
+    proxy.start()
+    try:
+        mp = CListMempool(proxy.mempool, height=1, recheck=True)
+        for tx in (b"a=1", b"b=2", b"c=3"):
+            assert mp.check_tx(tx).code == 0
+        assert mp.size() == 3
+        # block commits a=1; the app turns against b=2
+        app.reject.add(b"b=2")
+        mp.lock()
+        try:
+            mp.update(2, [b"a=1"], [CheckTxResponse(code=0)])
+        finally:
+            mp.unlock()
+        assert mp.size() == 1
+        assert mp.contains(b"c=3")
+        assert not mp.contains(b"b=2")
+        assert b"b=2" in app.recheck_types  # really used RECHECK type
+        # evicted tx left the cache: it can be resubmitted once valid
+        app.reject.discard(b"b=2")
+        assert mp.check_tx(b"b=2").code == 0
+        assert mp.size() == 2
+    finally:
+        proxy.stop()
