@@ -1,0 +1,98 @@
+"""Seeded randomized e2e (reference: test/e2e/generator/generate.go —
+randomized testnet manifests).  A deterministic RNG picks the
+perturbation sequence, victims, and tx bursts; the invariants
+(liveness, no fork, height monotonicity, catch-up) must hold for every
+seed.  Add seeds here when a generated sequence ever finds a bug."""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.test_e2e_perturb import _Net, _height, _rpc, _wait_heights
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("seed", [1337, 90210])
+def test_generated_perturbation_sequence(tmp_path, seed):
+    rng = random.Random(seed)
+    base_port = 27500 + (seed % 50) * 10
+
+    import tests.test_e2e_perturb as ep
+
+    old_port = ep.BASE_PORT
+    ep.BASE_PORT = base_port
+    try:
+        net = _Net(str(tmp_path / "gen"))
+        net.init()
+        for i in range(4):
+            net.start(i)
+
+        def port(i):
+            return base_port + 2 * i + 1
+
+        ports = [port(i) for i in range(4)]
+        _wait_heights(ports, 3, timeout=240)
+
+        def burst_txs():
+            n = rng.randrange(1, 6)
+            target = rng.randrange(4)
+            for k in range(n):
+                tx = b"g%d-%d=v" % (seed, rng.randrange(10**9))
+                try:
+                    _rpc(port(target), "broadcast_tx_sync", tx=tx.hex())
+                except Exception:
+                    pass  # node may be the currently-perturbed one
+
+        actions = ["kill", "pause", "rotate"]
+        rng.shuffle(actions)
+        for action in actions:
+            victim = rng.randrange(4)
+            others = [p for i, p in enumerate(ports) if i != victim]
+            burst_txs()
+            if action == "kill":
+                net.kill9(victim)
+                base = max(_height(p) for p in others)
+                _wait_heights(others, base + 2, timeout=240)
+                net.start(victim)
+            elif action == "pause":
+                net.pause(victim)
+                base = max(_height(p) for p in others)
+                _wait_heights(others, base + 2, timeout=240)
+                net.resume(victim)
+            else:  # rotate: wipe stores (keep sign state) + restart
+                net.kill9(victim)
+                subprocess.run(
+                    [sys.executable, "-m", "cometbft_tpu", "--home",
+                     os.path.join(net.root, f"node{victim}"),
+                     "reset-state"],
+                    env=net.env, check=True, capture_output=True,
+                    cwd=REPO,
+                )
+                base = max(_height(p) for p in others)
+                _wait_heights(others, base + 2, timeout=240)
+                net.start(victim)
+            live = max(_height(p) for p in others)
+            _wait_heights(ports, live, timeout=300)
+
+        # final invariants: agreement over a sample of heights
+        head = min(_height(p) for p in ports)
+        for h in rng.sample(range(1, head + 1), min(5, head)):
+            hashes = {
+                _rpc(p, "block", height=h)["block_id"]["hash"]
+                for p in ports
+            }
+            assert len(hashes) == 1, f"seed {seed}: fork at {h}"
+        net.stop_all()
+    finally:
+        ep.BASE_PORT = old_port
+        try:
+            net.stop_all()
+        except Exception:
+            pass
