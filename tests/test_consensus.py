@@ -125,8 +125,11 @@ class TestSingleValidator:
             node.mempool.check_tx(b"name=alice")
             wait_for_height(node, 3)
             assert app.query(QueryRequest(data=b"name")).value == b"alice"
-            # committed chain state advanced with the store
-            assert node.consensus.state.last_block_height >= 3
+            # committed chain state follows the store by one beat
+            deadline = time.time() + 30
+            while node.consensus.state.last_block_height < 3:
+                assert time.time() < deadline
+                time.sleep(0.05)
         finally:
             node.stop()
 
