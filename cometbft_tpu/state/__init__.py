@@ -118,19 +118,19 @@ def encode_validator(v: Validator) -> bytes:
 
 
 def decode_validator(data: bytes) -> Validator:
-    from cometbft_tpu.types.codec import s64
+    from cometbft_tpu.types.codec import _bz, _iv, s64
     from cometbft_tpu.utils.protoio import sfixed64_from_u64
 
     f = ProtoReader(data).to_dict()
-    pkf = ProtoReader(f[1][0]).to_dict()
-    ktype = bytes(pkf.get(1, [b""])[0]).decode()
-    kbytes = bytes(pkf.get(2, [b""])[0])
+    pkf = ProtoReader(_bz(f[1][0])).to_dict()
+    ktype = _bz(pkf.get(1, [b""])[0]).decode()
+    kbytes = _bz(pkf.get(2, [b""])[0])
     if ktype != "ed25519":
         raise StateError(f"unsupported key type {ktype!r}")
     return Validator(
         pub_key=Ed25519PubKey(kbytes),
         voting_power=s64(f.get(2, [0])[0]),
-        proposer_priority=sfixed64_from_u64(int(f.get(3, [0])[0])),
+        proposer_priority=sfixed64_from_u64(_iv(f.get(3, [0])[0])),
     )
 
 
@@ -145,10 +145,12 @@ def encode_validator_set(vs: ValidatorSet) -> bytes:
 
 
 def decode_validator_set(data: bytes) -> ValidatorSet:
+    from cometbft_tpu.types.codec import _bz
+
     f = ProtoReader(data).to_dict()
-    vals = [decode_validator(raw) for raw in f.get(1, [])]
+    vals = [decode_validator(_bz(raw)) for raw in f.get(1, [])]
     vs = ValidatorSet(vals)
-    prop_addr = bytes(f.get(2, [b""])[0])
+    prop_addr = _bz(f.get(2, [b""])[0])
     if prop_addr:
         _, prop = vs.get_by_address(prop_addr)
         if prop is not None:

@@ -9,6 +9,12 @@ from cometbft_tpu.types.block import Block, BlockID, Header
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 
 
+def _codec_iv(v):
+    from cometbft_tpu.types.codec import as_int
+
+    return as_int(v)
+
+
 @dataclass(frozen=True)
 class BlockMeta:
     block_id: BlockID = field(default_factory=BlockID)
@@ -43,8 +49,8 @@ class BlockMeta:
 
         f = ProtoReader(data).to_dict()
         return cls(
-            block_id=codec.decode_block_id(f[1][0]) if 1 in f else BlockID(),
-            block_size=int(f.get(2, [0])[0]),
-            header=codec.decode_header(f[3][0]) if 3 in f else Header(),
-            num_txs=int(f.get(4, [0])[0]),
+            block_id=codec.decode_block_id(codec.as_bytes(f[1][0])) if 1 in f else BlockID(),
+            block_size=_codec_iv(f.get(2, [0])[0]),
+            header=codec.decode_header(codec.as_bytes(f[3][0])) if 3 in f else Header(),
+            num_txs=_codec_iv(f.get(4, [0])[0]),
         )

@@ -27,29 +27,55 @@ from cometbft_tpu.utils.protoio import (
 
 def s64(v) -> int:
     """Wire value -> signed int64 (varint or fixed64 payloads)."""
-    return int64_from_varint(int(v))
+    if not isinstance(v, int):
+        raise CodecError("expected varint, got length-delimited field")
+    return int64_from_varint(v)
+
+
+class CodecError(ValueError):
+    """Malformed wire bytes (typed: decoders must never surface
+    OverflowError/MemoryError from bytes(huge_varint) — fuzz-found)."""
+
+
+def _bz(v) -> bytes:
+    """Wire value -> bytes; a varint here would make bytes(n) try to
+    allocate n zero bytes (OverflowError/MemoryError DoS)."""
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        raise CodecError("expected length-delimited field, got varint")
+    return bytes(v)
+
+
+def _iv(v) -> int:
+    if not isinstance(v, int):
+        raise CodecError("expected varint, got length-delimited field")
+    return v
+
+
+# public names for other modules' decoders
+as_bytes = _bz
+as_int = _iv
 
 
 def decode_timestamp(data: bytes) -> int:
     f = ProtoReader(data).to_dict()
     sec = s64(f.get(1, [0])[0])
-    nanos = int(f.get(2, [0])[0])
+    nanos = _iv(f.get(2, [0])[0])
     return sec * 1_000_000_000 + nanos
 
 
 def decode_part_set_header(data: bytes) -> PartSetHeader:
     f = ProtoReader(data).to_dict()
     return PartSetHeader(
-        total=int(f.get(1, [0])[0]), hash=bytes(f.get(2, [b""])[0])
+        total=_iv(f.get(1, [0])[0]), hash=_bz(f.get(2, [b""])[0])
     )
 
 
 def decode_block_id(data: bytes) -> BlockID:
     f = ProtoReader(data).to_dict()
     return BlockID(
-        hash=bytes(f.get(1, [b""])[0]),
+        hash=_bz(f.get(1, [b""])[0]),
         part_set_header=(
-            decode_part_set_header(f[2][0]) if 2 in f else PartSetHeader()
+            decode_part_set_header(_bz(f[2][0])) if 2 in f else PartSetHeader()
         ),
     )
 
@@ -82,25 +108,25 @@ def decode_header(data: bytes) -> Header:
     f = ProtoReader(data).to_dict()
     vb, va = 0, 0
     if 1 in f:
-        vf = ProtoReader(f[1][0]).to_dict()
-        vb = int(vf.get(1, [0])[0])
-        va = int(vf.get(2, [0])[0])
+        vf = ProtoReader(_bz(f[1][0])).to_dict()
+        vb = _iv(vf.get(1, [0])[0])
+        va = _iv(vf.get(2, [0])[0])
     return Header(
         version_block=vb,
         version_app=va,
-        chain_id=bytes(f.get(2, [b""])[0]).decode("utf-8"),
+        chain_id=_bz(f.get(2, [b""])[0]).decode("utf-8"),
         height=s64(f.get(3, [0])[0]),
-        time_ns=decode_timestamp(f[4][0]) if 4 in f else 0,
-        last_block_id=decode_block_id(f[5][0]) if 5 in f else BlockID(),
-        last_commit_hash=bytes(f.get(6, [b""])[0]),
-        data_hash=bytes(f.get(7, [b""])[0]),
-        validators_hash=bytes(f.get(8, [b""])[0]),
-        next_validators_hash=bytes(f.get(9, [b""])[0]),
-        consensus_hash=bytes(f.get(10, [b""])[0]),
-        app_hash=bytes(f.get(11, [b""])[0]),
-        last_results_hash=bytes(f.get(12, [b""])[0]),
-        evidence_hash=bytes(f.get(13, [b""])[0]),
-        proposer_address=bytes(f.get(14, [b""])[0]),
+        time_ns=decode_timestamp(_bz(f[4][0])) if 4 in f else 0,
+        last_block_id=decode_block_id(_bz(f[5][0])) if 5 in f else BlockID(),
+        last_commit_hash=_bz(f.get(6, [b""])[0]),
+        data_hash=_bz(f.get(7, [b""])[0]),
+        validators_hash=_bz(f.get(8, [b""])[0]),
+        next_validators_hash=_bz(f.get(9, [b""])[0]),
+        consensus_hash=_bz(f.get(10, [b""])[0]),
+        app_hash=_bz(f.get(11, [b""])[0]),
+        last_results_hash=_bz(f.get(12, [b""])[0]),
+        evidence_hash=_bz(f.get(13, [b""])[0]),
+        proposer_address=_bz(f.get(14, [b""])[0]),
     )
 
 
@@ -120,19 +146,19 @@ def decode_commit(data: bytes) -> Commit:
     f = ProtoReader(data).to_dict()
     sigs = []
     for raw in f.get(4, []):
-        sf = ProtoReader(raw).to_dict()
+        sf = ProtoReader(_bz(raw)).to_dict()
         sigs.append(
             CommitSig(
-                block_id_flag=int(sf.get(1, [0])[0]),
-                validator_address=bytes(sf.get(2, [b""])[0]),
-                timestamp_ns=decode_timestamp(sf[3][0]) if 3 in sf else 0,
-                signature=bytes(sf.get(4, [b""])[0]),
+                block_id_flag=_iv(sf.get(1, [0])[0]),
+                validator_address=_bz(sf.get(2, [b""])[0]),
+                timestamp_ns=decode_timestamp(_bz(sf[3][0])) if 3 in sf else 0,
+                signature=_bz(sf.get(4, [b""])[0]),
             )
         )
     return Commit(
         height=s64(f.get(1, [0])[0]),
-        round=int(f.get(2, [0])[0]),
-        block_id=decode_block_id(f[3][0]) if 3 in f else BlockID(),
+        round=_iv(f.get(2, [0])[0]),
+        block_id=decode_block_id(_bz(f[3][0])) if 3 in f else BlockID(),
         signatures=tuple(sigs),
     )
 
@@ -177,26 +203,26 @@ def decode_evidence(data: bytes):
 
     f = ProtoReader(data).to_dict()
     if 1 in f:
-        ef = ProtoReader(f[1][0]).to_dict()
+        ef = ProtoReader(_bz(f[1][0])).to_dict()
         return DuplicateVoteEvidence(
-            vote_a=Vote.decode(ef[1][0]),
-            vote_b=Vote.decode(ef[2][0]),
+            vote_a=Vote.decode(_bz(ef[1][0])),
+            vote_b=Vote.decode(_bz(ef[2][0])),
             total_voting_power=s64(ef.get(3, [0])[0]),
             validator_power=s64(ef.get(4, [0])[0]),
-            timestamp_ns=decode_timestamp(ef[5][0]) if 5 in ef else 0,
+            timestamp_ns=decode_timestamp(_bz(ef[5][0])) if 5 in ef else 0,
         )
     if 2 in f:
         from cometbft_tpu.types.light_block import LightBlock
 
-        ef = ProtoReader(f[2][0]).to_dict()
+        ef = ProtoReader(_bz(f[2][0])).to_dict()
         if 1 not in ef:
             raise ValueError("light client attack evidence missing block")
         return LightClientAttackEvidence(
-            conflicting_block=LightBlock.decode(bytes(ef[1][0])),
+            conflicting_block=LightBlock.decode(_bz(ef[1][0])),
             common_height=s64(ef.get(3, [0])[0]),
-            byzantine_validators=tuple(bytes(a) for a in ef.get(4, [])),
+            byzantine_validators=tuple(_bz(a) for a in ef.get(4, [])),
             total_voting_power=s64(ef.get(5, [0])[0]),
-            timestamp_ns=decode_timestamp(ef[6][0]) if 6 in ef else 0,
+            timestamp_ns=decode_timestamp(_bz(ef[6][0])) if 6 in ef else 0,
         )
     raise ValueError("unknown evidence encoding")
 
@@ -221,16 +247,16 @@ def encode_block(b: Block) -> bytes:
 
 def decode_block(data: bytes) -> Block:
     f = ProtoReader(data).to_dict()
-    header = decode_header(f[1][0])
+    header = decode_header(_bz(f[1][0]))
     txs: tuple[bytes, ...] = ()
     if 2 in f:
-        df = ProtoReader(f[2][0]).to_dict()
-        txs = tuple(bytes(t) for t in df.get(1, []))
+        df = ProtoReader(_bz(f[2][0])).to_dict()
+        txs = tuple(_bz(t) for t in df.get(1, []))
     evidence = ()
     if 3 in f:
-        ef = ProtoReader(f[3][0]).to_dict()
-        evidence = tuple(decode_evidence(raw) for raw in ef.get(1, []))
-    last_commit = decode_commit(f[4][0]) if 4 in f else None
+        ef = ProtoReader(_bz(f[3][0])).to_dict()
+        evidence = tuple(decode_evidence(_bz(raw)) for raw in ef.get(1, []))
+    last_commit = decode_commit(_bz(f[4][0])) if 4 in f else None
     return Block(
         header=header,
         data=Data(txs=txs),
@@ -256,10 +282,10 @@ def decode_proof(data: bytes):
 
     f = ProtoReader(data).to_dict()
     return Proof(
-        total=int(f.get(1, [0])[0]),
-        index=int(f.get(2, [0])[0]),
-        leaf_hash=bytes(f.get(3, [b""])[0]),
-        aunts=[bytes(a) for a in f.get(4, [])],
+        total=_iv(f.get(1, [0])[0]),
+        index=_iv(f.get(2, [0])[0]),
+        leaf_hash=_bz(f.get(3, [b""])[0]),
+        aunts=[_bz(a) for a in f.get(4, [])],
     )
 
 
@@ -276,7 +302,7 @@ def decode_part(data: bytes):
 
     f = ProtoReader(data).to_dict()
     return Part(
-        index=int(f.get(1, [0])[0]),
-        bytes=bytes(f.get(2, [b""])[0]),
-        proof=decode_proof(f[3][0]),
+        index=_iv(f.get(1, [0])[0]),
+        bytes=_bz(f.get(2, [b""])[0]),
+        proof=decode_proof(_bz(f[3][0])),
     )

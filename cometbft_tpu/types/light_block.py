@@ -16,6 +16,12 @@ from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 
 
+def _codec_bz(v):
+    from cometbft_tpu.types.codec import as_bytes
+
+    return as_bytes(v)
+
+
 class LightBlockError(ValueError):
     pass
 
@@ -65,8 +71,8 @@ class SignedHeader:
     def decode(cls, data: bytes) -> "SignedHeader":
         f = ProtoReader(data).to_dict()
         return cls(
-            header=codec.decode_header(bytes(f[1][0])),
-            commit=codec.decode_commit(bytes(f[2][0])),
+            header=codec.decode_header(_codec_bz(f[1][0])),
+            commit=codec.decode_commit(_codec_bz(f[2][0])),
         )
 
 
@@ -122,8 +128,8 @@ class LightBlock:
 
         f = ProtoReader(data).to_dict()
         return cls(
-            signed_header=SignedHeader.decode(bytes(f[1][0])),
-            validator_set=decode_validator_set(bytes(f[2][0])),
+            signed_header=SignedHeader.decode(_codec_bz(f[1][0])),
+            validator_set=decode_validator_set(_codec_bz(f[2][0])),
         )
 
 

@@ -3,6 +3,7 @@ types/proposal.go)."""
 
 from __future__ import annotations
 
+
 from dataclasses import dataclass, field, replace
 
 from cometbft_tpu.types import canonical
@@ -14,6 +15,19 @@ from cometbft_tpu.types.block import (
     CommitSig,
 )
 from cometbft_tpu.utils.protoio import ProtoWriter, ProtoReader
+
+
+def _codec_bz(v):
+    from cometbft_tpu.types.codec import as_bytes
+
+    return as_bytes(v)
+
+
+def _codec_iv(v):
+    from cometbft_tpu.types.codec import as_int
+
+    return as_int(v)
+
 
 
 @dataclass(frozen=True)
@@ -99,16 +113,16 @@ class Vote:
 
         f = ProtoReader(data).to_dict()
         return cls(
-            type=int(f.get(1, [0])[0]),
+            type=_codec_iv(f.get(1, [0])[0]),
             height=codec.s64(f.get(2, [0])[0]),
             round=codec.s64(f.get(3, [0])[0]),
-            block_id=codec.decode_block_id(f[4][0]) if 4 in f else BlockID(),
-            timestamp_ns=codec.decode_timestamp(f[5][0]) if 5 in f else 0,
-            validator_address=bytes(f.get(6, [b""])[0]),
+            block_id=codec.decode_block_id(codec.as_bytes(f[4][0])) if 4 in f else BlockID(),
+            timestamp_ns=codec.decode_timestamp(codec.as_bytes(f[5][0])) if 5 in f else 0,
+            validator_address=_codec_bz(f.get(6, [b""])[0]),
             validator_index=codec.s64(f.get(7, [0])[0]),
-            signature=bytes(f.get(8, [b""])[0]),
-            extension=bytes(f.get(9, [b""])[0]),
-            extension_signature=bytes(f.get(10, [b""])[0]),
+            signature=_codec_bz(f.get(8, [b""])[0]),
+            extension=_codec_bz(f.get(9, [b""])[0]),
+            extension_signature=_codec_bz(f.get(10, [b""])[0]),
         )
 
 
@@ -162,7 +176,7 @@ class Proposal:
             height=codec.s64(f.get(1, [0])[0]),
             round=codec.s64(f.get(2, [0])[0]),
             pol_round=codec.s64(f.get(3, [0])[0]),
-            block_id=codec.decode_block_id(f[4][0]) if 4 in f else BlockID(),
-            timestamp_ns=codec.decode_timestamp(f[5][0]) if 5 in f else 0,
-            signature=bytes(f.get(6, [b""])[0]),
+            block_id=codec.decode_block_id(codec.as_bytes(f[4][0])) if 4 in f else BlockID(),
+            timestamp_ns=codec.decode_timestamp(codec.as_bytes(f[5][0])) if 5 in f else 0,
+            signature=_codec_bz(f.get(6, [b""])[0]),
         )

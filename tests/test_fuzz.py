@@ -208,12 +208,25 @@ class TestFuzzWireDecoders:
         from cometbft_tpu.types import codec
 
         rng = random.Random(0xF0225)
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types.block_meta import BlockMeta
+        from cometbft_tpu.types.light_block import LightBlock
+        from cometbft_tpu.types.vote import Proposal, Vote
+
         decoders = [
             codec.decode_evidence,
             codec.decode_block,
             codec.decode_commit,
             codec.decode_header,
             codec.decode_part,
+            codec.decode_block_id,
+            codec.decode_timestamp,
+            codec.decode_proof,
+            Vote.decode,
+            Proposal.decode,
+            BlockMeta.decode,
+            LightBlock.decode,
+            BlockStore.decode_extended_votes,
         ]
         for _ in range(FUZZ_ITERS):
             raw = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200)))
