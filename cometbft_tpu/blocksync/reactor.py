@@ -24,6 +24,7 @@ from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
 from cometbft_tpu.types.validation import verify_commit_light
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 BLOCKSYNC_CHANNEL = 0x40
 
@@ -91,24 +92,24 @@ def encode_status_response(height: int, base: int) -> bytes:
 def decode_bs_message(data: bytes):
     f = ProtoReader(data).to_dict()
     if _F_BLOCK_REQUEST in f:
-        m = ProtoReader(bytes(f[_F_BLOCK_REQUEST][0])).to_dict()
-        return ("block_request", int(m.get(1, [0])[0]))
+        m = ProtoReader(_bz(f[_F_BLOCK_REQUEST][0])).to_dict()
+        return ("block_request", _iv(m.get(1, [0])[0]))
     if _F_NO_BLOCK_RESPONSE in f:
-        m = ProtoReader(bytes(f[_F_NO_BLOCK_RESPONSE][0])).to_dict()
-        return ("no_block", int(m.get(1, [0])[0]))
+        m = ProtoReader(_bz(f[_F_NO_BLOCK_RESPONSE][0])).to_dict()
+        return ("no_block", _iv(m.get(1, [0])[0]))
     if _F_BLOCK_RESPONSE in f:
-        m = ProtoReader(bytes(f[_F_BLOCK_RESPONSE][0])).to_dict()
+        m = ProtoReader(_bz(f[_F_BLOCK_RESPONSE][0])).to_dict()
         ext_votes = None
         if 2 in m:
             from cometbft_tpu.store import BlockStore
 
-            ext_votes = BlockStore.decode_extended_votes(bytes(m[2][0]))
-        return ("block", codec.decode_block(bytes(m[1][0])), ext_votes)
+            ext_votes = BlockStore.decode_extended_votes(_bz(m[2][0]))
+        return ("block", codec.decode_block(_bz(m[1][0])), ext_votes)
     if _F_STATUS_REQUEST in f:
         return ("status_request",)
     if _F_STATUS_RESPONSE in f:
-        m = ProtoReader(bytes(f[_F_STATUS_RESPONSE][0])).to_dict()
-        return ("status", int(m.get(1, [0])[0]), int(m.get(2, [0])[0]))
+        m = ProtoReader(_bz(f[_F_STATUS_RESPONSE][0])).to_dict()
+        return ("status", _iv(m.get(1, [0])[0]), _iv(m.get(2, [0])[0]))
     raise ValueError("unknown blocksync message")
 
 

@@ -17,9 +17,15 @@ from cometbft_tpu.types.part_set import Part
 from cometbft_tpu.types.vote import Proposal, Vote
 from cometbft_tpu.utils.bit_array import BitArray
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter, _unzigzag
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 
-class MessageError(Exception):
+#: absolute cap on wire-decoded bit arrays (votes/parts are bounded
+#: by validator count and part count; 1M bits = 128KB is generous)
+_MAX_BIT_ARRAY_BITS = 1 << 20
+
+
+class MessageError(ValueError):
     pass
 
 
@@ -116,8 +122,17 @@ def _enc_bit_array(ba: BitArray) -> bytes:
 
 def _dec_bit_array(data: bytes) -> BitArray:
     f = ProtoReader(data).to_dict()
-    bits = int(f.get(1, [0])[0])
-    return BitArray.from_bytes(bits, bytes(f.get(2, [b""])[0]))
+    bits = _iv(f.get(1, [0])[0])
+    data = _bz(f.get(2, [b""])[0])
+    # the bit count is attacker-controlled and sizes an allocation:
+    # bound it by the payload actually sent (+ an absolute cap far
+    # above any real validator-set/part-set size)
+    if bits < 0 or bits > _MAX_BIT_ARRAY_BITS or (bits + 7) // 8 > max(
+        len(data), 1
+    ):
+        raise MessageError(f"implausible bit array ({bits} bits, "
+                           f"{len(data)} bytes)")
+    return BitArray.from_bytes(bits, data)
 
 
 def encode_message(msg) -> bytes:
@@ -186,62 +201,62 @@ def decode_message(data: bytes):
     if len(f) != 1:
         raise MessageError("consensus message must have exactly one body")
     tag = next(iter(f))
-    body = bytes(f[tag][0])
+    body = _bz(f[tag][0])
     m = ProtoReader(body).to_dict() if tag != _TAG_PROPOSAL else None
     if tag == _TAG_NEW_ROUND_STEP:
         return NewRoundStepMessage(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
-            step=int(m.get(3, [0])[0]),
-            seconds_since_start_time=int(m.get(4, [0])[0]),
-            last_commit_round=_unzigzag(int(m.get(5, [0])[0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
+            step=_iv(m.get(3, [0])[0]),
+            seconds_since_start_time=_iv(m.get(4, [0])[0]),
+            last_commit_round=_unzigzag(_iv(m.get(5, [0])[0])),
         )
     if tag == _TAG_NEW_VALID_BLOCK:
         return NewValidBlockMessage(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
             block_part_set_header=codec.decode_part_set_header(
-                bytes(m[3][0])
+                _bz(m[3][0])
             ),
-            block_parts=_dec_bit_array(bytes(m[4][0])),
+            block_parts=_dec_bit_array(_bz(m[4][0])),
             is_commit=bool(m.get(5, [0])[0]),
         )
     if tag == _TAG_PROPOSAL:
         return ProposalMessage(proposal=Proposal.decode(body))
     if tag == _TAG_PROPOSAL_POL:
         return ProposalPOLMessage(
-            height=int(m.get(1, [0])[0]),
-            proposal_pol_round=_unzigzag(int(m.get(2, [0])[0])),
-            proposal_pol=_dec_bit_array(bytes(m[3][0])),
+            height=_iv(m.get(1, [0])[0]),
+            proposal_pol_round=_unzigzag(_iv(m.get(2, [0])[0])),
+            proposal_pol=_dec_bit_array(_bz(m[3][0])),
         )
     if tag == _TAG_BLOCK_PART:
         return BlockPartMessage(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
-            part=codec.decode_part(bytes(m[3][0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
+            part=codec.decode_part(_bz(m[3][0])),
         )
     if tag == _TAG_VOTE:
         return VoteMessage(vote=Vote.decode(body))
     if tag == _TAG_HAS_VOTE:
         return HasVoteMessage(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
-            type=int(m.get(3, [0])[0]),
-            index=_unzigzag(int(m.get(4, [0])[0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
+            type=_iv(m.get(3, [0])[0]),
+            index=_unzigzag(_iv(m.get(4, [0])[0])),
         )
     if tag == _TAG_VOTE_SET_MAJ23:
         return VoteSetMaj23Message(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
-            type=int(m.get(3, [0])[0]),
-            block_id=codec.decode_block_id(bytes(m[4][0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
+            type=_iv(m.get(3, [0])[0]),
+            block_id=codec.decode_block_id(_bz(m[4][0])),
         )
     if tag == _TAG_VOTE_SET_BITS:
         return VoteSetBitsMessage(
-            height=int(m.get(1, [0])[0]),
-            round=_unzigzag(int(m.get(2, [0])[0])),
-            type=int(m.get(3, [0])[0]),
-            block_id=codec.decode_block_id(bytes(m[4][0])),
-            votes=_dec_bit_array(bytes(m[5][0])),
+            height=_iv(m.get(1, [0])[0]),
+            round=_unzigzag(_iv(m.get(2, [0])[0])),
+            type=_iv(m.get(3, [0])[0]),
+            block_id=codec.decode_block_id(_bz(m[4][0])),
+            votes=_dec_bit_array(_bz(m[5][0])),
         )
     raise MessageError(f"unknown consensus message tag {tag}")

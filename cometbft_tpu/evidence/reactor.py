@@ -16,6 +16,7 @@ from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.types import codec
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.types.codec import as_bytes
 
 EVIDENCE_CHANNEL = 0x38
 
@@ -31,7 +32,7 @@ def encode_evidence_list(ev_list) -> bytes:
 
 def decode_evidence_list(data: bytes):
     f = ProtoReader(data).to_dict()
-    return [codec.decode_evidence(bytes(v)) for v in f.get(1, [])]
+    return [codec.decode_evidence(as_bytes(v)) for v in f.get(1, [])]
 
 
 class EvidenceReactor(Reactor):

@@ -17,6 +17,7 @@ from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.types.codec import as_bytes
 
 MEMPOOL_CHANNEL = 0x30
 
@@ -34,7 +35,7 @@ def encode_txs(txs: list[bytes]) -> bytes:
 
 def decode_txs(data: bytes) -> list[bytes]:
     f = ProtoReader(data).to_dict()
-    return [bytes(v) for v in f.get(1, [])]
+    return [as_bytes(v) for v in f.get(1, [])]
 
 
 class MempoolReactor(Reactor):

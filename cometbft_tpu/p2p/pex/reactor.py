@@ -18,6 +18,7 @@ from cometbft_tpu.p2p.netaddr import NetAddress
 from cometbft_tpu.p2p.pex.addrbook import AddrBook
 from cometbft_tpu.utils.log import default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 PEX_CHANNEL = 0x00
 
@@ -52,14 +53,14 @@ def decode_pex_msg(raw: bytes):
         return "request", None
     if 2 in f:
         addrs = []
-        inner = ProtoReader(bytes(f[2][0])).to_dict()
+        inner = ProtoReader(_bz(f[2][0])).to_dict()
         for araw in inner.get(1, []):
-            af = ProtoReader(bytes(araw)).to_dict()
+            af = ProtoReader(_bz(araw)).to_dict()
             addrs.append(
                 NetAddress(
-                    id=bytes(af.get(1, [b""])[0]).decode(),
-                    host=bytes(af.get(2, [b""])[0]).decode(),
-                    port=int(af.get(3, [0])[0]),
+                    id=_bz(af.get(1, [b""])[0]).decode(),
+                    host=_bz(af.get(2, [b""])[0]).decode(),
+                    port=_iv(af.get(3, [0])[0]),
                 )
             )
         return "addrs", addrs

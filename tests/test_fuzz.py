@@ -247,3 +247,97 @@ class TestFuzzWireDecoders:
                     dec(raw)
                 except (ValueError, KeyError, IndexError, EOFError):
                     pass
+
+
+class TestFuzzReactorDecoders:
+    """The reactor gossip decoders are the most adversarial-exposed
+    surface — every connected peer can send arbitrary channel bytes
+    (reference fuzz targets cover the p2p receive paths).  Typed
+    errors only; crashes here are remote node-killers."""
+
+    def test_reactor_message_decoders_random(self):
+        from cometbft_tpu.blocksync.reactor import decode_bs_message
+        from cometbft_tpu.consensus.messages import decode_message
+        from cometbft_tpu.evidence.reactor import decode_evidence_list
+        from cometbft_tpu.mempool.reactor import decode_txs
+        from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+
+        decoders = [
+            decode_bs_message,
+            decode_message,
+            decode_evidence_list,
+            decode_txs,
+            decode_pex_msg,
+        ]
+        rng = random.Random(0xF0227)
+        for _ in range(FUZZ_ITERS):
+            raw = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 256))
+            )
+            for dec in decoders:
+                try:
+                    dec(raw)
+                except (ValueError, KeyError, IndexError, EOFError):
+                    pass
+
+    def test_reactor_decoders_varint_as_bytes(self):
+        """The allocation-DoS shape specifically: huge varints in
+        length-delimited positions at every field number, plus one
+        level of nesting."""
+        from cometbft_tpu.blocksync.reactor import decode_bs_message
+        from cometbft_tpu.consensus.messages import decode_message
+        from cometbft_tpu.evidence.reactor import decode_evidence_list
+        from cometbft_tpu.mempool.reactor import decode_txs
+        from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+        from cometbft_tpu.utils.protoio import ProtoWriter
+
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types import codec as tcodec
+        from cometbft_tpu.types.block_meta import BlockMeta
+        from cometbft_tpu.types.light_block import LightBlock
+        from cometbft_tpu.types.vote import Proposal, Vote
+
+        decoders = [
+            decode_bs_message,
+            decode_message,
+            decode_evidence_list,
+            decode_txs,
+            decode_pex_msg,
+            tcodec.decode_evidence,
+            tcodec.decode_block,
+            tcodec.decode_commit,
+            tcodec.decode_header,
+            Vote.decode,
+            Proposal.decode,
+            BlockMeta.decode,
+            LightBlock.decode,
+            BlockStore.decode_extended_votes,
+        ]
+        # every combination of field numbers across three nesting
+        # levels (nested decoders live at MIXED paths like pex 2->1->1
+        # and consensus tag->3->1), and both absurd (2**62, fails
+        # allocation instantly) and mid-size (2**31, would SUCCEED and
+        # eat gigabytes) varints
+        for magnitude in (2**62, 2**31):
+            for f1 in range(1, 15):
+                for f2 in (1, 2, 3, 4, 5):
+                    for f3 in (1, 2, 3):
+                        lv1 = ProtoWriter()
+                        lv1.varint(f3, magnitude)
+                        lv2 = ProtoWriter()
+                        lv2.message(f2, lv1.finish())
+                        top = ProtoWriter()
+                        top.message(f1, lv2.finish())
+                        flat = ProtoWriter()
+                        flat.varint(f1, magnitude)
+                        mid = ProtoWriter()
+                        mid.message(f1, lv1.finish())
+                        for raw in (
+                            flat.finish(), mid.finish(), top.finish()
+                        ):
+                            for dec in decoders:
+                                try:
+                                    dec(raw)
+                                except (ValueError, KeyError,
+                                        IndexError, EOFError):
+                                    pass
