@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 SNAPSHOT_CHANNEL = 0x60
 CHUNK_CHANNEL = 0x61
@@ -86,28 +87,28 @@ def decode_ss_message(data: bytes):
     if _F_SNAPSHOTS_REQUEST in f:
         return SnapshotsRequest()
     if _F_SNAPSHOTS_RESPONSE in f:
-        m = ProtoReader(bytes(f[_F_SNAPSHOTS_RESPONSE][0])).to_dict()
+        m = ProtoReader(_bz(f[_F_SNAPSHOTS_RESPONSE][0])).to_dict()
         return SnapshotsResponse(
-            height=int(m.get(1, [0])[0]),
-            format=int(m.get(2, [0])[0]),
-            chunks=int(m.get(3, [0])[0]),
-            hash=bytes(m.get(4, [b""])[0]),
-            metadata=bytes(m.get(5, [b""])[0]),
+            height=_iv(m.get(1, [0])[0]),
+            format=_iv(m.get(2, [0])[0]),
+            chunks=_iv(m.get(3, [0])[0]),
+            hash=_bz(m.get(4, [b""])[0]),
+            metadata=_bz(m.get(5, [b""])[0]),
         )
     if _F_CHUNK_REQUEST in f:
-        m = ProtoReader(bytes(f[_F_CHUNK_REQUEST][0])).to_dict()
+        m = ProtoReader(_bz(f[_F_CHUNK_REQUEST][0])).to_dict()
         return ChunkRequest(
-            height=int(m.get(1, [0])[0]),
-            format=int(m.get(2, [0])[0]),
-            index=int(m.get(3, [0])[0]),
+            height=_iv(m.get(1, [0])[0]),
+            format=_iv(m.get(2, [0])[0]),
+            index=_iv(m.get(3, [0])[0]),
         )
     if _F_CHUNK_RESPONSE in f:
-        m = ProtoReader(bytes(f[_F_CHUNK_RESPONSE][0])).to_dict()
+        m = ProtoReader(_bz(f[_F_CHUNK_RESPONSE][0])).to_dict()
         return ChunkResponse(
-            height=int(m.get(1, [0])[0]),
-            format=int(m.get(2, [0])[0]),
-            index=int(m.get(3, [0])[0]),
-            chunk=bytes(m.get(4, [b""])[0]),
+            height=_iv(m.get(1, [0])[0]),
+            format=_iv(m.get(2, [0])[0]),
+            index=_iv(m.get(3, [0])[0]),
+            chunk=_bz(m.get(4, [b""])[0]),
             missing=bool(m.get(5, [0])[0]),
         )
     raise ValueError("unknown statesync message")

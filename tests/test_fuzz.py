@@ -261,6 +261,7 @@ class TestFuzzReactorDecoders:
         from cometbft_tpu.evidence.reactor import decode_evidence_list
         from cometbft_tpu.mempool.reactor import decode_txs
         from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+        from cometbft_tpu.statesync.messages import decode_ss_message
 
         decoders = [
             decode_bs_message,
@@ -268,6 +269,7 @@ class TestFuzzReactorDecoders:
             decode_evidence_list,
             decode_txs,
             decode_pex_msg,
+            decode_ss_message,
         ]
         rng = random.Random(0xF0227)
         for _ in range(FUZZ_ITERS):
@@ -297,12 +299,15 @@ class TestFuzzReactorDecoders:
         from cometbft_tpu.types.light_block import LightBlock
         from cometbft_tpu.types.vote import Proposal, Vote
 
+        from cometbft_tpu.statesync.messages import decode_ss_message
+
         decoders = [
             decode_bs_message,
             decode_message,
             decode_evidence_list,
             decode_txs,
             decode_pex_msg,
+            decode_ss_message,
             tcodec.decode_evidence,
             tcodec.decode_block,
             tcodec.decode_commit,
