@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from cometbft_tpu.p2p.key import validate_id
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.version import BLOCK_PROTOCOL, P2P_PROTOCOL, __version__ as SEMVER
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 MAX_NODE_INFO_SIZE = 10240  # p2p/node_info.go:19
 
@@ -100,25 +101,25 @@ class NodeInfo:
         f = ProtoReader(data).to_dict()
         pv = ProtocolVersion()
         if 1 in f:
-            pf = ProtoReader(bytes(f[1][0])).to_dict()
+            pf = ProtoReader(_bz(f[1][0])).to_dict()
             pv = ProtocolVersion(
-                p2p=int(pf.get(1, [0])[0]),
-                block=int(pf.get(2, [0])[0]),
-                app=int(pf.get(3, [0])[0]),
+                p2p=_iv(pf.get(1, [0])[0]),
+                block=_iv(pf.get(2, [0])[0]),
+                app=_iv(pf.get(3, [0])[0]),
             )
         tx_index, rpc_address = "on", ""
         if 8 in f:
-            of = ProtoReader(bytes(f[8][0])).to_dict()
-            tx_index = bytes(of.get(1, [b"on"])[0]).decode()
-            rpc_address = bytes(of.get(2, [b""])[0]).decode()
+            of = ProtoReader(_bz(f[8][0])).to_dict()
+            tx_index = _bz(of.get(1, [b"on"])[0]).decode()
+            rpc_address = _bz(of.get(2, [b""])[0]).decode()
         return cls(
             protocol_version=pv,
-            node_id=bytes(f.get(2, [b""])[0]).decode(),
-            listen_addr=bytes(f.get(3, [b""])[0]).decode(),
-            network=bytes(f.get(4, [b""])[0]).decode(),
-            version=bytes(f.get(5, [b""])[0]).decode(),
-            channels=bytes(f.get(6, [b""])[0]),
-            moniker=bytes(f.get(7, [b"node"])[0]).decode(),
+            node_id=_bz(f.get(2, [b""])[0]).decode(),
+            listen_addr=_bz(f.get(3, [b""])[0]).decode(),
+            network=_bz(f.get(4, [b""])[0]).decode(),
+            version=_bz(f.get(5, [b""])[0]).decode(),
+            channels=_bz(f.get(6, [b""])[0]),
+            moniker=_bz(f.get(7, [b"node"])[0]).decode(),
             tx_index=tx_index,
             rpc_address=rpc_address,
         )

@@ -36,6 +36,7 @@ from cometbft_tpu.utils.protoio import (
     read_uvarint_from,
 )
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
 MAX_SIGNER_MSG = 1 << 20
 
@@ -85,7 +86,7 @@ def _err_body(msg: str) -> bytes:
 
 def _body_err(f: dict) -> str | None:
     if 99 in f:
-        return bytes(f[99][0]).decode()
+        return _bz(f[99][0]).decode()
     return None
 
 
@@ -125,8 +126,8 @@ class SignerClient:
         err = _body_err(f)
         if err:
             raise RemoteSignerError(err)
-        key_type = bytes(f.get(1, [b""])[0]).decode()
-        key_bytes = bytes(f.get(2, [b""])[0])
+        key_type = _bz(f.get(1, [b""])[0]).decode()
+        key_bytes = _bz(f.get(2, [b""])[0])
         if key_type != ed.KEY_TYPE:
             raise RemoteSignerError(f"unsupported key type {key_type}")
         return ed.Ed25519PubKey(key_bytes)
@@ -146,7 +147,7 @@ class SignerClient:
         err = _body_err(f)
         if err:
             raise RemoteSignerError(err)
-        return Vote.decode(bytes(f[1][0]))
+        return Vote.decode(_bz(f[1][0]))
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
         w = ProtoWriter()
@@ -159,7 +160,7 @@ class SignerClient:
         err = _body_err(f)
         if err:
             raise RemoteSignerError(err)
-        return Proposal.decode(bytes(f[1][0]))
+        return Proposal.decode(_bz(f[1][0]))
 
 
 class SignerListenerEndpoint(BaseService):
@@ -392,7 +393,7 @@ class SignerServer(BaseService):
         # compromised node must not be able to shop signatures across
         # chain ids (signer_requestHandlers chainID check)
         if no in (1, 3, 5):
-            req_chain = bytes(f.get(1, [b""])[0]).decode()
+            req_chain = _bz(f.get(1, [b""])[0]).decode()
             if req_chain != self.chain_id:
                 return (
                     {1: 2, 3: 4, 5: 6}[no],
@@ -408,7 +409,7 @@ class SignerServer(BaseService):
             return 2, w.finish()
         if no == 3:  # SignVoteRequest
             chain_id = self.chain_id
-            vote = Vote.decode(bytes(f[2][0]))
+            vote = Vote.decode(_bz(f[2][0]))
             with_ext = bool(f.get(3, [0])[0])
             try:
                 signed = self.pv.sign_vote(
@@ -421,7 +422,7 @@ class SignerServer(BaseService):
             return 4, w.finish()
         if no == 5:  # SignProposalRequest
             chain_id = self.chain_id
-            proposal = Proposal.decode(bytes(f[2][0]))
+            proposal = Proposal.decode(_bz(f[2][0]))
             try:
                 signed = self.pv.sign_proposal(chain_id, proposal)
             except PrivValidatorError as exc:

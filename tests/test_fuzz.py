@@ -261,6 +261,7 @@ class TestFuzzReactorDecoders:
         from cometbft_tpu.evidence.reactor import decode_evidence_list
         from cometbft_tpu.mempool.reactor import decode_txs
         from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+        from cometbft_tpu.p2p.node_info import NodeInfo
         from cometbft_tpu.statesync.messages import decode_ss_message
 
         decoders = [
@@ -270,6 +271,7 @@ class TestFuzzReactorDecoders:
             decode_txs,
             decode_pex_msg,
             decode_ss_message,
+            NodeInfo.decode,
         ]
         rng = random.Random(0xF0227)
         for _ in range(FUZZ_ITERS):
@@ -299,6 +301,7 @@ class TestFuzzReactorDecoders:
         from cometbft_tpu.types.light_block import LightBlock
         from cometbft_tpu.types.vote import Proposal, Vote
 
+        from cometbft_tpu.p2p.node_info import NodeInfo
         from cometbft_tpu.statesync.messages import decode_ss_message
 
         decoders = [
@@ -308,6 +311,7 @@ class TestFuzzReactorDecoders:
             decode_txs,
             decode_pex_msg,
             decode_ss_message,
+            NodeInfo.decode,
             tcodec.decode_evidence,
             tcodec.decode_block,
             tcodec.decode_commit,
