@@ -40,7 +40,7 @@ RECV_RATE = 5_120_000
 MAX_PACKET_OVERHEAD = 256          # framing + proto tag slack over max payload
 
 
-class MConnError(Exception):
+class MConnError(ValueError):
     pass
 
 
@@ -101,12 +101,14 @@ def decode_packet(data: bytes):
     if _F_PONG in f:
         return ("pong",)
     if _F_MSG in f:
-        m = ProtoReader(bytes(f[_F_MSG][0])).to_dict()
+        from cometbft_tpu.types.codec import as_bytes, as_int
+
+        m = ProtoReader(as_bytes(f[_F_MSG][0])).to_dict()
         return (
             "msg",
-            int(m.get(1, [0])[0]),
+            as_int(m.get(1, [0])[0]),
             bool(m.get(2, [0])[0]),
-            bytes(m.get(3, [b""])[0]),
+            as_bytes(m.get(3, [b""])[0]),
         )
     raise MConnError("unknown packet")
 

@@ -261,6 +261,7 @@ class TestFuzzReactorDecoders:
         from cometbft_tpu.evidence.reactor import decode_evidence_list
         from cometbft_tpu.mempool.reactor import decode_txs
         from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+        from cometbft_tpu.p2p.conn.connection import decode_packet
         from cometbft_tpu.p2p.node_info import NodeInfo
         from cometbft_tpu.statesync.messages import decode_ss_message
 
@@ -272,6 +273,7 @@ class TestFuzzReactorDecoders:
             decode_pex_msg,
             decode_ss_message,
             NodeInfo.decode,
+            decode_packet,
         ]
         rng = random.Random(0xF0227)
         for _ in range(FUZZ_ITERS):
