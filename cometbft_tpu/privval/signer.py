@@ -74,7 +74,7 @@ def _recv(f) -> tuple[int, bytes]:
     size = read_uvarint_from(read_exact, max_value=MAX_SIGNER_MSG)
     fields = ProtoReader(read_exact(size)).to_dict()
     for no, vals in fields.items():
-        return no, bytes(vals[0])
+        return no, _bz(vals[0])
     raise ValueError("empty signer message")
 
 

@@ -63,7 +63,8 @@ def ws_accept_key(client_key: str) -> str:
 
 
 def ws_read_frame(rfile) -> tuple[int, bytes] | None:
-    """Returns (opcode, payload) or None on EOF/close."""
+    """Returns (opcode, payload); None on EOF/close/truncation/
+    oversize — adversarial streams must never surface struct.error."""
     head = rfile.read(2)
     if len(head) < 2:
         return None
@@ -72,13 +73,26 @@ def ws_read_frame(rfile) -> tuple[int, bytes] | None:
     masked = bool(b2 & 0x80)
     length = b2 & 0x7F
     if length == 126:
-        length = struct.unpack(">H", rfile.read(2))[0]
+        ext = rfile.read(2)
+        if len(ext) < 2:
+            return None
+        length = struct.unpack(">H", ext)[0]
     elif length == 127:
-        length = struct.unpack(">Q", rfile.read(8))[0]
+        ext = rfile.read(8)
+        if len(ext) < 8:
+            return None
+        length = struct.unpack(">Q", ext)[0]
     if length > 16 * 1024 * 1024:
         return None
-    mask = rfile.read(4) if masked else b""
+    if masked:
+        mask = rfile.read(4)
+        if len(mask) < 4:
+            return None
+    else:
+        mask = b""
     payload = rfile.read(length)
+    if len(payload) < length:
+        return None
     if masked:
         payload = bytes(
             c ^ mask[i % 4] for i, c in enumerate(payload)

@@ -352,3 +352,38 @@ class TestFuzzReactorDecoders:
                                 except (ValueError, KeyError,
                                         IndexError, EOFError):
                                     pass
+
+
+class TestFuzzWsFrames:
+    """RFC 6455 frame reader against adversarial byte streams
+    (the server side parses whatever a websocket client sends)."""
+
+    def test_ws_read_frame_random(self):
+        import io
+
+        from cometbft_tpu.rpc.jsonrpc import ws_read_frame
+
+        rng = random.Random(0xF0228)
+        for _ in range(FUZZ_ITERS):
+            raw = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(0, 64))
+            )
+            try:
+                out = ws_read_frame(io.BytesIO(raw))
+            except (ValueError, EOFError):
+                continue
+            # contract: None (close/EOF/oversize) or (opcode, payload)
+            assert out is None or (
+                isinstance(out[0], int)
+                and isinstance(out[1], bytes)
+            )
+
+    def test_ws_read_frame_oversize_length(self):
+        """64-bit length header must be bounded, not allocated."""
+        import io
+        import struct
+
+        from cometbft_tpu.rpc.jsonrpc import ws_read_frame
+
+        frame = bytes([0x81, 127]) + struct.pack(">Q", 2**62)
+        assert ws_read_frame(io.BytesIO(frame + b"x" * 64)) is None
