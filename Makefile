@@ -31,3 +31,6 @@ bench-micro:
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \
 		-o native/build/libcmtbls.so
+
+fuzz:
+	python tools/fuzz.py --time $${FUZZ_TIME:-60}

@@ -8,87 +8,71 @@ flight so host->device transfer overlaps device compute, the way the
 node's replay paths (blocksync, light sync) drive the kernel.  Sync
 single-launch latency is logged to stderr alongside.
 
-Run with the default environment (TPU via the axon platform); falls
-back to whatever jax.devices() offers (CPU in dev shells).
+Robustness contract (round-3 postmortem: a transient axon backend-init
+failure recorded a 0): the benchmark must always produce the most
+honest nonzero number it can.
+  - Each attempt runs in a FRESH forked child (a wedged PJRT client
+    cannot be retried in-process; a hung import can't be interrupted).
+  - Backend init / early crashes are retried with backoff while the
+    watchdog budget lasts.
+  - The last attempt falls back to JAX_PLATFORMS='' (auto-select, in
+    practice CPU) so a dead device window still yields a real measured
+    number, labeled as a fallback in the "note" field.
+  - The child's actual exception text travels to the final JSON
+    "error"/"note" field via a result file — never a guessed message.
+  - XLA compile cache persists in .xla_cache/ so a short device window
+    is not eaten by recompilation (first compile measured 96 s in r1).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_SIGS_PER_SEC = 1_000_000
+METRIC = "ed25519_batch_verify_throughput"
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-BASELINE_SIGS_PER_SEC = 1_000_000
+def _enable_compile_cache() -> None:
+    cache = os.path.join(REPO, ".xla_cache")
+    os.makedirs(cache, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 
-def _run_with_watchdog(seconds: int) -> None:
-    """A wedged device tunnel can hang `import jax` inside a C call
-    where no Python signal handler ever runs, so an in-process alarm
-    cannot save us.  Fork instead: the CHILD runs the benchmark, the
-    parent (which never touches jax) waits with a deadline and emits
-    ONE honestly-labeled failure JSON line if the child hangs or dies
-    without output — the driver always gets its line."""
-    pid = os.fork()
-    if pid == 0:
-        try:
-            main()
-            os._exit(0)
-        except BaseException as exc:  # noqa: BLE001
-            log(f"bench failed: {exc!r}")
-            os._exit(3)
-    deadline = time.monotonic() + seconds
-    while time.monotonic() < deadline:
-        done, status = os.waitpid(pid, os.WNOHANG)
-        if done:
-            if os.waitstatus_to_exitcode(status) == 0:
-                return
-            break  # child died without printing: fall through
-        time.sleep(1.0)
-    else:
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_batch_verify_throughput",
-                "value": 0,
-                "unit": "sigs/sec",
-                "vs_baseline": 0.0,
-                "error": f"no result within {seconds}s "
-                         "(device tunnel wedged or bench crashed)",
-            }
-        ),
-        flush=True,
-    )
-    sys.exit(2)
-
-
-def main() -> None:
+def main() -> dict:
+    _enable_compile_cache()
     import jax
 
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.ops.ed25519_verify import (
+        _finish,
         verify_arrays,
+        verify_arrays_async,
         verify_stream,
     )
+
+    import numpy as np
 
     dev = jax.devices()[0]
     log(f"device: {dev}")
     on_cpu = dev.platform == "cpu"
 
-    # Full batch on accelerators; small batch on the CPU dev fallback.
-    n = 256 if on_cpu else 4096
+    # Full batch on accelerators; tiny batch on the CPU dev fallback —
+    # this container is 1-core and the kernel measures ~0.2 s/sig on
+    # it, so the fallback must stay minimal to fit its ~280 s reserve
+    # (incl. compile) and still report an honest nonzero number.
+    n = 32 if on_cpu else 4096
     nchunks = 2 if on_cpu else 8
     msglen = 120
     rng = np.random.RandomState(0)
@@ -112,44 +96,43 @@ def main() -> None:
 
     # sync latency (one launch, transfers + compute + result fetch)
     lat = float("inf")
-    for i in range(3):
+    for _ in range(0 if on_cpu else 3):
         t0 = time.time()
         out = verify_arrays(pubs, sigs, msgs)
         lat = min(lat, time.time() - t0)
-    assert bool(out.all())
-    log(f"sync latency: {lat * 1e3:.1f} ms/launch ({n} sigs)")
+    if not on_cpu:
+        assert bool(out.all())
+        log(f"sync latency: {lat * 1e3:.1f} ms/launch ({n} sigs)")
 
-    # device-vs-link split: time K back-to-back dispatches that all
-    # synchronize through ONE combined fetch, vs a single dispatch+
-    # fetch; the difference isolates marginal device compute from the
-    # fixed link round-trip (block_until_ready does not block on the
-    # tunneled axon backend, so this is the honest way to measure it).
-    from cometbft_tpu.ops.ed25519_verify import (
-        _finish,
-        verify_arrays_async,
-    )
-
-    k = 2 if on_cpu else 6
-    t0 = time.time()
-    parts = []
-    for _ in range(k):
-        parts.extend(verify_arrays_async(pubs, sigs, msgs))
-    _finish(parts)
-    t_k = time.time() - t0
-    t0 = time.time()
-    _finish(verify_arrays_async(pubs, sigs, msgs))
-    t_1 = time.time() - t0
-    dev_per_launch = max(t_k - t_1, 0.0) / (k - 1)
-    log(
-        f"marginal device+transfer: {dev_per_launch * 1e3:.1f} ms/launch "
-        f"({n / dev_per_launch if dev_per_launch else 0:,.0f} sigs/s "
-        f"device-side); fixed link overhead ≈ "
-        f"{max(t_1 - dev_per_launch, 0) * 1e3:.1f} ms"
-    )
+    if not on_cpu:
+        # device-vs-link split: time K back-to-back dispatches that all
+        # synchronize through ONE combined fetch, vs a single dispatch+
+        # fetch; the difference isolates marginal device compute from
+        # the fixed link round-trip (block_until_ready does not block
+        # on the tunneled axon backend, so this is the honest way to
+        # measure it).
+        k = 6
+        t0 = time.time()
+        parts = []
+        for _ in range(k):
+            parts.extend(verify_arrays_async(pubs, sigs, msgs))
+        _finish(parts)
+        t_k = time.time() - t0
+        t0 = time.time()
+        _finish(verify_arrays_async(pubs, sigs, msgs))
+        t_1 = time.time() - t0
+        dev_per_launch = max(t_k - t_1, 0.0) / (k - 1)
+        log(
+            f"marginal device+transfer: {dev_per_launch * 1e3:.1f} "
+            f"ms/launch "
+            f"({n / dev_per_launch if dev_per_launch else 0:,.0f} sigs/s "
+            f"device-side); fixed link overhead ≈ "
+            f"{max(t_1 - dev_per_launch, 0) * 1e3:.1f} ms"
+        )
 
     # steady-state pipelined throughput over nchunks in-flight launches
     best = 0.0
-    for trial in range(3):
+    for trial in range(1 if on_cpu else 3):
         t0 = time.time()
         total = 0
         for res in verify_stream(
@@ -166,17 +149,142 @@ def main() -> None:
         )
         best = max(best, rate)
 
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_batch_verify_throughput",
-                "value": round(best, 1),
-                "unit": "sigs/sec",
-                "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 4),
-            }
-        )
+    return {
+        "metric": METRIC,
+        "value": round(best, 1),
+        "unit": "sigs/sec",
+        "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 4),
+        "platform": dev.platform,
+    }
+
+
+def _child(result_path: str) -> None:
+    """Run one attempt; ALWAYS leave a JSON object at result_path."""
+    try:
+        result = main()
+    except BaseException as exc:  # noqa: BLE001 — must report, not raise
+        result = {"error": f"{type(exc).__name__}: {exc}"}
+        log(f"bench attempt failed: {result['error']}")
+    tmp = result_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, result_path)
+
+
+def _run_attempt(
+    result_path: str, platform_override: str | None, timeout_s: float
+) -> dict:
+    """Exec one attempt in a FRESH interpreter with a deadline.
+
+    A wedged device tunnel hangs `import jax` itself (the device
+    plugin's sitecustomize/import path blocks in C where no Python
+    signal handler runs), so (a) the parent — which never touches
+    jax — enforces the deadline and SIGKILLs on overrun, and (b) the
+    cpu fallback scrubs the device plugin's env vars entirely: with
+    the plugin importable, even JAX_PLATFORMS=cpu hangs (measured)."""
+    if os.path.exists(result_path):
+        os.unlink(result_path)
+    env = dict(os.environ)
+    if platform_override is not None:
+        env["JAX_PLATFORMS"] = platform_override
+    if platform_override == "cpu":
+        for var in list(env):
+            if var.startswith("PALLAS_AXON") or var.startswith("AXON_"):
+                env.pop(var)
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", result_path],
+        env=env,
+        cwd=REPO,
     )
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return {"error": f"attempt hung; killed after {timeout_s:.0f}s"}
+    try:
+        with open(result_path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"error": "attempt died without writing a result"}
+
+
+def run() -> None:
+    budget = float(os.environ.get("CMT_BENCH_WATCHDOG_S", "2400"))
+    start = time.monotonic()
+    result_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"cmt_bench_{os.getpid()}.json"
+    )
+    backoffs = (0, 15, 30, 60, 120)
+    errors: list[str] = []
+    result: dict = {}
+    # Always leave room for the CPU fallback: a single hung device
+    # attempt must not eat the whole watchdog budget (a 420 s drive
+    # test did exactly that — attempt 0 ran 390 s and the fallback
+    # never fired).
+    fallback_reserve = 300.0
+    for i, backoff in enumerate(backoffs):
+        remaining = budget - (time.monotonic() - start)
+        attempt_timeout = min(remaining - fallback_reserve, 600)
+        if attempt_timeout < 60:
+            break
+        if backoff and i:
+            time.sleep(min(backoff, max(remaining - fallback_reserve, 1)))
+            attempt_timeout = min(
+                budget - (time.monotonic() - start) - fallback_reserve, 600
+            )
+            if attempt_timeout < 60:
+                break
+        result = _run_attempt(result_path, None, attempt_timeout)
+        if "value" in result:
+            break
+        errors.append(f"attempt {i}: {result.get('error', 'unknown')}")
+        log(f"device attempt {i} failed: {result.get('error')}")
+    if "value" not in result:
+        # Dead device window: measure on whatever backend auto-select
+        # finds (CPU) — an honest slow number beats a zero.
+        remaining = budget - (time.monotonic() - start)
+        if remaining > 60:
+            # force cpu: auto-select ('') would try the wedged device
+            # plugin first and hang exactly like the attempts above
+            log("falling back to the cpu backend")
+            result = _run_attempt(
+                result_path, "cpu", min(remaining - 20, 900)
+            )
+            if "value" in result:
+                result["note"] = (
+                    "device unavailable - measured on fallback backend "
+                    f"'{result.get('platform', '?')}'; device errors: "
+                    + " | ".join(errors[-2:])
+                )
+            else:
+                errors.append(
+                    f"cpu fallback: {result.get('error', 'unknown')}"
+                )
+    if "value" not in result:
+        result = {
+            "metric": METRIC,
+            "value": 0,
+            "unit": "sigs/sec",
+            "vs_baseline": 0.0,
+            "error": " | ".join(errors[-3:]) or "no attempt completed",
+        }
+    try:
+        os.unlink(result_path)
+    except OSError:
+        pass
+    print(json.dumps(result), flush=True)
+    if not result.get("value"):
+        sys.exit(2)
 
 
 if __name__ == "__main__":
-    _run_with_watchdog(int(os.environ.get("CMT_BENCH_WATCHDOG_S", "2400")))
+    if len(sys.argv) == 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        run()

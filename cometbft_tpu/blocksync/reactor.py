@@ -416,10 +416,12 @@ class BlocksyncReactor(Reactor):
         _, val = self.state.validators.get_by_address(addr)
         if val is None:
             return False
-        # integer arithmetic: float total/3 misclassifies at int64
-        # voting-power scale (reference uses total/3 integer division)
+        # reference (reactor.go:509) compares power >= total/3 with Go
+        # integer floor division, so e.g. power=3 of total=10 counts as
+        # blocking; match that boundary exactly (3*power >= total is
+        # mathematically stricter and diverges at non-multiples of 3)
         total = self.state.validators.total_voting_power()
-        return 3 * val.voting_power >= total
+        return val.voting_power >= total // 3
 
     def _maybe_switch_to_consensus(self) -> bool:
         """(reactor.go poolRoutine switch check)"""

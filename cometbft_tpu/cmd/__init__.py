@@ -406,28 +406,42 @@ def cmd_reindex_event(args) -> int:
 
 
 def cmd_confix(args) -> int:
-    """(internal/confix) — normalize/migrate config.toml: keep every
-    value the operator set, add missing keys at current defaults,
-    drop unknown keys. --dry-run prints the result instead of
-    writing; a .bak of the original is kept otherwise."""
+    """(internal/confix migrations.go:1, upgrade.go:29) — migrate
+    config.toml across versions and normalize to the current schema:
+    keys renamed between versions carry the operator's value
+    (fast_sync -> block_sync, timeout_prevote -> timeout_vote),
+    missing keys are added at current defaults, unknown keys dropped.
+    --from pins the source version (default: fingerprint detection);
+    --dry-run prints the plan + result instead of writing; a .bak of
+    the original is kept otherwise."""
+    from cometbft_tpu import confix
+
     path = os.path.join(args.home, "config", "config.toml")
     if not os.path.exists(path):
         print(f"no config at {path}", file=sys.stderr)
         return 1
-    cfg = Config.load(args.home)  # parses + validates known keys
-    new_toml = cfg.to_toml()
-    if args.dry_run:
-        print(new_toml)
-        return 0
     with open(path, encoding="utf-8") as f:
         old = f.read()
-    if old == new_toml:
-        print("config already normalized")
-        return 0
-    with open(path + ".bak", "w", encoding="utf-8") as f:
-        f.write(old)
-    cfg.save()
-    print(f"rewrote {path} (backup at {path}.bak)")
+    try:
+        # migrate() owns the write: .bak of the original + tmp-file +
+        # os.replace, so a crash mid-write can't truncate the config
+        steps, new_toml = confix.migrate(
+            args.home,
+            from_version=args.from_version,
+            dry_run=args.dry_run,
+            skip_validate=args.skip_validate,
+        )
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        print(f"confix failed: {exc}", file=sys.stderr)
+        return 1
+    for step in steps:
+        print(f"  {step}")
+    if args.dry_run:
+        print(new_toml)
+    elif old == new_toml:
+        print("config already at current schema")
+    else:
+        print(f"rewrote {path} (backup at {path}.bak)")
     return 0
 
 
@@ -485,6 +499,73 @@ def cmd_debug_kill(args) -> int:
         pass
     print(f"wrote {out}")
     return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """(commands/debug/dump.go) — periodically collect debug archives
+    from a running node: RPC state (status/net_info/
+    dump_consensus_state), the diagnostics plane's stack dump, GC
+    stats, and a CPU profile (the goroutine/heap/profile analogs),
+    plus config — one timestamped .tar.gz per interval in
+    ``output_dir``."""
+    import tarfile
+    import tempfile
+    import time as _time
+    import urllib.request
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    base = args.rpc_laddr.split("://")[-1]
+    diag = args.diag_laddr.split("://")[-1] if args.diag_laddr else None
+    rounds = 0
+    while True:
+        # round counter in the name: sub-second --frequency must not
+        # overwrite the previous archive
+        stamp = f"{_time.strftime('%Y%m%d-%H%M%S')}-{rounds:04d}"
+        tmp = tempfile.mkdtemp(prefix="cmt-dump-")
+
+        def save(name: str, data: bytes) -> None:
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(data)
+
+        for route in ("status", "net_info", "dump_consensus_state"):
+            try:
+                with urllib.request.urlopen(
+                    f"http://{base}/{route}", timeout=5
+                ) as resp:
+                    save(f"{route}.json", resp.read())
+            except Exception as exc:  # noqa: BLE001 — collect best-effort
+                save(f"{route}.err", repr(exc).encode())
+        if diag:
+            probes = [
+                ("stacks.txt", "/debug/stacks", 5),
+                ("gc.txt", "/debug/gc", 5),
+                (
+                    "profile.txt",
+                    f"/debug/profile?seconds={args.profile_seconds}",
+                    args.profile_seconds + 10,
+                ),
+            ]
+            for name, route, timeout in probes:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://{diag}{route}", timeout=timeout
+                    ) as resp:
+                        save(name, resp.read())
+                except Exception as exc:  # noqa: BLE001
+                    save(name + ".err", repr(exc).encode())
+        p = os.path.join(args.home, "config", "config.toml")
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                save("config.toml", f.read())
+        out = os.path.join(args.output_dir, f"{stamp}.tar.gz")
+        with tarfile.open(out, "w:gz") as tar:
+            tar.add(tmp, arcname="debug")
+        shutil.rmtree(tmp, ignore_errors=True)
+        print(f"wrote {out}")
+        rounds += 1
+        if args.count and rounds >= args.count:
+            return 0
+        _time.sleep(args.frequency)
 
 
 def cmd_version(args) -> int:
@@ -635,9 +716,15 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_reindex_event)
 
     p = sub.add_parser(
-        "confix", help="normalize config.toml to the current schema"
+        "confix", help="migrate/normalize config.toml to the current schema"
     )
     p.add_argument("--dry-run", action="store_true")
+    p.add_argument(
+        "--from", dest="from_version", default=None,
+        help="source config version (v0.34/v0.37/v0.38/v1.0); "
+             "default: auto-detect",
+    )
+    p.add_argument("--skip-validate", action="store_true")
     p.set_defaults(fn=cmd_confix)
 
     p = sub.add_parser(
@@ -651,6 +738,20 @@ def main(argv: list[str] | None = None) -> int:
     dk.add_argument("--rpc-laddr", default="",
                     help="node RPC to snapshot (host:port)")
     dk.set_defaults(fn=cmd_debug_kill)
+    dd = dsub.add_parser(
+        "dump", help="periodic debug archives (dump.go analog)"
+    )
+    dd.add_argument("output_dir")
+    dd.add_argument("--frequency", type=float, default=30.0,
+                    help="seconds between collections")
+    dd.add_argument("--count", type=int, default=0,
+                    help="stop after N archives (0 = run until killed)")
+    dd.add_argument("--rpc-laddr", default="127.0.0.1:26657",
+                    help="node RPC address (host:port)")
+    dd.add_argument("--diag-laddr", default="",
+                    help="diagnostics plane address (host:port)")
+    dd.add_argument("--profile-seconds", type=int, default=5)
+    dd.set_defaults(fn=cmd_debug_dump)
 
     p = sub.add_parser("load", help="generate timestamped tx load")
     p.add_argument("--endpoints", required=True,

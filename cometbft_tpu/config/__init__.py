@@ -178,10 +178,10 @@ class ConsensusConfig:
     wal_file: str = "data/cs.wal/wal"
     timeout_propose_ns: int = 3 * 10**9
     timeout_propose_delta_ns: int = 500 * 10**6
-    timeout_prevote_ns: int = 10**9
-    timeout_prevote_delta_ns: int = 500 * 10**6
-    timeout_precommit_ns: int = 10**9
-    timeout_precommit_delta_ns: int = 500 * 10**6
+    # v1.0 merged the prevote/precommit timeout pairs into one vote
+    # timeout (config.go:1211 TimeoutVote); confix migrates old keys
+    timeout_vote_ns: int = 10**9
+    timeout_vote_delta_ns: int = 500 * 10**6
     timeout_commit_ns: int = 10**9
     skip_timeout_commit: bool = False
     create_empty_blocks: bool = True
@@ -196,12 +196,10 @@ class ConsensusConfig:
         return self.timeout_propose_ns + self.timeout_propose_delta_ns * round_
 
     def prevote_timeout_ns(self, round_: int) -> int:
-        return self.timeout_prevote_ns + self.timeout_prevote_delta_ns * round_
+        return self.timeout_vote_ns + self.timeout_vote_delta_ns * round_
 
     def precommit_timeout_ns(self, round_: int) -> int:
-        return (
-            self.timeout_precommit_ns + self.timeout_precommit_delta_ns * round_
-        )
+        return self.timeout_vote_ns + self.timeout_vote_delta_ns * round_
 
 
 @dataclass
@@ -329,8 +327,7 @@ class Config:
             raise ConfigError("rpc max_open_connections cannot be negative")
         for name in (
             "timeout_propose_ns",
-            "timeout_prevote_ns",
-            "timeout_precommit_ns",
+            "timeout_vote_ns",
             "timeout_commit_ns",
         ):
             if getattr(self.consensus, name) < 0:
@@ -444,10 +441,8 @@ def test_config(home: str = "") -> Config:
     cfg.consensus = ConsensusConfig(
         timeout_propose_ns=80 * 10**6,
         timeout_propose_delta_ns=1 * 10**6,
-        timeout_prevote_ns=20 * 10**6,
-        timeout_prevote_delta_ns=1 * 10**6,
-        timeout_precommit_ns=20 * 10**6,
-        timeout_precommit_delta_ns=1 * 10**6,
+        timeout_vote_ns=20 * 10**6,
+        timeout_vote_delta_ns=1 * 10**6,
         timeout_commit_ns=20 * 10**6,
         peer_gossip_sleep_duration_ns=5 * 10**6,
         peer_query_maj23_sleep_duration_ns=250 * 10**6,

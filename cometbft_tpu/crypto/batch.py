@@ -20,6 +20,17 @@ def _ed25519_factory() -> BatchVerifier:
     if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
         return _ed.CpuBatchVerifier()
     try:
+        import jax
+
+        if (
+            len(jax.devices()) > 1
+            and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY")
+        ):
+            # multi-chip: shard the batch over a 1-D mesh — every
+            # caller of this seam scales across chips transparently
+            from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
+
+            return ShardedTpuBatchVerifier()
         from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier
 
         return TpuBatchVerifier()

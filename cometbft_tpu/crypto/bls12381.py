@@ -45,6 +45,7 @@ tests/bls_naive_oracle.py.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import os
 
 from cometbft_tpu.crypto import PrivKey, PubKey
@@ -900,11 +901,35 @@ def gen_priv_key() -> Bls12381PrivKey:
             return Bls12381PrivKey(raw)
 
 
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    okm, t, i = b"", b"", 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
 def priv_key_from_secret(secret: bytes) -> Bls12381PrivKey:
-    d = (
-        int.from_bytes(hashlib.sha512(secret).digest(), "big") % (R - 1)
-    ) + 1
-    return Bls12381PrivKey(d.to_bytes(32, "big"))
+    """Seed-compatible with the reference: blst.KeyGen per
+    draft-irtf-cfrg-bls-signature-05 §2.3 (HKDF-SHA256, salt chain from
+    "BLS-SIG-KEYGEN-SALT-", L=48), with non-32-byte secrets sha256
+    pre-hashed first (key_bls12381.go:63-70)."""
+    if len(secret) != 32:
+        secret = hashlib.sha256(secret).digest()
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    ikm = secret + b"\x00"
+    info = (48).to_bytes(2, "big")
+    while True:
+        salt = hashlib.sha256(salt).digest()
+        okm = _hkdf_expand(_hkdf_extract(salt, ikm), info, 48)
+        d = int.from_bytes(okm, "big") % R
+        if d:
+            return Bls12381PrivKey(d.to_bytes(32, "big"))
 
 
 # -- aggregation (key_bls12381.go:37-38 aggregate APIs) -----------------

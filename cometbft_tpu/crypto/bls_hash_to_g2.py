@@ -69,11 +69,19 @@ ISO3_XDEN = (
     (0xC, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
     (0x1, 0x0),
 )
+#
+# Sign convention note (round-4 fix): a Vélu derivation determines the
+# isogeny only up to composition with [-1]; the tool originally emitted
+# the negated y-map, which passes every on-curve/subgroup property test
+# while making every produced point (hence every signature) the
+# NEGATION of what RFC 9380 (and blst, i.e. reference nodes) compute.
+# Anchored now to the RFC 9380 appendix J.10.1 known-answer vectors
+# (tests/test_bls.py::test_hash_to_g2_rfc9380_j10_vectors).
 ISO3_YNUM = (
-    (0x4D0CA6DBECBD55EF176E62B3BDE9B4454F9A5B05305AE2371EC98C879891123221FDA12B88AD097A72F38E38E38D3A5, 0x4D0CA6DBECBD55EF176E62B3BDE9B4454F9A5B05305AE2371EC98C879891123221FDA12B88AD097A72F38E38E38D3A5),
-    (0x0, 0x1439B899BAF1B35B8FC02D1BFB73BF5231B21E4AF64B0E94DE7B4E7D31A614C6C285C71B6D7A38E357C65555555512ED),
-    (0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F, 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C),
-    (0x7B47715FE12EEFE4F24A3785FCA9206EE5C3C4D51A2B038B6475ADA5C0E81D1D032F6845A77B425D84B8E38E38E1F9B, 0x0),
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706, 0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0x0, 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C, 0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0x0),
 )
 ISO3_YDEN = (
     (0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),

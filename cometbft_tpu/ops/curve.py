@@ -118,6 +118,24 @@ def pt_add_niels(p, n):
     return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
+def pt_add_pniels(p, n):
+    """Mixed add with a projective Niels point (Y2+X2, Y2-X2, 2*Z2,
+    2d*T2) — one mul more than the affine-Niels add, but table entries
+    need NO batched inversion at build time (the per-validator device
+    tables, ops/precompute.py, keep their projective Z)."""
+    x1, y1, z1, t1 = p
+    yplus, yminus, z2dbl, t2d = n
+    a = F.mul(F.sub(y1, x1), yminus)
+    b = F.mul(F.add(y1, x1), yplus)
+    c = F.mul(t1, t2d)
+    dd = F.mul(z1, z2dbl)
+    e = F.sub(b, a)
+    f = F.sub(dd, c)
+    g = F.add(dd, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
 def pt_double(p):
     """Doubling (dbl-2008-hwcd)."""
     x1, y1, z1, _ = p

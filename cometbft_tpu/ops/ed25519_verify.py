@@ -34,7 +34,9 @@ Batch shaping (TPU-first):
 
 from __future__ import annotations
 
+import json
 import os
+import time
 
 import numpy as np
 
@@ -120,6 +122,57 @@ def verify_kernel(pub, sig, msg, msglen, nblocks: int):
     return eq_ok & a_ok & r_ok & s_ok
 
 
+def verify_kernel_keyed(
+    pub, sig, msg, msglen, key_ids, table, key_valid, nblocks: int,
+    window_bits: int,
+):
+    """Keyed variant: A's decompression and window tables come from the
+    device-resident per-validator-set precompute (ops/precompute.py) —
+    steady-state commit verification does only SHA-512, R's
+    decompression, and comb adds against hot tables.  Reference analog:
+    the expanded-pubkey LRU (crypto/ed25519/ed25519.go:43).
+
+    key_ids (B,) int32 index rows of ``table``/``key_valid``; semantics
+    otherwise identical to verify_kernel.
+    """
+    from cometbft_tpu.ops import precompute as PR
+
+    r_enc = sig[:32]
+    s_bytes = sig[32:]
+    r_pt, r_ok = C.decompress(r_enc)
+    s_ok = SC.bytes_lt_l(s_bytes)
+    buf, nblocks_lane = build_padded_input(r_enc, pub, msg, msglen, nblocks)
+    digest = SH.sha512_padded(buf, nblocks, nblocks_lane)
+    k_limbs = SC.reduce_digest(digest)
+    if window_bits == 8:
+        k_win = SC.limbs_to_windows8(k_limbs)
+    else:
+        k_win = SC.limbs_to_nibbles(k_limbs)
+    p1 = PR.comb_mul_base8(s_bytes)                       # [S]B
+    p2 = PR.comb_mul_keyed(table, key_ids, k_win, window_bits)  # [k](-A)
+    q = C.pt_add(C.pt_add(p1, p2), C.pt_neg(r_pt))
+    eq_ok = C.pt_is_identity(C.mul8(q))
+    return eq_ok & r_ok & s_ok & key_valid[key_ids]
+
+
+def verify_kernel_keyed_packed(
+    buf, table, key_valid, bucket: int, nblocks: int, window_bits: int
+):
+    """Packed keyed variant: (104+bucket, B) u8 rows
+    pub[32] | sig[64] | msg[bucket] | msglen_le[4] | key_id_le[4]."""
+    pub = buf[:32]
+    sig = buf[32:96]
+    msg = buf[96 : 96 + bucket]
+    lnb = buf[96 + bucket : 100 + bucket].astype(jnp.int32)
+    msglen = lnb[0] | (lnb[1] << 8) | (lnb[2] << 16) | (lnb[3] << 24)
+    knb = buf[100 + bucket : 104 + bucket].astype(jnp.int32)
+    key_ids = knb[0] | (knb[1] << 8) | (knb[2] << 16) | (knb[3] << 24)
+    return verify_kernel_keyed(
+        pub, sig, msg, msglen, key_ids, table, key_valid, nblocks,
+        window_bits,
+    )
+
+
 def verify_kernel_packed(buf, bucket: int, nblocks: int):
     """Single-buffer variant: (32+64+bucket+4, B) u8 -> (B,) bool.
 
@@ -183,11 +236,13 @@ def _next_pow2(n: int) -> int:
 
 def pack_inputs(
     pub: np.ndarray, sig: np.ndarray, msgs: list[bytes], start: int = 0,
-    end: int | None = None,
+    end: int | None = None, key_ids: np.ndarray | None = None,
 ) -> tuple[np.ndarray, int]:
     """Pad + pack (pub, sig, msgs[start:end]) into the feature-first
     (100+bucket, batch) u8 layout of verify_kernel_packed — fully
-    vectorized, no per-message Python loop. Returns (packed, bucket)."""
+    vectorized, no per-message Python loop. Returns (packed, bucket).
+    With ``key_ids`` (int32 per message), appends 4 LE id bytes per
+    lane for the keyed kernel ((104+bucket, batch))."""
     if end is None:
         end = len(msgs)
     n = end - start
@@ -198,7 +253,8 @@ def pack_inputs(
     if bucket is None:
         raise ValueError(f"message too large for device path: {maxlen}")
     batch = max(_next_pow2(n), _MIN_BATCH)
-    packed = np.zeros((100 + bucket, batch), dtype=np.uint8)
+    tail = 100 if key_ids is None else 104
+    packed = np.zeros((tail + bucket, batch), dtype=np.uint8)
     packed[:32, :n] = pub[start:end].T
     packed[32:96, :n] = sig[start:end].T
     flat = np.frombuffer(b"".join(msgs[start:end]), dtype=np.uint8)
@@ -213,6 +269,10 @@ def pack_inputs(
     packed[96 + bucket : 100 + bucket, :n] = (
         lens.astype("<u4").view(np.uint8).reshape(n, 4).T
     )
+    if key_ids is not None:
+        packed[100 + bucket : 104 + bucket, :n] = (
+            key_ids[start:end].astype("<u4").view(np.uint8).reshape(n, 4).T
+        )
     return packed, bucket
 
 
@@ -220,6 +280,58 @@ def _dispatch(pub, sig, msgs, start, end):
     packed, bucket = pack_inputs(pub, sig, msgs, start, end)
     fn = _compiled(packed.shape[-1], bucket)
     return fn(jax.device_put(packed))
+
+
+_keyed_cache: dict[tuple[int, int, int], object] = {}
+
+
+def _compiled_keyed(bucket: int, window_bits: int, chunk: int):
+    """Jit of the keyed kernel over (buf, table, key_valid); batch and
+    table shapes retrace inside the one jit wrapper (jax caches per
+    shape; table widths are pow2-padded by the table cache so the
+    variant count stays small).  Batches wider than ``chunk`` process
+    in lax.map slices — bounded working set, one dispatch."""
+    key = (bucket, window_bits, chunk)
+    fn = _keyed_cache.get(key)
+    if fn is None:
+        nblocks = (64 + bucket + 17 + 127) // 128
+
+        def run(buf, table, key_valid):
+            batch = buf.shape[-1]
+            if batch <= chunk:
+                return verify_kernel_keyed_packed(
+                    buf, table, key_valid, bucket, nblocks, window_bits
+                )
+            k = batch // chunk
+            chunks = buf.reshape(buf.shape[0], k, chunk).transpose(1, 0, 2)
+            out = jax.lax.map(
+                lambda c: verify_kernel_keyed_packed(
+                    c, table, key_valid, bucket, nblocks, window_bits
+                ),
+                chunks,
+            )
+            return out.reshape(batch)
+
+        fn = jax.jit(run)
+        _keyed_cache[key] = fn
+    return fn
+
+
+def verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs):
+    """Keyed dispatch: ``entry`` is a precompute.KeySetTables covering
+    every key id in ``key_ids``.  Same contract as
+    verify_arrays_async."""
+    n = len(msgs)
+    packed, bucket = pack_inputs(pub, sig, msgs, key_ids=key_ids)
+    batch = packed.shape[-1]
+    if batch > MAX_LAUNCH and batch % MAX_LAUNCH:
+        pad = MAX_LAUNCH - batch % MAX_LAUNCH
+        packed = np.pad(packed, [(0, 0), (0, pad)])
+    fn = _compiled_keyed(bucket, entry.window_bits, MAX_LAUNCH)
+    out = fn(
+        jax.device_put(packed), entry.table, jnp.asarray(entry.valid)
+    )
+    return [(out, n)]
 
 
 def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
@@ -320,11 +432,72 @@ def verify_stream(jobs, max_in_flight: int = 8):
         yield from flush(len(pending))
 
 
-#: Below this batch size the host verifier is faster than a device
-#: launch (fixed dispatch cost + one-time XLA compile per shape); the
-#: device path wins from there up to the 10k-validator north star.
-#: Overridable for benchmarking via CMT_TPU_DEVICE_MIN_BATCH.
+#: Static floor for the device dispatch threshold.  The RUNTIME
+#: threshold is dynamic: a single launch pays the link round trip
+#: (~70 ms on a tunneled axon backend, ~0 on direct-attached), so the
+#: crossover batch n* satisfies n*·t_cpu = RTT + n*·t_dev.  The per-sig
+#: rates come from tools/derive_device_min_batch.py's calibration file;
+#: the RTT is measured live once per process, so a 150-validator commit
+#: is never routed to a path that's slower than the CPU fallback
+#: (reference analog: types/validation.go:15 shouldBatchVerify — batch
+#: only when it wins).
 DEVICE_MIN_BATCH = 64
+
+CALIBRATION_PATH = os.environ.get(
+    "CMT_TPU_CALIBRATION",
+    os.path.join(
+        os.path.expanduser("~"), ".cache", "cometbft_tpu",
+        "device_calibration.json",
+    ),
+)
+
+#: conservative defaults when no calibration file exists (measured r4,
+#: TPU v5e via tunnel: CPU batch ~120 us/sig, device marginal ~5 us/sig)
+_DEFAULT_T_CPU_SIG = 120e-6
+_DEFAULT_T_DEV_SIG = 5e-6
+
+_runtime_threshold: int | None = None
+
+
+def _measure_link_rtt() -> float:
+    """Min of 3 tiny transfer round trips (device_put + host fetch) —
+    the fixed cost every synchronous launch pays."""
+    probe = np.zeros(8, dtype=np.uint8)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_put(probe))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def runtime_device_min_batch() -> int:
+    """The dispatch threshold: env override > calibrated crossover."""
+    global _runtime_threshold
+    env = os.environ.get("CMT_TPU_DEVICE_MIN_BATCH")
+    if env:
+        return int(env)
+    if _runtime_threshold is not None:
+        return _runtime_threshold
+    t_cpu, t_dev = _DEFAULT_T_CPU_SIG, _DEFAULT_T_DEV_SIG
+    try:
+        with open(CALIBRATION_PATH) as f:
+            cal = json.load(f)
+        t_cpu = float(cal.get("t_cpu_per_sig", t_cpu))
+        t_dev = float(cal.get("t_dev_per_sig", t_dev))
+    except (OSError, ValueError):
+        pass
+    try:
+        rtt = _measure_link_rtt()
+    except Exception:  # no usable device: verify() falls back anyway
+        _runtime_threshold = 1 << 30
+        return _runtime_threshold
+    n_star = rtt / max(t_cpu - t_dev, 1e-9)
+    threshold = DEVICE_MIN_BATCH
+    while threshold < n_star and threshold < 16384:
+        threshold <<= 1
+    _runtime_threshold = threshold
+    return threshold
 
 
 class TpuBatchVerifier(BatchVerifier):
@@ -334,9 +507,7 @@ class TpuBatchVerifier(BatchVerifier):
 
     def __init__(self, device_min_batch: int | None = None) -> None:
         if device_min_batch is None:
-            device_min_batch = int(
-                os.environ.get("CMT_TPU_DEVICE_MIN_BATCH", DEVICE_MIN_BATCH)
-            )
+            device_min_batch = runtime_device_min_batch()
         self._device_min_batch = device_min_batch
         self._pubs: list[bytes] = []
         self._msgs: list[bytes] = []
@@ -368,6 +539,30 @@ class TpuBatchVerifier(BatchVerifier):
             return cpu.verify()
         pub = np.frombuffer(b"".join(self._pubs), dtype=np.uint8).reshape(n, 32)
         sig = np.frombuffer(b"".join(self._sigs), dtype=np.uint8).reshape(n, 64)
-        out = verify_arrays(pub, sig, self._msgs)
+        entry = None
+        if not os.environ.get("CMT_TPU_DISABLE_PRECOMPUTE"):
+            from cometbft_tpu.ops import precompute as _pr
+
+            try:
+                entry = _pr.TABLE_CACHE.lookup_or_build(self._pubs)
+            except Exception:
+                entry = None  # any device hiccup -> generic kernel
+        if entry is not None:
+            out = self._run_keyed(
+                entry, entry.key_ids(self._pubs), pub, sig, self._msgs
+            )
+        else:
+            out = self._run_generic(pub, sig, self._msgs)
         results = [bool(v) for v in out]
         return all(results), results
+
+    # dispatch seam: the multi-chip verifier (parallel/mesh.py
+    # ShardedTpuBatchVerifier) overrides these two with mesh-sharded
+    # launches; callers only ever see the BatchVerifier interface.
+    def _run_generic(self, pub, sig, msgs) -> np.ndarray:
+        return _finish(verify_arrays_async(pub, sig, msgs))
+
+    def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
+        return _finish(
+            verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs)
+        )

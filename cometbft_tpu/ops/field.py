@@ -133,11 +133,30 @@ def neg(a):
     return -a
 
 
-def _columns(a, b):
-    """Schoolbook columns cols[j] = sum_i a[i]*b[j-i], shape (51, *b):
-    a padded copy of b is sliced at 26 static offsets and stacked, so
-    the contraction is one elementwise multiply + a single sum over the
-    26-long leading axis — no scatter, no reshape tricks."""
+import os as _os
+
+#: column-formation strategy; the full verify kernel is HBM-bound, so
+#: the winner is whichever materializes fewest bytes inside XLA's big
+#: fused graphs — measured end-to-end (tools/bench_kernel_ab.py), not
+#: in isolated loops (where all variants fuse perfectly).
+COLS_IMPL = _os.environ.get("CMT_TPU_COLS_IMPL", "stack")
+SQUARE_IMPL = _os.environ.get("CMT_TPU_SQUARE_IMPL", "fast")
+
+
+def _tree_sum(terms):
+    while len(terms) > 1:
+        nxt = [
+            terms[k] + terms[k + 1] for k in range(0, len(terms) - 1, 2)
+        ]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
+
+
+def _columns_stack(a, b):
+    """Stack 26 shifted (51, *batch) views of b, multiply, reduce: one
+    concatenate materialized, mul+sum fuse into the reduce."""
     pad = [(NLIMBS - 1, NLIMBS - 1)] + [(0, 0)] * (b.ndim - 1)
     bp = jnp.pad(b, pad)  # (76, *batch)
     s = jnp.stack(
@@ -147,6 +166,23 @@ def _columns(a, b):
         ]
     )  # (26, 51, *batch); s[i, j] = b[j - i]
     return (a[:, None] * s).sum(axis=0, dtype=DTYPE)
+
+
+def _columns_tree(a, b):
+    """Balanced tree-sum of 26 row-shifted elementwise products — no
+    (26, 51, batch) stack; computes only the 676 nonzero products."""
+    spatial = [(0, 0)] * (b.ndim - 1)
+    terms = [
+        jnp.pad(a[i] * b, [(i, NLIMBS - 1 - i)] + spatial)
+        for i in range(NLIMBS)
+    ]
+    return _tree_sum(terms)
+
+
+def _columns(a, b):
+    if COLS_IMPL == "tree":
+        return _columns_tree(a, b)
+    return _columns_stack(a, b)
 
 
 def _fold_high(cols):
@@ -175,8 +211,32 @@ def mul(a, b):
     return relax(_fold_high(_columns(a, b)))
 
 
+def _square_columns(a):
+    """Columns of a*a using the symmetry cols[j] =
+    2*sum_{2i<j} a[i]*a[j-i] + (j even) a[j/2]^2 — 351 products instead
+    of 676.  Bound: 27 * max|a|^2 (13 doubled cross terms + diagonal),
+    so the same operand budget as mul (< 2^13 limbs) stays < 2^31."""
+    spatial = [(0, 0)] * (a.ndim - 1)
+    d = a + a
+    sq = a * a
+    # diagonal a[i]^2 lands at even row 2i: interleave with zeros.
+    diag = jnp.stack([sq, jnp.zeros_like(sq)], axis=1).reshape(
+        2 * NLIMBS, *a.shape[1:]
+    )[: 2 * NLIMBS - 1]
+    terms = [diag]
+    for i in range(NLIMBS - 1):
+        # 2*a[i] * a[i+1:] occupies rows 2i+1 .. i+25
+        prod = d[i] * a[i + 1 :]
+        terms.append(jnp.pad(prod, [(2 * i + 1, NLIMBS - 1 - i)] + spatial))
+    return _tree_sum(terms)
+
+
 def square(a):
-    return mul(a, a)
+    """Field square — dedicated half-product column form (or plain
+    mul(a, a) when CMT_TPU_SQUARE_IMPL=mul)."""
+    if SQUARE_IMPL == "mul":
+        return mul(a, a)
+    return relax(_fold_high(_square_columns(a)))
 
 
 def mul_small(a, k: int):

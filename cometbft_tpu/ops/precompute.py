@@ -1,0 +1,289 @@
+"""Device-resident per-validator precomputation for the verify kernel.
+
+The reference caches expanded public keys in an LRU sized to the
+validator set because the same keys verify every block
+(crypto/ed25519/ed25519.go:43,62-68 — a 4k-entry ExpandedPublicKey
+cache).  On TPU the analogous (and much larger) win is keeping whole
+scalar-multiplication tables device-resident: steady-state commit
+verification then does only SHA-512, the R decompression, and comb
+table adds — no per-launch point decompression or window-table build.
+
+Two table families:
+
+- **Fixed base B** (shared, host-built once): an 8-bit comb
+  ``B_COMB8[w][j] = j * 256^w * B`` in affine-Niels form — 32 mixed
+  adds for [S]B instead of 64.
+
+- **Per-validator-set tables** (device-built): for each key A, comb
+  entries ``j * (2^wb)^w * (-A)`` in *projective* Niels form
+  (Y+X, Y-X, 2Z, 2dT) — keeping Z projective skips the batched field
+  inversion at build time for one extra field mul per add
+  (curve.pt_add_pniels).  Window width adapts to the set size: 8-bit
+  combs (32 adds/verify, ~3.4 MB/key) for sets up to KEY8_MAX keys,
+  4-bit (64 adds, ~430 KB/key) above.
+
+Tables are cached per validator *set* (hash of the sorted unique
+pubkeys) in an LRU bounded by CMT_TPU_TABLE_CACHE_MB.  Set-granular
+caching rebuilds on any rotation, but a build costs ~10 verifies per
+key and a set serves every block until it changes — the steady-state
+amortization the reference's per-key LRU is after.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.crypto import edwards as _ref
+from cometbft_tpu.ops import curve as C
+from cometbft_tpu.ops import field as F
+
+#: largest set that gets 8-bit per-key combs (3.4 MB/key on device)
+KEY8_MAX = int(os.environ.get("CMT_TPU_KEY8_MAX", 256))
+#: largest set we precompute tables for at all
+TABLE_MAX_KEYS = int(os.environ.get("CMT_TPU_TABLE_MAX_KEYS", 16384))
+#: total device bytes across cached sets before LRU eviction
+TABLE_CACHE_MB = int(os.environ.get("CMT_TPU_TABLE_CACHE_MB", 6144))
+
+
+# -- fixed-base 8-bit comb (host-built, shared) ------------------------
+
+_B8_LOCK = threading.Lock()
+_B8: np.ndarray | None = None
+
+
+def b_comb8() -> np.ndarray:
+    """(32, 3, 26, 256) affine-Niels comb of B, gather-friendly layout
+    (entry index on the minor axis). Built lazily: ~8k host EC ops."""
+    global _B8
+    with _B8_LOCK:
+        if _B8 is None:
+            table = np.zeros((32, 256, 3, F.NLIMBS), dtype=np.int32)
+            base = _ref.B_POINT
+            for w in range(32):
+                acc = _ref.IDENTITY
+                for j in range(256):
+                    if j == 0:
+                        table[w, j] = np.stack([F.ONE, F.ONE, F.ZERO])
+                    else:
+                        acc = _ref.pt_add(acc, base)
+                        ax, ay = _ref.pt_to_affine(acc)
+                        table[w, j] = C._niels_from_affine(ax, ay)
+                for _ in range(8):
+                    base = _ref.pt_double(base)
+            _B8 = np.ascontiguousarray(table.transpose(0, 2, 3, 1))
+        return _B8
+
+
+def comb_mul_base8(s_bytes):
+    """[S]B via the 8-bit Niels comb: s_bytes (32, *batch) uint8 (LE
+    scalar encoding; the comb is exact for any 256-bit integer)."""
+    table = jnp.asarray(b_comb8())
+    idx = s_bytes.astype(jnp.int32)
+
+    def body(acc, xs):
+        tbl_w, byte = xs  # (3, 26, 256), (*batch,)
+        e = jnp.take(tbl_w, byte, axis=-1)  # (3, 26, *batch)
+        return C.pt_add_niels(acc, (e[0], e[1], e[2])), None
+
+    acc, _ = lax.scan(body, C.identity(s_bytes.shape[1:]), (table, idx))
+    return acc
+
+
+# -- per-key projective-Niels comb builder (device) --------------------
+
+_BX, _BY = _ref.pt_to_affine(_ref.B_POINT)
+_B_AFFINE = (F.from_int(_BX), F.from_int(_BY))
+
+
+def build_tables_kernel(pub, window_bits: int):
+    """pub (32, n) uint8 -> (table, valid).
+
+    table: (nwin, 4, 26, n * nent) int32 — window-major projective
+    Niels entries ``j * (2^wb)^w * (-A_key)``, minor axis ordered
+    (key, entry) so a verify gathers with ``key_id * nent + window``.
+    valid: (n,) bool — ZIP-215 decompression validity per key; invalid
+    keys get B's table (harmless) and must be masked by callers.
+    """
+    n = pub.shape[-1]
+    nwin = 256 // window_bits
+    nent = 1 << window_bits
+    a_pt, valid = C.decompress(pub)
+    # keep the formulas on-curve for invalid encodings: substitute B
+    bx = F.cvec(_B_AFFINE[0], pub.ndim)
+    by = F.cvec(_B_AFFINE[1], pub.ndim)
+    one = F.cvec(F.ONE, pub.ndim)
+    x = F.select(valid, a_pt[0], jnp.broadcast_to(bx, a_pt[0].shape))
+    y = F.select(valid, a_pt[1], jnp.broadcast_to(by, a_pt[1].shape))
+    z = jnp.broadcast_to(one, y.shape)
+    base = C.pt_neg((x, y, z, F.mul(x, y)))
+
+    def win_body(p, _):
+        out = p
+        for _ in range(window_bits):
+            p = C.pt_double(p)
+        return p, out
+
+    _, bases = lax.scan(win_body, base, None, length=nwin)
+    # (nwin, 26, n) per coord -> windows into the batch: (26, nwin*n)
+    base_flat = tuple(
+        jnp.moveaxis(c, 0, 1).reshape(F.NLIMBS, nwin * n) for c in bases
+    )
+
+    def ent_body(acc, _):
+        return C.pt_add(acc, base_flat), acc  # collect j, carry j+1
+
+    _, entries = lax.scan(
+        ent_body, C.identity((nwin * n,)), None, length=nent
+    )
+    # scan stacked the entry axis in front: (nent, 26, nwin*n) per
+    # coord; field ops want limbs first.
+    ex, ey, ez, et = (jnp.moveaxis(c, 0, 1) for c in entries)
+    t2d = F.mul(et, F.cvec(C.TWO_D_LIMBS, et.ndim))
+    pn = jnp.stack([ey + ex, ey - ex, ez + ez, t2d])  # (4, 26, nent, nwin*n)
+    pn = pn.reshape(4, F.NLIMBS, nent, nwin, n)
+    # -> (nwin, 4, 26, n, nent) -> (nwin, 4, 26, n*nent)
+    pn = jnp.transpose(pn, (3, 0, 1, 4, 2))
+    return pn.reshape(nwin, 4, F.NLIMBS, n * nent), valid
+
+
+_build_cache: dict[tuple[int, int], object] = {}
+
+
+def _compiled_build(n: int, window_bits: int):
+    key = (n, window_bits)
+    fn = _build_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda p: build_tables_kernel(p, window_bits))
+        _build_cache[key] = fn
+    return fn
+
+
+def comb_mul_keyed(table, key_ids, windows, window_bits: int):
+    """Per-key comb: table from build_tables_kernel, key_ids (*batch,)
+    int32, windows (nwin, *batch) int32 LE digit decomposition of k.
+    Returns [k](-A_key) per lane as an extended point."""
+    nent = 1 << window_bits
+    base_idx = key_ids * nent
+
+    def body(acc, xs):
+        tbl_w, win = xs  # (4, 26, m), (*batch,)
+        e = jnp.take(tbl_w, base_idx + win, axis=-1)  # (4, 26, *batch)
+        return C.pt_add_pniels(acc, (e[0], e[1], e[2], e[3])), None
+
+    acc, _ = lax.scan(body, C.identity(key_ids.shape), (table, windows))
+    return acc
+
+
+# -- per-set table cache ----------------------------------------------
+
+
+@dataclass
+class KeySetTables:
+    """A validator set's device-resident tables."""
+
+    sethash: bytes
+    window_bits: int
+    key_index: dict[bytes, int]  # pubkey bytes -> table row
+    table: object                # device array (nwin, 4, 26, n*nent)
+    valid: np.ndarray            # (n,) bool
+    nbytes: int
+
+    def key_ids(self, pubs: list[bytes]) -> np.ndarray:
+        return np.fromiter(
+            (self.key_index[p] for p in pubs), dtype=np.int32, count=len(pubs)
+        )
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
+
+
+class KeyTableCache:
+    """LRU of per-validator-set device tables, bounded by device bytes.
+
+    The reference analog is the expanded-pubkey LRU sized to the
+    validator set (ed25519.go:43); here a whole set is one entry and
+    the bound is device memory, not entry count.
+    """
+
+    def __init__(self, cap_bytes: int = TABLE_CACHE_MB << 20) -> None:
+        self._cap = cap_bytes
+        self._lock = threading.Lock()
+        self._sets: OrderedDict[bytes, KeySetTables] = OrderedDict()
+        self._building: dict[bytes, threading.Event] = {}
+
+    def lookup_or_build(self, pubs: list[bytes]) -> KeySetTables | None:
+        """Device tables covering every key in ``pubs``, building them
+        on a miss; None when the unique-key count is out of policy.
+        Concurrent misses for the same set (consensus addVote + light
+        client racing on a rotation) build ONCE: losers wait on the
+        winner's latch instead of duplicating the device build."""
+        unique = sorted(set(pubs))
+        n = len(unique)
+        if n == 0 or n > TABLE_MAX_KEYS:
+            return None
+        h = hashlib.sha256(b"".join(unique)).digest()
+        while True:
+            with self._lock:
+                entry = self._sets.get(h)
+                if entry is not None:
+                    self._sets.move_to_end(h)
+                    return entry
+                latch = self._building.get(h)
+                if latch is None:
+                    self._building[h] = threading.Event()
+                    break
+            latch.wait()
+        try:
+            entry = self._build(h, unique)
+            with self._lock:
+                self._sets[h] = entry
+                total = sum(e.nbytes for e in self._sets.values())
+                while total > self._cap and len(self._sets) > 1:
+                    _, old = self._sets.popitem(last=False)
+                    total -= old.nbytes
+        finally:
+            with self._lock:
+                self._building.pop(h).set()
+        return entry
+
+    def _build(self, h: bytes, unique: list[bytes]) -> KeySetTables:
+        n = len(unique)
+        window_bits = 8 if n <= KEY8_MAX else 4
+        n_pad = _next_pow2(n)
+        pub = np.zeros((32, n_pad), dtype=np.uint8)
+        for i, p in enumerate(unique):
+            pub[:, i] = np.frombuffer(p, dtype=np.uint8)
+        # pad lanes with B's encoding (a valid key) to keep shapes pow2
+        if n_pad > n:
+            benc = np.frombuffer(
+                _ref.encode_point(_ref.B_POINT), dtype=np.uint8
+            )
+            pub[:, n:] = benc[:, None]
+        fn = _compiled_build(n_pad, window_bits)
+        table, valid = fn(jax.device_put(pub))
+        return KeySetTables(
+            sethash=h,
+            window_bits=window_bits,
+            key_index={p: i for i, p in enumerate(unique)},
+            table=table,
+            valid=np.asarray(valid),
+            nbytes=int(np.prod(table.shape)) * 4,
+        )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._sets.clear()
+
+
+TABLE_CACHE = KeyTableCache()

@@ -126,6 +126,15 @@ def bytes_lt_l(s_bytes):
     return borrow < 0
 
 
+def limbs_to_windows8(limbs16):
+    """(16, *batch) canonical limbs -> (32, *batch) little-endian 8-bit
+    windows (int32), for the 8-bit per-key combs."""
+    lo = limbs16 & 0xFF
+    hi = (limbs16 >> 8) & 0xFF
+    win = jnp.stack([lo, hi], axis=1).reshape(32, *limbs16.shape[1:])
+    return win.astype(jnp.int32)
+
+
 def limbs_to_nibbles(limbs16):
     """(16, *batch) canonical limbs -> (64, *batch) little-endian 4-bit
     windows."""

@@ -95,7 +95,17 @@ class SecretConnection:
         # sort to give both sides the same transcript (secret_connection.go:104)
         lo, hi = sorted((eph_pub, their_eph))
         we_are_lo = eph_pub == lo
-        dh = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
+        try:
+            dh = eph_priv.exchange(
+                X25519PublicKey.from_public_bytes(their_eph)
+            )
+        except ValueError as exc:
+            # the backend rejects low-order/invalid peer points with a
+            # raw ValueError; adversarial pre-auth input must surface
+            # as the typed handshake error (found by guided fuzzing)
+            raise SecretConnectionError(
+                f"invalid ephemeral public key: {exc}"
+            ) from exc
         if dh == b"\x00" * 32:
             raise SecretConnectionError("zero shared secret (low-order point)")
 

@@ -17,10 +17,19 @@ Mesh convention (2-D, ``("blocks", "sigs")``):
 """
 
 from cometbft_tpu.parallel.mesh import (
+    ShardedTpuBatchVerifier,
     all_valid,
+    flat_mesh,
     make_mesh,
     shard_batch,
     sharded_verify_fn,
 )
 
-__all__ = ["all_valid", "make_mesh", "shard_batch", "sharded_verify_fn"]
+__all__ = [
+    "ShardedTpuBatchVerifier",
+    "all_valid",
+    "flat_mesh",
+    "make_mesh",
+    "shard_batch",
+    "sharded_verify_fn",
+]

@@ -1,0 +1,243 @@
+"""Coverage-guided fuzz target registry (reference: test/fuzz/tests/).
+
+Each target = (callable(bytes), allowed-exception tuple, seed builder).
+Seeds are VALID encodings of the protocol in question — mutation from
+valid structures is what makes coverage-guided fuzzing find the deep
+paths that random bytes never reach.
+
+Run ad hoc:    python tools/fuzz.py --target abci_request --time 60
+In the suite:  tests/test_fuzz_guided.py (replay + short guided burst)
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CORPUS_ROOT = os.path.join(HERE, "data", "fuzz_corpus")
+CRASH_ROOT = os.path.join(HERE, "data", "fuzz_crashes")
+
+_ALLOWED = (ValueError, KeyError, IndexError, EOFError, OverflowError)
+
+
+def _seed_abci() -> list[bytes]:
+    from cometbft_tpu.abci import codec
+    from cometbft_tpu.abci import types as T
+
+    reqs = [
+        T.CheckTxRequest(tx=b"tx-bytes", type=1),
+        T.InfoRequest(),
+        T.FinalizeBlockRequest(
+            txs=(b"a", b"b"), hash=b"\x01" * 32, height=3,
+            proposer_address=b"\x02" * 20,
+        ),
+        T.PrepareProposalRequest(max_tx_bytes=1024, height=2),
+    ]
+    return [codec.encode_request(r) for r in reqs]
+
+
+def _abci_target(data: bytes) -> None:
+    from cometbft_tpu.abci import codec
+
+    codec.decode_request(data)
+
+
+def _seed_types() -> list[bytes]:
+    from cometbft_tpu.types import codec as tc
+
+    import helpers as H
+
+    vals, keys = H.make_val_set(3)
+    bid = H.make_block_id()
+    commit = H.make_commit(vals, keys, bid)
+    lb = H.make_light_block(vals, keys)
+    return [
+        tc.encode_commit(commit),
+        tc.encode_header(lb.signed_header.header),
+    ]
+
+
+def _types_target(data: bytes) -> None:
+    from cometbft_tpu.types import codec as tc
+    from cometbft_tpu.types.vote import Proposal, Vote
+
+    for dec in (
+        tc.decode_block, tc.decode_commit, tc.decode_header,
+        tc.decode_evidence, tc.decode_block_id, Vote.decode,
+        Proposal.decode,
+    ):
+        try:
+            dec(data)
+        except _ALLOWED:
+            pass  # each decoder judged independently below by the engine
+
+
+def _seed_mconn() -> list[bytes]:
+    from cometbft_tpu.p2p.conn import connection as mc
+
+    return [
+        mc.encode_packet_msg(0x20, True, b"payload"),
+        mc.encode_packet_msg(0x00, False, b""),
+        mc.encode_packet_ping(),
+        mc.encode_packet_pong(),
+    ]
+
+
+def _mconn_target(data: bytes) -> None:
+    from cometbft_tpu.p2p.conn.connection import decode_packet
+
+    decode_packet(data)
+
+
+def _seed_node_info() -> list[bytes]:
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.node_info import NodeInfo
+
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    nk = NodeKey(ed.gen_priv_key())
+    ni = NodeInfo(
+        node_id=nk.id(),
+        listen_addr="tcp://127.0.0.1:26656",
+        network="chain-fuzz",
+        version="1.0.0",
+        channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30]),
+        moniker="fuzz",
+    )
+    return [ni.encode()]
+
+
+def _node_info_target(data: bytes) -> None:
+    from cometbft_tpu.p2p.node_info import NodeInfo
+
+    NodeInfo.decode(data)
+
+
+def _seed_ws() -> list[bytes]:
+    from cometbft_tpu.rpc.jsonrpc import ws_write_frame
+
+    out = []
+    for payload, opcode in ((b'{"id":1}', 0x1), (b"", 0x9), (b"x" * 200, 0x2)):
+        buf = io.BytesIO()
+        ws_write_frame(buf, payload, opcode)
+        out.append(buf.getvalue())
+    # client-masked frame: set MASK bit + 4-byte key
+    masked = bytearray(out[0])
+    masked[1] |= 0x80
+    key = b"\x01\x02\x03\x04"
+    body = bytes(
+        b ^ key[i % 4] for i, b in enumerate(masked[2:])
+    )
+    out.append(bytes(masked[:2]) + key + body)
+    return out
+
+
+def _ws_target(data: bytes) -> None:
+    from cometbft_tpu.rpc.jsonrpc import ws_read_frame
+
+    ws_read_frame(io.BytesIO(data))
+
+
+def _seed_reactor_msgs() -> list[bytes]:
+    from cometbft_tpu.mempool.reactor import encode_txs
+
+    seeds = [encode_txs([b"tx1", b"tx2"])]
+    try:
+        from cometbft_tpu.p2p.pex.reactor import encode_pex_request
+
+        seeds.append(encode_pex_request())
+    except ImportError:
+        pass
+    return seeds
+
+
+def _reactor_target(data: bytes) -> None:
+    from cometbft_tpu.blocksync.reactor import decode_bs_message
+    from cometbft_tpu.consensus.messages import decode_message
+    from cometbft_tpu.evidence.reactor import decode_evidence_list
+    from cometbft_tpu.mempool.reactor import decode_txs
+    from cometbft_tpu.p2p.pex.reactor import decode_pex_msg
+    from cometbft_tpu.statesync.messages import decode_ss_message
+
+    for dec in (
+        decode_bs_message, decode_message, decode_evidence_list,
+        decode_txs, decode_pex_msg, decode_ss_message,
+    ):
+        try:
+            dec(data)
+        except _ALLOWED:
+            pass
+
+
+def _secretconn_target(data: bytes) -> None:
+    """Pre-auth frame surface: feed raw bytes where ciphertext frames
+    are expected; everything must fail closed with typed errors."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.p2p.conn.secret_connection import (
+        SecretConnection,
+        SecretConnectionError,
+    )
+    import socket as _socket
+
+    a, b = _socket.socketpair()
+    try:
+        a.settimeout(0.25)
+        b.settimeout(0.25)
+        import threading
+
+        def attacker():
+            try:
+                b.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    b.shutdown(_socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=attacker, daemon=True)
+        t.start()
+        try:
+            SecretConnection(a, ed.priv_key_from_secret(b"fuzz-local"))
+        except (SecretConnectionError, OSError, EOFError, TimeoutError):
+            pass
+        t.join(timeout=1)
+    finally:
+        a.close()
+        b.close()
+
+
+def make_fuzzers(names: list[str] | None = None):
+    """Instantiate GuidedFuzzer objects for the named targets."""
+    from cometbft_tpu.utils.fuzzing import GuidedFuzzer
+
+    registry = {
+        "abci_request": (_abci_target, _ALLOWED, _seed_abci),
+        "types_codec": (_types_target, _ALLOWED, _seed_types),
+        "mconn_packet": (_mconn_target, _ALLOWED, _seed_mconn),
+        "node_info": (_node_info_target, _ALLOWED, _seed_node_info),
+        "ws_frame": (_ws_target, _ALLOWED, _seed_ws),
+        "reactor_msgs": (_reactor_target, _ALLOWED, _seed_reactor_msgs),
+        "secret_connection": (
+            _secretconn_target,
+            (OSError, EOFError, TimeoutError),
+            lambda: [b"\x00" * 32, os.urandom(64)],
+        ),
+    }
+    out = []
+    for name, (fn, allowed, seeds) in registry.items():
+        if names and name not in names:
+            continue
+        out.append(
+            GuidedFuzzer(
+                name=name,
+                target=fn,
+                allowed=allowed,
+                corpus_dir=os.path.join(CORPUS_ROOT, name),
+                crash_dir=os.path.join(CRASH_ROOT, name),
+                seeds=seeds(),
+            )
+        )
+    return out

@@ -458,3 +458,82 @@ class TestNativeBackend:
         sig_py = sk.sign(msg)
         assert sig_py == sig_native
         assert pk.verify_signature(msg, sig_native)
+
+
+# -- RFC 9380 known-answer vectors --------------------------------------
+# Appendix K.1: expand_message_xmd(SHA-256) with
+# DST = "QUUX-V01-CS02-with-expander-SHA256-128".  These anchor the
+# expander against the published spec independently of this repo's
+# implementations (Python + native C++ share derivation tooling, so
+# property tests alone cannot catch a systematic deviation).
+
+_K1_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+_K1_VECTORS_32 = [
+    (b"", "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (b"abcdef0123456789",
+     "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1"),
+    (b"q128_" + b"q" * 128,
+     "b23a1d2b4d97b2ef7785562a7e8bac7eed54ed6e97e29aa51bfe3f12ddad1ff9"),
+    (b"a512_" + b"a" * 512,
+     "4623227bcc01293b8c130bf771da8c298dede7383243dc0993d2d94823958c4c"),
+]
+
+
+def test_expand_message_xmd_rfc9380_k1():
+    for msg, want in _K1_VECTORS_32:
+        got = H2.expand_message_xmd(msg, _K1_DST, 32)
+        assert got.hex() == want, f"K.1 vector mismatch for msg={msg!r}"
+
+
+def test_expand_message_xmd_rfc9380_k1_independent_reimpl():
+    """Cross-check the expander against a from-the-pseudocode
+    reimplementation (RFC 9380 section 5.3.1) for arbitrary lengths."""
+
+    def expand_ref(msg: bytes, dst: bytes, n: int) -> bytes:
+        ell = -(-n // 32)
+        assert ell <= 255 and len(dst) <= 255
+        dst_prime = dst + bytes([len(dst)])
+        z_pad = bytes(64)
+        l_i_b = n.to_bytes(2, "big")
+        b0 = hashlib.sha256(z_pad + msg + l_i_b + b"\x00" + dst_prime).digest()
+        bs = [hashlib.sha256(b0 + b"\x01" + dst_prime).digest()]
+        for i in range(2, ell + 1):
+            prev = bytes(x ^ y for x, y in zip(b0, bs[-1]))
+            bs.append(hashlib.sha256(prev + bytes([i]) + dst_prime).digest())
+        return b"".join(bs)[:n]
+
+    for msg in (b"", b"abc", b"tendermint/consensus", bytes(range(100))):
+        for n in (32, 48, 96, 128):
+            assert H2.expand_message_xmd(msg, _K1_DST, n) == expand_ref(
+                msg, _K1_DST, n
+            )
+
+
+def test_hash_to_g2_rfc9380_j10_vectors():
+    """Appendix J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_) full-pipeline
+    known-answer vectors — the anchor that pins the isogeny's sign
+    convention (a Velu derivation is ambiguous up to point negation,
+    which no property test can see but breaks blst wire compat)."""
+    import unittest.mock as um
+
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    vectors = {
+        b"": (
+            (0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+             0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D),
+            (0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+             0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6),
+        ),
+        b"abc": (
+            (0x02C2D18E033B960562AAE3CAB37A27CE00D80CCD5BA4B7FE0E7A210245129DBEC7780CCC7954725F4168AFF2787776E6,
+             0x139CDDBCCDC5E91B9623EFD38C49F81A6F83F175E80B06FC374DE9EB4B41DFE4CA3A230ED250FBE3A2ACF73A41177FD8),
+            (0x1787327B68159716A37440985269CF584BCB1E621D3A7202BE6EA05C4CFE244AEB197642555A0645FB87BF7466B2BA48,
+             0x00AA65DAE3C8D732D10ECD2C50F8A1BAF3001578F71C694E03866E9F3D49AC1E1CE70DD94A733534F106D4CEC0EDDD16),
+        ),
+    }
+    with um.patch.object(H2, "DST", dst):
+        for msg, (want_x, want_y) in vectors.items():
+            x, y = H2.hash_to_g2(msg)
+            assert x == want_x, f"J.10.1 x mismatch for msg={msg!r}"
+            assert y == want_y, f"J.10.1 y mismatch for msg={msg!r}"

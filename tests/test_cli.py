@@ -323,3 +323,132 @@ class TestOpsCommands:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+class TestConfixMigrations:
+    """Version-aware config migration (internal/confix/migrations.go)."""
+
+    V034_FIXTURE = """\
+proxy_app = "tcp://127.0.0.1:26658"
+moniker = "legacy-node"
+fast_sync = false
+
+[p2p]
+laddr = "tcp://0.0.0.0:26656"
+upnp = true
+
+[fastsync]
+version = "v0"
+
+[consensus]
+timeout_propose = "2.5s"
+
+[mempool]
+size = 2222
+"""
+
+    V038_FIXTURE = """\
+version = "0.38.0"
+moniker = "v38-node"
+
+[consensus]
+timeout_prevote = "1.5s"
+timeout_prevote_delta = "700ms"
+timeout_precommit = "9s"
+"""
+
+    def _write(self, tmp_path, text):
+        home = tmp_path / "home"
+        (home / "config").mkdir(parents=True)
+        (home / "config" / "config.toml").write_text(text)
+        return str(home)
+
+    def test_v034_migrates_with_values_carried(self, tmp_path):
+        from cometbft_tpu import confix
+        from cometbft_tpu.config import Config
+
+        home = self._write(tmp_path, self.V034_FIXTURE)
+        steps, _new = confix.migrate(home)
+        actions = {(s.action, s.key) for s in steps}
+        assert ("move", "fast_sync") in actions
+        assert ("drop", "p2p.upnp") in actions
+        cfg = Config.load(home)
+        # operator values survived the rename/normalize
+        assert cfg.base.moniker == "legacy-node"
+        assert cfg.base.block_sync is False  # carried from fast_sync
+        assert cfg.mempool.size == 2222
+        assert cfg.consensus.timeout_propose_ns == 2_500_000_000
+        # original kept
+        assert (tmp_path / "home" / "config" / "config.toml.bak").exists()
+
+    def test_v038_timeout_rename_carries_value(self, tmp_path):
+        from cometbft_tpu import confix
+        from cometbft_tpu.config import Config
+
+        home = self._write(tmp_path, self.V038_FIXTURE)
+        steps, _ = confix.migrate(home, from_version="v0.38")
+        assert any(
+            s.action == "move" and s.key == "consensus.timeout_prevote"
+            for s in steps
+        )
+        cfg = Config.load(home)
+        assert cfg.consensus.timeout_vote_ns == 1_500_000_000
+        assert cfg.consensus.timeout_vote_delta_ns == 700_000_000
+
+    def test_detect_version(self):
+        from cometbft_tpu import confix
+
+        assert confix.detect_version({"fast_sync": True}) == "v0.34"
+        assert confix.detect_version({"block_sync": True}) == "v0.37"
+        assert (
+            confix.detect_version({"consensus.timeout_prevote": "1s"})
+            == "v0.38"
+        )
+        assert confix.detect_version({"moniker": "x"}) == "v1.0"
+
+    def test_dry_run_leaves_file(self, tmp_path, capsys):
+        home = self._write(tmp_path, self.V034_FIXTURE)
+        assert run_cli("--home", home, "confix", "--dry-run") == 0
+        out = capsys.readouterr().out
+        assert "move" in out and "fast_sync" in out
+        assert (
+            tmp_path / "home" / "config" / "config.toml"
+        ).read_text() == self.V034_FIXTURE
+
+    def test_cli_migrates(self, tmp_path, capsys):
+        home = self._write(tmp_path, self.V034_FIXTURE)
+        assert run_cli("--home", home, "confix") == 0
+        from cometbft_tpu.config import Config
+
+        assert Config.load(home).base.moniker == "legacy-node"
+
+    def test_idempotent(self, tmp_path, capsys):
+        home = self._write(tmp_path, self.V034_FIXTURE)
+        assert run_cli("--home", home, "confix") == 0
+        assert run_cli("--home", home, "confix") == 0
+        assert "already at current schema" in capsys.readouterr().out
+
+
+def test_debug_dump_collects_archives(tmp_path, capsys):
+    """debug dump (commands/debug/dump.go analog): periodic tarballs
+    with RPC snapshots; unreachable endpoints recorded as .err, not
+    fatal."""
+    import tarfile
+
+    home = tmp_path / "home"
+    (home / "config").mkdir(parents=True)
+    (home / "config" / "config.toml").write_text("moniker = \"dump-test\"\n")
+    out_dir = tmp_path / "dumps"
+    rc = run_cli(
+        "--home", str(home),
+        "debug", "dump", str(out_dir),
+        "--count", "2", "--frequency", "0.1",
+        "--rpc-laddr", "127.0.0.1:1",  # nothing listening
+    )
+    assert rc == 0
+    archives = sorted(out_dir.glob("*.tar.gz"))
+    assert len(archives) >= 1  # same-second stamps may collapse to one
+    with tarfile.open(archives[0]) as tar:
+        names = tar.getnames()
+    assert any("status.err" in n for n in names)
+    assert any("config.toml" in n for n in names)

@@ -78,3 +78,75 @@ class TestMesh:
             shard_batch(mesh, msglen, ("blocks", "sigs")),
         )
         assert bool(jax.device_get(jax.jit(all_valid)(fn(*args))))
+
+
+class TestShardedSeam:
+    """The production dispatch path: crypto/batch.py selects the mesh
+    verifier when >1 device is visible (VERDICT r3 #3), at light-client
+    scale with shards that do NOT divide evenly into mesh tiles
+    (VERDICT r3 #10 — the padding/masking path is the one that breaks
+    in practice)."""
+
+    def test_factory_selects_sharded(self):
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.crypto.batch import create_batch_verifier
+        from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
+
+        bv = create_batch_verifier(ed.priv_key_from_secret(b"f").pub_key())
+        assert isinstance(bv, ShardedTpuBatchVerifier)
+
+    def test_10k_sigs_uneven_keyed(self):
+        """Light-client shape: >=10k signatures over a 150-key set,
+        batch size deliberately not a multiple of 8 devices or any
+        pow2 tile; exact planted-invalid recovery."""
+        import numpy as np
+
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.ops import precompute as PR
+        from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
+
+        PR.TABLE_CACHE.clear()
+        rng = np.random.RandomState(42)
+        privs = [
+            ed.priv_key_from_secret(b"v%03d" % i) for i in range(150)
+        ]
+        n = 10_007  # prime: never tiles evenly
+        msgs = [b"h%d" % (i // 150) for i in range(n)]
+        bv = ShardedTpuBatchVerifier(device_min_batch=0)
+        expect = np.ones(n, dtype=bool)
+        bad_idx = rng.choice(n, size=97, replace=False)
+        expect[bad_idx] = False
+        bad = set(int(i) for i in bad_idx)
+        for i in range(n):
+            priv = privs[i % 150]
+            s = priv.sign(msgs[i])
+            if i in bad:
+                s = s[:-1] + bytes([s[-1] ^ 1])
+            bv.add(priv.pub_key(), msgs[i], s)
+        ok, results = bv.verify()
+        assert not ok
+        assert np.array_equal(np.array(results), expect)
+
+    def test_generic_path_uneven(self, monkeypatch):
+        """Mesh path with precompute disabled (generic kernel), uneven
+        batch."""
+        import numpy as np
+
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
+
+        monkeypatch.setenv("CMT_TPU_DISABLE_PRECOMPUTE", "1")
+        priv = ed.priv_key_from_secret(b"g")
+        n = 203
+        bv = ShardedTpuBatchVerifier(device_min_batch=0)
+        expect = []
+        for i in range(n):
+            m = b"m%d" % i
+            s = priv.sign(m)
+            good = i % 7 != 2
+            if not good:
+                m = m + b"!"
+            bv.add(priv.pub_key(), m, s)
+            expect.append(good)
+        _, results = bv.verify()
+        assert results == expect

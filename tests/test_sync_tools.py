@@ -221,3 +221,29 @@ class TestConditionIntegration:
             assert mp.wait_for_txs_after(0, timeout=1.0)
         finally:
             proxy.stop()
+
+
+class TestWatchdogTimedAcquire:
+    def test_caller_timeout_returns_false_not_deadlock(self):
+        """A caller-supplied finite timeout shorter than the watchdog
+        limit preserves timed-acquire semantics: return False, no
+        PotentialDeadlock (ADVICE r3: utils/sync.py:67)."""
+        lk = _WatchdogLock(threading.Lock(), timeout=5.0)
+        lk.acquire()
+        try:
+            t0 = time.monotonic()
+            assert lk.acquire(True, 0.05) is False
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            lk.release()
+
+    def test_watchdog_still_fires_for_longer_caller_timeout(self):
+        """When the caller's timeout exceeds the watchdog limit, the
+        watchdog is the binding constraint and diagnoses."""
+        lk = _WatchdogLock(threading.Lock(), timeout=0.05)
+        lk.acquire()
+        try:
+            with pytest.raises(PotentialDeadlock):
+                lk.acquire(True, 10.0)
+        finally:
+            lk.release()

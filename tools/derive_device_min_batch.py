@@ -89,6 +89,33 @@ def main() -> None:
         if winner == "cpu":
             crossover = None  # must win from here on up
 
+    # per-sig slopes + fixed link cost -> calibration file the runtime
+    # threshold (ops/ed25519_verify.runtime_device_min_batch) reads.
+    import os
+
+    from cometbft_tpu.ops.ed25519_verify import CALIBRATION_PATH
+
+    big = rows[-1]
+    mid = next(r for r in rows if r["batch"] >= 1024)
+    t_dev_sig = max(
+        (big["device_ms"] - mid["device_ms"])
+        / 1e3
+        / max(big["batch"] - mid["batch"], 1),
+        1e-7,
+    )
+    t_cpu_sig = big["cpu_ms"] / 1e3 / big["batch"]
+    rtt = max(mid["device_ms"] / 1e3 - mid["batch"] * t_dev_sig, 0.0)
+    cal = {
+        "t_cpu_per_sig": round(t_cpu_sig, 9),
+        "t_dev_per_sig": round(t_dev_sig, 9),
+        "fitted_link_rtt_s": round(rtt, 6),
+        "rows": rows,
+    }
+    os.makedirs(os.path.dirname(CALIBRATION_PATH), exist_ok=True)
+    with open(CALIBRATION_PATH, "w") as f:
+        json.dump(cal, f, indent=1)
+    print(f"calibration written to {CALIBRATION_PATH}", file=sys.stderr)
+
     print(
         json.dumps(
             {
@@ -99,6 +126,9 @@ def main() -> None:
                     else "smallest batch where the device path wins "
                     "end-to-end, stable through the largest measured"
                 ),
+                "calibration": {
+                    k: v for k, v in cal.items() if k != "rows"
+                },
                 "rows": rows,
             }
         )

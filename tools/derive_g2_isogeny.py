@@ -211,6 +211,11 @@ def derive():
             break
     else:
         raise SystemExit("no small rational 6th root for the isomorphism")
+    # RFC 9380's published 3-isogeny uses the NEGATIVE root (s = -1/3):
+    # with s = +1/3 every hashed point comes out negated — valid by all
+    # on-curve/subgroup properties, wire-incompatible with blst.  Pinned
+    # by the appendix J.10.1 KATs (tests/test_bls.py).
+    s = P - s
     s2 = (pow(s, 2, P), 0)
     s3 = (pow(s, 3, P), 0)
 

@@ -1,0 +1,45 @@
+"""Coverage-guided fuzz soak runner (reference: test/fuzz/ CI targets).
+
+    python tools/fuzz.py                     # all targets, 30s each
+    python tools/fuzz.py --target ws_frame --time 600 --execs 2000000
+
+New coverage-growing inputs land in tests/data/fuzz_corpus/<target>/
+(check them in); crashes land in tests/data/fuzz_crashes/<target>/ and
+exit nonzero — turn each into a regression test before clearing it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target", action="append", default=None)
+    ap.add_argument("--time", type=float, default=30.0,
+                    help="seconds per target")
+    ap.add_argument("--execs", type=int, default=1_000_000)
+    args = ap.parse_args()
+
+    from fuzz_targets import make_fuzzers
+
+    failed = False
+    for fz in make_fuzzers(args.target):
+        rep = fz.run(max_execs=args.execs, time_budget_s=args.time)
+        print(rep, flush=True)
+        for c in rep.crashes:
+            print(f"  CRASH {c}", flush=True)
+            failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
