@@ -413,7 +413,32 @@ def _section_toml(name: str | None, section) -> str:
     return "\n".join(lines) + "\n"
 
 
+#: pre-v1.0 keys accepted as aliases so an un-migrated config.toml
+#: keeps the operator's tuned values instead of silently reverting to
+#: defaults (new name wins when both are present; `confix` rewrites
+#: the file properly)
+_LEGACY_KEY_ALIASES: dict[type, dict[str, str]] = {
+    ConsensusConfig: {
+        "timeout_prevote": "timeout_vote",
+        "timeout_prevote_delta": "timeout_vote_delta",
+    },
+}
+
+
 def _section_from_dict(typ: type, data: dict):
+    aliases = _LEGACY_KEY_ALIASES.get(typ, {})
+    if aliases and any(k in data for k in aliases):
+        data = dict(data)
+        for old, new in aliases.items():
+            if old in data and new not in data:
+                import warnings
+
+                warnings.warn(
+                    f"config key '{old}' is pre-v1.0; using its value "
+                    f"for '{new}' — run `confix` to migrate the file",
+                    stacklevel=2,
+                )
+                data[new] = data[old]
     kwargs = {}
     for f in fields(typ):
         key = f.name[:-3] if f.name.endswith("_ns") else f.name

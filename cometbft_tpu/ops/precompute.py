@@ -46,6 +46,7 @@ from jax import lax
 from cometbft_tpu.crypto import edwards as _ref
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as F
+from cometbft_tpu.ops.ed25519_verify import _next_pow2
 
 #: largest set that gets 8-bit per-key combs (3.4 MB/key on device)
 KEY8_MAX = int(os.environ.get("CMT_TPU_KEY8_MAX", 256))
@@ -204,8 +205,6 @@ class KeySetTables:
         )
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
 
 
 class KeyTableCache:

@@ -81,11 +81,21 @@ def all_valid(results) -> jax.Array:
 DATA_AXIS = "d"
 
 
+_FLAT_MESH: Mesh | None = None
+
+
 def flat_mesh(devices=None) -> Mesh:
     """1-D data mesh over all (or the given) devices — the layout the
-    BatchVerifier seam shards its flat signature batch over."""
-    devices = list(devices if devices is not None else jax.devices())
-    return Mesh(np.array(devices), (DATA_AXIS,))
+    BatchVerifier seam shards its flat signature batch over.  The
+    all-devices mesh is cached: verifiers are constructed per
+    VerifyCommit, and a fresh Mesh per call would defeat the
+    table-replication cache keyed on it."""
+    global _FLAT_MESH
+    if devices is not None:
+        return Mesh(np.array(list(devices)), (DATA_AXIS,))
+    if _FLAT_MESH is None:
+        _FLAT_MESH = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+    return _FLAT_MESH
 
 
 class ShardedTpuBatchVerifier(TpuBatchVerifier):
@@ -160,7 +170,7 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         packed = self._pad_cols(packed, chunk=chunk)
         fn = _compiled_keyed(bucket, entry.window_bits, chunk)
         repl = getattr(entry, "_replicated", None)
-        if repl is None or repl[0] is not self._mesh:
+        if repl is None or repl[0] != self._mesh:
             repl = (
                 self._mesh,
                 jax.device_put(

@@ -98,32 +98,64 @@ class FuzzReport:
         )
 
 
-_TOOL_ID = sys.monitoring.COVERAGE_ID
+_TOOL_NAME = "cmt-fuzz"
+_tool_id: int | None = None
+
+
+def _acquire_tool_id() -> int | None:
+    """Claim a sys.monitoring tool id for this process, once.
+
+    Never hijack an id another tool (e.g. coverage.py's sysmon core on
+    COVERAGE_ID) already owns: prefer COVERAGE_ID when free, else the
+    first free id, else None — the fuzzer then runs without coverage
+    feedback rather than corrupting someone else's instrumentation."""
+    global _tool_id
+    if _tool_id is not None:
+        return _tool_id
+    mon = sys.monitoring
+    candidates = [mon.COVERAGE_ID] + [
+        i for i in range(6) if i != mon.COVERAGE_ID
+    ]
+    for tid in candidates:
+        owner = mon.get_tool(tid)
+        if owner == _TOOL_NAME:
+            _tool_id = tid
+            return tid
+        if owner is None:
+            try:
+                mon.use_tool_id(tid, _TOOL_NAME)
+            except ValueError:
+                continue
+            _tool_id = tid
+            return tid
+    return None
 
 
 class _CoverageSensor:
     """New-line detector: the LINE hook disables each line after its
-    first report, so only first-ever executions cost anything."""
+    first report, so only first-ever executions cost anything.  With
+    no free monitoring tool id the sensor degrades to hits=0 (pure
+    random fuzzing) instead of stepping on another tool."""
 
     def __init__(self) -> None:
         self.hits = 0
-        self._registered = False
+        self._tid: int | None = None
 
     def __enter__(self):
-        mon = sys.monitoring
-        try:
-            mon.use_tool_id(_TOOL_ID, "cmt-fuzz")
-        except ValueError:
-            pass  # already ours from a previous engine in this process
-        self._registered = True
-        mon.register_callback(_TOOL_ID, mon.events.LINE, self._on_line)
-        mon.set_events(_TOOL_ID, mon.events.LINE)
+        self._tid = _acquire_tool_id()
+        if self._tid is not None:
+            mon = sys.monitoring
+            mon.register_callback(
+                self._tid, mon.events.LINE, self._on_line
+            )
+            mon.set_events(self._tid, mon.events.LINE)
         return self
 
     def __exit__(self, *exc):
-        mon = sys.monitoring
-        mon.set_events(_TOOL_ID, 0)
-        mon.register_callback(_TOOL_ID, mon.events.LINE, None)
+        if self._tid is not None:
+            mon = sys.monitoring
+            mon.set_events(self._tid, 0)
+            mon.register_callback(self._tid, mon.events.LINE, None)
 
     def _on_line(self, code, line):
         self.hits += 1
