@@ -10,22 +10,124 @@ the device path for free.
 from __future__ import annotations
 
 import os
+import sys
+import threading
 from typing import Callable
 
 from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
+
+# Device availability is probed in a SUBPROCESS: a wedged accelerator
+# plugin can hang `import jax` inside C where the GIL never releases —
+# observed to freeze every thread in the node (consensus froze 50 s
+# mid-round), so neither the caller's thread NOR a helper thread may
+# perform the first import.  Until a probe subprocess proves the
+# device usable, callers get the CPU verifier immediately — consensus
+# liveness beats batch speed.  When jax is already imported (tests,
+# benches, the dryrun), the inline fast path keeps selection
+# deterministic.  A failed probe retries after _PROBE_RETRY_S.
+_probe_lock = threading.Lock()
+_device_state = {"status": "unknown", "ndev": 0, "failed_at": 0.0}
+_PROBE_TIMEOUT_S = float(os.environ.get("CMT_TPU_PROBE_TIMEOUT_S", 20))
+_PROBE_RETRY_S = float(os.environ.get("CMT_TPU_PROBE_RETRY_S", 120))
+
+
+def _probe_subprocess() -> None:
+    import subprocess
+    import time
+
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(len(jax.devices()))",
+            ],
+            capture_output=True,
+            timeout=_PROBE_TIMEOUT_S,
+            text=True,
+        )
+        ndev = int(proc.stdout.strip()) if proc.returncode == 0 else 0
+    except Exception:
+        ndev = 0
+    if ndev > 0:
+        # the tunnel answers; the in-process import should now be
+        # quick (and runs on THIS daemon thread, not a node thread)
+        try:
+            import jax
+
+            _device_state["ndev"] = len(jax.devices())
+            _device_state["status"] = "ready"
+            return
+        except Exception:
+            pass
+    _device_state["failed_at"] = time.monotonic()
+    _device_state["status"] = "failed"
+
+
+def _jax_backends_initialized() -> bool:
+    """True only when some jax backend has ALREADY initialized in this
+    process — merely having `jax` in sys.modules proves nothing (device
+    plugins' sitecustomize imports jax at interpreter startup, and the
+    HANG lives in the first backend init, i.e. the first
+    jax.devices() call, not the import)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _device_ndev() -> int:
+    """Visible device count: 0 while unknown/probing/failed."""
+    import time
+
+    st = _device_state["status"]
+    if st == "ready":
+        return _device_state["ndev"]
+    if st == "probing":
+        return 0
+    if st == "failed" and (
+        time.monotonic() - _device_state["failed_at"] < _PROBE_RETRY_S
+    ):
+        return 0
+    with _probe_lock:
+        st = _device_state["status"]
+        if st == "ready":
+            return _device_state["ndev"]
+        if st == "probing":
+            return 0
+        if _jax_backends_initialized():
+            # a backend is live in-process: devices() is a cheap read
+            try:
+                import jax
+
+                _device_state["ndev"] = len(jax.devices())
+                _device_state["status"] = "ready"
+                return _device_state["ndev"]
+            except Exception:
+                _device_state["failed_at"] = time.monotonic()
+                _device_state["status"] = "failed"
+                return 0
+        _device_state["status"] = "probing"
+        threading.Thread(
+            target=_probe_subprocess, daemon=True, name="device-probe"
+        ).start()
+        return 0
 
 
 def _ed25519_factory() -> BatchVerifier:
     if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
         return _ed.CpuBatchVerifier()
     try:
-        import jax
-
-        if (
-            len(jax.devices()) > 1
-            and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY")
-        ):
+        ndev = _device_ndev()
+        if ndev == 0:
+            return _ed.CpuBatchVerifier()
+        if ndev > 1 and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY"):
             # multi-chip: shard the batch over a 1-D mesh — every
             # caller of this seam scales across chips transparently
             from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier

@@ -13,58 +13,27 @@ suite pins native == python byte-for-byte)."""
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO, "native", "bls", "bls12381.cpp")
-_OUT = os.path.join(_REPO, "native", "build", "libcmtbls.so")
+from cometbft_tpu.utils.native_build import NativeLib
 
+_NATIVE = NativeLib(
+    "native/bls/bls12381.cpp", "libcmtbls.so", "CMT_TPU_NO_NATIVE_BLS"
+)
 _lock = threading.Lock()
 _lib = None
-_tried = False
-
-
-def _build() -> bool:
-    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
-    try:
-        proc = subprocess.run(
-            [
-                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                _SRC, "-o", _OUT + ".tmp",
-            ],
-            capture_output=True,
-            timeout=300,
-        )
-    except (OSError, subprocess.TimeoutExpired):
-        return False
-    if proc.returncode != 0:
-        return False
-    os.replace(_OUT + ".tmp", _OUT)
-    return True
 
 
 def load():
     """The ctypes library, or None when unavailable."""
-    global _lib, _tried
-    if _lib is not None or _tried:
+    global _lib
+    if _lib is not None:
         return _lib
     with _lock:
-        if _lib is not None or _tried:
+        if _lib is not None:
             return _lib
-        _tried = True
-        if os.environ.get("CMT_TPU_NO_NATIVE_BLS"):
-            return None
-        if not os.path.exists(_OUT) and os.path.exists(_SRC):
-            if not _build():
-                return None
-        if not os.path.exists(_OUT):
-            return None
-        try:
-            lib = ctypes.CDLL(_OUT)
-        except OSError:
+        lib = _NATIVE.load()
+        if lib is None:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)  # noqa: F841
         lib.cmt_bls_init.restype = ctypes.c_int

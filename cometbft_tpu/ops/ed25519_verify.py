@@ -402,14 +402,20 @@ def verify_arrays(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     return _finish(verify_arrays_async(pub, sig, msgs))
 
 
-def verify_stream(jobs, max_in_flight: int = 8):
+def verify_stream(jobs, max_in_flight: int = 8, dispatch=None):
     """Pipelined verification: ``jobs`` yields (pub, sig, msgs) tuples;
     yields bool[n] results in order, keeping up to ``max_in_flight``
     jobs outstanding so device compute overlaps host packing and
     transfers.  Completed windows synchronize with a single combined
-    fetch (see _finish) instead of one round trip per job."""
+    fetch (see _finish) instead of one round trip per job.
+
+    ``dispatch`` overrides the async launcher — e.g. a closure over
+    verify_arrays_keyed_async with a hot per-validator table entry, so
+    replay planes stream through the precomputed path."""
     from collections import deque
 
+    if dispatch is None:
+        dispatch = verify_arrays_async
     pending: deque = deque()
 
     def flush(count: int):
@@ -425,7 +431,7 @@ def verify_stream(jobs, max_in_flight: int = 8):
             off += n
 
     for job in jobs:
-        pending.append(verify_arrays_async(*job))
+        pending.append(dispatch(*job))
         if len(pending) >= max_in_flight:
             yield from flush(max(1, len(pending) // 2))
     if pending:

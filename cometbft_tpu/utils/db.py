@@ -245,6 +245,47 @@ class SQLiteDB(DB):
         self._local = threading.local()
 
 
+class CometKVDB(DB):
+    """Native log-structured engine (native/kv/cometkv.cpp) behind the
+    ordered-KV interface — the framework's goleveldb-class backend
+    (reference selects goleveldb/rocksdb/badger/pebble via cometbft-db;
+    config.toml.md:117-120).  Bitcask design: append-only CRC-framed
+    log + in-memory ordered index; write_batch is the durability
+    boundary (one fsync), matching how the stores commit blocks."""
+
+    def __init__(self, path: str):
+        from cometbft_tpu.utils.kv_native import CometKV
+
+        try:
+            self._kv = CometKV(path)
+        except RuntimeError as exc:
+            raise DBError(str(exc)) from exc
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._kv.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._kv.put(key, value)
+
+    def delete(self, key: bytes) -> None:
+        self._kv.delete(key)
+
+    def iterator(self, start=None, end=None):
+        yield from self._kv.iterate(start, end, reverse=False)
+
+    def reverse_iterator(self, start=None, end=None):
+        yield from self._kv.iterate(start, end, reverse=True)
+
+    def write_batch(self, ops):
+        self._kv.batch(ops)
+
+    def compact(self) -> None:
+        self._kv.compact()
+
+    def close(self) -> None:
+        self._kv.close()
+
+
 def open_db(name: str, backend: str = "memdb", dir_: str = ".") -> DB:
     """Backend dispatch (cometbft-db NewDB)."""
     if backend == "memdb":
@@ -254,4 +295,9 @@ def open_db(name: str, backend: str = "memdb", dir_: str = ".") -> DB:
 
         os.makedirs(dir_, exist_ok=True)
         return SQLiteDB(os.path.join(dir_, f"{name}.db"))
+    if backend == "cometkv":
+        import os
+
+        os.makedirs(dir_, exist_ok=True)
+        return CometKVDB(os.path.join(dir_, f"{name}.ckv"))
     raise DBError(f"unknown db backend {backend!r}")

@@ -1,0 +1,199 @@
+"""ctypes binding for the native cometkv storage engine.
+
+The reference ships pluggable storage backends (goleveldb default,
+rocksdb/badger/pebble selectable); `native/kv/cometkv.cpp` is this
+framework's native engine — a Bitcask-style append-only log with an
+in-memory ordered index (see the C++ header comment for the format).
+Build-on-demand with graceful absence, same pattern as
+crypto/bls_native.py; select with db_backend = "cometkv".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from cometbft_tpu.utils.native_build import NativeLib
+
+_NATIVE = NativeLib(
+    "native/kv/cometkv.cpp", "libcmtkv.so", "CMT_TPU_NO_NATIVE_KV"
+)
+_sig_lock = threading.Lock()
+_configured = None
+
+
+def load():
+    """The ctypes library (signatures configured), or None."""
+    global _configured
+    if _configured is not None:
+        return _configured
+    with _sig_lock:
+        if _configured is not None:
+            return _configured
+        lib = _NATIVE.load()
+        if lib is None:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ckv_open.restype = ctypes.c_void_p
+        lib.ckv_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ckv_get.restype = ctypes.c_int
+        lib.ckv_get.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ckv_free.argtypes = [u8p]
+        lib.ckv_put.restype = ctypes.c_int
+        lib.ckv_put.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
+        ]
+        lib.ckv_del.restype = ctypes.c_int
+        lib.ckv_del.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
+        lib.ckv_batch.restype = ctypes.c_int
+        lib.ckv_batch.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
+        lib.ckv_iter.restype = ctypes.c_void_p
+        lib.ckv_iter.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.ckv_iter_next.restype = ctypes.c_int
+        lib.ckv_iter_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.ckv_iter_close.argtypes = [ctypes.c_void_p]
+        lib.ckv_compact.restype = ctypes.c_int
+        lib.ckv_compact.argtypes = [ctypes.c_void_p]
+        lib.ckv_sync.restype = ctypes.c_int
+        lib.ckv_sync.argtypes = [ctypes.c_void_p]
+        lib.ckv_count.restype = ctypes.c_uint64
+        lib.ckv_count.argtypes = [ctypes.c_void_p]
+        lib.ckv_dead_bytes.restype = ctypes.c_uint64
+        lib.ckv_dead_bytes.argtypes = [ctypes.c_void_p]
+        lib.ckv_close.argtypes = [ctypes.c_void_p]
+        _configured = lib
+        return _configured
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _u8(b: bytes):
+    return ctypes.cast(
+        ctypes.create_string_buffer(b, len(b) or 1),
+        ctypes.POINTER(ctypes.c_uint8),
+    )
+
+
+class CometKV:
+    """Thin handle wrapper; cometbft_tpu.utils.db.CometKVDB adapts it
+    to the DB interface."""
+
+    def __init__(self, path: str):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native cometkv unavailable")
+        self._lib = lib
+        err = ctypes.create_string_buffer(256)
+        self._h = lib.ckv_open(path.encode(), err, 256)
+        if not self._h:
+            raise RuntimeError(
+                f"cometkv open failed: {err.value.decode()}"
+            )
+
+    def get(self, key: bytes) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int()
+        rc = self._lib.ckv_get(
+            self._h, _u8(key), len(key), ctypes.byref(out),
+            ctypes.byref(n),
+        )
+        if rc < 0:
+            raise RuntimeError("cometkv get failed")
+        if rc == 0:
+            return None
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            self._lib.ckv_free(out)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if self._lib.ckv_put(
+            self._h, _u8(key), len(key), _u8(value), len(value)
+        ) != 0:
+            raise RuntimeError("cometkv put failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.ckv_del(self._h, _u8(key), len(key)) != 0:
+            raise RuntimeError("cometkv delete failed")
+
+    def batch(self, ops: list[tuple[bytes, bytes | None]]) -> None:
+        buf = bytearray()
+        for key, value in ops:
+            if value is None:
+                buf.append(1)
+                buf += len(key).to_bytes(4, "little")
+                buf += key
+            else:
+                buf.append(0)
+                buf += len(key).to_bytes(4, "little")
+                buf += key
+                buf += len(value).to_bytes(4, "little")
+                buf += value
+        if self._lib.ckv_batch(self._h, _u8(bytes(buf)), len(buf)) != 0:
+            raise RuntimeError("cometkv batch failed")
+
+    def iterate(self, start: bytes | None, end: bytes | None,
+                reverse: bool = False):
+        s = start or b""
+        e = end or b""
+        it = self._lib.ckv_iter(
+            self._h, _u8(s), len(s), _u8(e), len(e), int(reverse)
+        )
+        if not it:
+            raise RuntimeError("cometkv iterator failed")
+        k = ctypes.POINTER(ctypes.c_uint8)()
+        v = ctypes.POINTER(ctypes.c_uint8)()
+        kl = ctypes.c_int()
+        vl = ctypes.c_int()
+        try:
+            while True:
+                rc = self._lib.ckv_iter_next(
+                    it, ctypes.byref(k), ctypes.byref(kl),
+                    ctypes.byref(v), ctypes.byref(vl),
+                )
+                if rc < 0:
+                    raise RuntimeError("cometkv iteration failed")
+                if rc == 0:
+                    return
+                yield (
+                    ctypes.string_at(k, kl.value),
+                    ctypes.string_at(v, vl.value),
+                )
+        finally:
+            self._lib.ckv_iter_close(it)
+
+    def compact(self) -> None:
+        rc = self._lib.ckv_compact(self._h)
+        if rc == -2:
+            return  # live iterators; skip this cycle
+        if rc != 0:
+            raise RuntimeError("cometkv compact failed")
+
+    def sync(self) -> None:
+        if self._lib.ckv_sync(self._h) != 0:
+            raise RuntimeError("cometkv sync failed")
+
+    def count(self) -> int:
+        return int(self._lib.ckv_count(self._h))
+
+    def dead_bytes(self) -> int:
+        return int(self._lib.ckv_dead_bytes(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ckv_close(self._h)
+            self._h = None

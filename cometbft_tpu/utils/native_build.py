@@ -1,0 +1,76 @@
+"""Shared build-on-demand loader for the in-tree C++ components.
+
+One implementation of the pattern crypto/bls_native.py and
+utils/kv_native.py previously each carried: compile the single-file
+source with g++ when the .so is missing, load via ctypes, degrade
+gracefully when the toolchain or library is unavailable.  The temp
+output is pid-unique so concurrent builders (parallel test workers on
+a clean checkout) cannot replace each other's half-written object.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class NativeLib:
+    """Lazily built + loaded shared library handle."""
+
+    def __init__(self, src_rel: str, out_name: str,
+                 disable_env: str) -> None:
+        self.src = os.path.join(REPO, src_rel)
+        self.out = os.path.join(REPO, "native", "build", out_name)
+        self.disable_env = disable_env
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._tried = False
+
+    def _build(self) -> bool:
+        os.makedirs(os.path.dirname(self.out), exist_ok=True)
+        tmp = f"{self.out}.tmp.{os.getpid()}"
+        try:
+            proc = subprocess.run(
+                [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    self.src, "-o", tmp,
+                ],
+                capture_output=True,
+                timeout=300,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if proc.returncode != 0:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        os.replace(tmp, self.out)
+        return True
+
+    def load(self) -> ctypes.CDLL | None:
+        """The ctypes library, or None when unavailable."""
+        if self._lib is not None or self._tried:
+            return self._lib
+        with self._lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            if os.environ.get(self.disable_env):
+                return None
+            if not os.path.exists(self.out) and os.path.exists(self.src):
+                if not self._build():
+                    return None
+            if not os.path.exists(self.out):
+                return None
+            try:
+                self._lib = ctypes.CDLL(self.out)
+            except OSError:
+                self._lib = None
+            return self._lib

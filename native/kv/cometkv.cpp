@@ -1,0 +1,519 @@
+// cometkv — log-structured ordered KV store (native storage backend).
+//
+// The reference node selects among goleveldb/rocksdb/badger/pebble
+// through the cometbft-db seam (docs/references/config/config.toml.md:
+// 117-120).  This is the framework's native equivalent behind
+// cometbft_tpu/utils/db.py's ordered-KV interface: a Bitcask-style
+// design — one append-only CRC-framed data log, an in-memory ordered
+// index mapping keys to (offset, length), batch-grained fsync, and
+// stop-at-first-corrupt-record recovery so a crash mid-append loses at
+// most the unsynced tail.
+//
+// Record framing:  [crc32 u32][klen u32][vlen i32][key][value]
+//   vlen == -1 marks a tombstone (no value bytes); vlen == -2 with
+//   klen == 0 is a COMMIT MARKER.  crc covers klen|vlen|key|value.
+// Batch op buffer (ckv_batch): repeated [op u8][klen u32][key]
+//   ([vlen u32][value] when op==0);  op 0=put, 1=delete.  One fsync.
+//
+// Crash atomicity: every logical write group (a batch, or a single
+// put/delete) is its records followed by a commit marker.  Recovery
+// stages records in a pending buffer and applies them only when the
+// group's marker is reached; a torn tail therefore drops the WHOLE
+// half-written group, never a prefix of it — the same all-or-nothing
+// contract the SQLite backend gets from transactions.
+//
+// Concurrency: a coarse mutex per DB; iterators snapshot the key range
+// at creation and read values lazily (they tolerate later writes, and
+// compaction is excluded while any iterator is live).  The DB handle
+// is refcounted against live iterators: close() with a suspended
+// iterator defers the actual free to the last iterator close.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---- crc32 (IEEE 802.3 polynomial, table driven) ---------------------
+
+uint32_t crc_table[256];
+struct CrcInit {
+    CrcInit() {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            crc_table[i] = c;
+        }
+    }
+} crc_init_once;
+
+uint32_t crc32(const uint8_t* p, size_t n, uint32_t crc = 0) {
+    crc = ~crc;
+    while (n--) crc = crc_table[(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    return ~crc;
+}
+
+void put_u32(std::string& s, uint32_t v) {
+    char b[4] = {char(v), char(v >> 8), char(v >> 16), char(v >> 24)};
+    s.append(b, 4);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+    return uint32_t(p[0]) | uint32_t(p[1]) << 8 | uint32_t(p[2]) << 16 |
+           uint32_t(p[3]) << 24;
+}
+
+struct Entry {
+    uint64_t value_off;  // file offset of the VALUE bytes
+    int32_t value_len;
+};
+
+struct DB {
+    std::mutex mu;
+    std::string path;       // data log path
+    int fd = -1;
+    uint64_t file_size = 0;
+    std::map<std::string, Entry> index;
+    int live_iters = 0;
+    bool closing = false;
+    uint64_t dead_bytes = 0;  // garbage from overwrites/deletes
+
+    ~DB() {
+        if (fd >= 0) ::close(fd);
+    }
+};
+
+struct Iter {
+    DB* db;
+    std::vector<std::string> keys;
+    size_t pos = 0;
+    std::string val_buf;
+    std::string key_buf;
+};
+
+// append a framed record; returns offset of the VALUE bytes within
+// the file, or -1 on IO error (a torn partial append is rolled back
+// with ftruncate so the log never carries garbage between records)
+int64_t append_record(DB* db, const std::string& key, const uint8_t* val,
+                      int32_t vlen) {
+    std::string rec;
+    rec.reserve(12 + key.size() + (vlen > 0 ? vlen : 0));
+    std::string body;
+    put_u32(body, (uint32_t)key.size());
+    put_u32(body, (uint32_t)vlen);
+    body.append(key);
+    if (vlen > 0) body.append((const char*)val, vlen);
+    uint32_t crc = crc32((const uint8_t*)body.data(), body.size());
+    put_u32(rec, crc);
+    rec.append(body);
+    size_t off = 0;
+    while (off < rec.size()) {
+        ssize_t n = ::write(db->fd, rec.data() + off, rec.size() - off);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            // roll the torn bytes back so later appends land where
+            // the index thinks they do
+            (void)ftruncate(db->fd, (off_t)db->file_size);
+            (void)lseek(db->fd, 0, SEEK_END);
+            return -1;
+        }
+        off += (size_t)n;
+    }
+    uint64_t value_off =
+        db->file_size + 12 + key.size();  // crc+klen+vlen+key
+    db->file_size += rec.size();
+    return (int64_t)value_off;
+}
+
+constexpr int32_t kTombstone = -1;
+constexpr int32_t kCommitMarker = -2;
+
+// apply one staged record to the index (marker already consumed)
+void apply_entry(DB* db, const std::string& key, uint64_t value_off,
+                 int32_t vlen) {
+    if (vlen == kTombstone) {
+        auto it = db->index.find(key);
+        if (it != db->index.end()) {
+            db->dead_bytes +=
+                2 * (12 + key.size()) + (uint64_t)it->second.value_len;
+            db->index.erase(it);
+        }
+        return;
+    }
+    auto it = db->index.find(key);
+    if (it != db->index.end())
+        db->dead_bytes += 12 + key.size() + (uint64_t)it->second.value_len;
+    db->index[key] = Entry{value_off, vlen};
+}
+
+// commit marker record after a write group; -1 on IO error
+int append_marker(DB* db) {
+    return append_record(db, std::string(), nullptr, kCommitMarker) < 0
+               ? -1
+               : 0;
+}
+
+void maybe_free(DB* db, std::unique_lock<std::mutex>& lock) {
+    bool gone = db->closing && db->live_iters == 0;
+    lock.unlock();
+    if (gone) delete db;
+}
+
+bool read_exact_at(int fd, uint64_t off, uint8_t* buf, size_t n) {
+    size_t done = 0;
+    while (done < n) {
+        ssize_t r = ::pread(fd, buf + done, n - done, (off_t)(off + done));
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        if (r == 0) return false;
+        done += (size_t)r;
+    }
+    return true;
+}
+
+// scan the log, rebuilding the index.  Records are staged per write
+// group and applied only when the group's commit marker is reached;
+// an uncommitted or corrupt tail is truncated at the last committed
+// group boundary — whole-group all-or-nothing recovery.
+bool recover(DB* db, std::string& err) {
+    struct stat st;
+    if (fstat(db->fd, &st) != 0) {
+        err = "fstat failed";
+        return false;
+    }
+    uint64_t size = (uint64_t)st.st_size;
+    uint64_t pos = 0;        // scan cursor
+    uint64_t committed = 0;  // end of last committed group
+    std::vector<uint8_t> hdr(12);
+    std::string key;
+    std::vector<uint8_t> body;
+    struct Staged {
+        std::string key;
+        uint64_t value_off;
+        int32_t vlen;
+    };
+    std::vector<Staged> pending;
+    while (pos + 12 <= size) {
+        if (!read_exact_at(db->fd, pos, hdr.data(), 12)) break;
+        uint32_t crc = get_u32(hdr.data());
+        uint32_t klen = get_u32(hdr.data() + 4);
+        int32_t vlen = (int32_t)get_u32(hdr.data() + 8);
+        if (klen > (1u << 30) || vlen > (1 << 30)) break;  // insane
+        uint64_t vbytes = vlen > 0 ? (uint64_t)vlen : 0;
+        if (pos + 12 + klen + vbytes > size) break;  // short tail
+        body.resize(8 + klen + vbytes);
+        if (!read_exact_at(db->fd, pos + 4, body.data(), body.size()))
+            break;
+        if (crc32(body.data(), body.size()) != crc) break;  // corrupt
+        key.assign((const char*)body.data() + 8, klen);
+        pos += 12 + klen + vbytes;
+        if (vlen == kCommitMarker) {
+            for (auto& s : pending)
+                apply_entry(db, s.key, s.value_off, s.vlen);
+            pending.clear();
+            committed = pos;
+        } else {
+            pending.push_back(
+                Staged{key, pos - vbytes, vlen});
+        }
+    }
+    if (committed < size) {
+        if (ftruncate(db->fd, (off_t)committed) != 0) {
+            err = "tail truncate failed";
+            return false;
+        }
+    }
+    db->file_size = committed;
+    if (lseek(db->fd, 0, SEEK_END) < 0) {
+        err = "seek failed";
+        return false;
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ckv_open(const char* path, char* err, int errlen) {
+    auto* db = new DB();
+    db->path = path;
+    db->fd = ::open(path, O_RDWR | O_CREAT | O_APPEND, 0644);
+    std::string e;
+    if (db->fd < 0) {
+        e = std::string("open failed: ") + strerror(errno);
+    } else if (!recover(db, e)) {
+        // e set by recover
+    } else {
+        return db;
+    }
+    if (err && errlen > 0) {
+        snprintf(err, (size_t)errlen, "%s", e.c_str());
+    }
+    delete db;
+    return nullptr;
+}
+
+void ckv_free(uint8_t* p) { free(p); }
+
+// returns 1 found, 0 missing, -1 error
+int ckv_get(void* h, const uint8_t* k, int klen, uint8_t** val,
+            int* vlen) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    auto it = db->index.find(std::string((const char*)k, klen));
+    if (it == db->index.end()) return 0;
+    auto* buf = (uint8_t*)malloc(it->second.value_len ? it->second.value_len : 1);
+    if (!buf) return -1;
+    if (!read_exact_at(db->fd, it->second.value_off, buf,
+                       (size_t)it->second.value_len)) {
+        free(buf);
+        return -1;
+    }
+    *val = buf;
+    *vlen = it->second.value_len;
+    return 1;
+}
+
+int ckv_put(void* h, const uint8_t* k, int klen, const uint8_t* v,
+            int vlen) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    std::string key((const char*)k, klen);
+    uint64_t undo = db->file_size;
+    int64_t off = append_record(db, key, v, vlen);
+    if (off < 0 || append_marker(db) < 0) {
+        (void)ftruncate(db->fd, (off_t)undo);
+        (void)lseek(db->fd, 0, SEEK_END);
+        db->file_size = undo;
+        return -1;
+    }
+    apply_entry(db, key, (uint64_t)off, vlen);
+    return 0;
+}
+
+int ckv_del(void* h, const uint8_t* k, int klen) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    std::string key((const char*)k, klen);
+    if (db->index.find(key) == db->index.end()) return 0;
+    uint64_t undo = db->file_size;
+    if (append_record(db, key, nullptr, kTombstone) < 0 ||
+        append_marker(db) < 0) {
+        (void)ftruncate(db->fd, (off_t)undo);
+        (void)lseek(db->fd, 0, SEEK_END);
+        db->file_size = undo;
+        return -1;
+    }
+    apply_entry(db, key, 0, kTombstone);
+    return 0;
+}
+
+// one crash-atomic batch: records + commit marker, ONE fsync; on any
+// failure the whole group is rolled back in-file and in-memory state
+// is untouched (the index updates only after the marker lands)
+int ckv_batch(void* h, const uint8_t* buf, int buflen) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    uint64_t undo = db->file_size;
+    struct Staged {
+        std::string key;
+        uint64_t value_off;
+        int32_t vlen;
+    };
+    std::vector<Staged> staged;
+    int pos = 0;
+    bool ok = true;
+    while (pos < buflen) {
+        if (pos + 5 > buflen) { ok = false; break; }
+        uint8_t op = buf[pos];
+        uint32_t klen = get_u32(buf + pos + 1);
+        pos += 5;
+        if (pos + (int)klen > buflen) { ok = false; break; }
+        std::string key((const char*)buf + pos, klen);
+        pos += klen;
+        if (op == 0) {
+            if (pos + 4 > buflen) { ok = false; break; }
+            uint32_t vlen = get_u32(buf + pos);
+            pos += 4;
+            if (pos + (int)vlen > buflen) { ok = false; break; }
+            int64_t off = append_record(db, key, buf + pos, (int32_t)vlen);
+            if (off < 0) { ok = false; break; }
+            staged.push_back(Staged{key, (uint64_t)off, (int32_t)vlen});
+            pos += vlen;
+        } else if (op == 1) {
+            if (append_record(db, key, nullptr, kTombstone) < 0) {
+                ok = false;
+                break;
+            }
+            staged.push_back(Staged{key, 0, kTombstone});
+        } else {
+            ok = false;
+            break;
+        }
+    }
+    if (ok) ok = append_marker(db) == 0;
+    if (ok) ok = fsync(db->fd) == 0;
+    if (!ok) {
+        (void)ftruncate(db->fd, (off_t)undo);
+        (void)lseek(db->fd, 0, SEEK_END);
+        db->file_size = undo;
+        return -1;
+    }
+    for (auto& s : staged) apply_entry(db, s.key, s.value_off, s.vlen);
+    return 0;
+}
+
+uint64_t ckv_count(void* h) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    return db->index.size();
+}
+
+// iterator over [start, end); empty start/end = unbounded
+void* ckv_iter(void* h, const uint8_t* start, int slen, const uint8_t* end,
+               int elen, int reverse) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    auto* it = new Iter();
+    it->db = db;
+    auto lo = slen ? db->index.lower_bound(
+                         std::string((const char*)start, slen))
+                   : db->index.begin();
+    auto hi = elen ? db->index.lower_bound(
+                         std::string((const char*)end, elen))
+                   : db->index.end();
+    for (auto p = lo; p != hi; ++p) it->keys.push_back(p->first);
+    if (reverse) std::reverse(it->keys.begin(), it->keys.end());
+    db->live_iters++;
+    return it;
+}
+
+// 1 = produced a pair, 0 = exhausted, -1 = error.  Pointers are valid
+// until the next call on this iterator.
+int ckv_iter_next(void* hi, const uint8_t** k, int* klen,
+                  const uint8_t** v, int* vlen) {
+    auto* it = (Iter*)hi;
+    DB* db = it->db;
+    std::lock_guard<std::mutex> lock(db->mu);
+    if (db->closing || db->fd < 0) return -1;  // DB closed under us
+    while (it->pos < it->keys.size()) {
+        const std::string& key = it->keys[it->pos++];
+        auto e = db->index.find(key);
+        if (e == db->index.end()) continue;  // deleted after snapshot
+        it->val_buf.resize((size_t)e->second.value_len);
+        if (e->second.value_len > 0 &&
+            !read_exact_at(db->fd, e->second.value_off,
+                           (uint8_t*)it->val_buf.data(),
+                           (size_t)e->second.value_len))
+            return -1;
+        it->key_buf = key;
+        *k = (const uint8_t*)it->key_buf.data();
+        *klen = (int)it->key_buf.size();
+        *v = (const uint8_t*)it->val_buf.data();
+        *vlen = (int)it->val_buf.size();
+        return 1;
+    }
+    return 0;
+}
+
+void ckv_iter_close(void* hi) {
+    auto* it = (Iter*)hi;
+    DB* db = it->db;
+    std::unique_lock<std::mutex> lock(db->mu);
+    db->live_iters--;
+    delete it;
+    maybe_free(db, lock);  // last iterator after close() frees the DB
+}
+
+// rewrite live records into a fresh log; atomic rename over the old
+int ckv_compact(void* h) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    if (db->live_iters > 0) return -2;  // busy; caller may retry
+    std::string tmp = db->path + ".compact";
+    int nfd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC | O_APPEND,
+                     0644);
+    if (nfd < 0) return -1;
+    DB fresh;
+    fresh.fd = nfd;
+    fresh.file_size = 0;
+    std::map<std::string, Entry> nindex;
+    std::string val;
+    for (auto& kv : db->index) {
+        val.resize((size_t)kv.second.value_len);
+        if (kv.second.value_len > 0 &&
+            !read_exact_at(db->fd, kv.second.value_off,
+                           (uint8_t*)val.data(),
+                           (size_t)kv.second.value_len)) {
+            ::close(nfd);
+            ::unlink(tmp.c_str());
+            return -1;
+        }
+        int64_t off = append_record(&fresh, kv.first,
+                                    (const uint8_t*)val.data(),
+                                    kv.second.value_len);
+        if (off < 0) {
+            ::close(nfd);
+            ::unlink(tmp.c_str());
+            return -1;
+        }
+        nindex[kv.first] = Entry{(uint64_t)off, kv.second.value_len};
+    }
+    if (append_marker(&fresh) != 0) {
+        ::close(nfd);
+        ::unlink(tmp.c_str());
+        return -1;
+    }
+    if (fsync(nfd) != 0 || ::rename(tmp.c_str(), db->path.c_str()) != 0) {
+        ::close(nfd);
+        ::unlink(tmp.c_str());
+        return -1;
+    }
+    ::close(db->fd);
+    db->fd = nfd;
+    fresh.fd = -1;  // ownership moved
+    db->file_size = fresh.file_size;
+    db->index.swap(nindex);
+    db->dead_bytes = 0;
+    return 0;
+}
+
+int ckv_sync(void* h) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    return fsync(db->fd) == 0 ? 0 : -1;
+}
+
+uint64_t ckv_dead_bytes(void* h) {
+    auto* db = (DB*)h;
+    std::lock_guard<std::mutex> lock(db->mu);
+    return db->dead_bytes;
+}
+
+void ckv_close(void* h) {
+    auto* db = (DB*)h;
+    std::unique_lock<std::mutex> lock(db->mu);
+    if (db->fd >= 0) {
+        fsync(db->fd);
+        ::close(db->fd);
+        db->fd = -1;
+    }
+    db->closing = true;
+    maybe_free(db, lock);  // defers to last live iterator if any
+}
+
+}  // extern "C"

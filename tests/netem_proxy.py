@@ -1,0 +1,158 @@
+"""TCP network-emulation proxy for e2e WAN tests.
+
+Reference analog: the e2e testnet's latency-emulation zones and
+docker-level partitions (test/e2e/pkg/infra/docker + tc netem in the
+QA methodology, CometBFT-QA-v1.md "emulated WAN latency").  Containers
+here can't use tc, so emulation happens at the TCP relay level: nodes
+dial each other through NetemProxy listeners that forward to the real
+node ports with injected one-way latency, and can drop links entirely
+(partition) or heal them.
+
+The proxy is protocol-transparent: SecretConnection handshakes and
+MConnection framing pass through untouched, so everything the node
+stack does over TCP — including auth against the *target's* node id —
+works unchanged.
+"""
+
+from __future__ import annotations
+
+import heapq
+import socket
+import threading
+import time
+
+
+class _Pump(threading.Thread):
+    """One direction of a proxied connection with delayed delivery."""
+
+    def __init__(self, src: socket.socket, dst: socket.socket,
+                 delay_s: float, closed: threading.Event):
+        super().__init__(daemon=True)
+        self.src, self.dst = src, dst
+        self.delay = delay_s
+        self.closed = closed
+        self._q: list[tuple[float, int, bytes]] = []
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+
+    def run(self) -> None:
+        self._sender.start()
+        try:
+            while not self.closed.is_set():
+                try:
+                    chunk = self.src.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                due = time.monotonic() + self.delay
+                with self._cv:
+                    heapq.heappush(self._q, (due, self._seq, chunk))
+                    self._seq += 1
+                    self._cv.notify()
+        finally:
+            self.closed.set()
+            with self._cv:
+                self._cv.notify()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self.closed.is_set():
+                    self._cv.wait(timeout=0.5)
+                if not self._q:
+                    if self.closed.is_set():
+                        break
+                    continue
+                due, _, chunk = self._q[0]
+                now = time.monotonic()
+                if due > now:
+                    self._cv.wait(timeout=due - now)
+                    continue
+                heapq.heappop(self._q)
+            try:
+                self.dst.sendall(chunk)
+            except OSError:
+                self.closed.set()
+                break
+        try:
+            self.dst.close()
+        except OSError:
+            pass
+
+
+class NetemProxy:
+    """Listens on an ephemeral port; forwards to (host, port) with
+    one-way ``latency_ms`` in each direction.  ``partition()`` drops
+    every live connection and refuses new ones until ``heal()``."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 latency_ms: float = 0.0):
+        self.target = (target_host, target_port)
+        self.latency_s = latency_ms / 1e3
+        self._partitioned = threading.Event()
+        self._stop = threading.Event()
+        self._conns: list[threading.Event] = []
+        self._lock = threading.Lock()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                cli, _ = self._lsock.accept()
+            except OSError:
+                break
+            if self._partitioned.is_set():
+                cli.close()
+                continue
+            try:
+                srv = socket.create_connection(self.target, timeout=5)
+            except OSError:
+                cli.close()
+                continue
+            closed = threading.Event()
+            with self._lock:
+                self._conns.append(closed)
+                self._conns = [c for c in self._conns if not c.is_set()]
+            a = _Pump(cli, srv, self.latency_s, closed)
+            b = _Pump(srv, cli, self.latency_s, closed)
+            a.start()
+            b.start()
+
+            def reaper(cli=cli, srv=srv, closed=closed):
+                closed.wait()
+                for s in (cli, srv):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+            threading.Thread(target=reaper, daemon=True).start()
+
+    def partition(self) -> None:
+        """Cut the link: kill live connections, refuse new ones."""
+        self._partitioned.set()
+        with self._lock:
+            for closed in self._conns:
+                closed.set()
+            self._conns.clear()
+
+    def heal(self) -> None:
+        self._partitioned.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.partition()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass

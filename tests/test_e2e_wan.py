@@ -1,0 +1,138 @@
+"""WAN-behavior e2e: latency zones + TCP-level partitions.
+
+Reference analog: the QA methodology's emulated-WAN runs (tc-based
+latency zones over the 200-node testnet, CometBFT-QA-v1.md:307) and
+the e2e runner's perturbations.  Containers here can't use tc or
+docker networks, so links route through tests/netem_proxy.NetemProxy —
+real TCP relays with injected one-way latency and partition/heal
+control.  The full node stack (SecretConnection, MConnection,
+reactors, consensus) runs unchanged over the emulated links.
+"""
+
+from __future__ import annotations
+
+import time
+
+from cometbft_tpu.p2p.netaddr import NetAddress
+
+from netem_proxy import NetemProxy
+from test_reactors import make_localnet, wait_all_height
+
+ZONES = {0: "a", 1: "a", 2: "b", 3: "b"}
+
+
+def _wan_config(_i, cfg):
+    """Timeouts sized for emulated WAN RTTs: the default test config's
+    20-80 ms timeouts are shorter than a 160 ms cross-zone round trip,
+    which livelocks rounds exactly like a misconfigured real WAN."""
+    cfg.consensus.timeout_propose_ns = 1_000_000_000
+    cfg.consensus.timeout_propose_delta_ns = 200_000_000
+    cfg.consensus.timeout_vote_ns = 400_000_000
+    cfg.consensus.timeout_vote_delta_ns = 100_000_000
+    cfg.consensus.timeout_commit_ns = 200_000_000
+    # PEX gossips REAL listen addresses; peers would redial each other
+    # directly and bypass the emulated links entirely (observed: a
+    # "partitioned" net kept committing through pex-discovered direct
+    # connections) — topology must stay pinned to the proxies
+    cfg.p2p.pex = False
+
+
+def _heights(nodes) -> list[int]:
+    return [n.height() for n in nodes]
+
+
+def _wire_zoned(nodes, latency_ms: float):
+    """Full mesh: same-zone links direct, cross-zone via delayed
+    proxies.  Returns the cross-zone proxies (one inbound per node)."""
+    proxies = {}
+    for j, node in enumerate(nodes):
+        la = node.transport.listen_addr
+        proxies[j] = NetemProxy(la.host, la.port, latency_ms=latency_ms)
+    for i, src in enumerate(nodes):
+        for j, dst in enumerate(nodes):
+            if j <= i:
+                continue
+            la = dst.transport.listen_addr
+            if ZONES[i] == ZONES[j]:
+                addr = NetAddress(id=la.id, host=la.host, port=la.port)
+            else:
+                addr = NetAddress(
+                    id=la.id, host="127.0.0.1", port=proxies[j].port
+                )
+            src.switch.dial_peer_with_address(addr, persistent=True)
+    return proxies
+
+
+class TestWanEmulation:
+    def test_latency_zones_still_commit(self, tmp_path):
+        """With 80 ms one-way latency between zones, consensus still
+        commits blocks (QA-v1 saw ~10 blocks/min under WAN emulation
+        vs 20-40 without; here the assertion is sustained progress)."""
+        nodes, _, _ = make_localnet(tmp_path, 4, configure=_wan_config)
+        for n in nodes:
+            n.start()
+        proxies = {}
+        try:
+            proxies = _wire_zoned(nodes, latency_ms=80.0)
+            deadline = time.monotonic() + 40
+            while time.monotonic() < deadline:
+                if all(
+                    n.switch.peers.size() == len(nodes) - 1 for n in nodes
+                ):
+                    break
+                time.sleep(0.25)
+            wait_all_height(nodes, 5, timeout=120)
+            # cross-zone links really carry the delay: a partition of
+            # them must stall the chain (checked in the next test);
+            # here just confirm every node kept all peers
+            assert all(
+                n.switch.peers.size() == len(nodes) - 1 for n in nodes
+            )
+        finally:
+            for p in proxies.values():
+                p.close()
+            for n in nodes:
+                n.stop()
+
+    def test_partition_halts_then_heals(self, tmp_path):
+        """Cutting every cross-zone link (2+2 split, no 2/3 quorum)
+        halts commits; healing restores progress — the rotating-node /
+        recovery property at TCP level."""
+        nodes, _, _ = make_localnet(tmp_path, 4, configure=_wan_config)
+        for n in nodes:
+            n.start()
+        proxies = {}
+        try:
+            proxies = _wire_zoned(nodes, latency_ms=10.0)
+            wait_all_height(nodes, 3, timeout=90)
+            for p in proxies.values():
+                p.partition()
+            # cross-zone links must actually drop (each node keeps
+            # only its same-zone peer)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(n.switch.peers.size() <= 1 for n in nodes):
+                    break
+                time.sleep(0.5)
+            assert all(n.switch.peers.size() <= 1 for n in nodes), [
+                n.switch.peers.size() for n in nodes
+            ]
+            # let in-flight rounds settle, then measure stall
+            time.sleep(4.0)
+            h0 = max(_heights(nodes))
+            time.sleep(8.0)
+            h1 = max(_heights(nodes))
+            assert h1 <= h0 + 1, (
+                f"chain advanced {h0}->{h1} during a 2+2 partition"
+            )
+            for p in proxies.values():
+                p.heal()
+            # persistent-peer reconnect logic must re-establish the
+            # cross-zone links and consensus must resume
+            target = h1 + 3
+            wait_all_height(nodes, target, timeout=180)
+        finally:
+            for p in proxies.values():
+                p.close()
+            for n in nodes:
+                n.stop()
