@@ -13,65 +13,56 @@ suite pins native == python byte-for-byte)."""
 from __future__ import annotations
 
 import ctypes
-import threading
 
 from cometbft_tpu.utils.native_build import NativeLib
 
+
+def _configure(lib) -> None:
+    lib.cmt_bls_init.restype = ctypes.c_int
+    for name, args in (
+        ("cmt_bls_pubkey_validate", [ctypes.c_char_p]),
+        (
+            "cmt_bls_verify",
+            [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+             ctypes.c_char_p],
+        ),
+        (
+            "cmt_bls_aggregate_verify",
+            [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+             ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p],
+        ),
+        (
+            "cmt_bls_batch_verify",
+            [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+             ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p,
+             ctypes.c_char_p],
+        ),
+        (
+            "cmt_bls_sign",
+            [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+             ctypes.c_char_p],
+        ),
+        ("cmt_bls_sk_to_pk", [ctypes.c_char_p, ctypes.c_char_p]),
+        (
+            "cmt_bls_hash_to_g2_compressed",
+            [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p],
+        ),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = args
+        fn.restype = ctypes.c_int
+    lib.cmt_bls_init()
+
+
 _NATIVE = NativeLib(
-    "native/bls/bls12381.cpp", "libcmtbls.so", "CMT_TPU_NO_NATIVE_BLS"
+    "native/bls/bls12381.cpp", "libcmtbls.so", "CMT_TPU_NO_NATIVE_BLS",
+    configure=_configure,
 )
-_lock = threading.Lock()
-_lib = None
 
 
 def load():
-    """The ctypes library, or None when unavailable."""
-    global _lib
-    if _lib is not None:
-        return _lib
-    with _lock:
-        if _lib is not None:
-            return _lib
-        lib = _NATIVE.load()
-        if lib is None:
-            return None
-        u8p = ctypes.POINTER(ctypes.c_uint8)  # noqa: F841
-        lib.cmt_bls_init.restype = ctypes.c_int
-        for name, args in (
-            ("cmt_bls_pubkey_validate", [ctypes.c_char_p]),
-            (
-                "cmt_bls_verify",
-                [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-                 ctypes.c_char_p],
-            ),
-            (
-                "cmt_bls_aggregate_verify",
-                [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
-                 ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p],
-            ),
-            (
-                "cmt_bls_batch_verify",
-                [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
-                 ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p,
-                 ctypes.c_char_p],
-            ),
-            (
-                "cmt_bls_sign",
-                [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-                 ctypes.c_char_p],
-            ),
-            ("cmt_bls_sk_to_pk", [ctypes.c_char_p, ctypes.c_char_p]),
-            (
-                "cmt_bls_hash_to_g2_compressed",
-                [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p],
-            ),
-        ):
-            fn = getattr(lib, name)
-            fn.argtypes = args
-            fn.restype = ctypes.c_int
-        lib.cmt_bls_init()
-        _lib = lib
-        return _lib
+    """The ctypes library (signatures configured, init run), or None."""
+    return _NATIVE.load()
 
 
 def available() -> bool:

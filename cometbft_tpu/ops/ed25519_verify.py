@@ -494,6 +494,14 @@ def runtime_device_min_batch() -> int:
     except (OSError, ValueError):
         pass
     try:
+        if jax.devices()[0].platform == "cpu":
+            # the "device" here IS the host CPU running the XLA kernel
+            # — strictly slower than the host batch verifier, so the
+            # dispatch can never win (measured 43 ms/sig vs 0.12):
+            # route everything to the CPU path unless explicitly
+            # overridden (tests pass device_min_batch directly)
+            _runtime_threshold = 1 << 30
+            return _runtime_threshold
         rtt = _measure_link_rtt()
     except Exception:  # no usable device: verify() falls back anyway
         _runtime_threshold = 1 << 30

@@ -11,70 +11,62 @@ crypto/bls_native.py; select with db_backend = "cometkv".
 from __future__ import annotations
 
 import ctypes
-import threading
 
 from cometbft_tpu.utils.native_build import NativeLib
 
+
+def _configure(lib) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ckv_open.restype = ctypes.c_void_p
+    lib.ckv_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.ckv_get.restype = ctypes.c_int
+    lib.ckv_get.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_int,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ckv_free.argtypes = [u8p]
+    lib.ckv_put.restype = ctypes.c_int
+    lib.ckv_put.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
+    ]
+    lib.ckv_del.restype = ctypes.c_int
+    lib.ckv_del.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
+    lib.ckv_batch.restype = ctypes.c_int
+    lib.ckv_batch.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
+    lib.ckv_iter.restype = ctypes.c_void_p
+    lib.ckv_iter.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.ckv_iter_next.restype = ctypes.c_int
+    lib.ckv_iter_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.ckv_iter_close.argtypes = [ctypes.c_void_p]
+    lib.ckv_compact.restype = ctypes.c_int
+    lib.ckv_compact.argtypes = [ctypes.c_void_p]
+    lib.ckv_sync.restype = ctypes.c_int
+    lib.ckv_sync.argtypes = [ctypes.c_void_p]
+    lib.ckv_count.restype = ctypes.c_uint64
+    lib.ckv_count.argtypes = [ctypes.c_void_p]
+    lib.ckv_dead_bytes.restype = ctypes.c_uint64
+    lib.ckv_dead_bytes.argtypes = [ctypes.c_void_p]
+    lib.ckv_close.argtypes = [ctypes.c_void_p]
+
+
 _NATIVE = NativeLib(
-    "native/kv/cometkv.cpp", "libcmtkv.so", "CMT_TPU_NO_NATIVE_KV"
+    "native/kv/cometkv.cpp", "libcmtkv.so", "CMT_TPU_NO_NATIVE_KV",
+    configure=_configure,
 )
-_sig_lock = threading.Lock()
-_configured = None
 
 
 def load():
     """The ctypes library (signatures configured), or None."""
-    global _configured
-    if _configured is not None:
-        return _configured
-    with _sig_lock:
-        if _configured is not None:
-            return _configured
-        lib = _NATIVE.load()
-        if lib is None:
-            return None
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        lib.ckv_open.restype = ctypes.c_void_p
-        lib.ckv_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.ckv_get.restype = ctypes.c_int
-        lib.ckv_get.argtypes = [
-            ctypes.c_void_p, u8p, ctypes.c_int,
-            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.ckv_free.argtypes = [u8p]
-        lib.ckv_put.restype = ctypes.c_int
-        lib.ckv_put.argtypes = [
-            ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
-        ]
-        lib.ckv_del.restype = ctypes.c_int
-        lib.ckv_del.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
-        lib.ckv_batch.restype = ctypes.c_int
-        lib.ckv_batch.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int]
-        lib.ckv_iter.restype = ctypes.c_void_p
-        lib.ckv_iter.argtypes = [
-            ctypes.c_void_p, u8p, ctypes.c_int, u8p, ctypes.c_int,
-            ctypes.c_int,
-        ]
-        lib.ckv_iter_next.restype = ctypes.c_int
-        lib.ckv_iter_next.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.ckv_iter_close.argtypes = [ctypes.c_void_p]
-        lib.ckv_compact.restype = ctypes.c_int
-        lib.ckv_compact.argtypes = [ctypes.c_void_p]
-        lib.ckv_sync.restype = ctypes.c_int
-        lib.ckv_sync.argtypes = [ctypes.c_void_p]
-        lib.ckv_count.restype = ctypes.c_uint64
-        lib.ckv_count.argtypes = [ctypes.c_void_p]
-        lib.ckv_dead_bytes.restype = ctypes.c_uint64
-        lib.ckv_dead_bytes.argtypes = [ctypes.c_void_p]
-        lib.ckv_close.argtypes = [ctypes.c_void_p]
-        _configured = lib
-        return _configured
+    return _NATIVE.load()
 
 
 def available() -> bool:
@@ -104,11 +96,20 @@ class CometKV:
                 f"cometkv open failed: {err.value.decode()}"
             )
 
+    def _handle(self):
+        """The live native handle; raises (never segfaults) after
+        close() — a shutdown race must surface as an error, not take
+        the node process down with a NULL deref."""
+        h = self._h
+        if not h:
+            raise RuntimeError("cometkv handle is closed")
+        return h
+
     def get(self, key: bytes) -> bytes | None:
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_int()
         rc = self._lib.ckv_get(
-            self._h, _u8(key), len(key), ctypes.byref(out),
+            self._handle(), _u8(key), len(key), ctypes.byref(out),
             ctypes.byref(n),
         )
         if rc < 0:
@@ -122,12 +123,12 @@ class CometKV:
 
     def put(self, key: bytes, value: bytes) -> None:
         if self._lib.ckv_put(
-            self._h, _u8(key), len(key), _u8(value), len(value)
+            self._handle(), _u8(key), len(key), _u8(value), len(value)
         ) != 0:
             raise RuntimeError("cometkv put failed")
 
     def delete(self, key: bytes) -> None:
-        if self._lib.ckv_del(self._h, _u8(key), len(key)) != 0:
+        if self._lib.ckv_del(self._handle(), _u8(key), len(key)) != 0:
             raise RuntimeError("cometkv delete failed")
 
     def batch(self, ops: list[tuple[bytes, bytes | None]]) -> None:
@@ -143,7 +144,7 @@ class CometKV:
                 buf += key
                 buf += len(value).to_bytes(4, "little")
                 buf += value
-        if self._lib.ckv_batch(self._h, _u8(bytes(buf)), len(buf)) != 0:
+        if self._lib.ckv_batch(self._handle(), _u8(bytes(buf)), len(buf)) != 0:
             raise RuntimeError("cometkv batch failed")
 
     def iterate(self, start: bytes | None, end: bytes | None,
@@ -151,7 +152,7 @@ class CometKV:
         s = start or b""
         e = end or b""
         it = self._lib.ckv_iter(
-            self._h, _u8(s), len(s), _u8(e), len(e), int(reverse)
+            self._handle(), _u8(s), len(s), _u8(e), len(e), int(reverse)
         )
         if not it:
             raise RuntimeError("cometkv iterator failed")
@@ -177,21 +178,21 @@ class CometKV:
             self._lib.ckv_iter_close(it)
 
     def compact(self) -> None:
-        rc = self._lib.ckv_compact(self._h)
+        rc = self._lib.ckv_compact(self._handle())
         if rc == -2:
             return  # live iterators; skip this cycle
         if rc != 0:
             raise RuntimeError("cometkv compact failed")
 
     def sync(self) -> None:
-        if self._lib.ckv_sync(self._h) != 0:
+        if self._lib.ckv_sync(self._handle()) != 0:
             raise RuntimeError("cometkv sync failed")
 
     def count(self) -> int:
-        return int(self._lib.ckv_count(self._h))
+        return int(self._lib.ckv_count(self._handle()))
 
     def dead_bytes(self) -> int:
-        return int(self._lib.ckv_dead_bytes(self._h))
+        return int(self._lib.ckv_dead_bytes(self._handle()))
 
     def close(self) -> None:
         if self._h:

@@ -22,11 +22,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 class NativeLib:
     """Lazily built + loaded shared library handle."""
 
-    def __init__(self, src_rel: str, out_name: str,
-                 disable_env: str) -> None:
+    def __init__(self, src_rel: str, out_name: str, disable_env: str,
+                 configure=None) -> None:
         self.src = os.path.join(REPO, src_rel)
         self.out = os.path.join(REPO, "native", "build", out_name)
         self.disable_env = disable_env
+        self._configure = configure  # one-time ctypes signature setup
         self._lock = threading.Lock()
         self._lib: ctypes.CDLL | None = None
         self._tried = False
@@ -70,7 +71,10 @@ class NativeLib:
             if not os.path.exists(self.out):
                 return None
             try:
-                self._lib = ctypes.CDLL(self.out)
+                lib = ctypes.CDLL(self.out)
             except OSError:
-                self._lib = None
+                return None
+            if self._configure is not None:
+                self._configure(lib)
+            self._lib = lib
             return self._lib

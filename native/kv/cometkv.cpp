@@ -39,6 +39,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/file.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -244,6 +245,17 @@ bool recover(DB* db, std::string& err) {
     return true;
 }
 
+int fsync_parent_dir(const std::string& path) {
+    std::string dir = ".";
+    auto slash = path.find_last_of('/');
+    if (slash != std::string::npos) dir = path.substr(0, slash);
+    int dfd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd < 0) return -1;
+    int rc = fsync(dfd);
+    ::close(dfd);
+    return rc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -255,6 +267,14 @@ void* ckv_open(const char* path, char* err, int errlen) {
     std::string e;
     if (db->fd < 0) {
         e = std::string("open failed: ") + strerror(errno);
+    } else if (flock(db->fd, LOCK_EX | LOCK_NB) != 0) {
+        // single-writer engine: a second process (e.g. compact-db CLI
+        // against a running node) must fail cleanly, not corrupt
+        e = "database is locked by another process";
+    } else if (fsync_parent_dir(db->path) != 0) {
+        // the directory entry must be durable or a fresh log can
+        // vanish across power loss while batches report success
+        e = "directory fsync failed";
     } else if (!recover(db, e)) {
         // e set by recover
     } else {
@@ -478,7 +498,8 @@ int ckv_compact(void* h) {
         ::unlink(tmp.c_str());
         return -1;
     }
-    if (fsync(nfd) != 0 || ::rename(tmp.c_str(), db->path.c_str()) != 0) {
+    if (fsync(nfd) != 0 || ::rename(tmp.c_str(), db->path.c_str()) != 0 ||
+        fsync_parent_dir(db->path) != 0) {
         ::close(nfd);
         ::unlink(tmp.c_str());
         return -1;

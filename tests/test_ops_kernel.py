@@ -427,10 +427,19 @@ class TestDispatchThreshold:
         monkeypatch.delenv("CMT_TPU_DEVICE_MIN_BATCH", raising=False)
         return EV
 
+    class _FakeDev:
+        platform = "tpu"
+
+    def _fake_accel(self, monkeypatch, EV):
+        monkeypatch.setattr(
+            EV.jax, "devices", lambda *a, **k: [self._FakeDev()]
+        )
+
     def test_calibrated_crossover_tunneled_link(self, tmp_path, monkeypatch):
         import json as _json
 
         EV = self._reset(monkeypatch)
+        self._fake_accel(monkeypatch, EV)
         cal = tmp_path / "cal.json"
         cal.write_text(
             _json.dumps({"t_cpu_per_sig": 100e-6, "t_dev_per_sig": 5e-6})
@@ -443,9 +452,16 @@ class TestDispatchThreshold:
 
     def test_direct_attached_link_uses_floor(self, tmp_path, monkeypatch):
         EV = self._reset(monkeypatch)
+        self._fake_accel(monkeypatch, EV)
         monkeypatch.setattr(EV, "CALIBRATION_PATH", str(tmp_path / "x"))
         monkeypatch.setattr(EV, "_measure_link_rtt", lambda: 0.0002)
         assert EV.runtime_device_min_batch() == EV.DEVICE_MIN_BATCH
+
+    def test_cpu_backend_never_dispatches_to_xla_path(self, monkeypatch):
+        """On a cpu jax backend the XLA kernel can't beat the host
+        verifier; the threshold must push everything to the CPU path."""
+        EV = self._reset(monkeypatch)
+        assert EV.runtime_device_min_batch() >= 1 << 29
 
     def test_env_override_wins(self, monkeypatch):
         EV = self._reset(monkeypatch)
@@ -461,3 +477,51 @@ class TestDispatchThreshold:
 
         monkeypatch.setattr(EV, "_measure_link_rtt", boom)
         assert EV.runtime_device_min_batch() >= 1 << 29
+
+
+def test_verify_stream_keyed_dispatch(rng):
+    """verify_stream's dispatch hook with a hot per-set table — the
+    pattern bench_all's replay streams use (key_ids tiled per job)."""
+    import numpy as np
+
+    from cometbft_tpu.ops import precompute as PR
+    from cometbft_tpu.ops.ed25519_verify import (
+        verify_arrays_keyed_async,
+        verify_stream,
+    )
+
+    PR.TABLE_CACHE.clear()
+    privs = [ed.priv_key_from_secret(b"st%d" % i) for i in range(5)]
+    pub_bytes = [p.pub_key().bytes() for p in privs]
+    entry = PR.TABLE_CACHE.lookup_or_build(pub_bytes)
+    key_ids1 = entry.key_ids(pub_bytes)
+    nsig = len(privs)
+
+    def dispatch(pub, sig, ms):
+        k = len(ms) // nsig
+        return verify_arrays_keyed_async(
+            entry, np.concatenate([key_ids1] * k), pub, sig, ms
+        )
+
+    msgs = [b"commit-sig-%d" % i for i in range(nsig)]
+    sigs = np.stack(
+        [np.frombuffer(p.sign(m), dtype=np.uint8)
+         for p, m in zip(privs, msgs)]
+    )
+    pubs = np.stack(
+        [np.frombuffer(b, dtype=np.uint8) for b in pub_bytes]
+    )
+
+    def jobs():
+        for k in (1, 2, 3):  # varying commits-per-launch
+            yield (
+                np.concatenate([pubs] * k),
+                np.concatenate([sigs] * k),
+                msgs * k,
+            )
+
+    total = 0
+    for res in verify_stream(jobs(), max_in_flight=2, dispatch=dispatch):
+        assert bool(res.all())
+        total += len(res)
+    assert total == nsig * 6

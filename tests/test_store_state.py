@@ -465,3 +465,37 @@ def test_cometkv_close_with_suspended_iterator(tmp_path):
     next(gen)
     db.close()  # iterator still suspended
     gen.close()  # runs ckv_iter_close after the DB closed
+
+
+def test_cometkv_use_after_close_raises(tmp_path):
+    """Operations after close() raise instead of handing the C layer a
+    NULL handle (a shutdown race must not SIGSEGV the node)."""
+    import pytest
+
+    db = _ckv(tmp_path)
+    db.set(b"a", b"1")
+    gen = db.iterator()  # created but not started before close
+    db.close()
+    with pytest.raises((RuntimeError, Exception)):
+        db.get(b"a")
+    with pytest.raises(Exception):
+        db.set(b"b", b"2")
+    with pytest.raises(Exception):
+        list(gen)  # lazy ckv_iter on a closed handle must raise too
+
+
+def test_cometkv_single_writer_lock(tmp_path):
+    """A second open of the same log fails cleanly (compact-db against
+    a running node must not corrupt the store)."""
+    import pytest
+
+    from cometbft_tpu.utils.db import CometKVDB, DBError
+
+    db = _ckv(tmp_path)
+    db.set(b"a", b"1")
+    with pytest.raises((DBError, RuntimeError), match="locked"):
+        CometKVDB(str(tmp_path / "c.ckv"))
+    db.close()
+    db2 = _ckv(tmp_path)  # lock released on close
+    assert db2.get(b"a") == b"1"
+    db2.close()
