@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -185,6 +186,141 @@ def _columns(a, b):
     return _columns_stack(a, b)
 
 
+# -- pallas fused core (CMT_TPU_COLS_IMPL=pallas) ----------------------
+#
+# The measured wall for the XLA core is HBM traffic on materialized
+# intermediates (docs/device_kernel_perf.md §1): each mul streams the
+# (26, 51, B) column stack through HBM.  The pallas kernel fuses
+# columns -> high fold -> relax into ONE program whose intermediates
+# are plain vectors in VMEM/registers; HBM sees only the two operands
+# and the result.  Formulation: limbs live as PYTHON LISTS of (T,)
+# row vectors, so every "shift" in the carry machinery is list index
+# arithmetic — no pad/roll/stack ops for the TPU dialect to choke on.
+
+def _vec_tree_sum(terms):
+    while len(terms) > 1:
+        nxt = [terms[k] + terms[k + 1] for k in range(0, len(terms) - 1, 2)]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
+
+
+def _fold_high_rows(cols):
+    """51 column rows -> 26 lazy rows (row-list _fold_high)."""
+    zero = cols[0] - cols[0]
+    low = cols[:NLIMBS]
+    high = cols[NLIMBS:] + [zero, zero]  # 27 rows
+    for _ in range(2):
+        carry = [h >> LIMB_BITS for h in high]
+        lo = [h - (c << LIMB_BITS) for h, c in zip(high, carry)]
+        high = [lo[0]] + [
+            lo[j] + carry[j - 1] for j in range(1, len(high))
+        ]
+    low = [low[i] + high[i] * WRAP for i in range(NLIMBS)]
+    low[0] = low[0] + high[NLIMBS] * (WRAP * WRAP)
+    return low
+
+
+def _relax_rows(rows, iters: int = 4):
+    for _ in range(iters):
+        carry = [r >> LIMB_BITS for r in rows]
+        lo = [r - (c << LIMB_BITS) for r, c in zip(rows, carry)]
+        rows = [lo[0] + carry[NLIMBS - 1] * WRAP] + [
+            lo[j] + carry[j - 1] for j in range(1, NLIMBS)
+        ]
+    return rows
+
+
+def _mul_rows(a, b):
+    cols = []
+    for j in range(2 * NLIMBS - 1):
+        lo_i = max(0, j - (NLIMBS - 1))
+        hi_i = min(NLIMBS - 1, j)
+        cols.append(
+            _vec_tree_sum([a[i] * b[j - i] for i in range(lo_i, hi_i + 1)])
+        )
+    return _relax_rows(_fold_high_rows(cols))
+
+
+def _square_rows(a):
+    d = [x + x for x in a]
+    cols = []
+    for j in range(2 * NLIMBS - 1):
+        terms = []
+        if j % 2 == 0:
+            terms.append(a[j // 2] * a[j // 2])
+        for i in range(max(0, j - (NLIMBS - 1)), (j + 1) // 2):
+            terms.append(d[i] * a[j - i])
+        cols.append(_vec_tree_sum(terms))
+    return _relax_rows(_fold_high_rows(cols))
+
+
+_PALLAS_INTERPRET = bool(_os.environ.get("CMT_TPU_PALLAS_INTERPRET"))
+
+
+def _pallas_elementwise(rows_fn, nin: int):
+    """Build a pallas-fused (26, *batch) field op from a row-list
+    implementation.  The batch is flattened and tiled at the largest
+    divisor from the ladder; tile=1 always divides, so every shape is
+    accepted (tiny tiles are slow but correct — production batches are
+    pow2 and land on 512)."""
+    from jax.experimental import pallas as pl
+
+    def run(*ops):
+        shape = ops[0].shape
+        flat = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        tile = 1
+        for t in (512, 256, 128, 64, 32, 16, 8):
+            if flat % t == 0:
+                tile = t
+                break
+        a2 = [o.reshape(NLIMBS, flat) for o in ops]
+
+        def kernel(*refs):
+            ins = refs[:nin]
+            o_ref = refs[nin]
+            rows_in = [
+                [r[i, :] for i in range(NLIMBS)] for r in ins
+            ]
+            out = rows_fn(*rows_in)
+            for i in range(NLIMBS):
+                o_ref[i, :] = out[i]
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(flat // tile,),
+            in_specs=[
+                pl.BlockSpec((NLIMBS, tile), lambda i: (0, i))
+                for _ in range(nin)
+            ],
+            out_specs=pl.BlockSpec((NLIMBS, tile), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((NLIMBS, flat), DTYPE),
+            interpret=_PALLAS_INTERPRET,
+        )(*a2)
+        return out.reshape(shape)
+
+    return run
+
+
+_mul_pallas = None
+_square_pallas = None
+
+
+def _get_mul_pallas():
+    global _mul_pallas
+    if _mul_pallas is None:
+        _mul_pallas = _pallas_elementwise(_mul_rows, 2)
+    return _mul_pallas
+
+
+def _get_square_pallas():
+    global _square_pallas
+    if _square_pallas is None:
+        _square_pallas = _pallas_elementwise(_square_rows, 1)
+    return _square_pallas
+
+
 def _fold_high(cols):
     """51 columns -> 26 lazy limbs: relax the 25 high columns as their
     own block (2 shift-only passes; the padded rows absorb the shifted
@@ -208,6 +344,11 @@ def mul(a, b):
     relaxation passes. Budget: 26 * max|a_i| * max|b_j| < 2^31, i.e.
     each operand may be a mul output (< 2^11) plus up to 2 lazy
     add/subs. Output limbs < 2^11."""
+    if COLS_IMPL == "pallas":
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        return _get_mul_pallas()(
+            jnp.broadcast_to(a, shape), jnp.broadcast_to(b, shape)
+        )
     return relax(_fold_high(_columns(a, b)))
 
 
@@ -234,6 +375,8 @@ def _square_columns(a):
 def square(a):
     """Field square — dedicated half-product column form (or plain
     mul(a, a) when CMT_TPU_SQUARE_IMPL=mul)."""
+    if COLS_IMPL == "pallas" and SQUARE_IMPL != "mul":
+        return _get_square_pallas()(a)
     if SQUARE_IMPL == "mul":
         return mul(a, a)
     return relax(_fold_high(_square_columns(a)))

@@ -82,13 +82,20 @@ def _u8(b: bytes):
 
 class CometKV:
     """Thin handle wrapper; cometbft_tpu.utils.db.CometKVDB adapts it
-    to the DB interface."""
+    to the DB interface.  An op lock serializes native calls against
+    close(): an in-flight operation finishes before close() releases
+    the handle, and post-close calls raise RuntimeError — never a NULL
+    or freed-handle deref (iterators are protected C-side by the
+    engine's deferred-free refcount)."""
 
     def __init__(self, path: str):
+        import threading
+
         lib = load()
         if lib is None:
             raise RuntimeError("native cometkv unavailable")
         self._lib = lib
+        self._oplock = threading.Lock()
         err = ctypes.create_string_buffer(256)
         self._h = lib.ckv_open(path.encode(), err, 256)
         if not self._h:
@@ -97,9 +104,7 @@ class CometKV:
             )
 
     def _handle(self):
-        """The live native handle; raises (never segfaults) after
-        close() — a shutdown race must surface as an error, not take
-        the node process down with a NULL deref."""
+        """The live native handle (call under self._oplock)."""
         h = self._h
         if not h:
             raise RuntimeError("cometkv handle is closed")
@@ -108,10 +113,11 @@ class CometKV:
     def get(self, key: bytes) -> bytes | None:
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_int()
-        rc = self._lib.ckv_get(
-            self._handle(), _u8(key), len(key), ctypes.byref(out),
-            ctypes.byref(n),
-        )
+        with self._oplock:
+            rc = self._lib.ckv_get(
+                self._handle(), _u8(key), len(key), ctypes.byref(out),
+                ctypes.byref(n),
+            )
         if rc < 0:
             raise RuntimeError("cometkv get failed")
         if rc == 0:
@@ -122,13 +128,17 @@ class CometKV:
             self._lib.ckv_free(out)
 
     def put(self, key: bytes, value: bytes) -> None:
-        if self._lib.ckv_put(
-            self._handle(), _u8(key), len(key), _u8(value), len(value)
-        ) != 0:
+        with self._oplock:
+            rc = self._lib.ckv_put(
+                self._handle(), _u8(key), len(key), _u8(value), len(value)
+            )
+        if rc != 0:
             raise RuntimeError("cometkv put failed")
 
     def delete(self, key: bytes) -> None:
-        if self._lib.ckv_del(self._handle(), _u8(key), len(key)) != 0:
+        with self._oplock:
+            rc = self._lib.ckv_del(self._handle(), _u8(key), len(key))
+        if rc != 0:
             raise RuntimeError("cometkv delete failed")
 
     def batch(self, ops: list[tuple[bytes, bytes | None]]) -> None:
@@ -144,16 +154,22 @@ class CometKV:
                 buf += key
                 buf += len(value).to_bytes(4, "little")
                 buf += value
-        if self._lib.ckv_batch(self._handle(), _u8(bytes(buf)), len(buf)) != 0:
+        with self._oplock:
+            rc = self._lib.ckv_batch(
+                self._handle(), _u8(bytes(buf)), len(buf)
+            )
+        if rc != 0:
             raise RuntimeError("cometkv batch failed")
 
     def iterate(self, start: bytes | None, end: bytes | None,
                 reverse: bool = False):
         s = start or b""
         e = end or b""
-        it = self._lib.ckv_iter(
-            self._handle(), _u8(s), len(s), _u8(e), len(e), int(reverse)
-        )
+        with self._oplock:
+            it = self._lib.ckv_iter(
+                self._handle(), _u8(s), len(s), _u8(e), len(e),
+                int(reverse),
+            )
         if not it:
             raise RuntimeError("cometkv iterator failed")
         k = ctypes.POINTER(ctypes.c_uint8)()
@@ -178,23 +194,35 @@ class CometKV:
             self._lib.ckv_iter_close(it)
 
     def compact(self) -> None:
-        rc = self._lib.ckv_compact(self._handle())
+        with self._oplock:
+            rc = self._lib.ckv_compact(self._handle())
         if rc == -2:
             return  # live iterators; skip this cycle
+        if rc == -3:
+            raise RuntimeError(
+                "cometkv compact completed but directory sync failed; "
+                "durability across power loss uncertain until the next "
+                "successful sync"
+            )
         if rc != 0:
             raise RuntimeError("cometkv compact failed")
 
     def sync(self) -> None:
-        if self._lib.ckv_sync(self._handle()) != 0:
+        with self._oplock:
+            rc = self._lib.ckv_sync(self._handle())
+        if rc != 0:
             raise RuntimeError("cometkv sync failed")
 
     def count(self) -> int:
-        return int(self._lib.ckv_count(self._handle()))
+        with self._oplock:
+            return int(self._lib.ckv_count(self._handle()))
 
     def dead_bytes(self) -> int:
-        return int(self._lib.ckv_dead_bytes(self._handle()))
+        with self._oplock:
+            return int(self._lib.ckv_dead_bytes(self._handle()))
 
     def close(self) -> None:
-        if self._h:
-            self._lib.ckv_close(self._h)
-            self._h = None
+        with self._oplock:
+            if self._h:
+                self._lib.ckv_close(self._h)
+                self._h = None

@@ -498,18 +498,27 @@ int ckv_compact(void* h) {
         ::unlink(tmp.c_str());
         return -1;
     }
-    if (fsync(nfd) != 0 || ::rename(tmp.c_str(), db->path.c_str()) != 0 ||
-        fsync_parent_dir(db->path) != 0) {
+    // take the single-writer lock on the NEW inode before it becomes
+    // the database — closing the old fd below releases the old lock,
+    // and an unlocked post-compaction log would let a second process
+    // corrupt the store (the exact guard ckv_open added)
+    if (fsync(nfd) != 0 || flock(nfd, LOCK_EX | LOCK_NB) != 0 ||
+        ::rename(tmp.c_str(), db->path.c_str()) != 0) {
         ::close(nfd);
         ::unlink(tmp.c_str());
         return -1;
     }
+    // rename succeeded: the new file IS the database from here on —
+    // install it unconditionally (closing nfd now would leave the
+    // process appending to an unlinked ghost inode)
     ::close(db->fd);
     db->fd = nfd;
     fresh.fd = -1;  // ownership moved
     db->file_size = fresh.file_size;
     db->index.swap(nindex);
     db->dead_bytes = 0;
+    if (fsync_parent_dir(db->path) != 0)
+        return -3;  // state installed; directory durability uncertain
     return 0;
 }
 

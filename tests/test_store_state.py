@@ -476,11 +476,11 @@ def test_cometkv_use_after_close_raises(tmp_path):
     db.set(b"a", b"1")
     gen = db.iterator()  # created but not started before close
     db.close()
-    with pytest.raises((RuntimeError, Exception)):
+    with pytest.raises(RuntimeError, match="closed"):
         db.get(b"a")
-    with pytest.raises(Exception):
+    with pytest.raises(RuntimeError, match="closed"):
         db.set(b"b", b"2")
-    with pytest.raises(Exception):
+    with pytest.raises(RuntimeError, match="closed"):
         list(gen)  # lazy ckv_iter on a closed handle must raise too
 
 
