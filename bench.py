@@ -50,7 +50,10 @@ def _enable_compile_cache() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 
-def main() -> dict:
+def main(checkpoint=None) -> dict:
+    """``checkpoint(result_dict)`` persists a partial result so a
+    watchdog SIGKILL mid-benchmark (e.g. during the keyed section's
+    compile) cannot discard an already-measured number."""
     _enable_compile_cache()
     import jax
 
@@ -131,7 +134,7 @@ def main() -> dict:
         )
 
     # steady-state pipelined throughput over nchunks in-flight launches
-    best = 0.0
+    generic_best = 0.0
     for trial in range(1 if on_cpu else 3):
         t0 = time.time()
         total = 0
@@ -147,28 +150,131 @@ def main() -> dict:
             f"pipelined trial {trial}: {total} sigs in {dt * 1e3:.1f} ms "
             f"= {rate:,.0f} sigs/s"
         )
-        best = max(best, rate)
+        generic_best = max(generic_best, rate)
 
-    return {
-        "metric": METRIC,
-        "value": round(best, 1),
-        "unit": "sigs/sec",
-        "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 4),
-        "platform": dev.platform,
-    }
+    def make_result(generic: float, keyed: float, note: str | None) -> dict:
+        best = max(generic, keyed)
+        result = {
+            "metric": METRIC,
+            "value": round(best, 1),
+            "unit": "sigs/sec",
+            "vs_baseline": round(best / BASELINE_SIGS_PER_SEC, 4),
+            "platform": dev.platform,
+            "generic_sigs_per_sec": round(generic, 1),
+            "keyed_sigs_per_sec": round(keyed, 1),
+        }
+        if keyed > generic:
+            result["path"] = (
+                "steady-state keyed (per-validator device-resident comb "
+                "tables, 150-validator set round-robin)"
+            )
+        if note:
+            result["note"] = note
+        return result
+
+    if checkpoint is not None and generic_best:
+        checkpoint(make_result(
+            generic_best, 0.0, "partial: keyed section did not complete"
+        ))
+
+    # Steady-state KEYED throughput — the production path for commit
+    # verification: per-validator comb tables live on device in the LRU
+    # (ops/precompute.py; reference analog: the expanded-pubkey cache,
+    # crypto/ed25519/ed25519.go:43,62-68), so block after block the
+    # kernel does only SHA-512 + R decompress + comb adds against hot
+    # tables.  Shape mirrors BASELINE: a 150-validator set signing
+    # round-robin, streamed the way blocksync/light-sync replay does.
+    keyed_best = 0.0
+    note = None
+    if not on_cpu:
+        try:
+            from cometbft_tpu.ops import precompute as PR
+            from cometbft_tpu.ops.ed25519_verify import (
+                verify_arrays_keyed_async,
+            )
+
+            nval = 150
+            privs = [ed.gen_priv_key() for _ in range(nval)]
+            pubs_b = [p.pub_key().bytes() for p in privs]
+            t0 = time.time()
+            entry = PR.TABLE_CACHE.lookup_or_build(pubs_b)
+            np.asarray(jax.device_get(entry.table[0, 0, 0, :4]))
+            log(
+                f"keyed tables: {nval} keys, {entry.window_bits}-bit, "
+                f"{entry.nbytes / 1e6:.0f} MB, built in "
+                f"{time.time() - t0:.1f}s"
+            )
+            sel = [pubs_b[i % nval] for i in range(n)]
+            kmsgs = [
+                rng.randint(0, 256, size=msglen, dtype=np.uint8).tobytes()
+                for _ in range(n)
+            ]
+            ksigs = np.stack(
+                [
+                    np.frombuffer(privs[i % nval].sign(m), dtype=np.uint8)
+                    for i, m in enumerate(kmsgs)
+                ]
+            )
+            kpubs = np.stack(
+                [np.frombuffer(p, dtype=np.uint8) for p in sel]
+            )
+            key_ids = entry.key_ids(sel)
+
+            def keyed_dispatch(pub, sig, msgs):
+                return verify_arrays_keyed_async(
+                    entry, key_ids, pub, sig, msgs
+                )
+
+            t0 = time.time()
+            out = _finish(keyed_dispatch(kpubs, ksigs, kmsgs))
+            log(f"first keyed launch {time.time() - t0:.1f}s")
+            assert bool(out.all()), "keyed benchmark signatures must verify"
+            for trial in range(3):
+                t0 = time.time()
+                total = 0
+                for res in verify_stream(
+                    ((kpubs, ksigs, kmsgs) for _ in range(nchunks)),
+                    max_in_flight=nchunks,
+                    dispatch=keyed_dispatch,
+                ):
+                    assert bool(res.all())
+                    total += len(res)
+                dt = time.time() - t0
+                rate = total / dt
+                log(
+                    f"keyed pipelined trial {trial}: {total} sigs in "
+                    f"{dt * 1e3:.1f} ms = {rate:,.0f} sigs/s"
+                )
+                keyed_best = max(keyed_best, rate)
+        except Exception as exc:  # noqa: BLE001 — keyed path must not
+            # take down the headline; report the generic number instead
+            # (and discard any keyed trials: a path that just failed —
+            # possibly by mis-verifying — must not headline)
+            keyed_best = 0.0
+            log(f"keyed path failed ({type(exc).__name__}: {exc}); "
+                "headline falls back to the generic kernel")
+            note = f"keyed path failed: {type(exc).__name__}: {exc}"
+
+    return make_result(generic_best, keyed_best, note)
 
 
 def _child(result_path: str) -> None:
     """Run one attempt; ALWAYS leave a JSON object at result_path."""
+
+    def persist(result: dict) -> None:
+        tmp = result_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+        os.replace(tmp, result_path)
+
     try:
-        result = main()
+        result = main(checkpoint=persist)
     except BaseException as exc:  # noqa: BLE001 — must report, not raise
         result = {"error": f"{type(exc).__name__}: {exc}"}
         log(f"bench attempt failed: {result['error']}")
-    tmp = result_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(result, f)
-    os.replace(tmp, result_path)
+        if os.path.exists(result_path):
+            return  # keep the checkpointed partial number
+    persist(result)
 
 
 def _run_attempt(
@@ -206,6 +312,19 @@ def _run_attempt(
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             pass
+        # a checkpointed partial result survives the kill — prefer an
+        # honest partial number over reporting only the hang
+        try:
+            with open(result_path) as f:
+                partial = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            partial = None
+        if partial and "value" in partial:
+            partial["note"] = (
+                partial.get("note", "")
+                + f" (attempt killed after {timeout_s:.0f}s)"
+            ).strip()
+            return partial
         return {"error": f"attempt hung; killed after {timeout_s:.0f}s"}
     try:
         with open(result_path) as f:

@@ -479,24 +479,21 @@ int ckv_compact(void* h) {
             !read_exact_at(db->fd, kv.second.value_off,
                            (uint8_t*)val.data(),
                            (size_t)kv.second.value_len)) {
-            ::close(nfd);
             ::unlink(tmp.c_str());
-            return -1;
+            return -1;  // fresh's destructor closes nfd
         }
         int64_t off = append_record(&fresh, kv.first,
                                     (const uint8_t*)val.data(),
                                     kv.second.value_len);
         if (off < 0) {
-            ::close(nfd);
             ::unlink(tmp.c_str());
-            return -1;
+            return -1;  // fresh's destructor closes nfd
         }
         nindex[kv.first] = Entry{(uint64_t)off, kv.second.value_len};
     }
     if (append_marker(&fresh) != 0) {
-        ::close(nfd);
         ::unlink(tmp.c_str());
-        return -1;
+        return -1;  // fresh's destructor closes nfd
     }
     // take the single-writer lock on the NEW inode before it becomes
     // the database — closing the old fd below releases the old lock,
@@ -504,9 +501,8 @@ int ckv_compact(void* h) {
     // corrupt the store (the exact guard ckv_open added)
     if (fsync(nfd) != 0 || flock(nfd, LOCK_EX | LOCK_NB) != 0 ||
         ::rename(tmp.c_str(), db->path.c_str()) != 0) {
-        ::close(nfd);
         ::unlink(tmp.c_str());
-        return -1;
+        return -1;  // fresh's destructor closes nfd
     }
     // rename succeeded: the new file IS the database from here on —
     // install it unconditionally (closing nfd now would leave the
