@@ -155,18 +155,25 @@ def _tree_sum(terms):
     return terms[0]
 
 
-def _columns_stack(a, b):
+def _columns_stack(a, b, stack_dtype=DTYPE):
     """Stack 26 shifted (51, *batch) views of b, multiply, reduce: one
-    concatenate materialized, mul+sum fuse into the reduce."""
+    concatenate materialized, mul+sum fuse into the reduce.
+
+    ``stack_dtype=int16`` (CMT_TPU_COLS_IMPL=stack16): the kernel is
+    HBM-bound on this materialized stack (docs/device_kernel_perf.md
+    §1), and mul's operand budget bounds limbs by 2^13 in magnitude —
+    they fit int16, halving the stack's bytes.  The widening convert
+    fuses into the multiply-reduce, so HBM sees half the traffic while
+    all arithmetic stays int32."""
     pad = [(NLIMBS - 1, NLIMBS - 1)] + [(0, 0)] * (b.ndim - 1)
-    bp = jnp.pad(b, pad)  # (76, *batch)
+    bp = jnp.pad(b.astype(stack_dtype), pad)  # (76, *batch)
     s = jnp.stack(
         [
             bp[NLIMBS - 1 - i : NLIMBS - 1 - i + 2 * NLIMBS - 1]
             for i in range(NLIMBS)
         ]
     )  # (26, 51, *batch); s[i, j] = b[j - i]
-    return (a[:, None] * s).sum(axis=0, dtype=DTYPE)
+    return (a[:, None] * s.astype(DTYPE)).sum(axis=0, dtype=DTYPE)
 
 
 def _columns_tree(a, b):
@@ -183,6 +190,8 @@ def _columns_tree(a, b):
 def _columns(a, b):
     if COLS_IMPL == "tree":
         return _columns_tree(a, b)
+    if COLS_IMPL == "stack16":
+        return _columns_stack(a, b, stack_dtype=jnp.int16)
     return _columns_stack(a, b)
 
 

@@ -126,3 +126,39 @@ def test_pallas_fused_core_matches_oracle(monkeypatch):
     for i, (x, y) in enumerate(zip(xs, ys)):
         assert F.to_int(out[:, i]) % P == (2 * x * y) % P
         assert F.to_int(sq[:, i]) % P == (2 * x * 2 * x) % P
+
+
+def test_stack16_core_matches_oracle(monkeypatch):
+    """The int16-stack column form (CMT_TPU_COLS_IMPL=stack16) agrees
+    with the big-int oracle, including lazy operands at the full
+    2-chained-adds budget (the int16 cast bound: limbs must stay
+    within +-2^13 <= int16 range)."""
+    import random
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from cometbft_tpu.crypto.edwards import P
+    from cometbft_tpu.ops import field as F
+
+    monkeypatch.setattr(F, "COLS_IMPL", "stack16")
+    # square must route through mul(a, a) to exercise the int16 stack
+    # (the dedicated _square_columns form never calls _columns)
+    monkeypatch.setattr(F, "SQUARE_IMPL", "mul")
+    rng = random.Random(0x57AC16)
+    xs = [rng.getrandbits(255) for _ in range(8)] + [0, 1, P - 1]
+    ys = [rng.getrandbits(255) for _ in range(8)] + [P - 1, 0, 2]
+    a = jnp.asarray(np.stack([F.from_int(x) for x in xs], axis=-1))
+    b = jnp.asarray(np.stack([F.from_int(y) for y in ys], axis=-1))
+    # lazy inputs at the budget: the contract's max magnitude is a MUL
+    # OUTPUT (limbs < 2^11) carried through two chained adds (4x,
+    # < 2^13) — from_int limbs are only < 2^10, so chain from a mul
+    # result to actually reach the top of the int16-cast range
+    m = F.mul(a, b)  # limbs < 2^11
+    lazy = F.add(F.add(m, m), F.add(m, m))  # limbs < 2^13
+    out = np.asarray(F.mul(a, lazy))
+    sq = np.asarray(F.square(lazy))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert F.to_int(out[:, i]) % P == (x * 4 * x * y) % P
+        assert F.to_int(sq[:, i]) % P == (4 * x * y) ** 2 % P

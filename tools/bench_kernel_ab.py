@@ -3,9 +3,13 @@
 Prints one line: device-side marginal sigs/s (K-dispatch difference
 method, cancels the tunneled link RTT).  Drive with:
 
-    for cols in stack tree; do for sq in fast mul; do
+    for cols in stack stack16 tree pallas; do for sq in fast mul; do
       CMT_TPU_COLS_IMPL=$cols CMT_TPU_SQUARE_IMPL=$sq \
         python tools/bench_kernel_ab.py; done; done
+
+(stack16 halves the stacked operand's HBM bytes and only changes mul,
+so pair it with CMT_TPU_SQUARE_IMPL=mul; pallas fuses the whole field
+op into one VMEM-resident program.)
 """
 
 from __future__ import annotations
