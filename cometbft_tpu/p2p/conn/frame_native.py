@@ -1,0 +1,120 @@
+"""ctypes binding for the native secret-connection frame pump
+(native/transport/frame_crypto.cpp).
+
+Same build-on-demand pattern as the cometkv and BLS components
+(utils/native_build.py): compiled with g++ on first use, gracefully
+absent when the toolchain isn't.  SecretConnection picks this up
+automatically; set CMT_TPU_NO_NATIVE_TRANSPORT=1 to force the
+pure-Python (OpenSSL AEAD) frame path.
+
+The win over the Python loop is structural, not cipher speed: one C
+call seals a whole write's frames into one contiguous buffer (single
+sendall, no per-frame interpreter work, no per-frame allocations), the
+pattern the reference's sendRoutine batches toward
+(p2p/conn/secret_connection.go:33-50).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from cometbft_tpu.utils.native_build import NativeLib
+
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = 1028
+SEALED_FRAME_SIZE = 1044
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.cmt_aead_seal.restype = ctypes.c_long
+    lib.cmt_aead_seal.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+    ]
+    lib.cmt_aead_open.restype = ctypes.c_long
+    lib.cmt_aead_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_char_p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+    ]
+    lib.cmt_frames_seal.restype = ctypes.c_long
+    lib.cmt_frames_seal.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        u8p, ctypes.c_uint64,
+    ]
+    lib.cmt_frames_open.restype = ctypes.c_long
+    lib.cmt_frames_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.cmt_frame_backend.restype = ctypes.c_int
+    lib.cmt_frame_backend.argtypes = []
+
+
+_LIB = NativeLib(
+    src_rel="native/transport/frame_crypto.cpp",
+    out_name="libcmtframes.so",
+    disable_env="CMT_TPU_NO_NATIVE_TRANSPORT",
+    configure=_configure,
+)
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, or None (disabled / no toolchain)."""
+    return _LIB.load()
+
+
+def frame_count(length: int) -> int:
+    """Frames a ``length``-byte write seals into (empty writes still
+    send one empty frame) — the ONE definition callers reserving nonce
+    ranges share with the seal itself."""
+    return max(1, (length + DATA_MAX_SIZE - 1) // DATA_MAX_SIZE)
+
+
+def seal_frames(
+    lib, key: bytes, nonce0: int, data: bytes, nframes: int | None = None
+) -> memoryview:
+    """data -> contiguous sealed frames (n * 1044 bytes).
+
+    Returns a memoryview over the C output buffer (sendall and all
+    bytes-likes accept it) — no copy of the burst on the hot path."""
+    if nframes is None:
+        nframes = frame_count(len(data))
+    out = (ctypes.c_uint8 * (nframes * SEALED_FRAME_SIZE))()
+    rc = lib.cmt_frames_seal(
+        key, nonce0, data, len(data), out, len(out)
+    )
+    if rc != nframes:
+        raise ValueError(f"native frame seal failed: rc={rc}")
+    return memoryview(out).cast("B")
+
+
+def open_frames(
+    lib, key: bytes, nonce0: int, sealed: bytes
+) -> list[bytes]:
+    """Contiguous sealed frames -> per-frame payloads.
+
+    Raises ValueError on auth failure or an invalid declared length
+    (callers translate into their typed connection error).
+    """
+    n, rem = divmod(len(sealed), SEALED_FRAME_SIZE)
+    if rem or n == 0:
+        raise ValueError("sealed buffer is not whole frames")
+    out = (ctypes.c_uint8 * (n * DATA_MAX_SIZE))()
+    lens = (ctypes.c_uint32 * n)()
+    rc = lib.cmt_frames_open(
+        key, nonce0, sealed, n, out, len(out), lens
+    )
+    if rc < 0:
+        if rc <= -2000000:
+            raise ValueError(f"frame pump resource failure (rc={rc})")
+        if rc <= -1000000:
+            raise ValueError(f"invalid frame length (frame {-1000000 - rc})")
+        raise ValueError(f"frame auth failed (frame {-rc - 1})")
+    payloads = []
+    off = 0
+    buf = bytes(out)
+    for i in range(n):
+        payloads.append(buf[off : off + lens[i]])
+        off += lens[i]
+    return payloads

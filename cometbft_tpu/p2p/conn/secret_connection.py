@@ -30,12 +30,21 @@ from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.exceptions import InvalidTag
 
 from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+from cometbft_tpu.p2p.conn import frame_native
+
+# Load (and if needed compile) the native frame pump at import time —
+# node startup, not inside a handshake: a first-use g++ build mid-
+# handshake would stall past the remote's handshake timeout.  None
+# when disabled or no toolchain; connections fall back to the Python
+# AEAD per frame.
+_NATIVE_PUMP = frame_native.load()
 
 DATA_LEN_SIZE = 4          # secret_connection.go:40 dataLenSize
-DATA_MAX_SIZE = 1024       # secret_connection.go:41 dataMaxSize
-TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE  # 1028
-TAG_SIZE = 16              # poly1305 tag
-SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE
+# frame geometry is owned by frame_native (shared with the C pump)
+DATA_MAX_SIZE = frame_native.DATA_MAX_SIZE          # 1024
+TOTAL_FRAME_SIZE = frame_native.TOTAL_FRAME_SIZE    # 1028
+SEALED_FRAME_SIZE = frame_native.SEALED_FRAME_SIZE  # 1044
+TAG_SIZE = SEALED_FRAME_SIZE - TOTAL_FRAME_SIZE     # poly1305 tag
 NONCE_SIZE = 12
 
 
@@ -64,12 +73,24 @@ class _Nonce:
     def __init__(self) -> None:
         self._counter = 0
 
-    def next(self) -> bytes:
-        n = self._counter
-        self._counter += 1
-        if n >= 1 << 64:
+    def peek(self, n: int = 1) -> int:
+        """The next counter value, validating that ``n`` consecutive
+        values are available — WITHOUT consuming them (callers commit
+        with take() only after the seal succeeds, so a failed seal
+        leaves the counter in sync with what the peer received)."""
+        if self._counter + n > 1 << 64:
             raise SecretConnectionError("nonce counter overflow")
-        return b"\x00\x00\x00\x00" + struct.pack("<Q", n)
+        return self._counter
+
+    def take(self, n: int = 1) -> int:
+        """Reserve ``n`` consecutive counter values, returning the
+        first (the native pump seals a whole write burst per call)."""
+        start = self.peek(n)
+        self._counter += n
+        return start
+
+    def next(self) -> bytes:
+        return b"\x00\x00\x00\x00" + struct.pack("<Q", self.take())
 
 
 class SecretConnection:
@@ -120,8 +141,15 @@ class SecretConnection:
 
         self._send_aead = ChaCha20Poly1305(send_key)
         self._recv_aead = ChaCha20Poly1305(recv_key)
+        # raw send key for the native pump; the receive side stays on
+        # the Python AEAD (single-frame reads - see read()), so the
+        # raw recv key is deliberately NOT retained
+        self._send_key = send_key
         self._send_nonce = _Nonce()
         self._recv_nonce = _Nonce()
+        # native frame pump (one C call per write burst);
+        # None -> pure-Python OpenSSL AEAD per frame
+        self._native = _NATIVE_PUMP
 
         # -- authenticate (secret_connection.go:151 shareAuthSignature) --
         pub = priv_key.pub_key()
@@ -149,9 +177,34 @@ class SecretConnection:
         return buf
 
     def write(self, data: bytes) -> int:
-        """Seal ``data`` into as many frames as needed."""
+        """Seal ``data`` into as many frames as needed.
+
+        With the native pump, the whole burst seals in ONE C call and
+        leaves as ONE sendall — no per-frame interpreter work."""
         total = len(data)
         with self._send_mtx:
+            nframes = frame_native.frame_count(total)
+            # measured crossover (tools/bench_frames.py): the pump wins
+            # 2-5x on multi-frame bursts, but a single frame pays more
+            # in call overhead than it saves — route those to the
+            # Python AEAD (same reasoning as the device dispatch
+            # threshold, ed25519_verify.runtime_device_min_batch)
+            if self._native is not None and nframes >= 2:
+                nonce0 = self._send_nonce.peek(nframes)
+                try:
+                    sealed = frame_native.seal_frames(
+                        self._native, self._send_key, nonce0, data,
+                        nframes=nframes,
+                    )
+                except ValueError as exc:
+                    # counter stays unconsumed: the peer received
+                    # nothing, so the stream is still in sync
+                    raise SecretConnectionError(
+                        f"native frame seal failed: {exc}"
+                    ) from exc
+                self._send_nonce.take(nframes)
+                self._sock.sendall(sealed)
+                return total
             off = 0
             while True:
                 chunk = data[off : off + DATA_MAX_SIZE]
@@ -176,6 +229,9 @@ class SecretConnection:
                 sealed = self._read_exact(SEALED_FRAME_SIZE)
             except SecretConnectionError:
                 return b""
+            # read() is inherently single-frame, where the Python AEAD
+            # measures faster than a one-frame pump call (see write());
+            # frame_native.open_frames stays for batched readers.
             try:
                 frame = self._recv_aead.decrypt(
                     self._recv_nonce.next(), sealed, None

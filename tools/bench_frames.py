@@ -1,0 +1,102 @@
+"""Secret-connection frame-plane micro-benchmark: Python per-frame
+OpenSSL AEAD loop vs the native batched pump
+(native/transport/frame_crypto.cpp).
+
+Measures seal and open throughput for a burst of ``SIZE`` bytes (a
+typical block-part gossip write), printing MB/s and frames/s for each
+path.  Run on any host — no device involved.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from cometbft_tpu.p2p.conn import frame_native
+
+SIZE = int(os.environ.get("FB_SIZE", 65536))
+REPS = int(os.environ.get("FB_REPS", 200))
+DATA_MAX = 1024
+
+
+def py_seal(key: bytes, nonce0: int, data: bytes) -> bytes:
+    aead = ChaCha20Poly1305(key)
+    out = []
+    off = ctr = 0
+    while True:
+        chunk = data[off : off + DATA_MAX]
+        frame = struct.pack("<I", len(chunk)) + chunk
+        frame += b"\x00" * (1028 - len(frame))
+        nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", nonce0 + ctr)
+        out.append(aead.encrypt(nonce, frame, None))
+        off += len(chunk)
+        ctr += 1
+        if off >= len(data):
+            break
+    return b"".join(out)
+
+
+def py_open(key: bytes, nonce0: int, sealed: bytes) -> bytes:
+    aead = ChaCha20Poly1305(key)
+    out = []
+    for f in range(len(sealed) // 1044):
+        nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", nonce0 + f)
+        frame = aead.decrypt(nonce, sealed[f * 1044 : (f + 1) * 1044], None)
+        (length,) = struct.unpack("<I", frame[:4])
+        out.append(frame[4 : 4 + length])
+    return b"".join(out)
+
+
+def bench(label, fn):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        fn()
+    dt = time.perf_counter() - t0
+    nframes = -(-SIZE // DATA_MAX)
+    print(
+        f"{label:28s} {SIZE * REPS / dt / 1e6:8.1f} MB/s "
+        f"({nframes * REPS / dt:9,.0f} frames/s)"
+    )
+    return SIZE * REPS / dt / 1e6
+
+
+def main():
+    lib = frame_native.load()
+    key = os.urandom(32)
+    data = os.urandom(SIZE)
+    sealed = py_seal(key, 0, data)
+    results = {}
+    results["py_seal"] = bench(
+        "python seal (per-frame)", lambda: py_seal(key, 0, data)
+    )
+    results["py_open"] = bench(
+        "python open (per-frame)", lambda: py_open(key, 0, sealed)
+    )
+    if lib is None:
+        print("native pump unavailable")
+        return
+    assert frame_native.seal_frames(lib, key, 0, data) == sealed
+    results["native_seal"] = bench(
+        "native seal (one call)",
+        lambda: frame_native.seal_frames(lib, key, 0, data),
+    )
+    results["native_open"] = bench(
+        "native open (one call)",
+        lambda: frame_native.open_frames(lib, key, 0, sealed),
+    )
+    print(
+        f"seal speedup {results['native_seal'] / results['py_seal']:.2f}x, "
+        f"open speedup {results['native_open'] / results['py_open']:.2f}x "
+        f"(burst={SIZE} bytes)"
+    )
+
+
+if __name__ == "__main__":
+    main()
