@@ -89,13 +89,18 @@ def seal_frames(
     return memoryview(out).cast("B")
 
 
-def open_frames(
+def open_frames_partial(
     lib, key: bytes, nonce0: int, sealed: bytes
-) -> list[bytes]:
-    """Contiguous sealed frames -> per-frame payloads.
+) -> tuple[bytes, int, str | None]:
+    """Contiguous sealed frames -> (payload, frames_opened, error).
 
-    Raises ValueError on auth failure or an invalid declared length
-    (callers translate into their typed connection error).
+    Sequential semantics for batched readers: the C side stops at the
+    first bad frame, and everything a sequential reader would have
+    delivered BEFORE it comes back as the payload prefix (one copy out
+    of the C buffer — no per-frame split).  ``error`` is None on full
+    success; otherwise a message naming the bad frame, with
+    ``frames_opened`` telling the caller how many nonces were
+    legitimately consumed first.
     """
     n, rem = divmod(len(sealed), SEALED_FRAME_SIZE)
     if rem or n == 0:
@@ -105,16 +110,26 @@ def open_frames(
     rc = lib.cmt_frames_open(
         key, nonce0, sealed, n, out, len(out), lens
     )
-    if rc < 0:
-        if rc <= -2000000:
-            raise ValueError(f"frame pump resource failure (rc={rc})")
-        if rc <= -1000000:
-            raise ValueError(f"invalid frame length (frame {-1000000 - rc})")
-        raise ValueError(f"frame auth failed (frame {-rc - 1})")
-    payloads = []
-    off = 0
-    buf = bytes(out)
-    for i in range(n):
-        payloads.append(buf[off : off + lens[i]])
-        off += lens[i]
-    return payloads
+    if rc >= 0:
+        return bytes(memoryview(out)[:rc]), n, None
+    if rc <= -2000000:
+        # resource failure: nothing was verified, nothing consumed
+        return b"", 0, f"frame pump resource failure (rc={rc})"
+    if rc <= -1000000:
+        bad = -1000000 - rc
+        err = f"invalid frame length (frame {bad})"
+    else:
+        bad = -rc - 1
+        err = f"frame auth failed (frame {bad})"
+    prefix = sum(lens[i] for i in range(bad))
+    return bytes(memoryview(out)[:prefix]), bad, err
+
+
+def open_frames(lib, key: bytes, nonce0: int, sealed: bytes) -> bytes:
+    """Contiguous sealed frames -> concatenated payload; raises
+    ValueError on any bad frame (callers translate into their typed
+    connection error)."""
+    payload, _, err = open_frames_partial(lib, key, nonce0, sealed)
+    if err is not None:
+        raise ValueError(err)
+    return payload

@@ -19,6 +19,7 @@ with a 12-byte little-endian counter nonce exactly like the reference
 
 from __future__ import annotations
 
+import select
 import struct
 import threading
 
@@ -141,10 +142,16 @@ class SecretConnection:
 
         self._send_aead = ChaCha20Poly1305(send_key)
         self._recv_aead = ChaCha20Poly1305(recv_key)
-        # raw send key for the native pump; the receive side stays on
-        # the Python AEAD (single-frame reads - see read()), so the
-        # raw recv key is deliberately NOT retained
+        # raw keys for the native pump (batched seal on write bursts,
+        # batched open when the socket has several frames buffered)
         self._send_key = send_key
+        self._recv_key = recv_key
+        self._sealed_buf = bytearray()
+        # deferred receive error: a batched open that failed mid-burst
+        # first delivers the valid prefix (sequential semantics), then
+        # raises this on the following read
+        self._recv_err: SecretConnectionError | None = None
+        self._can_select: bool | None = None
         self._send_nonce = _Nonce()
         self._recv_nonce = _Nonce()
         # native frame pump (one C call per write burst);
@@ -219,19 +226,87 @@ class SecretConnection:
                     break
         return total
 
+    #: most sealed frames a single batched read() will drain (32 KB of
+    #: payload per native open call; bounds the buffer, not throughput)
+    MAX_READ_FRAMES = 32
+
+    def _drain_available(self) -> None:
+        """Pull whatever the kernel has ALREADY buffered into
+        _sealed_buf without blocking — the batched native open then
+        processes every complete frame in one call.  No-op for
+        socket-likes without a selectable fd (test doubles)."""
+        if self._can_select is None:
+            try:
+                self._sock.fileno()
+                self._can_select = True
+            except (AttributeError, OSError):
+                self._can_select = False
+        if not self._can_select:
+            return
+        cap = self.MAX_READ_FRAMES * SEALED_FRAME_SIZE
+        while len(self._sealed_buf) < cap:
+            try:
+                ready, _, _ = select.select([self._sock], [], [], 0)
+            except (OSError, ValueError):
+                return
+            if not ready:
+                return
+            try:
+                chunk = self._sock.recv(cap - len(self._sealed_buf))
+            except OSError:
+                return
+            if not chunk:
+                return  # EOF; complete frames already read still count
+            self._sealed_buf += chunk
+
     def read(self) -> bytes:
-        """Return the data of the next frame ('' on EOF)."""
+        """Return the data of the next frame(s) ('' on EOF).
+
+        One frame is read blocking; with the native pump, any further
+        frames the socket has already buffered are drained and opened
+        in the SAME C call (the 2x batched-open win measured by
+        tools/bench_frames.py) — their payloads return concatenated,
+        which read_exact()'s buffering makes transparent to callers."""
         with self._recv_mtx:
             if self._recv_buf:
                 out, self._recv_buf = self._recv_buf, b""
                 return out
-            try:
-                sealed = self._read_exact(SEALED_FRAME_SIZE)
-            except SecretConnectionError:
-                return b""
-            # read() is inherently single-frame, where the Python AEAD
-            # measures faster than a one-frame pump call (see write());
-            # frame_native.open_frames stays for batched readers.
+            if self._recv_err is not None:
+                raise self._recv_err
+            while len(self._sealed_buf) < SEALED_FRAME_SIZE:
+                # OSError (timeout, reset) propagates distinctly —
+                # only an orderly EOF reads as the empty string
+                chunk = self._sock.recv(
+                    SEALED_FRAME_SIZE - len(self._sealed_buf)
+                )
+                if not chunk:
+                    return b""
+                self._sealed_buf += chunk
+            if self._native is not None:
+                self._drain_available()
+            nframes = len(self._sealed_buf) // SEALED_FRAME_SIZE
+            if self._native is None or nframes < 2:
+                nframes = 1  # single frame: Python AEAD measures faster
+            take = nframes * SEALED_FRAME_SIZE
+            with memoryview(self._sealed_buf) as mv:
+                sealed = bytes(mv[:take])
+            del self._sealed_buf[:take]
+            if nframes > 1:
+                payload, opened, err = frame_native.open_frames_partial(
+                    self._native,
+                    self._recv_key,
+                    self._recv_nonce.peek(nframes),
+                    sealed,
+                )
+                self._recv_nonce.take(opened)
+                if err is not None:
+                    # sequential semantics: everything a frame-by-frame
+                    # reader would have delivered before the bad frame
+                    # goes out now; the error fires on the next read
+                    self._recv_err = SecretConnectionError(err)
+                    if not payload:
+                        raise self._recv_err
+                return payload
             try:
                 frame = self._recv_aead.decrypt(
                     self._recv_nonce.next(), sealed, None

@@ -275,7 +275,7 @@ EvpApi load_evp() {
   void* h = nullptr;
   for (const char* name :
        {"libcrypto.so.3", "libcrypto.so", "libcrypto.so.1.1"}) {
-    h = dlopen(name, RTLD_NOW | RTLD_GLOBAL);
+    h = dlopen(name, RTLD_NOW | RTLD_LOCAL);
     if (h) break;
   }
   if (!h) return api;

@@ -102,8 +102,8 @@ def test_open_differential_and_tamper():
         _py_seal_frame(key, 7 + f, data[f * 1024 : (f + 1) * 1024])
         for f in range(3)
     ]
-    payloads = frame_native.open_frames(lib, key, 7, b"".join(frames))
-    assert b"".join(payloads) == data
+    payload = frame_native.open_frames(lib, key, 7, b"".join(frames))
+    assert payload == data
     # wrong nonce -> auth failure naming the frame
     with pytest.raises(ValueError, match="frame auth failed \\(frame 0\\)"):
         frame_native.open_frames(lib, key, 8, b"".join(frames))
@@ -183,3 +183,79 @@ def test_scalar_and_evp_backends_agree():
     )
     assert out.returncode == 0, out.stderr.decode()
     assert out.stdout == sealed_evp
+
+
+def test_batched_read_path():
+    """A big burst written in one sendall is drained and opened in
+    batched native calls on the receive side; payload integrity and
+    nonce accounting hold across the mixed single/batched reads."""
+    import time
+
+    from cometbft_tpu.crypto.ed25519 import gen_priv_key
+    from cometbft_tpu.p2p.conn import secret_connection as sc
+
+    a, b = socket.socketpair()
+    res = {}
+
+    def server():
+        conn = sc.SecretConnection(b, gen_priv_key())
+        time.sleep(0.2)  # let the whole burst land in the socket buffer
+        res["got"] = conn.read_exact(50_000)
+        conn.write(b"done")
+        res["tail"] = conn.read_exact(7)
+
+    t = threading.Thread(target=server)
+    t.start()
+    conn = sc.SecretConnection(a, gen_priv_key())
+    assert conn._native is not None
+    blob = os.urandom(50_000)
+    conn.write(blob)                       # 49 frames, one sendall
+    assert conn.read_exact(4) == b"done"   # single-frame read path
+    conn.write(b"seven!!")                 # single frame write path
+    t.join(timeout=15)
+    assert res["got"] == blob
+    assert res["tail"] == b"seven!!"
+    conn.close()
+
+
+def test_batched_read_tamper_sequential_semantics():
+    """Corruption inside a batched burst: every frame a sequential
+    reader would have delivered BEFORE the bad one still arrives, then
+    the typed SecretConnectionError fires — regardless of how the
+    frames group into batches (the burst may even coalesce with the
+    handshake's auth read)."""
+    import time
+
+    from cometbft_tpu.crypto.ed25519 import gen_priv_key
+    from cometbft_tpu.p2p.conn import secret_connection as sc
+
+    a, b = socket.socketpair()
+    res: dict = {}
+
+    def server():
+        conn = sc.SecretConnection(b, gen_priv_key())
+        time.sleep(0.2)  # let the tampered burst coalesce in the buffer
+        try:
+            res["prefix"] = conn.read_exact(3 * 1024)  # frames 0-2: valid
+            conn.read_exact(1)  # frame 3 is tampered
+            res["err"] = None
+        except sc.SecretConnectionError as exc:
+            res["err"] = exc
+
+    t = threading.Thread(target=server)
+    t.start()
+    conn = sc.SecretConnection(a, gen_priv_key())
+    # seal a 10-frame burst, flip a bit in frame 3, send raw
+    from cometbft_tpu.p2p.conn import frame_native as fn
+
+    data = os.urandom(10_000)
+    nonce0 = conn._send_nonce.take(10)
+    sealed = bytearray(
+        fn.seal_frames(conn._native, conn._send_key, nonce0, data)
+    )
+    sealed[3 * 1044 + 50] ^= 1
+    conn._sock.sendall(bytes(sealed))
+    t.join(timeout=15)
+    assert res["prefix"] == data[: 3 * 1024]
+    assert res["err"] is not None and "auth failed" in str(res["err"])
+    conn.close()
