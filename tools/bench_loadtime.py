@@ -66,6 +66,11 @@ def main() -> int:
     ap.add_argument("--size", type=int, default=1024, help="tx bytes")
     ap.add_argument("--connections", type=int, default=1)
     ap.add_argument("--out", default=os.path.join(REPO, "BENCH_ALL.json"))
+    ap.add_argument(
+        "--config-name", default="loadtime_localnet",
+        help="BENCH_ALL.json entry to write (e.g. "
+        "loadtime_localnet_saturation for the knee probe)",
+    )
     args = ap.parse_args()
 
     env = _node_env()
@@ -144,7 +149,7 @@ def main() -> int:
     rep = reports[0].as_dict() if reports else {}
     committed = rep.get("count", 0)
     entry = {
-        "config": "loadtime_localnet",
+        "config": args.config_name,
         "value": round(committed / load_wall, 1),
         "unit": "tx/sec committed",
         "offered_rate": args.rate,
@@ -173,7 +178,7 @@ def main() -> int:
         bench = {"results": []}
     bench["results"] = [
         r for r in bench.get("results", [])
-        if r.get("config") != "loadtime_localnet"
+        if r.get("config") != args.config_name
     ] + [entry]
     with open(args.out, "w") as f:
         json.dump(bench, f, indent=1)
