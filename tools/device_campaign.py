@@ -1,0 +1,162 @@
+"""Round-4 device measurement campaign, resumable across tunnel
+windows.
+
+The tunneled axon backend comes and goes (r3's bench recorded 0 during
+an outage); this driver runs each measurement in its OWN subprocess
+with a deadline, appends whatever lands to docs/data/kernel_ab_r04.json
+immediately, and skips steps that already have a result — so a short
+healthy window makes progress and a wedge costs one step's timeout,
+not the campaign.
+
+    python tools/device_campaign.py [--only STEP] [--timeout S]
+
+Steps (env = the kernel config under test, tool = what runs):
+  keyed_stack     CMT_TPU_COLS_IMPL=stack             bench_keyed
+  keyed_stack16   CMT_TPU_COLS_IMPL=stack16 SQ=mul    bench_keyed
+  keyed_pallas    CMT_TPU_COLS_IMPL=pallas            bench_keyed
+  ab_stack        generic kernel A/B                  bench_kernel_ab
+  ab_stack16      generic kernel A/B                  bench_kernel_ab
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "data", "kernel_ab_r04.json")
+
+STEPS = {
+    "keyed_stack": (
+        {"CMT_TPU_COLS_IMPL": "stack", "CMT_TPU_SQUARE_IMPL": "fast"},
+        "tools/bench_keyed.py",
+    ),
+    "keyed_stack16": (
+        {"CMT_TPU_COLS_IMPL": "stack16", "CMT_TPU_SQUARE_IMPL": "mul"},
+        "tools/bench_keyed.py",
+    ),
+    "keyed_pallas": (
+        {"CMT_TPU_COLS_IMPL": "pallas", "CMT_TPU_SQUARE_IMPL": "fast"},
+        "tools/bench_keyed.py",
+    ),
+    "ab_stack": (
+        {"CMT_TPU_COLS_IMPL": "stack", "CMT_TPU_SQUARE_IMPL": "fast"},
+        "tools/bench_kernel_ab.py",
+    ),
+    "ab_stack16": (
+        {"CMT_TPU_COLS_IMPL": "stack16", "CMT_TPU_SQUARE_IMPL": "mul"},
+        "tools/bench_kernel_ab.py",
+    ),
+}
+
+RATE_RE = re.compile(r"([\d,]+) sigs/s device-side")
+
+
+def load() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"results": {}}
+
+
+def save(data: dict) -> None:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def probe(timeout: float = 75.0) -> bool:
+    """Is the device tunnel answering at all?"""
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "print(jax.devices());"
+        "print(float((jnp.arange(8) * 2).sum()))"
+    )
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, timeout=timeout,
+            capture_output=True,
+        ).returncode
+        return rc == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_step(name: str, timeout: float) -> dict:
+    env_extra, tool = STEPS[name]
+    env = dict(os.environ, **env_extra)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, tool], cwd=REPO, env=env, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        out = proc.stdout + proc.stderr
+        m = RATE_RE.search(out)
+        entry = {
+            "rc": proc.returncode,
+            "wall_s": round(time.time() - t0, 1),
+            "tail": out.strip().splitlines()[-4:],
+        }
+        if m:
+            entry["sigs_per_sec_device"] = float(m.group(1).replace(",", ""))
+        return entry
+    except subprocess.TimeoutExpired as exc:
+        out = ((exc.stdout or b"").decode(errors="replace") if
+               isinstance(exc.stdout, bytes) else (exc.stdout or ""))
+        return {
+            "rc": "timeout",
+            "wall_s": round(time.time() - t0, 1),
+            "tail": out.strip().splitlines()[-4:],
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", help="run just this step")
+    ap.add_argument("--timeout", type=float, default=1500.0)
+    ap.add_argument("--redo", action="store_true",
+                    help="rerun steps that already have results")
+    args = ap.parse_args()
+
+    if not probe():
+        print("device tunnel not answering; campaign deferred",
+              file=sys.stderr)
+        return 3
+    data = load()
+    steps = [args.only] if args.only else list(STEPS)
+    for name in steps:
+        done = data["results"].get(name, {})
+        if not args.redo and "sigs_per_sec_device" in done:
+            print(f"{name}: already measured "
+                  f"({done['sigs_per_sec_device']:,.0f} sigs/s), skipping",
+                  file=sys.stderr)
+            continue
+        print(f"{name}: running (timeout {args.timeout:.0f}s)...",
+              file=sys.stderr)
+        entry = run_step(name, args.timeout)
+        entry["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        data["results"][name] = entry
+        save(data)
+        rate = entry.get("sigs_per_sec_device")
+        print(f"{name}: " + (f"{rate:,.0f} sigs/s" if rate else
+                             f"no rate (rc={entry['rc']})"),
+              file=sys.stderr)
+        if not probe(45):
+            print("tunnel went away mid-campaign; stopping here",
+                  file=sys.stderr)
+            return 4
+    print(json.dumps(load(), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    main()
