@@ -146,12 +146,34 @@ def main() -> None:
     on_cpu = dev.platform == "cpu"
     log(f"device: {dev}")
     results = []
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json"
+    )
+
+    def checkpoint():
+        # merge-write after every config: a mid-run death (r3 lost the
+        # mixed-megacommit entry this way) keeps what was measured,
+        # and entries other tools own (loadtime_*) are preserved
+        try:
+            with open(path) as f:
+                existing = json.load(f).get("results", [])
+        except (OSError, ValueError):
+            existing = []
+        ours = {r["config"] for r in results}
+        merged = [
+            r for r in existing if r.get("config") not in ours
+        ] + results
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"device": str(dev), "results": merged}, f, indent=1)
+        os.replace(tmp, path)
 
     def record(config: str, value: float, unit: str, **extra):
         row = {"config": config, "value": round(value, 2), "unit": unit}
         row.update(extra)
         results.append(row)
         print(json.dumps(row), flush=True)
+        checkpoint()
 
     # ---- config 1: 64-sig micro-bench --------------------------------
     rng = np.random.RandomState(7)
@@ -326,14 +348,7 @@ def main() -> None:
         sigs_per_sec=round((n_ed + n_bls) / dt, 1),
     )
 
-    with open(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "BENCH_ALL.json"),
-        "w",
-    ) as f:
-        json.dump(
-            {"device": str(dev), "results": results}, f, indent=1
-        )
+    checkpoint()
     log("wrote BENCH_ALL.json")
 
 
