@@ -19,7 +19,13 @@ from tests.test_e2e_perturb import _Net, _height, _rpc, _wait_heights
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("seed", [1337, 90210])
+# soak with extra seeds: CMT_E2E_EXTRA_SEEDS=7,424242 make test
+_EXTRA_SEEDS = [
+    int(s) for s in os.environ.get("CMT_E2E_EXTRA_SEEDS", "").split(",") if s
+]
+
+
+@pytest.mark.parametrize("seed", [1337, 90210] + _EXTRA_SEEDS)
 def test_generated_perturbation_sequence(tmp_path, seed):
     rng = random.Random(seed)
     base_port = 27500 + (seed % 50) * 10
