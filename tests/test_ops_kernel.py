@@ -525,3 +525,53 @@ def test_verify_stream_keyed_dispatch(rng):
         assert bool(res.all())
         total += len(res)
     assert total == nsig * 6
+
+
+@pytest.mark.parametrize("impl", ["stack16", "pallas"])
+def test_keyed_kernel_under_alternate_field_cores(impl, monkeypatch):
+    """The keyed (precomputed-table) kernel is correct under every
+    column-formation variant the device A/B campaign measures
+    (tools/device_campaign.py) — a device window must never be spent
+    discovering a correctness bug.  pallas runs in interpret mode."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import field as F
+    from cometbft_tpu.ops import precompute as PR
+    from cometbft_tpu.ops.ed25519_verify import (
+        _finish,
+        verify_arrays_keyed_async,
+    )
+
+    monkeypatch.setattr(F, "COLS_IMPL", impl)
+    if impl == "pallas":
+        monkeypatch.setattr(F, "_PALLAS_INTERPRET", True)
+        monkeypatch.setattr(F, "_mul_pallas", None)
+        monkeypatch.setattr(F, "_square_pallas", None)
+    else:
+        monkeypatch.setattr(F, "SQUARE_IMPL", "mul")
+    rng = np.random.RandomState(11)
+    privs = [ed.gen_priv_key() for _ in range(3)]
+    pubs_b = [p.pub_key().bytes() for p in privs]
+    PR.TABLE_CACHE.clear()
+    try:
+        entry = PR.TABLE_CACHE.lookup_or_build(pubs_b)
+        idx = [i % 3 for i in range(8)]
+        msgs = [rng.bytes(100) for _ in range(8)]
+        sigs = np.stack(
+            [
+                np.frombuffer(privs[i].sign(m), dtype=np.uint8)
+                for i, m in zip(idx, msgs)
+            ]
+        )
+        pub = np.stack(
+            [np.frombuffer(pubs_b[i], dtype=np.uint8) for i in idx]
+        )
+        kid = entry.key_ids([pubs_b[i] for i in idx])
+        out = _finish(verify_arrays_keyed_async(entry, kid, pub, sigs, msgs))
+        assert out.all()
+        sigs[2, 7] ^= 1
+        out2 = _finish(
+            verify_arrays_keyed_async(entry, kid, pub, sigs, msgs)
+        )
+        assert not out2[2] and out2.sum() == 7
+    finally:
+        PR.TABLE_CACHE.clear()
