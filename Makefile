@@ -4,10 +4,14 @@
 
 PY ?= python
 
-.PHONY: test test-deadlock test-e2e bench bench-all bench-micro native
+.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# adds the interpret-mode pallas keyed-kernel trace (~10 min CPU)
+test-slow:
+	CMT_TPU_SLOW_TESTS=1 $(PY) -m pytest tests/ -x -q
 
 # go-deadlock build-tag analog (tests.mk:61): every core mutex gets a
 # watchdog that dumps stacks and raises instead of hanging.

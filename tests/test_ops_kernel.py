@@ -8,6 +8,7 @@ the TPU path must never disagree with the reference semantics
 """
 
 import hashlib
+import os
 import random
 
 import numpy as np
@@ -532,8 +533,16 @@ def test_keyed_kernel_under_alternate_field_cores(impl, monkeypatch):
     """The keyed (precomputed-table) kernel is correct under every
     column-formation variant the device A/B campaign measures
     (tools/device_campaign.py) — a device window must never be spent
-    discovering a correctness bug.  pallas runs in interpret mode."""
+    discovering a correctness bug.  pallas runs in interpret mode,
+    which re-executes every field op per trace (~10 min for the full
+    keyed graph), so that variant runs in the slow lane
+    (CMT_TPU_SLOW_TESTS=1, `make test-slow`); the pallas CORE's
+    differential vs the big-int oracle stays in every run
+    (tests/test_ops_field.py)."""
+    if impl == "pallas" and not os.environ.get("CMT_TPU_SLOW_TESTS"):
+        pytest.skip("pallas interpret-mode keyed trace: slow lane only")
     from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import ed25519_verify as EV
     from cometbft_tpu.ops import field as F
     from cometbft_tpu.ops import precompute as PR
     from cometbft_tpu.ops.ed25519_verify import (
@@ -541,6 +550,11 @@ def test_keyed_kernel_under_alternate_field_cores(impl, monkeypatch):
         verify_arrays_keyed_async,
     )
 
+    # fresh jit wrappers: the compiled-fn caches key only on shapes, so
+    # without this the second param would reuse the first's traced
+    # executable and never execute its own field core
+    monkeypatch.setattr(EV, "_keyed_cache", {})
+    monkeypatch.setattr(PR, "_build_cache", {})
     monkeypatch.setattr(F, "COLS_IMPL", impl)
     if impl == "pallas":
         monkeypatch.setattr(F, "_PALLAS_INTERPRET", True)
