@@ -263,8 +263,13 @@ class KVStoreApp(Application):
             return QueryResponse(
                 value=str(self._height).encode(), height=self._height
             )
-        key = req.data.decode()
-        value = self._kv.get(key)
+        try:
+            key = req.data.decode()
+            value = self._kv.get(key)
+        except UnicodeDecodeError:
+            # CheckTx only admits utf-8 "k=v" txs, so a non-utf-8 key
+            # can never have been stored — absent, not an error
+            value = None
         if value is None:
             return QueryResponse(
                 code=0, log="does not exist", key=req.data, height=self._height

@@ -253,13 +253,17 @@ def cmd_light(args) -> int:
         for w in args.witness.split(",")
         if w.strip()
     ]
-    light = Client(
-        chain_id=args.chain_id,
-        trust_options=TrustOptions(
+    trust_options = None
+    if args.trusted_height or args.trusted_hash:
+        trust_options = TrustOptions(
             period_ns=int(args.trust_period * 1e9),
             height=args.trusted_height,
             hash=bytes.fromhex(args.trusted_hash),
-        ),
+        )
+    light = Client(
+        chain_id=args.chain_id,
+        trust_options=trust_options,
+        trust_period_ns=int(args.trust_period * 1e9),
         primary=primary,
         witnesses=witnesses,
         trusted_store=LightStore(
@@ -694,8 +698,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="primary full-node RPC address")
     p.add_argument("--witness", default="",
                    help="comma-separated witness RPC addresses")
-    p.add_argument("--trusted-height", type=int, required=True)
-    p.add_argument("--trusted-hash", required=True,
+    p.add_argument("--trusted-height", type=int, default=0,
+                   help="trust-root height (required on first run; "
+                   "omit with --trusted-hash to resume from the "
+                   "existing trusted store, light.go:189)")
+    p.add_argument("--trusted-hash", default="",
                    help="hex header hash at the trusted height")
     p.add_argument("--trust-period", type=float, default=168 * 3600,
                    help="trusting period in seconds")

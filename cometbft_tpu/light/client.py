@@ -70,19 +70,28 @@ class Client:
     def __init__(
         self,
         chain_id: str,
-        trust_options: TrustOptions,
+        trust_options: TrustOptions | None,
         primary: Provider,
         witnesses: list[Provider],
         trusted_store: LightStore,
         verification_mode: str = SKIPPING,
         trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+        trust_period_ns: int = 7 * 24 * 3600 * 1_000_000_000,
         max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         logger: Logger | None = None,
     ):
-        trust_options.validate()
+        if trust_options is not None:
+            trust_options.validate()
         self.chain_id = chain_id
         self.trust_options = trust_options
+        # the trusting period outlives the root of trust: resume mode
+        # (trust_options=None, NewClientFromTrustedStore) still expires
+        # stored headers against it
+        self.trust_period_ns = (
+            trust_options.period_ns if trust_options is not None
+            else trust_period_ns
+        )
         self.primary = primary
         self.witnesses = list(witnesses)
         self.store = trusted_store
@@ -100,6 +109,14 @@ class Client:
         existing = self.store.latest()
         if existing is not None:
             return  # already have a trust root (client.go checkTrustedHeaderUsingOptions simplified: keep store)
+        if self.trust_options is None:
+            # NewClientFromTrustedStore semantics (light/client.go:233,
+            # cmd light.go:189 "continue from latest state"): without a
+            # root of trust there is nothing subjective to anchor to
+            raise LightClientError(
+                "trusted store is empty and no trust options given "
+                "(supply --trusted-height/--trusted-hash on first run)"
+            )
         lb = self.primary.light_block(self.trust_options.height)
         lb.validate_basic(self.chain_id)
         if lb.hash() != self.trust_options.hash:
@@ -197,7 +214,7 @@ class Client:
             nxt.validate_basic(self.chain_id)
             verify_adjacent(
                 current, nxt, self.chain_id,
-                self.trust_options.period_ns, now,
+                self.trust_period_ns, now,
                 self.max_clock_drift_ns,
             )
             if h != new.height:
@@ -221,7 +238,7 @@ class Client:
             try:
                 _verify(
                     base, target, self.chain_id,
-                    self.trust_options.period_ns, now,
+                    self.trust_period_ns, now,
                     self.trust_level, self.max_clock_drift_ns,
                 )
                 verified.append(target)

@@ -174,7 +174,13 @@ class Node(BaseService):
             self.proxy_app = AppConns(default_client_creator(proxy_addr))
         else:
             self.app = app if app is not None else default_app(config)
-            self.proxy_app = AppConns(local_client_creator(self.app))
+            creator = local_client_creator(self.app)
+            # fail-stop on the first app exception (multiAppConn
+            # killChan semantics): an app whose state is unknown takes
+            # the node down instead of leaving a poisoned proxy that
+            # answers RPC as a zombie
+            creator.set_on_error(self._stop_for_app_error)
+            self.proxy_app = AppConns(creator)
 
         # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()
@@ -706,6 +712,20 @@ class Node(BaseService):
             self.grpc_privileged.start()
         # pruner last (node.go:645)
         self.pruner.start()
+
+    def _stop_for_app_error(self, exc: BaseException) -> None:
+        """First app exception -> stop the whole node (proxy fail-stop
+        callback; reference analog: a Go app panic crashes the node
+        process, and multiAppConn's killChan stops it on client
+        errors).  Runs on its own thread, outside the app lock."""
+        self.logger.error(
+            "ABCI application raised; stopping node", err=repr(exc)
+        )
+        try:
+            if self.is_running():
+                self.stop()
+        except Exception as stop_exc:  # noqa: BLE001 — best-effort stop
+            self.logger.error("fail-stop error", err=repr(stop_exc))
 
     def on_stop(self) -> None:
         services = (

@@ -30,13 +30,16 @@ class _LocalClient:
     unsync mode).  Methods mirror the Application surface 1:1.
     """
 
-    def __init__(self, app: Application, lock, shared_error: list):
+    def __init__(
+        self, app: Application, lock, shared_error: list, on_error=None
+    ):
         self._app = app
         self._lock = lock
         # One-slot error latch shared by all four connections: a fatal
         # app error on any connection poisons the whole proxy, since the
         # app's state is unknown (multiAppConn StopForError semantics).
         self._shared_error = shared_error
+        self._on_error = on_error
 
     def _call(self, fn: Callable, *args):
         with self._lock:
@@ -47,7 +50,19 @@ class _LocalClient:
             try:
                 return fn(*args)
             except BaseException as exc:
+                first = not self._shared_error
                 self._shared_error.append(exc)
+                if first and self._on_error is not None:
+                    # fail-stop, the reference way (a Go app panic takes
+                    # the node process down; multiAppConn killChan):
+                    # fire OUTSIDE the app lock on a fresh thread — the
+                    # stop path joins threads that may be blocked on
+                    # this very lock
+                    cb, self._on_error = self._on_error, None
+                    threading.Thread(
+                        target=cb, args=(exc,), name="proxy-fail-stop",
+                        daemon=True,
+                    ).start()
                 raise
 
     def error(self) -> BaseException | None:
@@ -118,9 +133,25 @@ class ClientCreator:
         self._app = app
         self._lock = threading.RLock() if sync else _NopLock()
         self._shared_error: list = []
+        self._on_error = None
+
+    def set_on_error(self, cb) -> None:
+        """``cb(exc)`` fires ONCE, on the first app exception — the
+        node wires its own stop here (multiAppConn killChan analog:
+        an app whose state is unknown must take the node down, not
+        leave a poisoned zombie answering RPC)."""
+        self._on_error = cb
 
     def new_client(self) -> _LocalClient:
-        return _LocalClient(self._app, self._lock, self._shared_error)
+        return _LocalClient(
+            self._app, self._lock, self._shared_error,
+            on_error=lambda exc: self._fire(exc),
+        )
+
+    def _fire(self, exc) -> None:
+        cb, self._on_error = self._on_error, None
+        if cb is not None:
+            cb(exc)
 
 
 def local_client_creator(app: Application) -> ClientCreator:

@@ -12,7 +12,7 @@ import base64
 import threading
 
 from cometbft_tpu.abci.types import CheckTxRequest, InfoRequest, QueryRequest
-from cometbft_tpu.rpc.jsonrpc import RPCError
+from cometbft_tpu.rpc.jsonrpc import QuotedStr, RPCError
 from cometbft_tpu.rpc.serialize import (
     b64,
     block_id_json,
@@ -46,11 +46,16 @@ def _to_int(value, name: str) -> int:
 
 
 def _to_bytes(value, name: str) -> bytes:
-    """Accept hex (with/without 0x) or base64."""
+    """Accept hex (with/without 0x) or base64; a QUOTED URI arg means
+    the literal bytes of the unquoted string (the reference's URI-arg
+    semantics for []byte params — `tx="name=ada"` sends b"name=ada",
+    http_uri_handler.go)."""
     if isinstance(value, bytes):
         return value
     if not isinstance(value, str):
         raise RPCError(-32602, f"invalid {name}")
+    if isinstance(value, QuotedStr):
+        return value.encode()
     s = value[2:] if value.startswith("0x") else value
     try:
         return bytes.fromhex(s)

@@ -349,15 +349,27 @@ class JSONRPCServer(BaseService):
         self._httpd.server_close()
 
 
+class QuotedStr(str):
+    """A URI arg that arrived explicitly quoted.  The reference's URI
+    handler gives quoted args raw-string semantics for byte-typed
+    params (`tx="name=ada"` means the literal bytes b"name=ada", while
+    unquoted args must be hex/base64 — http_uri_handler.go arg
+    parsing); this marker carries the quoted-ness to _to_bytes without
+    changing anything for string-typed params."""
+
+
 def _parse_uri_arg(value: str):
     """URI args arrive as strings; JSON-decode the obvious scalars
     (http_uri_handler.go arg parsing)."""
     if value in ("true", "false"):
         return value == "true"
     try:
-        return json.loads(value)
+        decoded = json.loads(value)
     except (json.JSONDecodeError, ValueError):
         return value
+    if isinstance(decoded, str) and value.startswith('"'):
+        return QuotedStr(decoded)
+    return decoded
 
 
 __all__ = [

@@ -315,3 +315,70 @@ class TestMempoolRoutes:
             assert "dial_seeds" not in env.routes()
         finally:
             env.unsafe = was
+
+
+class TestQuotedUriArgs:
+    """Reference URI-arg semantics for []byte params
+    (rpc/jsonrpc/server/http_uri_handler.go): a QUOTED arg is the raw
+    bytes of the unquoted string, 0x... is hex, bare strings must be
+    hex/base64 — the curl-from-the-docs quickstart path."""
+
+    def test_quoted_tx_and_query_roundtrip(self, net):
+        import urllib.parse
+        import urllib.request
+
+        node = net[0]
+        base = f"http://{node.rpc_server.host}:{node.rpc_server.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=30) as r:
+                return json.loads(r.read())
+
+        q = urllib.parse.quote
+        res = get(f'/broadcast_tx_commit?tx={q(chr(34) + "qname=ada" + chr(34))}')
+        assert res["result"]["tx_result"]["code"] == 0
+        out = get(f'/abci_query?data={q(chr(34) + "qname" + chr(34))}')
+        resp = out["result"]["response"]
+        assert base64.b64decode(resp["value"]) == b"ada"
+        # bare non-hex/base64 arg still rejected with the typed error
+        bad = get("/abci_query?data=zz!!")
+        assert bad["error"]["code"] == -32602
+
+    def test_query_non_utf8_key_reports_absent(self, net):
+        """A base64-decoding arg yielding non-utf-8 bytes must get the
+        app's clean 'does not exist', never an internal error."""
+        c = client_for(net[0])
+        out = c.abci_query(data="naZ+")  # base64 -> non-utf8 bytes
+        assert out["response"]["log"] == "does not exist"
+
+
+def test_app_exception_fail_stops_node(tmp_path):
+    """First app exception takes the node down (multiAppConn killChan
+    semantics) instead of leaving a poisoned proxy zombie: before this,
+    a query crash latched the shared error and every subsequent CheckTx
+    failed while the node kept 'running'."""
+    from cometbft_tpu.abci.kvstore import KVStoreApp
+
+    class CrashyQueryApp(KVStoreApp):
+        def query(self, req):
+            if req.data == b"boom":
+                raise RuntimeError("app bug")
+            return super().query(req)
+
+    nodes, privs, gen = make_localnet(tmp_path, 1, app_factory=CrashyQueryApp)
+    node = nodes[0]
+    node.start()
+    try:
+        wait_all_height(nodes, 2)
+        c = client_for(node)
+        with pytest.raises(Exception):
+            c.abci_query(data=b"boom".hex())
+        deadline = time.time() + 15
+        while node.is_running() and time.time() < deadline:
+            time.sleep(0.2)
+        assert not node.is_running(), "node must fail-stop on app error"
+    finally:
+        try:
+            node.stop()
+        except Exception:
+            pass
