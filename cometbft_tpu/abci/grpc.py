@@ -181,6 +181,7 @@ class GrpcClient:
         self._channel: grpc.Channel | None = None
         self._lock = threading.Lock()
         self._closed = False
+        self._error: BaseException | None = None
 
     def ensure_connected(self) -> None:
         with self._lock:
@@ -200,6 +201,11 @@ class GrpcClient:
                 f"cannot connect to ABCI gRPC app at {self.addr}"
             ) from exc
         self._channel = ch
+
+    def error(self):
+        """First fatal RPC error, or None (socket-client parity; the
+        AppConns watcher polls this for fail-stop)."""
+        return self._error
 
     def close(self) -> None:
         # Deliberately NOT taking self._lock: grpc.Channel.close() is
@@ -231,6 +237,10 @@ class GrpcClient:
                     codec.encode_msg(req), timeout=self._request_timeout
                 )
             except grpc.RpcError as exc:
+                # latch for AppConns' fail-stop watcher (the socket
+                # client's error() analog, abci/client.py)
+                if self._error is None and not self._closed:
+                    self._error = exc
                 raise AbciClientError(
                     f"abci grpc call {method} failed: {exc}"
                 ) from exc

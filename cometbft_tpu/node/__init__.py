@@ -174,13 +174,13 @@ class Node(BaseService):
             self.proxy_app = AppConns(default_client_creator(proxy_addr))
         else:
             self.app = app if app is not None else default_app(config)
-            creator = local_client_creator(self.app)
-            # fail-stop on the first app exception (multiAppConn
-            # killChan semantics): an app whose state is unknown takes
-            # the node down instead of leaving a poisoned proxy that
-            # answers RPC as a zombie
-            creator.set_on_error(self._stop_for_app_error)
-            self.proxy_app = AppConns(creator)
+            self.proxy_app = AppConns(local_client_creator(self.app))
+        # fail-stop on the first fatal app/client error (multiAppConn
+        # killChan semantics): an app whose state is unknown takes the
+        # node down instead of leaving a poisoned proxy that answers
+        # RPC as a zombie.  In-process apps report synchronously;
+        # external (socket/grpc) apps via the AppConns error watcher.
+        self.proxy_app.set_on_error(self._stop_for_app_error)
 
         # 4. event bus + indexer (setup.go:181,190)
         self.event_bus = EventBus()

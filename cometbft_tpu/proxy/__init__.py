@@ -57,11 +57,11 @@ class _LocalClient:
                     # the node process down; multiAppConn killChan):
                     # fire OUTSIDE the app lock on a fresh thread — the
                     # stop path joins threads that may be blocked on
-                    # this very lock
-                    cb, self._on_error = self._on_error, None
+                    # this very lock.  Once-delivery is latched at the
+                    # AppConns level.
                     threading.Thread(
-                        target=cb, args=(exc,), name="proxy-fail-stop",
-                        daemon=True,
+                        target=self._on_error, args=(exc,),
+                        name="proxy-fail-stop", daemon=True,
                     ).start()
                 raise
 
@@ -136,10 +136,11 @@ class ClientCreator:
         self._on_error = None
 
     def set_on_error(self, cb) -> None:
-        """``cb(exc)`` fires ONCE, on the first app exception — the
-        node wires its own stop here (multiAppConn killChan analog:
-        an app whose state is unknown must take the node down, not
-        leave a poisoned zombie answering RPC)."""
+        """``cb(exc)`` is invoked on the first app exception — the
+        node wires its stop here (multiAppConn killChan analog: an app
+        whose state is unknown must take the node down, not leave a
+        poisoned zombie answering RPC).  Once-delivery is the caller's
+        concern (AppConns latches)."""
         self._on_error = cb
 
     def new_client(self) -> _LocalClient:
@@ -149,9 +150,8 @@ class ClientCreator:
         )
 
     def _fire(self, exc) -> None:
-        cb, self._on_error = self._on_error, None
-        if cb is not None:
-            cb(exc)
+        if self._on_error is not None:
+            self._on_error(exc)
 
 
 def local_client_creator(app: Application) -> ClientCreator:
@@ -227,6 +227,37 @@ class AppConns(BaseService):
         self.mempool = creator.new_client()
         self.query = creator.new_client()
         self.snapshot = creator.new_client()
+        self._on_error = None
+        self._sync_hook = False
+        self._watch_stop = threading.Event()
+        self._watcher: threading.Thread | None = None
+
+    def set_on_error(self, cb) -> None:
+        """``cb(exc)`` fires once on the first fatal client error
+        (multiAppConn startWatchersForClientErrors).  In-process apps
+        report synchronously through the creator; remote (socket/grpc)
+        clients latch their error and are polled by a watcher thread
+        started in on_start."""
+        self._on_error = cb
+        setter = getattr(self._creator, "set_on_error", None)
+        self._sync_hook = setter is not None
+        if setter is not None:
+            setter(self._fire)
+
+    def _fire(self, exc) -> None:
+        cb, self._on_error = self._on_error, None
+        if cb is not None:
+            cb(exc)
+
+    def _watch_errors(self) -> None:
+        clients = (self.consensus, self.mempool, self.query, self.snapshot)
+        while not self._watch_stop.wait(1.0):
+            for c in clients:
+                err_fn = getattr(c, "error", None)
+                err = err_fn() if err_fn is not None else None
+                if err is not None:
+                    self._fire(err)
+                    return
 
     def on_start(self) -> None:
         # Remote clients connect lazily; surface connection failures at
@@ -240,8 +271,16 @@ class AppConns(BaseService):
             connect = getattr(client, "ensure_connected", None)
             if connect is not None:
                 connect()
+        if self._on_error is not None and not self._sync_hook:
+            # no synchronous in-call hook wired: poll client errors
+            self._watcher = threading.Thread(
+                target=self._watch_errors, name="proxy-err-watch",
+                daemon=True,
+            )
+            self._watcher.start()
 
     def on_stop(self) -> None:
+        self._watch_stop.set()
         for client in (
             self.consensus,
             self.mempool,

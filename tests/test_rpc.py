@@ -382,3 +382,56 @@ def test_app_exception_fail_stops_node(tmp_path):
             node.stop()
         except Exception:
             pass
+
+
+def test_external_app_error_fail_stops_node(tmp_path):
+    """The AppConns error watcher extends fail-stop to external apps:
+    killing the socket app mid-chain latches the client error and the
+    node stops instead of limping (multiAppConn
+    startWatchersForClientErrors)."""
+    import subprocess
+    import sys
+
+    sock = f"unix://{tmp_path}/ext-app.sock"
+    app_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "cometbft_tpu.abci.server",
+            "--app", "kvstore", "--addr", sock,
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    nodes = []
+    try:
+        # interpreter startup can take tens of seconds on a contended
+        # single core; don't let the node's connect timeout race it
+        sock_path = sock[len("unix://"):]
+        deadline = time.time() + 90
+        import os as _os
+
+        while not _os.path.exists(sock_path) and time.time() < deadline:
+            time.sleep(0.2)
+        assert _os.path.exists(sock_path), "external app failed to start"
+        nodes, privs, gen = make_localnet(
+            tmp_path, 1,
+            configure=lambda i, cfg: setattr(cfg.base, "proxy_app", sock),
+        )
+        node = nodes[0]
+        node.start()
+        wait_all_height(nodes, 2)
+        app_proc.kill()
+        app_proc.wait(timeout=10)
+        deadline = time.time() + 30
+        while node.is_running() and time.time() < deadline:
+            time.sleep(0.3)
+        assert not node.is_running(), (
+            "node must fail-stop when the external app dies"
+        )
+    finally:
+        if app_proc.poll() is None:
+            app_proc.kill()
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
