@@ -15,9 +15,21 @@ test-slow:
 
 # go-deadlock build-tag analog (tests.mk:61): every core mutex gets a
 # watchdog that dumps stacks and raises instead of hanging.
+# Scoped to the concurrency-bearing planes: the watchdog multiplies
+# the cost of every lock acquisition, which makes the (lock-free)
+# device-kernel/crypto math suites hours-slow for zero signal.
 test-deadlock:
 	CMT_TPU_DEADLOCK=1 CMT_TPU_DEADLOCK_TIMEOUT=60 \
-		$(PY) -m pytest tests/ -x -q
+		$(PY) -m pytest tests/ -x -q \
+		--ignore=tests/test_ops_field.py \
+		--ignore=tests/test_ops_kernel.py \
+		--ignore=tests/test_parallel.py \
+		--ignore=tests/test_bls.py \
+		--ignore=tests/test_crypto.py \
+		--ignore=tests/test_crypto_openssl.py \
+		--ignore=tests/test_abci_wire_compat.py \
+		--ignore=tests/test_fuzz.py \
+		--ignore=tests/test_fuzz_guided.py
 
 # subprocess perturbation/misbehavior harness only (test/e2e analog)
 test-e2e:

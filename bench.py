@@ -262,37 +262,82 @@ def main(checkpoint=None) -> dict:
                 entry, key_ids, pub, sig, msgs
             )
 
-        t0 = time.time()
-        out = _finish(keyed_dispatch(kpubs, ksigs, kmsgs))
-        log(f"first keyed launch {time.time() - t0:.1f}s")
-        assert bool(out.all()), "keyed benchmark signatures must verify"
-        for trial in range(3):
+        def measure_keyed(label: str) -> float:
             t0 = time.time()
-            total = 0
-            for res in verify_stream(
-                ((kpubs, ksigs, kmsgs) for _ in range(nchunks)),
-                max_in_flight=nchunks,
-                dispatch=keyed_dispatch,
-            ):
-                assert bool(res.all())
-                total += len(res)
-            dt = time.time() - t0
-            rate = total / dt
-            log(
-                f"keyed pipelined trial {trial}: {total} sigs in "
-                f"{dt * 1e3:.1f} ms = {rate:,.0f} sigs/s"
+            out = _finish(keyed_dispatch(kpubs, ksigs, kmsgs))
+            log(f"first keyed launch [{label}] {time.time() - t0:.1f}s")
+            assert bool(out.all()), (
+                "keyed benchmark signatures must verify"
             )
-            keyed_best = max(keyed_best, rate)
+            best = 0.0
+            for trial in range(3):
+                t0 = time.time()
+                total = 0
+                for res in verify_stream(
+                    ((kpubs, ksigs, kmsgs) for _ in range(nchunks)),
+                    max_in_flight=nchunks,
+                    dispatch=keyed_dispatch,
+                ):
+                    assert bool(res.all())
+                    total += len(res)
+                dt = time.time() - t0
+                rate = total / dt
+                log(
+                    f"keyed [{label}] trial {trial}: {total} sigs in "
+                    f"{dt * 1e3:.1f} ms = {rate:,.0f} sigs/s"
+                )
+                best = max(best, rate)
+            return best
+
+        keyed_best = measure_keyed("stack")
+        keyed_cfg = "stack"
+        if checkpoint is not None:
+            # complete result so far; the stack16 A/B below is bonus —
+            # a watchdog kill mid-compile keeps this number.  A failed
+            # persist must not be misread as a keyed-path failure.
+            try:
+                partial = make_result(generic_best, keyed_best, None)
+                if keyed_best > generic_best:
+                    partial["keyed_cols_impl"] = keyed_cfg
+                checkpoint(partial)
+            except OSError as exc:
+                log(f"checkpoint write failed (ignored): {exc}")
+        # A/B the int16 column stack (docs/device_kernel_perf.md §3.0):
+        # the benchmark's job is the best honest number, and the tunnel
+        # may not grant another window for a standalone campaign run
+        from cometbft_tpu.ops import ed25519_verify as EV
+        from cometbft_tpu.ops import field as F
+
+        prior_cols, prior_sq = F.COLS_IMPL, F.SQUARE_IMPL
+        try:
+            F.COLS_IMPL = "stack16"
+            F.SQUARE_IMPL = "mul"
+            EV._keyed_cache.clear()  # force a retrace under the new core
+            rate16 = measure_keyed("stack16")
+            if rate16 > keyed_best:
+                keyed_best, keyed_cfg = rate16, "stack16"
+        except Exception as exc:  # noqa: BLE001 — variant is optional
+            log(f"stack16 variant failed ({type(exc).__name__}: {exc}); "
+                "keeping the stack number")
+        finally:
+            if keyed_cfg != "stack16":
+                # leave module state matching the reported config
+                F.COLS_IMPL, F.SQUARE_IMPL = prior_cols, prior_sq
+                EV._keyed_cache.clear()
     except Exception as exc:  # noqa: BLE001 — keyed path must not
         # take down the headline; report the generic number instead
         # (and discard any keyed trials: a path that just failed —
         # possibly by mis-verifying — must not headline)
         keyed_best = 0.0
+        keyed_cfg = None
         log(f"keyed path failed ({type(exc).__name__}: {exc}); "
             "headline falls back to the generic kernel")
         note = f"keyed path failed: {type(exc).__name__}: {exc}"
 
-    return make_result(generic_best, keyed_best, note)
+    result = make_result(generic_best, keyed_best, note)
+    if keyed_cfg is not None and keyed_best > generic_best:
+        result["keyed_cols_impl"] = keyed_cfg
+    return result
 
 
 def _load_result(result_path: str) -> dict | None:

@@ -59,10 +59,15 @@ class TestWatchdogLock:
         assert errs, "deadlock went undetected"
         assert "last acquired at" in str(errs[0])
 
-    def test_factory_returns_plain_lock_when_disabled(self):
-        # module was imported without CMT_TPU_DEADLOCK in the test env
+    def test_factory_returns_plain_lock_when_disabled(self, monkeypatch):
+        # the deadlock LANE itself runs with CMT_TPU_DEADLOCK=1 (and
+        # the module latches the env at import), so assert against the
+        # latched flag rather than assuming the plain-mode environment
+        monkeypatch.setattr(cmtsync, "_ENABLED", False)
         lk = cmtsync.Mutex()
         assert isinstance(lk, type(threading.Lock()))
+        monkeypatch.setattr(cmtsync, "_ENABLED", True)
+        assert isinstance(cmtsync.Mutex(), cmtsync._WatchdogLock)
 
     def test_core_components_use_the_seam(self):
         """The hot-path components construct their locks through
