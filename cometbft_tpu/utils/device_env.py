@@ -1,0 +1,35 @@
+"""Device-plugin environment handling shared by every CPU-mode path.
+
+The TPU plugin registers itself from sitecustomize at interpreter
+start and (a) with its env vars present and the tunnel wedged, backend
+initialization hangs in C where no Python signal handler runs, and
+(b) its registration overrides the JAX_PLATFORMS env var at the CONFIG
+level (jax.config.update("jax_platforms", "axon,cpu")).  Anything that
+wants a guaranteed-CPU jax — bench fallbacks, subprocess localnet
+nodes, the driver dryrun — must scrub the plugin env from CHILD
+environments before exec, and force the config back in-process.
+ONE definition of the prefix list lives here.
+"""
+
+from __future__ import annotations
+
+from typing import MutableMapping
+
+#: env prefixes owned by the device plugin/tunnel
+PLUGIN_ENV_PREFIXES = ("AXON_", "PALLAS_AXON")
+
+
+def scrub_plugin_env(env: MutableMapping[str, str]) -> None:
+    """Remove the device plugin's env vars from ``env`` in place
+    (pass a copy of os.environ for subprocess children)."""
+    for key in [k for k in env if k.startswith(PLUGIN_ENV_PREFIXES)]:
+        env.pop(key, None)
+
+
+def force_cpu_platform() -> None:
+    """In-process: undo the plugin registration's jax_platforms
+    override so only the CPU backend can initialize.  Call before any
+    jax computation; safe to call repeatedly."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -57,7 +57,8 @@ def _rpc_port(i: int) -> int:
 
 
 def _wait_heights(ports, target: int, timeout: float = 90.0) -> None:
-    deadline = time.monotonic() + timeout
+    # every liveness wait scales under the deadlock lane's overhead
+    deadline = time.monotonic() + timeout * DEADLINE_SCALE
     pending = set(ports)
     while pending:
         for p in list(pending):
@@ -301,7 +302,7 @@ class TestDoubleSigner:
         ev_hash = out["hash"]
 
         # wait until some block carries the evidence
-        deadline = time.monotonic() + 120
+        deadline = time.monotonic() + 120 * DEADLINE_SCALE
         seen_upto = _height(port)
         found = False
         scan_from = max(1, h)

@@ -53,9 +53,9 @@ def _node_env() -> dict:
     )
     # a wedged device tunnel can hang `import jax` while the device
     # plugin is importable — the localnet is CPU-only, scrub it
-    for var in list(env):
-        if var.startswith("PALLAS_AXON") or var.startswith("AXON_"):
-            env.pop(var)
+    from cometbft_tpu.utils.device_env import scrub_plugin_env
+
+    scrub_plugin_env(env)
     return env
 
 

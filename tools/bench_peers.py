@@ -78,9 +78,9 @@ def main() -> int:
     steps = [int(s) for s in args.steps.split(",")]
 
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
-    for var in list(env):
-        if var.startswith("PALLAS_AXON") or var.startswith("AXON_"):
-            env.pop(var)
+    from cometbft_tpu.utils.device_env import scrub_plugin_env
+
+    scrub_plugin_env(env)
     server = subprocess.Popen(
         [sys.executable, "-c", SERVER_SNIPPET.format(repo=REPO)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
