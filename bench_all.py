@@ -171,11 +171,16 @@ def main() -> None:
     def record(config: str, value: float, unit: str, **extra):
         row = {"config": config, "value": round(value, 2), "unit": unit}
         row.update(extra)
+        row["measured"] = time.strftime("round 5, %Y-%m-%d")
         results.append(row)
         print(json.dumps(row), flush=True)
         checkpoint()
 
     # ---- config 1: 64-sig micro-bench --------------------------------
+    # PRODUCTION dispatch: the runtime threshold routes a 64-sig batch
+    # wherever a real caller's batch would go (on a high-RTT link
+    # that's the host batch verifier — measuring the forced-device
+    # path here would record a path no caller takes; r4 verdict #3)
     rng = np.random.RandomState(7)
     priv = ed.gen_priv_key()
     msgs64 = [rng.bytes(120) for _ in range(64)]
@@ -183,15 +188,37 @@ def main() -> None:
     pub = priv.pub_key()
 
     def micro():
-        bv = TpuBatchVerifier(device_min_batch=1)
+        bv = TpuBatchVerifier()
         for m, s in zip(msgs64, sigs64):
             bv.add(pub, m, s)
         ok, bits = bv.verify()
         assert ok, "micro-bench sigs must verify"
 
+    from cometbft_tpu.ops.ed25519_verify import runtime_device_min_batch
+
+    threshold = runtime_device_min_batch()
     dt = timed(micro)
     record(
-        "micro_64sig", 64 / dt, "sigs/sec", latency_ms=round(dt * 1e3, 2)
+        "micro_64sig", 64 / dt, "sigs/sec", latency_ms=round(dt * 1e3, 2),
+        dispatch=(
+            "host batch verifier" if 64 < threshold else "device kernel"
+        ),
+        device_min_batch=threshold if threshold < (1 << 30) else "inf",
+    )
+
+    # forced-device variant: kernel+link progress stays visible even
+    # when the production router prefers the CPU at this size
+    def micro_device():
+        bv = TpuBatchVerifier(device_min_batch=1)
+        for m, s in zip(msgs64, sigs64):
+            bv.add(pub, m, s)
+        ok, _ = bv.verify()
+        assert ok
+
+    dt = timed(micro_device)
+    record(
+        "micro_64sig_device", 64 / dt, "sigs/sec",
+        latency_ms=round(dt * 1e3, 2),
     )
 
     # ---- config 2: VerifyCommit @ 150 validators ---------------------
@@ -301,11 +328,16 @@ def main() -> None:
             assert bool(res.all())
             total += len(res)
         dt = time.perf_counter() - t0
-        record(
-            name, total / dt, "sigs/sec",
+        extra = dict(
             commits_per_sec=round(n_commits / dt, 1),
-            n_commits_run=n_commits, n_commits_modeled=modeled,
+            n_commits_run=n_commits,
+            path="keyed" if dispatch is not None else "generic",
         )
+        if modeled != n_commits:
+            # only a CPU smoke run extrapolates; a device run measures
+            # the full count and carries no modeling caveat
+            extra["n_commits_modeled"] = modeled
+        record(name, total / dt, "sigs/sec", **extra)
 
     # full modeled counts on the accelerator — nothing extrapolated
     n4 = 64 if on_cpu else 10_000

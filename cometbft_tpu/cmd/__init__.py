@@ -255,6 +255,13 @@ def cmd_light(args) -> int:
     ]
     trust_options = None
     if args.trusted_height or args.trusted_hash:
+        if not (args.trusted_height and args.trusted_hash):
+            print(
+                "supply both --trusted-height and --trusted-hash "
+                "(or neither to resume from the trusted store)",
+                file=sys.stderr,
+            )
+            return 1
         trust_options = TrustOptions(
             period_ns=int(args.trust_period * 1e9),
             height=args.trusted_height,
@@ -615,12 +622,30 @@ def cmd_testnet(args) -> int:
         ),
     )
     ids = [NodeKey.load(cfg.node_key_path).id() for cfg in configs]
+
+    def node_addr(j: int) -> tuple[str, int, int]:
+        """(host, p2p_port, rpc_port) for node j. With
+        --starting-ip-address each node gets its OWN address
+        (testnet.go:91 startingIPAddress, the docker-e2e convention)
+        and the standard ports; otherwise sequential ports on
+        localhost."""
+        if args.starting_ip:
+            base = args.starting_ip.rsplit(".", 1)
+            host = f"{base[0]}.{int(base[1]) + j}"
+            return host, args.starting_port, args.starting_port + 1
+        return "127.0.0.1", (
+            args.starting_port + 2 * j
+        ), args.starting_port + 2 * j + 1
+
     for i, cfg in enumerate(configs):
-        port = args.starting_port + 2 * i
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{port}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{port + 1}"
+        host, p2p_port, rpc_port = node_addr(i)
+        # bind all interfaces: inside a netns/container the node's IP
+        # lives on its veth, not on loopback
+        bind = "0.0.0.0" if args.starting_ip else host
+        cfg.p2p.laddr = f"tcp://{bind}:{p2p_port}"
+        cfg.rpc.laddr = f"tcp://{bind}:{rpc_port}"
         cfg.p2p.persistent_peers = ",".join(
-            f"{ids[j]}@127.0.0.1:{args.starting_port + 2 * j}"
+            "{}@{}:{}".format(ids[j], *node_addr(j)[:2])
             for j in range(n)
             if j != i
         )
@@ -781,6 +806,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--o", default="./mytestnet")
     p.add_argument("--chain-id", default="")
     p.add_argument("--starting-port", type=int, default=26656)
+    p.add_argument("--starting-ip-address", dest="starting_ip", default="",
+                   help="give node i the address base+i with standard "
+                   "ports (one node per network namespace/container) "
+                   "instead of sequential ports on localhost")
     p.set_defaults(fn=cmd_testnet)
 
     args = parser.parse_args(argv)

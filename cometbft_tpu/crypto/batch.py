@@ -33,23 +33,13 @@ _PROBE_RETRY_S = float(os.environ.get("CMT_TPU_PROBE_RETRY_S", 120))
 
 
 def _probe_subprocess() -> None:
-    import subprocess
     import time
 
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; print(len(jax.devices()))",
-            ],
-            capture_output=True,
-            timeout=_PROBE_TIMEOUT_S,
-            text=True,
-        )
-        ndev = int(proc.stdout.strip()) if proc.returncode == 0 else 0
-    except Exception:
-        ndev = 0
+    from cometbft_tpu.utils.device_env import probe_device_count
+
+    # pipe-safe, process-group-killed probe (device_env docstring): a
+    # wedged tunnel must cost _PROBE_TIMEOUT_S, never a parent hang
+    ndev = probe_device_count(_PROBE_TIMEOUT_S)
     if ndev > 0:
         # the tunnel answers; the in-process import should now be
         # quick (and runs on THIS daemon thread, not a node thread)

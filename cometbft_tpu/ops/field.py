@@ -142,6 +142,20 @@ import os as _os
 #: in isolated loops (where all variants fuse perfectly).
 COLS_IMPL = _os.environ.get("CMT_TPU_COLS_IMPL", "stack")
 SQUARE_IMPL = _os.environ.get("CMT_TPU_SQUARE_IMPL", "fast")
+#: debug-mode runtime guards (host callbacks; never on in production)
+_DEBUG_CHECKS = bool(_os.environ.get("CMT_TPU_DEBUG_CHECKS"))
+
+
+def _limb_magnitude_check(maxabs) -> None:
+    """Host-side guard behind CMT_TPU_DEBUG_CHECKS: stack16 narrows
+    limbs to int16, valid only under the documented 2^13 magnitude
+    budget — fail loudly instead of wrapping to wrong arithmetic."""
+    if int(maxabs) >= 1 << 15:
+        raise OverflowError(
+            f"stack16 limb overflow: max |limb| = {int(maxabs)} >= 2^15; "
+            "an operand exceeded the 2-chained-add budget (field.py "
+            "module docstring)"
+        )
 
 
 def _tree_sum(terms):
@@ -164,7 +178,11 @@ def _columns_stack(a, b, stack_dtype=DTYPE):
     §1), and mul's operand budget bounds limbs by 2^13 in magnitude —
     they fit int16, halving the stack's bytes.  The widening convert
     fuses into the multiply-reduce, so HBM sees half the traffic while
-    all arithmetic stays int32."""
+    all arithmetic stays int32.  A caller exceeding the documented
+    budget would silently wrap to WRONG field arithmetic;
+    CMT_TPU_DEBUG_CHECKS=1 turns the cast into a loud failure."""
+    if stack_dtype != DTYPE and _DEBUG_CHECKS:
+        jax.debug.callback(_limb_magnitude_check, jnp.max(jnp.abs(b)))
     pad = [(NLIMBS - 1, NLIMBS - 1)] + [(0, 0)] * (b.ndim - 1)
     bp = jnp.pad(b.astype(stack_dtype), pad)  # (76, *batch)
     s = jnp.stack(

@@ -22,11 +22,13 @@ Two table families:
   combs (32 adds/verify, ~3.4 MB/key) for sets up to KEY8_MAX keys,
   4-bit (64 adds, ~430 KB/key) above.
 
-Tables are cached per validator *set* (hash of the sorted unique
-pubkeys) in an LRU bounded by CMT_TPU_TABLE_CACHE_MB.  Set-granular
-caching rebuilds on any rotation, but a build costs ~10 verifies per
-key and a set serves every block until it changes — the steady-state
-amortization the reference's per-key LRU is after.
+Tables are cached PER KEY in a device pool (``_KeyPool``) bounded by
+CMT_TPU_TABLE_CACHE_MB, matching the reference's per-key LRU
+(crypto/ed25519/ed25519.go:43,62-68): a set lookup EC-builds pages only
+for keys not already pooled, so rotating one validator out of 150 (or
+10,000) costs one key's build (~10 verifies), not the whole set's.
+``KeySetTables`` entries are immutable snapshots of the pool, memoized
+per set-hash while the pool is unchanged.
 """
 
 from __future__ import annotations
@@ -185,19 +187,26 @@ def comb_mul_keyed(table, key_ids, windows, window_bits: int):
     return acc
 
 
-# -- per-set table cache ----------------------------------------------
+# -- per-key incremental table cache ----------------------------------
 
 
 @dataclass
 class KeySetTables:
-    """A validator set's device-resident tables."""
+    """A validator set's view into the device-resident key-table pool.
+
+    ``key_index`` maps each pubkey to its POOL SLOT; ``table``/``valid``
+    are immutable snapshots of the pool arrays, so an entry stays
+    self-consistent even after later rotations grow, compact, or evict
+    the pool underneath it.
+    """
 
     sethash: bytes
     window_bits: int
-    key_index: dict[bytes, int]  # pubkey bytes -> table row
-    table: object                # device array (nwin, 4, 26, n*nent)
-    valid: np.ndarray            # (n,) bool
-    nbytes: int
+    key_index: dict[bytes, int]  # pubkey bytes -> pool slot
+    table: object                # device array (nwin, 4, 26, cap*nent)
+    valid: np.ndarray            # (cap,) bool
+    nbytes: int                  # bytes of ``table`` (whole pool)
+    set_nbytes: int = 0          # bytes attributable to this set's keys
 
     def key_ids(self, pubs: list[bytes]) -> np.ndarray:
         return np.fromiter(
@@ -205,84 +214,267 @@ class KeySetTables:
         )
 
 
+_B_ENC = np.frombuffer(_ref.encode_point(_ref.B_POINT), dtype=np.uint8)
+
+
+def _pool_cap(nkeys: int) -> int:
+    """Pool capacities come from a small fixed ladder (pow2 up to 4096,
+    then 2048-slot steps) so the shape-specialized verify kernel only
+    retraces a bounded number of times — while avoiding pow2's up-to-2x
+    HBM waste at large validator counts (10k keys: 10240 slots =
+    4.4 GB at 4-bit, vs 16384 slots = 7 GB)."""
+    if nkeys <= 4096:
+        return _next_pow2(max(nkeys, 1))
+    return -(-nkeys // 2048) * 2048
+
+
+class _KeyPool:
+    """One window width's device pool of per-key comb pages.
+
+    The pool's minor axis holds ``cap`` fixed-size key pages
+    (cap * nent entries); a key's page lives at
+    ``[slot*nent : (slot+1)*nent]`` so ``comb_mul_keyed``'s
+    ``key_id * nent`` indexing works with slot numbers as key ids.
+    Capacity is always a power of two: the compiled keyed-verify kernel
+    specializes on the table shape, so growth only retraces at pow2
+    boundaries (same behavior the per-set design had).
+    """
+
+    def __init__(self, window_bits: int) -> None:
+        self.window_bits = window_bits
+        self.nent = 1 << window_bits
+        self.nwin = 256 // window_bits
+        self.key_bytes = self.nwin * 4 * F.NLIMBS * self.nent * 4
+        self.cap = 0
+        self.table = None  # device (nwin, 4, 26, cap*nent) int32
+        self.valid = np.zeros(0, dtype=bool)
+        self.slots: OrderedDict[bytes, int] = OrderedDict()  # LRU order
+        self.free: list[int] = []
+        self.version = 0  # bumped on any table-array change
+
+    def nbytes(self) -> int:
+        return self.cap * self.key_bytes
+
+    def ensure_capacity(self, nkeys: int) -> None:
+        if self.cap >= nkeys:
+            return
+        new_cap = _pool_cap(nkeys)
+        shape = (self.nwin, 4, F.NLIMBS, new_cap * self.nent)
+        if self.table is None:
+            self.table = jnp.zeros(shape, dtype=jnp.int32)
+        else:
+            pad = (new_cap - self.cap) * self.nent
+            self.table = jnp.pad(
+                self.table, [(0, 0), (0, 0), (0, 0), (0, pad)]
+            )
+        self.valid = np.concatenate(
+            [self.valid, np.zeros(new_cap - self.cap, dtype=bool)]
+        )
+        self.free.extend(range(self.cap, new_cap))
+        self.cap = new_cap
+        self.version += 1
+
+    def compact(self) -> None:
+        """Gather live pages into a fresh pow2-capacity array (device
+        gather, no EC recompute) — run after eviction freed enough
+        slots that the pool holds mostly dead pages."""
+        n_live = len(self.slots)
+        new_cap = _pool_cap(n_live)
+        if new_cap >= self.cap:
+            return
+        order = list(self.slots.items())  # preserves LRU order
+        gather = np.concatenate(
+            [
+                np.arange(s * self.nent, (s + 1) * self.nent)
+                for _, s in order
+            ]
+        ) if order else np.zeros(0, dtype=np.int64)
+        pad = new_cap * self.nent - len(gather)
+        new_table = jnp.pad(
+            self.table[..., jnp.asarray(gather)],
+            [(0, 0), (0, 0), (0, 0), (0, pad)],
+        )
+        new_valid = np.zeros(new_cap, dtype=bool)
+        new_slots: OrderedDict[bytes, int] = OrderedDict()
+        for i, (p, s) in enumerate(order):
+            new_slots[p] = i
+            new_valid[i] = self.valid[s]
+        self.table = new_table
+        self.valid = new_valid
+        self.slots = new_slots
+        self.free = list(range(n_live, new_cap))
+        self.cap = new_cap
+        self.version += 1
 
 
 class KeyTableCache:
-    """LRU of per-validator-set device tables, bounded by device bytes.
+    """PER-KEY LRU of device-resident comb-table pages, bounded by
+    device bytes across both window widths.
 
-    The reference analog is the expanded-pubkey LRU sized to the
-    validator set (ed25519.go:43); here a whole set is one entry and
-    the bound is device memory, not entry count.
+    The reference's expanded-pubkey cache is per-key
+    (crypto/ed25519/ed25519.go:43,62-68) precisely so validator churn is
+    incremental; this cache matches that: a set lookup builds tables
+    ONLY for keys not already pooled, so rotating 1 of 150 (or 10,000)
+    validators costs one key's build (~10 verifies), not the whole
+    set's.
     """
 
     def __init__(self, cap_bytes: int = TABLE_CACHE_MB << 20) -> None:
         self._cap = cap_bytes
         self._lock = threading.Lock()
-        self._sets: OrderedDict[bytes, KeySetTables] = OrderedDict()
-        self._building: dict[bytes, threading.Event] = {}
+        self._pools = {8: _KeyPool(8), 4: _KeyPool(4)}
+        # pubkey-level build latches: concurrent misses on overlapping
+        # keys (consensus addVote + light client racing on a rotation)
+        # build each key ONCE — losers wait on the winner's latch
+        self._pending: dict[tuple[int, bytes], threading.Event] = {}
+        # set-hash -> (pool version, entry) memo so repeat lookups of
+        # an unchanged set return the SAME entry object (the mesh path
+        # hangs replicated copies off it)
+        self._entries: OrderedDict[bytes, tuple[int, KeySetTables]] = (
+            OrderedDict()
+        )
+        self.stats = {"keys_built": 0, "keys_evicted": 0}
 
     def lookup_or_build(self, pubs: list[bytes]) -> KeySetTables | None:
-        """Device tables covering every key in ``pubs``, building them
-        on a miss; None when the unique-key count is out of policy.
-        Concurrent misses for the same set (consensus addVote + light
-        client racing on a rotation) build ONCE: losers wait on the
-        winner's latch instead of duplicating the device build."""
+        """An entry covering every key in ``pubs``, building pages only
+        for keys not already pooled; None when the unique-key count is
+        out of policy."""
         unique = sorted(set(pubs))
         n = len(unique)
         if n == 0 or n > TABLE_MAX_KEYS:
             return None
+        window_bits = 8 if n <= KEY8_MAX else 4
+        pool = self._pools[window_bits]
         h = hashlib.sha256(b"".join(unique)).digest()
         while True:
             with self._lock:
-                entry = self._sets.get(h)
-                if entry is not None:
-                    self._sets.move_to_end(h)
-                    return entry
-                latch = self._building.get(h)
-                if latch is None:
-                    self._building[h] = threading.Event()
-                    break
-            latch.wait()
-        try:
-            entry = self._build(h, unique)
-            with self._lock:
-                self._sets[h] = entry
-                total = sum(e.nbytes for e in self._sets.values())
-                while total > self._cap and len(self._sets) > 1:
-                    _, old = self._sets.popitem(last=False)
-                    total -= old.nbytes
-        finally:
-            with self._lock:
-                self._building.pop(h).set()
+                waits = [
+                    self._pending[k]
+                    for p in unique
+                    if (k := (window_bits, p)) in self._pending
+                ]
+                if not waits:
+                    missing = [p for p in unique if p not in pool.slots]
+                    if not missing:
+                        return self._finish_lookup(h, pool, unique)
+                    for p in missing:
+                        self._pending[(window_bits, p)] = threading.Event()
+            if waits:
+                for ev in waits:
+                    ev.wait()
+                continue
+            try:
+                pages, page_valid = self._build_pages(missing, window_bits)
+                with self._lock:
+                    pool.ensure_capacity(len(pool.slots) + len(missing))
+                    slots = [pool.free.pop() for _ in missing]
+                    idx = (
+                        np.asarray(slots, dtype=np.int64)[:, None]
+                        * pool.nent
+                        + np.arange(pool.nent)
+                    ).ravel()
+                    pool.table = pool.table.at[..., jnp.asarray(idx)].set(
+                        pages[..., : len(missing) * pool.nent]
+                    )
+                    pool.version += 1
+                    for i, (p, s) in enumerate(zip(missing, slots)):
+                        pool.slots[p] = s
+                        pool.valid[s] = page_valid[i]
+                    self.stats["keys_built"] += len(missing)
+                    self._evict_over_budget(keep=set(unique))
+                    # a concurrent lookup's eviction may have dropped
+                    # keys of ours that were present before our build
+                    # released the lock — loop to rebuild them if so
+                    if all(p in pool.slots for p in unique):
+                        return self._finish_lookup(h, pool, unique)
+            finally:
+                with self._lock:
+                    for p in missing:
+                        self._pending.pop((window_bits, p)).set()
+
+    def _finish_lookup(
+        self, h: bytes, pool: _KeyPool, unique: list[bytes]
+    ) -> KeySetTables:
+        """Touch LRU order and return a (memoized) entry. Lock held."""
+        for p in unique:
+            pool.slots.move_to_end(p)
+        memo = self._entries.get(h)
+        if memo is not None and memo[0] == pool.version:
+            self._entries.move_to_end(h)
+            return memo[1]
+        # each memoized entry pins ITS version's full pool array: sweep
+        # stale-version entries so the memo never holds device arrays
+        # beyond the two live pools (a 64-count bound alone would pin
+        # ~64 pool-sized snapshots across rotations — an HBM leak)
+        for k in [
+            k
+            for k, (v, e) in self._entries.items()
+            if v != self._pools[e.window_bits].version
+        ]:
+            del self._entries[k]
+        entry = KeySetTables(
+            sethash=h,
+            window_bits=pool.window_bits,
+            key_index={p: pool.slots[p] for p in unique},
+            table=pool.table,
+            valid=pool.valid.copy(),
+            nbytes=pool.nbytes(),
+            set_nbytes=len(unique) * pool.key_bytes,
+        )
+        self._entries[h] = (pool.version, entry)
+        while len(self._entries) > 64:
+            self._entries.popitem(last=False)
         return entry
 
-    def _build(self, h: bytes, unique: list[bytes]) -> KeySetTables:
-        n = len(unique)
-        window_bits = 8 if n <= KEY8_MAX else 4
+    def _build_pages(self, missing: list[bytes], window_bits: int):
+        """EC-compute comb pages for ``missing`` keys (device kernel,
+        pow2-padded with B's encoding). Runs OUTSIDE the cache lock so
+        cached-set lookups aren't blocked behind a build."""
+        n = len(missing)
         n_pad = _next_pow2(n)
         pub = np.zeros((32, n_pad), dtype=np.uint8)
-        for i, p in enumerate(unique):
+        for i, p in enumerate(missing):
             pub[:, i] = np.frombuffer(p, dtype=np.uint8)
-        # pad lanes with B's encoding (a valid key) to keep shapes pow2
         if n_pad > n:
-            benc = np.frombuffer(
-                _ref.encode_point(_ref.B_POINT), dtype=np.uint8
-            )
-            pub[:, n:] = benc[:, None]
+            pub[:, n:] = _B_ENC[:, None]
         fn = _compiled_build(n_pad, window_bits)
         table, valid = fn(jax.device_put(pub))
-        return KeySetTables(
-            sethash=h,
-            window_bits=window_bits,
-            key_index={p: i for i, p in enumerate(unique)},
-            table=table,
-            valid=np.asarray(valid),
-            nbytes=int(np.prod(table.shape)) * 4,
-        )
+        return table, np.asarray(valid)[:n]
+
+    def _evict_over_budget(self, keep: set[bytes]) -> None:
+        """Drop LRU keys (never ones in ``keep``) until compaction can
+        bring the pools under budget, then compact. Lock held. A single
+        set larger than the budget stays resident: the ACTIVE set must
+        always fit. Eviction is minimal — LRU-first, stopping as soon
+        as the post-compaction footprint fits."""
+
+        def compacted_bytes(p: _KeyPool) -> int:
+            return min(p.cap, _pool_cap(len(p.slots))) * p.key_bytes
+
+        if sum(p.nbytes() for p in self._pools.values()) <= self._cap:
+            return
+        changed = False
+        for pool in self._pools.values():
+            evictable = [p for p in pool.slots if p not in keep]  # LRU order
+            for p in evictable:
+                if (
+                    sum(compacted_bytes(q) for q in self._pools.values())
+                    <= self._cap
+                ):
+                    break
+                s = pool.slots.pop(p)
+                pool.valid[s] = False
+                pool.free.append(s)
+                self.stats["keys_evicted"] += 1
+                changed = True
+        if changed:
+            for pool in self._pools.values():
+                pool.compact()
 
     def clear(self) -> None:
         with self._lock:
-            self._sets.clear()
+            self._pools = {8: _KeyPool(8), 4: _KeyPool(4)}
+            self._entries.clear()
 
 
 TABLE_CACHE = KeyTableCache()

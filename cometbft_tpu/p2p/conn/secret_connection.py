@@ -19,6 +19,7 @@ with a 12-byte little-endian counter nonce exactly like the reference
 
 from __future__ import annotations
 
+import errno
 import select
 import struct
 import threading
@@ -151,6 +152,9 @@ class SecretConnection:
         # first delivers the valid prefix (sequential semantics), then
         # raises this on the following read
         self._recv_err: SecretConnectionError | None = None
+        # deferred fd error from the opportunistic drain: surfaced only
+        # after every already-buffered complete frame is delivered
+        self._drain_err: OSError | None = None
         self._can_select: bool | None = None
         self._send_nonce = _Nonce()
         self._recv_nonce = _Nonce()
@@ -253,7 +257,16 @@ class SecretConnection:
                 return
             try:
                 chunk = self._sock.recv(cap - len(self._sealed_buf))
-            except OSError:
+            except OSError as exc:
+                # transient conditions (interrupted syscall, spurious
+                # readiness) just end this opportunistic drain; a real
+                # fd error (reset, bad fd) is PARKED and surfaced by
+                # read() once the complete frames already buffered have
+                # been delivered — raising here would strand them
+                if exc.errno not in (
+                    errno.EINTR, errno.EAGAIN, errno.EWOULDBLOCK
+                ):
+                    self._drain_err = exc
                 return
             if not chunk:
                 return  # EOF; complete frames already read still count
@@ -273,6 +286,15 @@ class SecretConnection:
                 return out
             if self._recv_err is not None:
                 raise self._recv_err
+            if (
+                self._drain_err is not None
+                and len(self._sealed_buf) < SEALED_FRAME_SIZE
+            ):
+                # buffered frames are exhausted: deliver the fd error
+                # the drain parked (a blocking recv would raise it
+                # anyway — this surfaces it one read sooner, typed)
+                err, self._drain_err = self._drain_err, None
+                raise err
             while len(self._sealed_buf) < SEALED_FRAME_SIZE:
                 # OSError (timeout, reset) propagates distinctly —
                 # only an orderly EOF reads as the empty string

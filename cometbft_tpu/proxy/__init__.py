@@ -228,6 +228,7 @@ class AppConns(BaseService):
         self.query = creator.new_client()
         self.snapshot = creator.new_client()
         self._on_error = None
+        self._fire_lock = threading.Lock()
         self._sync_hook = False
         self._watch_stop = threading.Event()
         self._watcher: threading.Thread | None = None
@@ -245,7 +246,11 @@ class AppConns(BaseService):
             setter(self._fire)
 
     def _fire(self, exc) -> None:
-        cb, self._on_error = self._on_error, None
+        # once-delivery is the documented contract: the latch swap must
+        # be atomic or the sync hook and the watcher (or two erroring
+        # connections) racing could both observe a non-None cb
+        with self._fire_lock:
+            cb, self._on_error = self._on_error, None
         if cb is not None:
             cb(exc)
 

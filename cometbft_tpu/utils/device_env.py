@@ -33,3 +33,55 @@ def force_cpu_platform() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def probe_device_count(timeout_s: float = 20.0) -> int:
+    """Count visible accelerator devices from a FRESH subprocess with a
+    parent-enforced deadline; 0 on any failure or timeout.
+
+    Pipe-safety matters here: subprocess.run(capture_output=True)
+    drains pipes to EOF after a timeout-kill, and a tunnel helper
+    grandchild holding the write end would block the parent forever —
+    the exact hang class the probe exists to dodge.  Output goes to a
+    temp file and the child gets its own session so the WHOLE process
+    group is killed on timeout."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.TemporaryFile() as out:
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; print(len(jax.devices()))",
+                ],
+                stdout=out,
+                stderr=subprocess.DEVNULL,
+                stdin=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+        except OSError:
+            return 0
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            return 0
+        if rc != 0:
+            return 0
+        out.seek(0)
+        try:
+            return int(out.read().strip() or 0)
+        except ValueError:
+            return 0

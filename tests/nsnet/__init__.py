@@ -1,0 +1,23 @@
+"""Namespace-container e2e infrastructure.
+
+The reference runs its e2e testnets in docker containers generated
+from TOML manifests (test/e2e/pkg/infra/docker/docker.go:1,
+test/e2e/runner/main.go:24).  This package provides the same machine-
+level isolation from kernel primitives directly — per-node network,
+mount, and UTS namespaces wired through a bridge with veth pairs — so
+it runs anywhere `unshare` works, with no docker daemon:
+
+- each node has its OWN network stack (IP, port space, routing table),
+  not a shared loopback: partitions are real link-downs, not proxy
+  drops;
+- each node has a private mount namespace (own /tmp) and hostname;
+- inter-zone latency is applied with tc netem when the kernel ships
+  sch_netem (best-effort: the invariants don't depend on it).
+
+Entry points:
+- ``runner.py``  — runs INSIDE the sandbox userns; builds the network
+  from a manifest, starts nodes, applies the perturbation schedule,
+  checks BFT invariants, prints one JSON verdict line.
+- ``test_e2e_nsnet.py`` (in tests/) — pytest wrapper: probes kernel
+  capability, launches the sandbox, asserts the verdict.
+"""

@@ -1,0 +1,312 @@
+"""In-sandbox testnet runner: containers from kernel namespaces.
+
+Runs as root inside a user+net+mount namespace sandbox (see
+tests/test_e2e_nsnet.py for the launch).  Builds the manifest's
+network — one bridge, one network namespace + veth per node — starts
+each node inside its own net/mount/UTS namespaces, applies the
+perturbation schedule, and checks the BFT invariants the reference's
+e2e runner checks (test/e2e/runner/main.go:24, runner/perturb.go:16):
+progress, no height regression, no fork, catch-up after every
+perturbation.
+
+Prints exactly one JSON line on stdout: {"ok": bool, "checks": [...],
+"error": ...}.  Everything else goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import tomllib
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+P2P_PORT = 26656
+RPC_PORT = 26657
+
+
+def log(msg: str) -> None:
+    print(f"[nsnet] {msg}", file=sys.stderr, flush=True)
+
+
+def sh(*cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        list(cmd), check=check, capture_output=True, text=True
+    )
+
+
+class Manifest:
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        t = raw.get("testnet", {})
+        self.chain_id = t.get("chain_id", "nsnet")
+        self.subnet = t.get("subnet", "10.186.0.0/24")
+        self.warmup_height = int(t.get("warmup_height", 3))
+        self.nodes = [
+            {"name": n.get("name", f"node{i}"), "zone": n.get("zone", "z0")}
+            for i, n in enumerate(raw.get("node", []))
+        ] or [{"name": f"node{i}", "zone": "z0"} for i in range(4)]
+        self.zone_delays = raw.get("zones", {})  # "a-b" -> one-way ms
+        self.perturbations = raw.get("perturb", [])
+        base = self.subnet.split("/")[0].rsplit(".", 1)[0]
+        self.bridge_ip = f"{base}.1"
+        self.node_ip = lambda i: f"{base}.{10 + i}"
+
+
+class NsNet:
+    """The running namespace testnet."""
+
+    def __init__(self, manifest: Manifest, workdir: str):
+        self.m = manifest
+        self.workdir = workdir
+        self.procs: dict[int, subprocess.Popen | None] = {}
+        self.env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+
+    # -- network construction ------------------------------------------
+
+    def build_network(self) -> None:
+        sh("mount", "-t", "tmpfs", "tmpfs", "/run", check=False)
+        sh("ip", "link", "add", "br0", "type", "bridge")
+        prefix = self.m.subnet.split("/")[1]
+        sh("ip", "addr", "add", f"{self.m.bridge_ip}/{prefix}", "dev", "br0")
+        sh("ip", "link", "set", "br0", "up")
+        for i, node in enumerate(self.m.nodes):
+            name = node["name"]
+            sh("ip", "netns", "add", name)
+            sh(
+                "ip", "link", "add", f"veth{i}", "type", "veth",
+                "peer", "name", "eth0", "netns", name,
+            )
+            sh("ip", "link", "set", f"veth{i}", "master", "br0")
+            sh("ip", "link", "set", f"veth{i}", "up")
+            ns = ("ip", "netns", "exec", name)
+            sh(*ns, "ip", "addr", "add",
+               f"{self.m.node_ip(i)}/{prefix}", "dev", "eth0")
+            sh(*ns, "ip", "link", "set", "eth0", "up")
+            sh(*ns, "ip", "link", "set", "lo", "up")
+            self._apply_zone_latency(i, node)
+        log(f"network up: bridge {self.m.bridge_ip}, "
+            f"{len(self.m.nodes)} namespaces")
+
+    def _apply_zone_latency(self, i: int, node: dict) -> None:
+        """Best-effort inter-zone delay on the node's veth egress.
+        Kernels without sch_netem (this CI image) just log and move on;
+        the invariants must never depend on the delay being real."""
+        delays = [
+            float(ms)
+            for pair, ms in self.m.zone_delays.items()
+            if node["zone"] in pair.split("-")
+        ]
+        if not delays:
+            return
+        r = sh(
+            "tc", "qdisc", "add", "dev", f"veth{i}", "root",
+            "netem", "delay", f"{delays[0]}ms", check=False,
+        )
+        if r.returncode:
+            log(f"netem unavailable ({r.stderr.strip()}); "
+                f"zone latency for {node['name']} skipped")
+
+    # -- node lifecycle ------------------------------------------------
+
+    def init_homes(self) -> None:
+        base_ip = self.m.node_ip(0)
+        subprocess.run(
+            [
+                sys.executable, "-m", "cometbft_tpu", "testnet",
+                "--v", str(len(self.m.nodes)),
+                "--o", self.workdir,
+                "--chain-id", self.m.chain_id,
+                "--starting-port", str(P2P_PORT),
+                "--starting-ip-address", base_ip,
+            ],
+            env=self.env, check=True, capture_output=True, cwd=REPO,
+        )
+
+    def start(self, i: int) -> None:
+        name = self.m.nodes[i]["name"]
+        home = os.path.join(self.workdir, f"node{i}")
+        # per-node container: own UTS (hostname) + mount namespaces
+        # around the node's network namespace.  The home is bind-
+        # mounted at /mnt BEFORE /tmp is made private — the node's
+        # filesystem view is its own even when the host workdir lives
+        # under /tmp (pytest tmp_path does)
+        script = (
+            f"hostname {name} && "
+            f"mount --bind {home} /mnt && "
+            "mount -t tmpfs tmpfs /tmp && "
+            f"exec ip netns exec {name} "
+            f"{sys.executable} -m cometbft_tpu --home /mnt start"
+        )
+        with open(
+            os.path.join(self.workdir, f"{name}.log"), "ab", buffering=0
+        ) as logf:
+            self.procs[i] = subprocess.Popen(
+                ["unshare", "--uts", "--mount", "sh", "-c", script],
+                env=self.env, stdout=subprocess.DEVNULL, stderr=logf,
+                cwd=REPO,
+            )
+
+    def kill9(self, i: int) -> None:
+        p = self.procs[i]
+        # the wrapper execs down to the node process, but signal the
+        # whole group equivalent: SIGKILL the direct child; `ip netns
+        # exec` execs too, so the child IS the node by now
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+        self.procs[i] = None
+
+    def pause(self, i: int) -> None:
+        self.procs[i].send_signal(signal.SIGSTOP)
+
+    def resume(self, i: int) -> None:
+        self.procs[i].send_signal(signal.SIGCONT)
+
+    def partition(self, i: int) -> None:
+        sh("ip", "link", "set", f"veth{i}", "down")
+
+    def heal(self, i: int) -> None:
+        sh("ip", "link", "set", f"veth{i}", "up")
+
+    def stop_all(self) -> None:
+        for p in self.procs.values():
+            if p is None:
+                continue
+            try:
+                p.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in self.procs.values():
+            if p is None:
+                continue
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # -- RPC helpers ---------------------------------------------------
+
+    def rpc(self, i: int, method: str, timeout: float = 3.0, **params):
+        req = urllib.request.Request(
+            f"http://{self.m.node_ip(i)}:{RPC_PORT}",
+            data=json.dumps(
+                {
+                    "jsonrpc": "2.0", "id": 1,
+                    "method": method, "params": params,
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+        if body.get("error"):
+            raise RuntimeError(body["error"])
+        return body["result"]
+
+    def height(self, i: int) -> int:
+        return int(
+            self.rpc(i, "status")["sync_info"]["latest_block_height"]
+        )
+
+    def wait_heights(self, idxs, target: int, timeout: float = 240.0):
+        deadline = time.monotonic() + timeout
+        pending = set(idxs)
+        while pending:
+            for i in list(pending):
+                try:
+                    if self.height(i) >= target:
+                        pending.discard(i)
+                except Exception:
+                    pass
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"nodes {sorted(pending)} never reached {target}"
+                )
+            time.sleep(0.3)
+
+    def assert_no_fork(self, idxs, upto: int) -> None:
+        for h in range(1, upto + 1):
+            hashes = {
+                self.rpc(i, "block", height=h)["block_id"]["hash"]
+                for i in idxs
+            }
+            assert len(hashes) == 1, f"fork at height {h}: {hashes}"
+
+
+def run_scenario(net: NsNet) -> list[str]:
+    """Warmup, then the manifest's perturbation schedule; returns the
+    list of passed checks (raises on the first violated invariant)."""
+    m = net.m
+    checks: list[str] = []
+    all_idx = list(range(len(m.nodes)))
+    net.wait_heights(all_idx, m.warmup_height)
+    checks.append(f"warmup: all {len(all_idx)} nodes at "
+                  f"height {m.warmup_height}")
+
+    for pert in m.perturbations:
+        victim = next(
+            i for i, n in enumerate(m.nodes) if n["name"] == pert["node"]
+        )
+        op = pert["op"]
+        others = [i for i in all_idx if i != victim]
+        base = max(net.height(i) for i in others)
+        log(f"perturb: {op} {pert['node']} at height {base}")
+        if op == "kill9":
+            net.kill9(victim)
+            net.wait_heights(others, base + 2)
+            net.start(victim)
+        elif op == "partition":
+            net.partition(victim)
+            net.wait_heights(others, base + 2)
+            net.heal(victim)
+        elif op == "pause":
+            net.pause(victim)
+            net.wait_heights(others, base + 2)
+            net.resume(victim)
+        else:
+            raise ValueError(f"unknown perturbation {op!r}")
+        live = max(net.height(i) for i in others)
+        net.wait_heights([victim], live)
+        checks.append(f"{op} {pert['node']}: liveness kept, "
+                      f"victim caught up to {live}")
+
+    head = min(net.height(i) for i in all_idx)
+    net.assert_no_fork(all_idx, head)
+    checks.append(f"no fork through height {head}")
+    return checks
+
+
+def main() -> int:
+    manifest_path, workdir = sys.argv[1], sys.argv[2]
+    m = Manifest(manifest_path)
+    net = NsNet(m, workdir)
+    verdict: dict = {"ok": False, "checks": []}
+    try:
+        net.build_network()
+        net.init_homes()
+        for i in range(len(m.nodes)):
+            net.start(i)
+        verdict["checks"] = run_scenario(net)
+        verdict["ok"] = True
+    except BaseException as exc:  # noqa: BLE001 — verdict must print
+        verdict["error"] = f"{type(exc).__name__}: {exc}"
+    finally:
+        net.stop_all()
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -402,18 +402,86 @@ class TestPrecompute:
         _, generic_results = bv2.verify()
         assert generic_results == oracle
 
-    def test_set_cache_hit_and_eviction(self):
+    def test_key_cache_hit_and_eviction(self):
         from cometbft_tpu.ops import precompute as PR
 
-        cache = PR.KeyTableCache(cap_bytes=1)  # evicts beyond one entry
+        cache = PR.KeyTableCache(cap_bytes=1)  # evicts all non-active keys
         pubs_a = [ed.gen_priv_key().pub_key().bytes() for _ in range(2)]
         pubs_b = [ed.gen_priv_key().pub_key().bytes() for _ in range(2)]
         ea = cache.lookup_or_build(pubs_a)
-        assert cache.lookup_or_build(pubs_a) is ea  # hit
-        cache.lookup_or_build(pubs_b)  # evicts a (cap 1 byte)
-        assert len(cache._sets) == 1
+        assert cache.stats["keys_built"] == 2
+        assert cache.lookup_or_build(pubs_a) is ea  # memoized hit
+        assert cache.stats["keys_built"] == 2
+        cache.lookup_or_build(pubs_b)  # over budget: a's keys evicted
+        assert cache.stats["keys_evicted"] == 2
         eb = cache.lookup_or_build(pubs_a)
         assert eb is not ea  # rebuilt after eviction
+        assert cache.stats["keys_built"] == 6
+
+    def test_per_key_incremental_rotation(self, monkeypatch):
+        """Rotating 1 of 150 validators builds ONE key's table page,
+        not the whole set's (the reference's per-key LRU behavior,
+        crypto/ed25519/ed25519.go:43,62-68)."""
+        from cometbft_tpu.ops import ed25519_verify as EV
+        from cometbft_tpu.ops import precompute as PR
+
+        monkeypatch.setattr(PR, "KEY8_MAX", 4)  # 4-bit pages: small build
+        cache = PR.KeyTableCache()
+        privs = [ed.gen_priv_key() for _ in range(150)]
+        pubs = [p.pub_key().bytes() for p in privs]
+        e1 = cache.lookup_or_build(pubs)
+        assert e1 is not None and e1.window_bits == 4
+        assert cache.stats["keys_built"] == 150
+
+        # block N+1: one validator rotates out, one in
+        new_priv = ed.gen_priv_key()
+        privs2 = privs[1:] + [new_priv]
+        pubs2 = [p.pub_key().bytes() for p in privs2]
+        e2 = cache.lookup_or_build(pubs2)
+        assert cache.stats["keys_built"] == 151  # ONE new page, no rebuild
+        assert cache.stats["keys_evicted"] == 0
+
+        # the post-rotation entry verifies real signatures end to end
+        # (old key kept its pooled page; new key's page is fresh)
+        sel = [privs2[0], new_priv]
+        msgs = [b"rotation block %d" % i for i in range(2)]
+        sigs = np.stack(
+            [
+                np.frombuffer(p.sign(m), dtype=np.uint8)
+                for p, m in zip(sel, msgs)
+            ]
+        )
+        kpubs = np.stack(
+            [
+                np.frombuffer(p.pub_key().bytes(), dtype=np.uint8)
+                for p in sel
+            ]
+        )
+        key_ids = e2.key_ids([p.pub_key().bytes() for p in sel])
+        out = EV._finish(
+            EV.verify_arrays_keyed_async(e2, key_ids, kpubs, sigs, msgs)
+        )
+        assert bool(out.all())
+        # and a corrupted sig still fails through the rotated entry
+        bad = sigs.copy()
+        bad[1, 3] ^= 1
+        out = EV._finish(
+            EV.verify_arrays_keyed_async(e2, key_ids, kpubs, bad, msgs)
+        )
+        assert out.tolist() == [True, False]
+
+    def test_10k_validator_4bit_tables_fit_hbm_budget(self):
+        """BASELINE config 5 shape: 10k validators take 4-bit pages and
+        the whole pool fits the device-table budget (and v5e's 16 GB
+        HBM) with room for verify batches."""
+        from cometbft_tpu.ops import precompute as PR
+
+        assert 10_000 > PR.KEY8_MAX  # policy: large sets use 4-bit
+        pool = PR._KeyPool(4)
+        pool_bytes = PR._pool_cap(10_000) * pool.key_bytes
+        assert pool.key_bytes == 64 * 4 * 26 * 16 * 4  # ~426 KB/key
+        assert pool_bytes <= PR.TABLE_CACHE_MB << 20
+        assert pool_bytes < 5 << 30  # ~4.4 GB: fits v5e HBM w/ headroom
 
 
 class TestDispatchThreshold:

@@ -1,12 +1,11 @@
-"""Round-4 device measurement campaign, resumable across tunnel
-windows.
+"""Device measurement campaign, resumable across tunnel windows.
 
 The tunneled axon backend comes and goes (r3's bench recorded 0 during
-an outage); this driver runs each measurement in its OWN subprocess
-with a deadline, appends whatever lands to docs/data/kernel_ab_r04.json
-immediately, and skips steps that already have a result — so a short
-healthy window makes progress and a wedge costs one step's timeout,
-not the campaign.
+an outage; r4's hung for two full rounds of 600 s); this driver runs
+each measurement in its OWN subprocess with a deadline, appends
+whatever lands to docs/data/kernel_ab_r05.json immediately, and skips
+steps that already have a result — so a short healthy window makes
+progress and a wedge costs one step's timeout, not the campaign.
 
     python tools/device_campaign.py [--only STEP] [--timeout S]
 
@@ -29,7 +28,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "docs", "data", "kernel_ab_r04.json")
+OUT = os.path.join(REPO, "docs", "data", "kernel_ab_r05.json")
 
 STEPS = {
     "keyed_stack": (
