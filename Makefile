@@ -35,6 +35,18 @@ test-deadlock:
 test-e2e:
 	$(PY) -m pytest tests/test_e2e_perturb.py tests/test_light_proxy.py -q
 
+# containerized e2e: manifest-driven namespace containers (docker.go
+# analog without a daemon) — real per-node network stacks + partitions
+test-e2e-nsnet:
+	$(PY) -m pytest tests/test_e2e_nsnet.py -q
+
+# QA macro campaign: saturation sweep + latency CDF + RSS envelope +
+# per-component profile (CometBFT-QA-v1.md methodology at localnet
+# scale); writes docs/qa/data/
+qa:
+	$(PY) tools/qa_campaign.py
+	$(PY) tools/qa_campaign.py --profile --rates 400
+
 bench:
 	$(PY) bench.py
 

@@ -16,18 +16,23 @@ import struct
 from typing import Iterator
 
 
+# single-byte varints (field keys, small lengths, counts) dominate the
+# call profile — the QA campaign measured 1.29M encode_uvarint calls in
+# a 60 s saturation run, almost all < 0x80 — so they come from a table
+_UV1 = [bytes([i]) for i in range(0x80)]
+
+
 def encode_uvarint(n: int) -> bytes:
-    if n < 0:
-        raise ValueError("uvarint must be non-negative")
+    if n < 0x80:
+        if n < 0:
+            raise ValueError("uvarint must be non-negative")
+        return _UV1[n]
     out = bytearray()
-    while True:
-        b = n & 0x7F
+    while n > 0x7F:
+        out.append((n & 0x7F) | 0x80)
         n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+    out.append(n)
+    return bytes(out)
 
 
 def decode_uvarint(buf: bytes, offset: int = 0) -> tuple[int, int]:
@@ -82,7 +87,9 @@ class ProtoWriter:
         self._buf = bytearray()
 
     def _key(self, field: int, wire_type: int) -> None:
-        self._buf += encode_uvarint((field << 3) | wire_type)
+        key = (field << 3) | wire_type
+        # fields <= 15 (every message here) key in one table byte
+        self._buf += _UV1[key] if key < 0x80 else encode_uvarint(key)
 
     def varint(self, field: int, value: int) -> None:
         """int32/int64/uint64/bool/enum. Negative ints use two's complement

@@ -31,6 +31,9 @@ _PROBE = (
 
 
 def _namespaces_usable() -> bool:
+    """Probed lazily INSIDE the test — a skipif decorator would fork
+    the unshare/bridge/netns probe at collection time, taxing every
+    pytest run that merely collects this module."""
     try:
         r = subprocess.run(
             ["unshare", "--user", "--map-root-user", "--net", "--mount",
@@ -42,14 +45,18 @@ def _namespaces_usable() -> bool:
         return False
 
 
-@pytest.mark.skipif(
-    not _namespaces_usable(),
-    reason="kernel namespaces (unshare -Urnm + bridge/veth) unavailable",
-)
 def test_ci_manifest_survives_perturbation_matrix(tmp_path):
     """4 validators in 4 namespace containers, 2 zones: the ci.toml
     perturbation schedule (kill9, real link partition, pause) keeps
-    liveness, every victim catches up, and no fork appears."""
+    liveness, every victim catches up, and no fork appears.
+
+    Runs in the DEFAULT tier: the full matrix measured 44 s on a
+    single contended core — this is the containerized-e2e headline
+    capability, so the default gate exercises it."""
+    if not _namespaces_usable():
+        pytest.skip(
+            "kernel namespaces (unshare -Urnm + bridge/veth) unavailable"
+        )
     manifest = os.path.join(NSNET, "ci.toml")
     r = subprocess.run(
         [

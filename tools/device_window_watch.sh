@@ -1,0 +1,29 @@
+#!/bin/sh
+# Watches the device tunnel; the moment it answers, fires the queued
+# round-5 device measurements in priority order:
+#   1. tools/device_campaign.py   — keyed stack/stack16/pallas A/B
+#                                   (docs/data/kernel_ab_r05.json)
+#   2. bench_all.py               — all five BASELINE configs, keyed
+#   3. tools/sharded_keyed_probe.py — mesh+keyed on chip, HBM accounted
+# Each step is resumable/checkpointed, so a window closing mid-run
+# keeps whatever landed. Log: /tmp/device_window.log
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/device_window.log
+while true; do
+  t0=$(date +%s)
+  out=$(timeout 25 python -c "import jax; print(len(jax.devices()))" 2>/dev/null)
+  t1=$(date +%s)
+  if [ "$out" != "" ] && [ "$out" != "0" ]; then
+    echo "$(date -u +%H:%M:%S) tunnel OPEN ($out devices, probe $((t1-t0))s) - firing campaign" >> "$LOG"
+    timeout 5400 python tools/device_campaign.py >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) campaign rc=$?" >> "$LOG"
+    timeout 3600 python bench_all.py >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) bench_all rc=$?" >> "$LOG"
+    timeout 2400 python tools/sharded_keyed_probe.py >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) sharded_keyed rc=$?" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) queue drained; watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) tunnel closed (probe $((t1-t0))s)" >> "$LOG"
+  sleep 240
+done

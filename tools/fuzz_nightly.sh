@@ -1,0 +1,30 @@
+#!/bin/sh
+# Nightly fuzz job (reference analogs: .github/workflows/fuzz-nightly.yml
+# + test/fuzz/oss-fuzz-build.sh). Run from cron/CI:
+#
+#     tools/fuzz_nightly.sh [seconds-per-target]
+#
+# Behavior matches the reference's nightly contract:
+#  - every target soaks for a fixed budget on the checked-in corpus
+#  - coverage-growing inputs are ADDED to tests/data/fuzz_corpus/
+#    (commit them: the corpus is an artifact that only grows)
+#  - any crash leaves a reproducer in tests/data/fuzz_crashes/<target>/
+#    and the job exits nonzero so CI pages — each reproducer must
+#    become a regression test before being cleared
+#  - a JSON summary is appended to docs/data/fuzz_nightly.jsonl so
+#    exec-rate and corpus-size trends are inspectable over time
+set -u
+cd "$(dirname "$0")/.." || exit 1
+BUDGET="${1:-600}"
+TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+OUT=$(python tools/fuzz.py --time "$BUDGET" 2>&1)
+RC=$?
+echo "$OUT"
+CORPUS=$(find tests/data/fuzz_corpus -type f | wc -l | tr -d ' ')
+# count only NEW (untracked) reproducers: checked-in crash files are
+# regression-test fixtures from already-fixed bugs
+CRASHES=$(git ls-files --others --exclude-standard tests/data/fuzz_crashes 2>/dev/null | wc -l | tr -d ' ')
+mkdir -p docs/data
+printf '{"ts": "%s", "budget_s": %s, "rc": %s, "corpus_files": %s, "crash_files": %s}\n' \
+    "$TS" "$BUDGET" "$RC" "$CORPUS" "$CRASHES" >> docs/data/fuzz_nightly.jsonl
+exit "$RC"

@@ -90,18 +90,41 @@ def _node_env() -> dict:
     return env
 
 
-class RssSampler(threading.Thread):
-    """Samples VmRSS of the node pids every couple of seconds."""
+class ResourceSampler(threading.Thread):
+    """Samples VmRSS and cumulative CPU (utime+stime) of the node pids
+    every couple of seconds — the CPU series turns "the localnet is
+    slower than the reference's 200-node testnet" into a measurable
+    statement about how much of the single core each node got."""
+
+    CLK = os.sysconf("SC_CLK_TCK")
 
     def __init__(self, pids: list[int], period: float = 2.0):
         super().__init__(daemon=True)
         self.pids = pids
         self.period = period
         self.samples: dict[int, list[int]] = {p: [] for p in pids}
-        self._stop = threading.Event()
+        self.cpu0: dict[int, float] = {}
+        self.cpu1: dict[int, float] = {}
+        self.t0 = time.monotonic()
+        # NB: must not be named _stop — that shadows Thread._stop,
+        # which join() calls internally
+        self._halt = threading.Event()
+
+    def _cpu_s(self, pid: int) -> float | None:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            # fields 14/15 (1-based utime/stime) land at 11/12 here
+            return (int(parts[11]) + int(parts[12])) / self.CLK
+        except (OSError, IndexError, ValueError):
+            return None
 
     def run(self) -> None:
-        while not self._stop.wait(self.period):
+        for pid in self.pids:
+            c = self._cpu_s(pid)
+            if c is not None:
+                self.cpu0[pid] = c
+        while not self._halt.wait(self.period):
             for pid in self.pids:
                 try:
                     with open(f"/proc/{pid}/status") as f:
@@ -112,12 +135,24 @@ class RssSampler(threading.Thread):
                                 break
                 except OSError:
                     pass
+                c = self._cpu_s(pid)
+                if c is not None:
+                    self.cpu1[pid] = c
 
     def stop(self) -> dict:
-        self._stop.set()
+        self._halt.set()
         self.join(timeout=5)
+        wall = max(time.monotonic() - self.t0, 1e-9)
         flat = [s for per in self.samples.values() for s in per]
         per_node_peak = [max(s) if s else 0 for s in self.samples.values()]
+        cpu_per_node = [
+            round(
+                (self.cpu1.get(p, self.cpu0.get(p, 0.0))
+                 - self.cpu0.get(p, 0.0)) / wall,
+                3,
+            )
+            for p in self.pids
+        ]
         return {
             "rss_peak_mb": round(max(flat) / 1024, 1) if flat else None,
             "rss_mean_mb": round(
@@ -126,6 +161,8 @@ class RssSampler(threading.Thread):
             "rss_peak_per_node_mb": [
                 round(p / 1024, 1) for p in per_node_peak
             ],
+            "cpu_cores_per_node": cpu_per_node,
+            "cpu_cores_total": round(sum(cpu_per_node), 3),
         }
 
 
@@ -214,7 +251,7 @@ def run_rate(
         log(f"rate {rate}: localnet up, loading {duration:.0f}s")
         from cometbft_tpu.loadtime import Loader
 
-        sampler = RssSampler([p.pid for p in procs])
+        sampler = ResourceSampler([p.pid for p in procs])
         sampler.start()
         loader = Loader(
             endpoints=[
@@ -225,11 +262,16 @@ def run_rate(
             connections=connections,
         )
         t0 = time.time()
-        loader.run(duration)
+        summary = loader.run(duration)
         load_wall = time.time() - t0
         time.sleep(5)  # tail commit
         entry.update(sampler.stop())
         entry["duration_s"] = round(load_wall, 1)
+        # offered vs actually-sent vs committed: distinguishes a
+        # client-side send shortfall / RPC rejections from consensus
+        # throughput when reading the saturation knee
+        entry["sent"] = summary.get("sent")
+        entry["send_errors"] = summary.get("errors")
     finally:
         for p in procs:
             p.terminate()
@@ -312,8 +354,12 @@ def main() -> int:
         doc["results"] = [
             r
             for r in doc["results"]
-            if (r["offered_rate"], bool(r.get("profile")))
-            != (rate, args.profile)
+            if (
+                r["offered_rate"],
+                r.get("connections"),
+                bool(r.get("profile")),
+            )
+            != (rate, args.connections, args.profile)
         ] + [entry]
         doc["results"].sort(key=lambda r: r["offered_rate"])
         tmp = args.out + ".tmp"
