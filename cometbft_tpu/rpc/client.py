@@ -1,6 +1,7 @@
 """RPC clients (reference: rpc/client/).
 
-- ``HTTPClient``: JSON-RPC over HTTP via urllib (rpc/client/http);
+- ``HTTPClient``: JSON-RPC over persistent HTTP/1.1 connections
+  (rpc/client/http);
 - ``LocalClient``: direct calls into an Environment, no network
   (rpc/client/local) — the embedding-friendly client;
 - ``WSClient``: JSON-RPC over a WebSocket with live event
@@ -17,18 +18,91 @@ import os
 import queue
 import socket
 import threading
-import urllib.request
 
 from cometbft_tpu.rpc.jsonrpc import RPCError
 
 
 class HTTPClient:
-    """(rpc/client/http/http.go HTTP)"""
+    """(rpc/client/http/http.go HTTP)
+
+    Connections are persistent per thread (the server speaks HTTP/1.1
+    keep-alive): urllib's one-TCP-handshake-per-call costs real CPU on
+    both ends at load — the QA campaign's saturation runs spend it
+    thousands of times a minute. A dead kept-alive socket is retried
+    once on a fresh connection."""
 
     def __init__(self, base_url: str, timeout: float = 10.0):
+        import urllib.parse
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._next_id = 0
+        parts = urllib.parse.urlsplit(self.base_url)
+        if parts.scheme not in ("http", "https", ""):
+            raise ValueError(f"unsupported scheme {parts.scheme!r}")
+        self._tls = parts.scheme == "https"
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or (443 if self._tls else 80)
+        self._path = parts.path or "/"
+        self._local = threading.local()
+
+    #: stale kept-alive socket signatures — the server closed the idle
+    #: connection BEFORE reading our request, so a resend cannot
+    #: double-submit. Timeouts and mid-response failures are NOT here:
+    #: the server may already have processed the (non-idempotent) call.
+    _RETRYABLE = None  # set below, needs http.client imported
+
+    def _request(self, payload: bytes) -> dict:
+        import http.client
+
+        if HTTPClient._RETRYABLE is None:
+            HTTPClient._RETRYABLE = (
+                http.client.RemoteDisconnected,
+                BrokenPipeError,
+                ConnectionResetError,
+                ConnectionRefusedError,
+            )
+        conn = getattr(self._local, "conn", None)
+        reused = conn is not None
+        while True:
+            if conn is None:
+                cls = (
+                    http.client.HTTPSConnection
+                    if self._tls
+                    else http.client.HTTPConnection
+                )
+                conn = cls(self._host, self._port, timeout=self.timeout)
+                self._local.conn = conn
+            try:
+                conn.request(
+                    "POST",
+                    self._path,
+                    body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise RPCError(
+                        -32603, f"http status {resp.status}",
+                        body.decode(errors="replace")[:200],
+                    )
+                return json.loads(body)
+            except Exception as exc:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                self._local.conn = conn = None
+                # retry ONCE, and only for a reused connection dying
+                # with a stale-socket signature — a fresh-connection
+                # failure, a timeout, or a mid-response error must
+                # surface immediately (the server may have processed
+                # the call; resending could double-submit)
+                if reused and isinstance(exc, HTTPClient._RETRYABLE):
+                    reused = False
+                    continue
+                raise
 
     def call(self, method: str, **params):
         self._next_id += 1
@@ -40,13 +114,7 @@ class HTTPClient:
                 "params": params,
             }
         ).encode()
-        req = urllib.request.Request(
-            self.base_url,
-            data=payload,
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            body = json.loads(resp.read())
+        body = self._request(payload)
         if "error" in body and body["error"]:
             err = body["error"]
             raise RPCError(

@@ -73,21 +73,54 @@ class NsNet:
         )
 
     # -- network construction ------------------------------------------
+    #
+    # One bridge PER ZONE, adjacent zones joined by a veth trunk pair:
+    # downing a trunk is a REAL inter-zone partition (both halves keep
+    # intra-zone connectivity — the shape docker e2e gets from
+    # disconnecting networks), while a node's own veth going down
+    # isolates just that node.
+
+    def _zones(self) -> list[str]:
+        seen: list[str] = []
+        for n in self.m.nodes:
+            if n["zone"] not in seen:
+                seen.append(n["zone"])
+        return seen
 
     def build_network(self) -> None:
         sh("mount", "-t", "tmpfs", "tmpfs", "/run", check=False)
-        sh("ip", "link", "add", "br0", "type", "bridge")
         prefix = self.m.subnet.split("/")[1]
-        sh("ip", "addr", "add", f"{self.m.bridge_ip}/{prefix}", "dev", "br0")
-        sh("ip", "link", "set", "br0", "up")
+        zones = self._zones()
+        self._trunks: dict[tuple[str, str], str] = {}
+        for zi, zone in enumerate(zones):
+            br = f"br-{zone}"[:15]
+            sh("ip", "link", "add", br, "type", "bridge")
+            sh("ip", "link", "set", br, "up")
+            if zi == 0:
+                # the runner's own foothold on the L2 domain; far-zone
+                # nodes are probed via netns exec during partitions
+                sh("ip", "addr", "add",
+                   f"{self.m.bridge_ip}/{prefix}", "dev", br)
+        for zi in range(len(zones) - 1):
+            a, b = zones[zi], zones[zi + 1]
+            ta, tb = f"tz{zi}a", f"tz{zi}b"
+            sh("ip", "link", "add", ta, "type", "veth",
+               "peer", "name", tb)
+            sh("ip", "link", "set", ta, "master", f"br-{a}"[:15])
+            sh("ip", "link", "set", tb, "master", f"br-{b}"[:15])
+            sh("ip", "link", "set", ta, "up")
+            sh("ip", "link", "set", tb, "up")
+            self._trunks[(a, b)] = ta
+            self._trunks[(b, a)] = ta
         for i, node in enumerate(self.m.nodes):
             name = node["name"]
+            br = f"br-{node['zone']}"[:15]
             sh("ip", "netns", "add", name)
             sh(
                 "ip", "link", "add", f"veth{i}", "type", "veth",
                 "peer", "name", "eth0", "netns", name,
             )
-            sh("ip", "link", "set", f"veth{i}", "master", "br0")
+            sh("ip", "link", "set", f"veth{i}", "master", br)
             sh("ip", "link", "set", f"veth{i}", "up")
             ns = ("ip", "netns", "exec", name)
             sh(*ns, "ip", "addr", "add",
@@ -95,8 +128,9 @@ class NsNet:
             sh(*ns, "ip", "link", "set", "eth0", "up")
             sh(*ns, "ip", "link", "set", "lo", "up")
             self._apply_zone_latency(i, node)
-        log(f"network up: bridge {self.m.bridge_ip}, "
-            f"{len(self.m.nodes)} namespaces")
+        log(f"network up: zones {zones} bridged at {self.m.bridge_ip}, "
+            f"{len(self.m.nodes)} namespaces, "
+            f"{len(self._trunks) // 2} trunk(s)")
 
     def _apply_zone_latency(self, i: int, node: dict) -> None:
         """Best-effort inter-zone delay on the node's veth egress.
@@ -178,6 +212,12 @@ class NsNet:
     def heal(self, i: int) -> None:
         sh("ip", "link", "set", f"veth{i}", "up")
 
+    def zone_partition(self, a: str, b: str) -> None:
+        sh("ip", "link", "set", self._trunks[(a, b)], "down")
+
+    def zone_heal(self, a: str, b: str) -> None:
+        sh("ip", "link", "set", self._trunks[(a, b)], "up")
+
     def stop_all(self) -> None:
         for p in self.procs.values():
             if p is None:
@@ -218,6 +258,28 @@ class NsNet:
             self.rpc(i, "status")["sync_info"]["latest_block_height"]
         )
 
+    def height_ns(self, i: int) -> int:
+        """Height probed FROM INSIDE the node's own network namespace —
+        reachable even while the node's zone is partitioned away from
+        the runner's bridge foothold."""
+        name = self.m.nodes[i]["name"]
+        code = (
+            "import json,urllib.request;"
+            "r=urllib.request.urlopen("
+            f"'http://{self.m.node_ip(i)}:{RPC_PORT}/status',timeout=3);"
+            "print(json.load(r)['result']['sync_info']"
+            "['latest_block_height'])"
+        )
+        r = sh(
+            "ip", "netns", "exec", name, sys.executable, "-c", code,
+            check=False,
+        )
+        if r.returncode:
+            raise RuntimeError(
+                f"ns height probe {name}: {r.stderr.strip()[-200:]}"
+            )
+        return int(r.stdout.strip())
+
     def wait_heights(self, idxs, target: int, timeout: float = 240.0):
         deadline = time.monotonic() + timeout
         pending = set(idxs)
@@ -256,10 +318,34 @@ def run_scenario(net: NsNet) -> list[str]:
                   f"height {m.warmup_height}")
 
     for pert in m.perturbations:
+        op = pert["op"]
+        if op == "zone_partition":
+            # full inter-zone split: with no quorum on either side the
+            # chain must HALT (no height advances beyond blocks already
+            # in flight), then resume WITHOUT a fork on heal — the BFT
+            # safety/liveness trade under partition
+            za, zb = pert["zones"]
+            halt_s = float(pert.get("halt_s", 8.0))
+            pre = [net.height_ns(i) for i in all_idx]
+            net.zone_partition(za, zb)
+            log(f"perturb: zone_partition {za}|{zb} at heights {pre}")
+            time.sleep(halt_s)
+            post = [net.height_ns(i) for i in all_idx]
+            stalled = all(p - q <= 1 for p, q in zip(post, pre))
+            net.zone_heal(za, zb)
+            assert stalled, (
+                f"chain advanced during a no-quorum partition: "
+                f"{pre} -> {post}"
+            )
+            net.wait_heights(all_idx, max(post) + 2)
+            checks.append(
+                f"zone_partition {za}|{zb}: halted for {halt_s:.0f}s "
+                f"(heights {post}), resumed after heal"
+            )
+            continue
         victim = next(
             i for i, n in enumerate(m.nodes) if n["name"] == pert["node"]
         )
-        op = pert["op"]
         others = [i for i in all_idx if i != victim]
         base = max(net.height(i) for i in others)
         log(f"perturb: {op} {pert['node']} at height {base}")

@@ -73,5 +73,8 @@ def test_ci_manifest_survives_perturbation_matrix(tmp_path):
     assert verdict["ok"], (
         f"verdict: {verdict}\nstderr: {r.stderr[-2000:]}"
     )
-    # the full matrix ran: warmup + 3 perturbations + fork check
-    assert len(verdict["checks"]) == 5, verdict["checks"]
+    # the full matrix ran: warmup + 4 perturbations (kill9, node
+    # partition, pause, inter-zone split) + fork check
+    assert len(verdict["checks"]) == 6, verdict["checks"]
+    assert any("zone_partition" in c and "halted" in c
+               for c in verdict["checks"]), verdict["checks"]

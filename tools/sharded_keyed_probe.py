@@ -70,6 +70,11 @@ def probe_shape(nval: int, nsig: int) -> dict:
     pubs_b = [p.pub_key().bytes() for p in privs]
     t0 = time.time()
     tbl = PR.TABLE_CACHE.lookup_or_build(pubs_b)
+    if tbl is None:
+        raise SystemExit(
+            f"{nval} unique keys is outside table policy "
+            f"(CMT_TPU_TABLE_MAX_KEYS={PR.TABLE_MAX_KEYS})"
+        )
     np.asarray(jax.device_get(tbl.table[0, 0, 0, :4]))  # force build
     entry["table_build_s"] = round(time.time() - t0, 1)
     entry["window_bits"] = tbl.window_bits
