@@ -38,10 +38,14 @@ class HTTPClient:
         self.timeout = timeout
         self._next_id = 0
         parts = urllib.parse.urlsplit(self.base_url)
-        if parts.scheme not in ("http", "https", ""):
-            raise ValueError(f"unsupported scheme {parts.scheme!r}")
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(
+                f"unsupported url {base_url!r} (need http:// or https://)"
+            )
         self._tls = parts.scheme == "https"
-        self._host = parts.hostname or "127.0.0.1"
+        if not parts.hostname:
+            raise ValueError(f"no host in url {base_url!r}")
+        self._host = parts.hostname
         self._port = parts.port or (443 if self._tls else 80)
         self._path = parts.path or "/"
         self._local = threading.local()
@@ -73,6 +77,7 @@ class HTTPClient:
                 )
                 conn = cls(self._host, self._port, timeout=self.timeout)
                 self._local.conn = conn
+            sent = False
             try:
                 conn.request(
                     "POST",
@@ -80,6 +85,7 @@ class HTTPClient:
                     body=payload,
                     headers={"Content-Type": "application/json"},
                 )
+                sent = True
                 resp = conn.getresponse()
                 body = resp.read()
                 if resp.status != 200:
@@ -94,12 +100,18 @@ class HTTPClient:
                 except Exception:
                     pass
                 self._local.conn = conn = None
-                # retry ONCE, and only for a reused connection dying
-                # with a stale-socket signature — a fresh-connection
-                # failure, a timeout, or a mid-response error must
-                # surface immediately (the server may have processed
-                # the call; resending could double-submit)
-                if reused and isinstance(exc, HTTPClient._RETRYABLE):
+                # retry ONCE, and only when a REUSED connection failed
+                # during the SEND itself — before the request could
+                # have reached the server. Anything after conn.request
+                # returned (getresponse, read), a timeout, or a fresh-
+                # connection failure surfaces immediately: the server
+                # may already have processed the call, and resending a
+                # non-idempotent RPC could double-submit it.
+                if (
+                    reused
+                    and not sent
+                    and isinstance(exc, HTTPClient._RETRYABLE)
+                ):
                     reused = False
                     continue
                 raise

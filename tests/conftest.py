@@ -23,6 +23,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# NB: kernel-compile caching for the suite is provided by
+# cometbft_tpu/ops/__init__.py (persistent cache at
+# ~/.cache/cometbft_tpu_xla) — warm runs skip recompiles of unchanged
+# kernels at known shapes; configuring a second cache dir here would
+# just be overridden when ops imports.
+
 import random
 
 import pytest

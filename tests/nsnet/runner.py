@@ -326,8 +326,12 @@ def run_scenario(net: NsNet) -> list[str]:
             # safety/liveness trade under partition
             za, zb = pert["zones"]
             halt_s = float(pert.get("halt_s", 8.0))
-            pre = [net.height_ns(i) for i in all_idx]
+            # baseline heights are sampled AFTER the trunk goes down:
+            # the four sequential netns probes take ~1 s total, and a
+            # healthy chain could legitimately commit during a
+            # pre-partition sample, tripping the halt assert spuriously
             net.zone_partition(za, zb)
+            pre = [net.height_ns(i) for i in all_idx]
             log(f"perturb: zone_partition {za}|{zb} at heights {pre}")
             time.sleep(halt_s)
             post = [net.height_ns(i) for i in all_idx]

@@ -25,6 +25,7 @@ _EXTRA_SEEDS = [
 ]
 
 
+@pytest.mark.slow  # randomized-manifest soak (~40 s/seed single-core)
 @pytest.mark.parametrize("seed", [1337, 90210] + _EXTRA_SEEDS)
 def test_generated_perturbation_sequence(tmp_path, seed):
     rng = random.Random(seed)

@@ -95,10 +95,16 @@ class TestShardedSeam:
         bv = create_batch_verifier(ed.priv_key_from_secret(b"f").pub_key())
         assert isinstance(bv, ShardedTpuBatchVerifier)
 
+    @pytest.mark.slow
     def test_10k_sigs_uneven_keyed(self):
         """Light-client shape: >=10k signatures over a 150-key set,
         batch size deliberately not a multiple of 8 devices or any
-        pow2 tile; exact planted-invalid recovery."""
+        pow2 tile; exact planted-invalid recovery.
+
+        Soak tier (28 min single-core on the 8-device virtual mesh):
+        the same mesh+keyed+uneven composition is covered at small
+        shape by test_generic_path_uneven and the planted-invalid mesh
+        tests in the default gate."""
         import numpy as np
 
         from cometbft_tpu.crypto import ed25519 as ed
