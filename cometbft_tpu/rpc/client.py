@@ -76,6 +76,15 @@ class HTTPClient:
                     else http.client.HTTPConnection
                 )
                 conn = cls(self._host, self._port, timeout=self.timeout)
+                conn.connect()
+                # http.client writes headers and body as two segments;
+                # on a long-lived connection Nagle + delayed ACK stalls
+                # the second ~40 ms per request (fresh sockets dodge it
+                # via initial quickack, which is why urllib didn't show
+                # it) — measured 22 tx/s vs 186 on the loadtime path
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
                 self._local.conn = conn
             sent = False
             try:

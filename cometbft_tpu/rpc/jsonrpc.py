@@ -144,6 +144,10 @@ class JSONRPCServer(BaseService):
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # headers and body flush as separate segments; without
+            # NODELAY a kept-alive connection pays Nagle + delayed-ACK
+            # (~40 ms) per response
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # route through our logger
                 outer.logger.debug("http " + (fmt % args))
