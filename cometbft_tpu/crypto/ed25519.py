@@ -116,11 +116,21 @@ def priv_key_from_secret(secret: bytes) -> Ed25519PrivKey:
 
 
 class CpuBatchVerifier(BatchVerifier):
-    """Sequential host-side batch verifier — the correctness fallback.
+    """Host-side batch verifier — the correctness fallback.
 
     The production batch path is cometbft_tpu.ops.ed25519.TpuBatchVerifier;
-    both must agree bit-for-bit (differential tests).
+    both must agree bit-for-bit (differential tests).  Batches of
+    NATIVE_MIN_BATCH+ go through ONE native random-linear-combination
+    check (native/crypto/ed25519_batch.cpp — a single Pippenger MSM
+    over the whole batch, the reference's batch.go strategy on this
+    host): all-valid batches, the overwhelmingly common case, cost one
+    equation; a failed batch falls back to per-signature verification
+    for exact per-lane verdicts, exactly as the reference re-verifies
+    individually on batch failure.
     """
+
+    #: below this, per-signature verification beats MSM setup
+    NATIVE_MIN_BATCH = 16
 
     def __init__(self) -> None:
         self._entries: list[tuple[Ed25519PubKey, bytes, bytes]] = []
@@ -138,7 +148,24 @@ class CpuBatchVerifier(BatchVerifier):
     def verify(self) -> tuple[bool, list[bool]]:
         if not self._entries:
             return False, []
+        if len(self._entries) >= self.NATIVE_MIN_BATCH:
+            from cometbft_tpu.crypto import ed25519_native as _native
+
+            lib = _native.load()
+            if lib is not None:
+                got = _native.rlc_verify(
+                    lib,
+                    [
+                        (pk.bytes(), msg, sig)
+                        for pk, msg, sig in self._entries
+                    ],
+                )
+                if got is True:
+                    return True, [True] * len(self._entries)
+                # False/None: per-signature pass below gives exact
+                # per-lane verdicts (reference batch.go fallback)
         results = [
-            pk.verify_signature(msg, sig) for pk, msg, sig in self._entries
+            pk.verify_signature(msg, sig)
+            for pk, msg, sig in self._entries
         ]
         return all(results), results

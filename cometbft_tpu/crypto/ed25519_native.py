@@ -1,0 +1,106 @@
+"""ctypes binding for the native Ed25519 RLC batch verifier
+(native/crypto/ed25519_batch.cpp).
+
+One C call checks a whole batch with a random-linear-combination
+equation — the host-side analog of the TPU kernel's batched math and
+of the reference's ed25519consensus batch verifier
+(crypto/ed25519/batch.go). Python computes all SCALARS with big-int
+arithmetic (SHA-512 challenges, random 128-bit weights, mod-L
+products); C++ does only curve work (ZIP-215 decompression, one
+Pippenger MSM, cofactor-8 identity check) with point formulas
+mirroring the pure-Python oracle.
+
+Build-on-demand via utils/native_build (same as the frame pump, BLS,
+cometkv). Disable with CMT_TPU_NO_NATIVE_ED25519=1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+
+from cometbft_tpu.crypto.edwards import B_POINT, L, encode_point
+from cometbft_tpu.utils.native_build import NativeLib
+
+_B_ENC = encode_point(B_POINT)
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.cmt_ed25519_rlc_verify.restype = ctypes.c_long
+    lib.cmt_ed25519_rlc_verify.argtypes = [
+        ctypes.c_char_p, i32p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_long, ctypes.c_long,
+    ]
+    lib.cmt_ed25519_backend.restype = ctypes.c_int
+    lib.cmt_ed25519_backend.argtypes = []
+
+
+_LIB = NativeLib(
+    src_rel="native/crypto/ed25519_batch.cpp",
+    out_name="libcmted25519.so",
+    disable_env="CMT_TPU_NO_NATIVE_ED25519",
+    configure=_configure,
+)
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, or None (disabled / no toolchain)."""
+    return _LIB.load()
+
+
+def rlc_verify(
+    lib: ctypes.CDLL,
+    entries: list[tuple[bytes, bytes, bytes]],
+) -> bool | None:
+    """One RLC check over ``entries`` = [(pub32, msg, sig64), ...].
+
+    Returns True (every signature valid), False (at least one invalid
+    OR a malformed scalar/point — caller re-verifies individually), or
+    None when the batch could not run at all. Entries must already
+    have sig length 64.
+
+    The equation (edwards.verify_zip215 batched):
+      [8]([c]B + sum[z_i](-R_i) + sum[(z_i k_i) mod L](-A_i)) == id
+    with c = sum z_i s_i mod L and independent random 128-bit z_i —
+    a forged signature survives with probability ~2^-128.
+    """
+    n = len(entries)
+    if n == 0:
+        return None
+
+    # unique-key table: commits verify many sigs under few keys, so
+    # the C side decompresses each key once
+    key_ids: dict[bytes, int] = {}
+    idx = (ctypes.c_int32 * n)()
+    rs = bytearray()
+    za = bytearray()
+    zr = bytearray()
+    c_acc = 0
+    for i, (pub, msg, sig) in enumerate(entries):
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:
+            return False  # oracle rejects; per-sig path reports lanes
+        idx[i] = key_ids.setdefault(pub, len(key_ids))
+        rs += sig[:32]
+        k = int.from_bytes(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+        ) % L
+        z = int.from_bytes(os.urandom(16), "little") | 1
+        za += (z * k % L).to_bytes(32, "little")
+        zr += z.to_bytes(32, "little")
+        c_acc = (c_acc + z * s) % L
+    upubs = b"".join(key_ids)  # dict preserves insertion order
+    rc = lib.cmt_ed25519_rlc_verify(
+        upubs, idx, bytes(rs), _B_ENC, bytes(za), bytes(zr),
+        c_acc.to_bytes(32, "little"), len(key_ids), n,
+    )
+    if rc == 1:
+        return True
+    if rc == 0:
+        return False
+    # a point failed to decode (rc < 0): the oracle path will return
+    # False for those lanes — treat like a failed batch
+    return False

@@ -1,0 +1,180 @@
+"""Differential suite for the native Ed25519 RLC batch verifier
+(native/crypto/ed25519_batch.cpp) against the pure-Python ZIP-215
+oracle — the native path must agree with the oracle on EVERY batch it
+accepts, and its failure fallback must produce exactly the oracle's
+per-lane verdicts.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import edwards as E
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import ed25519_native as nat
+
+
+@pytest.fixture(scope="module")
+def lib():
+    handle = nat.load()
+    if handle is None:
+        pytest.skip("native ed25519 unavailable (no toolchain)")
+    return handle
+
+
+def batch_via_seam(cases):
+    """Run [(pub_bytes, msg, sig)] through CpuBatchVerifier (which
+    takes the native RLC path at 16+ entries) and the oracle."""
+    bv = ed.CpuBatchVerifier()
+    for pub, msg, sig in cases:
+        bv.add(ed.Ed25519PubKey(pub), msg, sig)
+    ok, bits = bv.verify()
+    oracle = [E.verify_zip215(pub, msg, sig) for pub, msg, sig in cases]
+    assert bits == oracle, "seam verdicts diverge from the oracle"
+    assert ok == all(oracle)
+    return ok, bits
+
+
+def make_valid(n, nkeys=5, seed=0):
+    rng = random.Random(seed)
+    privs = [ed.gen_priv_key() for _ in range(nkeys)]
+    cases = []
+    for i in range(n):
+        p = privs[i % nkeys]
+        m = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 80)))
+        cases.append((p.pub_key().bytes(), m, p.sign(m)))
+    return cases
+
+
+class TestRlcDifferential:
+    def test_all_valid_batch(self, lib):
+        ok, bits = batch_via_seam(make_valid(64))
+        assert ok and all(bits)
+
+    def test_native_path_actually_taken(self, lib):
+        got = nat.rlc_verify(
+            lib, [(p, m, s) for p, m, s in make_valid(32)]
+        )
+        assert got is True
+
+    def test_mutations_agree_with_oracle(self, lib):
+        rng = random.Random(7)
+        cases = []
+        for pub, m, sig in make_valid(48, seed=1):
+            r = rng.random()
+            sig_b, pub_b = bytearray(sig), bytearray(pub)
+            if r < 0.25:
+                sig_b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            elif r < 0.4:
+                pub_b[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            elif r < 0.5:
+                m = m + b"!"
+            cases.append((bytes(pub_b), m, bytes(sig_b)))
+        ok, _ = batch_via_seam(cases)
+        assert not ok  # with these rates some lane is invalid
+
+    def test_cancellation_attack_rejected(self, lib):
+        """THE batch-verify trap: two bad signatures whose s-errors
+        cancel in the unweighted sum (s1+d, s2-d). Without independent
+        random weights the combined equation would pass; the RLC
+        weights must reject it."""
+        cases = make_valid(32, nkeys=1, seed=3)
+        pub, m1, s1 = cases[10]
+        _, m2, s2 = cases[20]
+        d = 12345
+        v1 = int.from_bytes(s1[32:], "little")
+        v2 = int.from_bytes(s2[32:], "little")
+        cases[10] = (
+            pub, m1, s1[:32] + ((v1 + d) % E.L).to_bytes(32, "little")
+        )
+        cases[20] = (
+            pub, m2, s2[:32] + ((v2 - d) % E.L).to_bytes(32, "little")
+        )
+        for trial in range(5):  # z_i are random; must fail every time
+            got = nat.rlc_verify(
+                lib, [(p, m, s) for p, m, s in cases]
+            )
+            assert got is False, f"cancellation survived trial {trial}"
+        batch_via_seam(cases)  # seam fallback agrees with the oracle
+
+    def test_s_out_of_range_rejected(self, lib):
+        cases = make_valid(20, seed=4)
+        pub, m, sig = cases[3]
+        bad_s = (E.L + 5).to_bytes(32, "little")
+        cases[3] = (pub, m, sig[:32] + bad_s)
+        ok, bits = batch_via_seam(cases)
+        assert not ok and not bits[3] and sum(bits) == 19
+
+    def test_torsion_pubkey_batch(self, lib):
+        """ZIP-215: a small-order pubkey with R = [s]B + torsion is
+        VALID under the cofactored equation — the native path must
+        accept what the oracle accepts."""
+        tors = E.small_order_points()
+        cases = make_valid(20, seed=5)
+        for lane, (a_enc, t_enc) in enumerate(
+            [(tors[1], tors[0]), (tors[3], tors[2]), (tors[5], tors[4])]
+        ):
+            s = random.Random(lane).randrange(1, E.L)
+            r_pt = E.pt_add(
+                E.pt_mul(s, E.B_POINT), E.decode_point(t_enc)
+            )
+            sig = E.encode_point(r_pt) + s.to_bytes(32, "little")
+            msg = b"torsion lane %d" % lane
+            assert E.verify_zip215(a_enc, msg, sig)
+            cases[lane * 5] = (a_enc, msg, sig)
+        ok, bits = batch_via_seam(cases)
+        assert ok and all(bits)
+
+    def test_noncanonical_r_encoding_accepted(self, lib):
+        """A signature whose R is a NON-CANONICAL encoding of a torsion
+        point (y = p + y0): k binds to the encoding bytes, s = k*a."""
+        priv = ed.gen_priv_key()
+        a = priv._scalar() if hasattr(priv, "_scalar") else None
+        if a is None:
+            # derive the clamped scalar the standard way
+            import hashlib
+
+            h = hashlib.sha512(priv._seed).digest()
+            a = int.from_bytes(
+                bytes([h[0] & 248]) + h[1:31] + bytes([(h[31] & 63) | 64]),
+                "little",
+            )
+        pub = priv.pub_key().bytes()
+        # identity encoded non-canonically: y = p + 1 (fits 255 bits)
+        r_enc = (E.P + 1).to_bytes(32, "little")
+        assert E.decode_point(r_enc) is not None
+        import hashlib
+
+        msg = b"non-canonical R"
+        k = int.from_bytes(
+            hashlib.sha512(r_enc + pub + msg).digest(), "little"
+        ) % E.L
+        sig = r_enc + (k * a % E.L).to_bytes(32, "little")
+        assert E.verify_zip215(pub, msg, sig)
+        cases = make_valid(20, seed=6)
+        cases[7] = (pub, msg, sig)
+        ok, bits = batch_via_seam(cases)
+        assert ok and all(bits)
+
+    def test_undecodable_points_fall_back(self, lib):
+        cases = make_valid(20, seed=8)
+        # a y with no valid x (the oracle refuses): probe for one
+        bad = next(
+            bytes([i]) + bytes(31)
+            for i in range(2, 255)
+            if E.decode_point(bytes([i]) + bytes(31)) is None
+        )
+        pub, m, sig = cases[11]
+        cases[11] = (bad, m, sig)       # undecodable pubkey
+        pub2, m2, sig2 = cases[12]
+        cases[12] = (pub2, m2, bad + sig2[32:])  # undecodable R
+        ok, bits = batch_via_seam(cases)
+        assert not ok and not bits[11] and not bits[12]
+        assert sum(bits) == 18
+
+    def test_large_mixed_key_batch(self, lib):
+        cases = make_valid(300, nkeys=37, seed=9)
+        ok, bits = batch_via_seam(cases)
+        assert ok and all(bits)
