@@ -121,6 +121,36 @@ def make_mixed_commit_fixture(n_ed: int, n_bls: int):
     return vals, commit, bid
 
 
+def merge_results(
+    path: str, results: list[dict], replace_if=None, **doc_fields
+) -> None:
+    """Merge ``results`` into a BENCH_ALL-shaped JSON file atomically.
+
+    Existing entries are kept unless ``replace_if(existing_row)`` says
+    this write owns them (default: same config name). ONE
+    implementation for every bench tool — bench_all, loadtime, and the
+    host-baseline tool all write the same file."""
+    if replace_if is None:
+        ours = {r["config"] for r in results}
+
+        def replace_if(row):  # noqa: F811 — default policy
+            return row.get("config") in ours
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"results": []}
+    doc["results"] = [
+        r for r in doc.get("results", []) if not replace_if(r)
+    ] + results
+    doc.update(doc_fields)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
 def timed(fn, warmups: int = 1, iters: int = 3) -> float:
     for _ in range(warmups):
         fn()
@@ -152,21 +182,18 @@ def main() -> None:
 
     def checkpoint():
         # merge-write after every config: a mid-run death (r3 lost the
-        # mixed-megacommit entry this way) keeps what was measured,
-        # and entries other tools own (loadtime_*) are preserved
-        try:
-            with open(path) as f:
-                existing = json.load(f).get("results", [])
-        except (OSError, ValueError):
-            existing = []
+        # mixed-megacommit entry this way) keeps what was measured.
+        # Entries other tools own are preserved: loadtime_* by config
+        # name, and the host-dispatch rows (host_path) even when they
+        # share a config name with a device measurement
         ours = {r["config"] for r in results}
-        merged = [
-            r for r in existing if r.get("config") not in ours
-        ] + results
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"device": str(dev), "results": merged}, f, indent=1)
-        os.replace(tmp, path)
+        merge_results(
+            path, results,
+            replace_if=lambda r: (
+                r.get("config") in ours and not r.get("host_path")
+            ),
+            device=str(dev),
+        )
 
     def record(config: str, value: float, unit: str, **extra):
         row = {"config": config, "value": round(value, 2), "unit": unit}

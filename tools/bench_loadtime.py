@@ -171,17 +171,9 @@ def main() -> int:
         "hardware": "single host, 1 CPU core, 4 subprocess validators",
     }
     print(json.dumps(entry, indent=1))
-    try:
-        with open(args.out) as f:
-            bench = json.load(f)
-    except (OSError, ValueError):
-        bench = {"results": []}
-    bench["results"] = [
-        r for r in bench.get("results", [])
-        if r.get("config") != args.config_name
-    ] + [entry]
-    with open(args.out, "w") as f:
-        json.dump(bench, f, indent=1)
+    from bench_all import merge_results
+
+    merge_results(args.out, [entry])
     print(f"merged into {args.out}", file=sys.stderr)
     return 0
 
