@@ -91,27 +91,39 @@ void fe_mul(fe& r, const fe& f, const fe& g) {
 
 inline void fe_sq(fe& r, const fe& f) { fe_mul(r, f, f); }
 
-// generic constant-exponent power via square-and-multiply over the
-// little-endian exponent bytes (top bit first); exponents are public
-void fe_pow(fe& r, const fe& z, const uint8_t exp[32], int topbit) {
-  fe acc = FE_ONE;
-  bool started = false;
-  for (int i = topbit; i >= 0; i--) {
-    if (started) fe_sq(acc, acc);
-    if ((exp[i >> 3] >> (i & 7)) & 1) {
-      if (started) fe_mul(acc, acc, z);
-      else { acc = z; started = true; }
-    }
-  }
-  r = started ? acc : FE_ONE;
+inline void fe_sqn(fe& r, const fe& z, int n) {
+  fe_sq(r, z);
+  for (int i = 1; i < n; i++) fe_sq(r, r);
 }
 
-// (p-5)/8 = 2^252 - 3  (LE bytes)
-const uint8_t EXP_P58[32] = {
-    0xfd, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x0f};
+// z^(2^252 - 3) via the standard 251-squaring / 11-multiply addition
+// chain ((p-5)/8 — decompression's dominant cost; the exponent has
+// ~250 one-bits, so generic square-and-multiply would double the work)
+void fe_pow22523(fe& r, const fe& z) {
+  fe t0, t1, t2;
+  fe_sq(t0, z);                    // 2
+  fe_sqn(t1, t0, 2);               // 8
+  fe_mul(t1, z, t1);               // 9
+  fe_mul(t0, t0, t1);              // 11
+  fe_sq(t0, t0);                   // 22
+  fe_mul(t0, t1, t0);              // 2^5 - 1
+  fe_sqn(t1, t0, 5);               // 2^10 - 2^5
+  fe_mul(t0, t1, t0);              // 2^10 - 1
+  fe_sqn(t1, t0, 10);              // 2^20 - 2^10
+  fe_mul(t1, t1, t0);              // 2^20 - 1
+  fe_sqn(t2, t1, 20);              // 2^40 - 2^20
+  fe_mul(t1, t2, t1);              // 2^40 - 1
+  fe_sqn(t1, t1, 10);              // 2^50 - 2^10
+  fe_mul(t0, t1, t0);              // 2^50 - 1
+  fe_sqn(t1, t0, 50);              // 2^100 - 2^50
+  fe_mul(t1, t1, t0);              // 2^100 - 1
+  fe_sqn(t2, t1, 100);             // 2^200 - 2^100
+  fe_mul(t1, t2, t1);              // 2^200 - 1
+  fe_sqn(t1, t1, 50);              // 2^250 - 2^50
+  fe_mul(t0, t1, t0);              // 2^250 - 1
+  fe_sqn(t0, t0, 2);               // 2^252 - 4
+  fe_mul(r, t0, z);                // 2^252 - 3
+}
 
 void fe_frombytes(fe& r, const uint8_t s[32]) {
   // 51-bit slices of the 255 low bits (bit 255 is the sign, masked by
@@ -259,7 +271,7 @@ bool ge_decode(ge& r, const uint8_t s[32]) {
   fe_sq(t, v3);
   fe_mul(v7, t, v);
   fe_mul(uv7, u, v7);
-  fe_pow(t, uv7, EXP_P58, 251);   // top set bit of 2^252-3 is bit 251
+  fe_pow22523(t, uv7);
   fe_mul(x, u, v3);
   fe_mul(x, x, t);
   fe_mul(vxx, v, x);

@@ -36,14 +36,11 @@ force_cpu_platform()
 
 from bench_all import (  # noqa: E402
     CHAIN_ID,
+    log,
     make_commit_fixture,
     merge_results,
     timed,
 )
-
-
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
 
 
 def main() -> int:
