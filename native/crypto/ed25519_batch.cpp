@@ -41,6 +41,10 @@ const fe FE_ONE = {{1, 0, 0, 0, 0}};
 // d = -121665/121666 mod p (matches edwards.py D)
 const fe FE_D = {{0x34dca135978a3ull, 0x1a8283b156ebdull, 0x5e7a26001c029ull,
                   0x739c663a03cbbull, 0x52036cee2b6ffull}};
+// 2d mod p
+const fe FE_2D = {{0x69b9426b2f159ull, 0x35050762add7aull,
+                   0x3cf44c0038052ull, 0x6738cc7407977ull,
+                   0x2406d9dc56dffull}};
 // sqrt(-1) = 2^((p-1)/4) (matches edwards.py SQRT_M1)
 const fe FE_SQRTM1 = {{0x61b274a0ea0b0ull, 0xd5a5fc8f189dull,
                        0x7ef5e9cbd0c60ull, 0x78595a6804c9eull,
@@ -205,9 +209,7 @@ void ge_add(ge& r, const ge& p, const ge& q) {
   fe_add(t, q.Y, q.X);
   fe_carry(t);
   fe_mul(b, b, t);                       // B = (y1+x1)(y2+x2)
-  fe_mul(c, p.T, FE_D);
-  fe_add(c, c, c);
-  fe_carry(c);
+  fe_mul(c, p.T, FE_2D);
   fe_mul(c, c, q.T);                     // C = 2 d t1 t2
   fe_mul(d, p.Z, q.Z);
   fe_add(d, d, d);                       // D = 2 z1 z2
@@ -302,6 +304,43 @@ void ge_neg(ge& r, const ge& p) {
   fe_carry(r.T);
 }
 
+// cached Niels form of a DECODED point (Z = 1): y+x, y-x, 2d*t —
+// the per-window bucket deposits then cost 7 muls instead of 9
+struct ge_niels {
+  fe ypx, ymx, t2d;
+};
+
+void ge_to_niels(ge_niels& r, const ge& p) {
+  // decode gives Z = 1, so affine x = X, y = Y, t = T
+  fe_add(r.ypx, p.Y, p.X);
+  fe_carry(r.ypx);
+  fe_sub(r.ymx, p.Y, p.X);
+  fe_carry(r.ymx);
+  fe_mul(r.t2d, p.T, FE_2D);
+}
+
+// mixed addition: r = p + q where q is a cached Niels point (Z = 1);
+// same add-2008-hwcd-3 structure as ge_add with D = 2 z1
+void ge_madd(ge& r, const ge& p, const ge_niels& q) {
+  fe a, b, c, d, e, f, g, h;
+  fe_sub(a, p.Y, p.X);
+  fe_mul(a, a, q.ymx);
+  fe_add(b, p.Y, p.X);
+  fe_mul(b, b, q.ypx);
+  fe_mul(c, p.T, q.t2d);
+  fe_add(d, p.Z, p.Z);
+  fe_sub(e, b, a);
+  fe_sub(f, d, c);
+  fe_add(g, d, c);
+  fe_carry(g);
+  fe_add(h, b, a);
+  fe_carry(h);
+  fe_mul(r.X, e, f);
+  fe_mul(r.Y, g, h);
+  fe_mul(r.Z, f, g);
+  fe_mul(r.T, e, h);
+}
+
 }  // namespace
 
 extern "C" {
@@ -329,39 +368,58 @@ long cmt_ed25519_rlc_verify(const uint8_t* upubs, const int32_t* keyidx,
                             const uint8_t* za, const uint8_t* zr,
                             const uint8_t* cb, long nu, long n) {
   if (nu <= 0 || n <= 0) return 0;
-  // decode unique pubkeys (negated: the MSM accumulates -A terms)
+  // decode unique pubkeys (negated: the MSM accumulates -A terms),
+  // keeping both the extended point (first bucket copy) and the
+  // cached Niels form (mixed-add deposits: 7 muls instead of 9)
   ge* apts = new (std::nothrow) ge[nu];
-  if (!apts) return 0;
+  ge_niels* anls = new (std::nothrow) ge_niels[nu];
+  if (!apts || !anls) {
+    delete[] apts;
+    delete[] anls;
+    return 0;
+  }
   for (long i = 0; i < nu; i++) {
     ge a;
     if (!ge_decode(a, upubs + 32 * i)) {
       delete[] apts;
+      delete[] anls;
       return -(i + 1);
     }
     ge_neg(apts[i], a);
+    ge_to_niels(anls[i], apts[i]);
   }
   ge b;
+  ge_niels bnls;
   if (!ge_decode(b, benc)) {
     delete[] apts;
+    delete[] anls;
     return 0;
   }
+  ge_to_niels(bnls, b);
 
   // Pippenger, window c = 8 (scalar bytes are the digits). Points:
   //   B with scalar cb, -A_{keyidx[i]} with scalar za_i,
   //   -R_i with scalar zr_i (all decoded once up front).
   ge* rpts = new (std::nothrow) ge[n];
-  if (!rpts) {
+  ge_niels* rnls = new (std::nothrow) ge_niels[n];
+  if (!rpts || !rnls) {
     delete[] apts;
+    delete[] anls;
+    delete[] rpts;
+    delete[] rnls;
     return 0;
   }
   for (long i = 0; i < n; i++) {
     ge r;
     if (!ge_decode(r, rs + 32 * i)) {
       delete[] apts;
+      delete[] anls;
       delete[] rpts;
+      delete[] rnls;
       return -(1000000 + i);
     }
     ge_neg(rpts[i], r);
+    ge_to_niels(rnls[i], rpts[i]);
   }
 
   ge buckets[256];  // bucket[0] unused
@@ -372,19 +430,19 @@ long cmt_ed25519_rlc_verify(const uint8_t* upubs, const int32_t* keyidx,
     if (acc_started)
       for (int k = 0; k < 8; k++) ge_double(acc, acc);
     for (int j = 1; j < 256; j++) used[j] = false;
-    auto deposit = [&](const ge& p, uint8_t digit) {
+    auto deposit = [&](const ge& p, const ge_niels& pn, uint8_t digit) {
       if (!digit) return;
       if (used[digit]) {
-        ge_add(buckets[digit], buckets[digit], p);
+        ge_madd(buckets[digit], buckets[digit], pn);
       } else {
         buckets[digit] = p;
         used[digit] = true;
       }
     };
-    deposit(b, cb[w]);
+    deposit(b, bnls, cb[w]);
     for (long i = 0; i < n; i++) {
-      deposit(apts[keyidx[i]], za[32 * i + w]);
-      deposit(rpts[i], zr[32 * i + w]);
+      deposit(apts[keyidx[i]], anls[keyidx[i]], za[32 * i + w]);
+      deposit(rpts[i], rnls[i], zr[32 * i + w]);
     }
     // fold buckets: sum_j j * bucket[j] via running suffix sums
     ge running = GE_ID, wsum = GE_ID;
@@ -405,7 +463,9 @@ long cmt_ed25519_rlc_verify(const uint8_t* upubs, const int32_t* keyidx,
     }
   }
   delete[] apts;
+  delete[] anls;
   delete[] rpts;
+  delete[] rnls;
   // cofactor: [8] acc must be the identity
   for (int k = 0; k < 3; k++) ge_double(acc, acc);
   return ge_is_identity(acc) ? 1 : 0;
