@@ -127,9 +127,19 @@ def main(checkpoint=None) -> dict:
         log(f"host batch verifier (production no-device dispatch): "
             f"{best:,.0f} sigs/s")
         result = _base_result(best, "cpu")
+        from cometbft_tpu.crypto import ed25519_native
+
+        native = ed25519_native.load() is not None
         result["path"] = (
             "host batch verifier via the production dispatch seam "
-            "(no accelerator present)"
+            "(no accelerator present; "
+            + (
+                "native RLC batch verifier — one Pippenger MSM per "
+                "batch, native/crypto/ed25519_batch.cpp"
+                if native
+                else "per-signature fallback, native lib unavailable"
+            )
+            + ")"
         )
         return result
 
