@@ -209,6 +209,71 @@ def _secretconn_target(data: bytes) -> None:
         b.close()
 
 
+def _seed_rlc() -> list[bytes]:
+    """Valid 3-entry batches (pub|sig|32-byte msg each), plus one with
+    a corrupted signature — mutation explores the decode/reject space
+    from real structures."""
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    def batch(corrupt: bool) -> bytes:
+        out = b""
+        for i in range(3):
+            priv = ed.priv_key_from_secret(b"rlcseed%d" % i)
+            msg = bytes([i]) * 32
+            sig = bytearray(priv.sign(msg))
+            if corrupt and i == 1:
+                sig[5] ^= 0xFF
+            out += priv.pub_key().bytes() + bytes(sig) + msg
+        return out
+
+    return [batch(False), batch(True)]
+
+
+def _rlc_target(data: bytes) -> None:
+    """DIFFERENTIAL target: the native RLC batch verifier must agree
+    with the ZIP-215 oracle on arbitrary (pub, sig, msg) triples —
+    both directions:
+      - seam verdicts (which fall back per-signature on a failed
+        batch) must equal the oracle's per-lane verdicts, catching
+        native false-ACCEPTS;
+      - when the oracle says every lane is valid, the native check
+        itself must return True, catching false-REJECTS that would
+        silently degrade production batches to the slow path.
+    No-op without the native lib (toolchain-less host) — the replay
+    test in test_fuzz_guided skips loudly in that case."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import ed25519_native as nat
+    from cometbft_tpu.crypto import edwards as E
+
+    lib = nat.load()
+    if lib is None:
+        return  # nothing to differentiate against
+    step = 32 + 64 + 32
+    n = min(len(data) // step, 8)
+    if n == 0:
+        return
+    entries = []
+    for i in range(n):
+        chunk = data[i * step : (i + 1) * step]
+        entries.append((chunk[:32], chunk[96:], chunk[32:96]))
+    bv = ed.CpuBatchVerifier()
+    bv.NATIVE_MIN_BATCH = 1  # instance attr: force the native path
+    for pub, msg, sig in entries:
+        bv.add(ed.Ed25519PubKey(pub), msg, sig)
+    _, bits = bv.verify()
+    oracle = [E.verify_zip215(p, m, s) for p, m, s in entries]
+    if bits != oracle:
+        raise AssertionError(
+            f"native batch verdicts {bits} != oracle {oracle}"
+        )
+    if all(oracle):
+        got = nat.rlc_verify(lib, entries)
+        if got is not True:
+            raise AssertionError(
+                f"native RLC rejected an all-valid batch ({got!r})"
+            )
+
+
 def make_fuzzers(names: list[str] | None = None):
     """Instantiate GuidedFuzzer objects for the named targets."""
     from cometbft_tpu.utils.fuzzing import GuidedFuzzer
@@ -225,6 +290,7 @@ def make_fuzzers(names: list[str] | None = None):
             (OSError, EOFError, TimeoutError),
             lambda: [b"\x00" * 32, os.urandom(64)],
         ),
+        "ed25519_rlc": (_rlc_target, _ALLOWED, _seed_rlc),
     }
     out = []
     for name, (fn, allowed, seeds) in registry.items():
