@@ -457,9 +457,14 @@ CALIBRATION_PATH = os.environ.get(
     ),
 )
 
-#: conservative defaults when no calibration file exists (measured r4,
-#: TPU v5e via tunnel: CPU batch ~120 us/sig, device marginal ~5 us/sig)
-_DEFAULT_T_CPU_SIG = 120e-6
+#: conservative defaults when no calibration file exists. t_cpu
+#: reflects the round-5 native RLC host batch verifier (~15 us/sig at
+#: production batch sizes, measured at 4096; the pre-RLC per-signature
+#: path was ~120 us/sig — that stale figure would route mid-size
+#: batches to a high-RTT device where the host now wins). t_dev is the
+#: r4 keyed device marginal. Re-derive with
+#: tools/derive_device_min_batch.py on the target hardware.
+_DEFAULT_T_CPU_SIG = 15e-6
 _DEFAULT_T_DEV_SIG = 5e-6
 
 _runtime_threshold: int | None = None
@@ -489,8 +494,12 @@ def runtime_device_min_batch() -> int:
     try:
         with open(CALIBRATION_PATH) as f:
             cal = json.load(f)
-        t_cpu = float(cal.get("t_cpu_per_sig", t_cpu))
-        t_dev = float(cal.get("t_dev_per_sig", t_dev))
+        # schema < 2 predates the native RLC host verifier: its t_cpu
+        # (~8x too slow) would over-favor the device — ignore the file
+        # and use the current defaults until re-derivation
+        if int(cal.get("schema", 1)) >= 2:
+            t_cpu = float(cal.get("t_cpu_per_sig", t_cpu))
+            t_dev = float(cal.get("t_dev_per_sig", t_dev))
     except (OSError, ValueError):
         pass
     try:

@@ -525,13 +525,37 @@ class TestDispatchThreshold:
         self._fake_accel(monkeypatch, EV)
         cal = tmp_path / "cal.json"
         cal.write_text(
-            _json.dumps({"t_cpu_per_sig": 100e-6, "t_dev_per_sig": 5e-6})
+            _json.dumps(
+                {
+                    "schema": 2,
+                    "t_cpu_per_sig": 100e-6,
+                    "t_dev_per_sig": 5e-6,
+                }
+            )
         )
         monkeypatch.setattr(EV, "CALIBRATION_PATH", str(cal))
         monkeypatch.setattr(EV, "_measure_link_rtt", lambda: 0.070)
         # n* = 0.07 / 95e-6 ~= 737 -> next pow2 = 1024: a 150-validator
         # commit stays on the CPU path on a 70 ms link
         assert EV.runtime_device_min_batch() == 1024
+
+    def test_stale_pre_rlc_calibration_ignored(self, tmp_path, monkeypatch):
+        """A schema-1 calibration (pre native-RLC t_cpu, ~8x too slow)
+        must NOT be honored — it would route mid-size batches to a
+        high-RTT device where the host path now wins. The defaults
+        (t_cpu 15us, t_dev 5us) apply instead: n* = 0.07/10e-6 = 7000
+        -> 8192."""
+        import json as _json
+
+        EV = self._reset(monkeypatch)
+        self._fake_accel(monkeypatch, EV)
+        cal = tmp_path / "cal.json"
+        cal.write_text(
+            _json.dumps({"t_cpu_per_sig": 120e-6, "t_dev_per_sig": 5e-6})
+        )
+        monkeypatch.setattr(EV, "CALIBRATION_PATH", str(cal))
+        monkeypatch.setattr(EV, "_measure_link_rtt", lambda: 0.070)
+        assert EV.runtime_device_min_batch() == 8192
 
     def test_direct_attached_link_uses_floor(self, tmp_path, monkeypatch):
         EV = self._reset(monkeypatch)

@@ -106,6 +106,11 @@ def main() -> None:
     t_cpu_sig = big["cpu_ms"] / 1e3 / big["batch"]
     rtt = max(mid["device_ms"] / 1e3 - mid["batch"] * t_dev_sig, 0.0)
     cal = {
+        # schema 2: t_cpu measured through the native RLC host batch
+        # verifier (round 5). Readers ignore older files — a schema-1
+        # t_cpu (~120 us/sig per-signature path) would route mid-size
+        # batches to a high-RTT device where the host now wins.
+        "schema": 2,
         "t_cpu_per_sig": round(t_cpu_sig, 9),
         "t_dev_per_sig": round(t_dev_sig, 9),
         "fitted_link_rtt_s": round(rtt, 6),

@@ -3,10 +3,14 @@
 # round-5 device measurements in priority order:
 #   1. tools/device_campaign.py   — keyed stack/stack16/pallas A/B
 #                                   (docs/data/kernel_ab_r05.json)
-#   2. bench_all.py               — all five BASELINE configs, keyed
-#   3. tools/sharded_keyed_probe.py — mesh+keyed on chip, HBM accounted
-# Each step is resumable/checkpointed, so a window closing mid-run
-# keeps whatever landed. Log: /tmp/device_window.log
+#   2. tools/derive_device_min_batch.py — re-derive the dispatch
+#      crossover against the 9x-faster host RLC path (writes the
+#      schema-2 calibration in ONE shot at the end — not resumable,
+#      which is why it runs early, right after the headline A/Bs)
+#   3. bench_all.py               — all five BASELINE configs, keyed
+#   4. tools/sharded_keyed_probe.py — mesh+keyed on chip, HBM accounted
+# Steps 1, 3, 4 are resumable/checkpointed, so a window closing
+# mid-run keeps whatever landed. Log: /tmp/device_window.log
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/device_window.log
 while true; do
@@ -17,6 +21,8 @@ while true; do
     echo "$(date -u +%H:%M:%S) tunnel OPEN ($out devices, probe $((t1-t0))s) - firing campaign" >> "$LOG"
     timeout 5400 python tools/device_campaign.py >> "$LOG" 2>&1
     echo "$(date -u +%H:%M:%S) campaign rc=$?" >> "$LOG"
+    timeout 1800 python tools/derive_device_min_batch.py >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) recalibrate rc=$?" >> "$LOG"
     timeout 3600 python bench_all.py >> "$LOG" 2>&1
     echo "$(date -u +%H:%M:%S) bench_all rc=$?" >> "$LOG"
     timeout 2400 python tools/sharded_keyed_probe.py >> "$LOG" 2>&1
