@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native
+.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native metrics-lint
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -60,6 +60,11 @@ bench-all:
 
 bench-micro:
 	$(PY) tools/bench_micro.py
+
+# every registered metric field must be updated by some subsystem
+# (also enforced in the tier-1 flow via tests/test_metrics.py)
+metrics-lint:
+	$(PY) tools/metrics_lint.py
 
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \

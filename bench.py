@@ -44,6 +44,24 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_SIGS_PER_SEC = 1_000_000
 METRIC = "ed25519_batch_verify_throughput"
 
+#: span-trace provenance for the run (utils/trace Chrome trace-event
+#: JSON, openable in Perfetto) — every device launch the bench made,
+#: with timings, next to the headline number
+TRACE_PATH = os.environ.get(
+    "CMT_BENCH_TRACE", os.path.join(REPO, "BENCH_TRACE.json")
+)
+
+
+def _dump_trace() -> None:
+    """Best-effort: write the in-process span ring to TRACE_PATH."""
+    try:
+        from cometbft_tpu.utils.trace import TRACER
+
+        TRACER.dump(TRACE_PATH)
+        log(f"trace written to {TRACE_PATH}")
+    except Exception as exc:  # noqa: BLE001 — provenance must not
+        log(f"trace dump failed (ignored): {exc}")  # fail the bench
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -77,6 +95,7 @@ def main(checkpoint=None) -> dict:
     _enable_compile_cache()
     import jax
 
+    from cometbft_tpu.utils.trace import TRACER as _tr
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.ops.ed25519_verify import (
         _finish,
@@ -260,7 +279,8 @@ def main(checkpoint=None) -> dict:
         # default, CMT_TPU_COLS_IMPL otherwise) — label and report the
         # config actually measured
         keyed_cfg = F.COLS_IMPL
-        keyed_best = measure_keyed(keyed_cfg)
+        with _tr.span("bench/keyed", cat="bench", cols_impl=keyed_cfg):
+            keyed_best = measure_keyed(keyed_cfg)
         if checkpoint is not None and keyed_best:
             # the headline path is in the bag: persist it before the
             # optional A/B and generic sections.  A failed persist must
@@ -359,12 +379,13 @@ def main(checkpoint=None) -> dict:
     for trial in range(3):
         t0 = time.time()
         total = 0
-        for res in verify_stream(
-            ((pubs, sigs, msgs) for _ in range(nchunks)),
-            max_in_flight=nchunks,
-        ):
-            assert bool(res.all())
-            total += len(res)
+        with _tr.span("bench/generic_pipelined", cat="bench", trial=trial):
+            for res in verify_stream(
+                ((pubs, sigs, msgs) for _ in range(nchunks)),
+                max_in_flight=nchunks,
+            ):
+                assert bool(res.all())
+                total += len(res)
         dt = time.time() - t0
         rate = total / dt
         log(
@@ -401,6 +422,7 @@ def _child(result_path: str) -> None:
     except BaseException as exc:  # noqa: BLE001 — must report, not raise
         err = f"{type(exc).__name__}: {exc}"
         log(f"bench attempt failed: {err}")
+        _dump_trace()  # whatever spans landed before the failure
         partial = _load_result(result_path)
         if partial and "value" in partial:
             # keep the checkpointed partial number, but carry the real
@@ -412,6 +434,8 @@ def _child(result_path: str) -> None:
             persist(partial)
             return
         result = {"error": err}
+    else:
+        _dump_trace()
     persist(result)
 
 

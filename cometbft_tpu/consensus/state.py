@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass, replace
@@ -61,6 +62,7 @@ from cometbft_tpu.types.vote_set import ConflictingVoteError, VoteSet
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.utils.trace import TRACER as _tracer
 from cometbft_tpu.wal import KIND_MSG_INFO, KIND_TIMEOUT, NopWAL, WALRecord
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 
@@ -148,6 +150,9 @@ class ConsensusState(BaseService):
         self.height = 0
         self.round = 0
         self.step = STEP_NEW_HEIGHT
+        self._step_start = time.perf_counter()
+        self._step_hr = (0, 0)  # (height, round) at step entry
+        self._quorum_prevote_round = -1
         self.start_time_ns = 0
         self.commit_time_ns = 0
         self.validators: ValidatorSet | None = None
@@ -437,7 +442,7 @@ class ConsensusState(BaseService):
 
         self.height = height
         self.round = 0
-        self.step = STEP_NEW_HEIGHT
+        self._set_step(STEP_NEW_HEIGHT)
         self.metrics.height.set(height)
         self.metrics.validators.set(len(validators))
         self.metrics.validators_power.set(validators.total_voting_power())
@@ -467,6 +472,7 @@ class ConsensusState(BaseService):
             ),
         )
         self.commit_round = -1
+        self._quorum_prevote_round = -1
         self.last_commit = last_commit
         self.last_validators = state.last_validators
         self.triggered_timeout_precommit = False
@@ -477,6 +483,41 @@ class ConsensusState(BaseService):
         self._ticker.schedule(
             TimeoutInfo(sleep, self.height, 0, STEP_NEW_HEIGHT)
         )
+
+    def _set_step(self, step: int) -> None:
+        """Advance ``self.step``, closing out the previous step's
+        observability: its duration lands in the
+        ``consensus_step_duration_seconds`` histogram and as a
+        ``consensus/<Step>`` trace span (recorded at transition time,
+        so the span's interval brackets everything — vote handling,
+        VerifyCommit, device launches — that ran during the step).
+        Callers mutate ``self.height``/``self.round`` before advancing
+        the step, so the closing span is labeled with the
+        height/round snapshotted when the step was ENTERED — the
+        block the step's work actually belonged to."""
+        if step == self.step:
+            return
+        now = time.perf_counter()
+        if not self._replay_mode:
+            # WAL replay re-drives transitions in microseconds; like
+            # the event-bus publishes (and the reference's
+            # updateRoundStep replayMode guard), those don't observe —
+            # they'd skew the histogram and flood the trace ring
+            name = STEP_NAMES[self.step]
+            self.metrics.step_duration_seconds.labels(step=name).observe(
+                now - self._step_start
+            )
+            height, round_ = self._step_hr
+            _tracer.add_complete(
+                f"consensus/{name}",
+                self._step_start,
+                now - self._step_start,
+                cat="consensus",
+                args={"height": height, "round": round_},
+            )
+        self._step_start = now
+        self._step_hr = (self.height, self.round)
+        self.step = step
 
     def _new_step(self) -> None:
         if self.event_bus is not None and not self._replay_mode:
@@ -504,7 +545,7 @@ class ConsensusState(BaseService):
                 round_ - self.round
             )
         self.round = round_
-        self.step = STEP_NEW_ROUND
+        self._set_step(STEP_NEW_ROUND)
         self.metrics.rounds.set(round_)
         if round_ != 0:
             # round 0 keeps the proposal received during NewHeight wait
@@ -532,7 +573,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.round = round_
-        self.step = STEP_PROPOSE
+        self._set_step(STEP_PROPOSE)
         self._new_step()
         self._ticker.schedule(
             TimeoutInfo(
@@ -674,13 +715,13 @@ class ConsensusState(BaseService):
             )
             # parts that raced ahead of this proposal message
             early, self._early_parts = self._early_parts, []
-            for part in early:
+            for part, from_peer in early:
                 try:
                     self._add_proposal_block_part(
                         BlockPartMessage(
                             height=self.height, round=self.round, part=part
                         ),
-                        "",
+                        from_peer,
                     )
                 except Exception:  # noqa: BLE001 — bad proofs skipped
                     continue
@@ -703,9 +744,13 @@ class ConsensusState(BaseService):
             # (enterCommit below); stash a bounded number so one gossip
             # pass suffices instead of waiting a full round reset.
             if len(self._early_parts) < 256:
-                self._early_parts.append(msg.part)
+                self._early_parts.append((msg.part, peer_id))
             return False
         added = self.proposal_block_parts.add_part(msg.part)
+        if added:
+            # per-peer part accounting (metrics.go BlockParts); ""
+            # (internal) parts are our own proposal's
+            self.metrics.block_parts.labels(peer_id=peer_id).inc()
         if added and self.proposal_block_parts.is_complete():
             from cometbft_tpu.types import codec
 
@@ -760,7 +805,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.round = round_
-        self.step = STEP_PREVOTE
+        self._set_step(STEP_PREVOTE)
         self._new_step()
         self._do_prevote(height, round_)
 
@@ -810,7 +855,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.round = round_
-        self.step = STEP_PREVOTE_WAIT
+        self._set_step(STEP_PREVOTE_WAIT)
         self._new_step()
         self._ticker.schedule(
             TimeoutInfo(
@@ -828,7 +873,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.round = round_
-        self.step = STEP_PRECOMMIT
+        self._set_step(STEP_PRECOMMIT)
         self._new_step()
         prevotes = self.votes.prevotes(round_)
         maj23 = prevotes.two_thirds_majority() if prevotes else None
@@ -906,7 +951,7 @@ class ConsensusState(BaseService):
             return
         self.commit_round = commit_round
         self.commit_time_ns = now_ns()
-        self.step = STEP_COMMIT
+        self._set_step(STEP_COMMIT)
         self._new_step()
         precommits = self.votes.precommits(commit_round)
         maj23 = precommits.two_thirds_majority()
@@ -932,13 +977,13 @@ class ConsensusState(BaseService):
                 # drain parts that arrived before the commit header was
                 # known (proof-checked against the header by add_part)
                 early, self._early_parts = self._early_parts, []
-                for part in early:
+                for part, from_peer in early:
                     try:
                         self._add_proposal_block_part(
                             BlockPartMessage(
                                 height=height, round=commit_round, part=part
                             ),
-                            "",
+                            from_peer,
                         )
                     except Exception:  # noqa: BLE001 — stashed parts are
                         continue  # unvalidated; bad proofs just get skipped
@@ -1004,6 +1049,14 @@ class ConsensusState(BaseService):
         m.num_txs.set(len(block.data.txs))
         m.total_txs.inc(len(block.data.txs))
         m.block_size_bytes.set(len(block.encode()))
+        byz: set[bytes] = set()
+        for ev in block.evidence:
+            vote_a = getattr(ev, "vote_a", None)
+            if vote_a is not None:
+                byz.add(vote_a.validator_address)
+            else:
+                byz.update(getattr(ev, "byzantine_validators", ()))
+        m.byzantine_validators.set(len(byz))
         prev = self.block_store.load_block_meta(height - 1)
         if prev is not None and prev.header.time_ns:
             m.block_interval_seconds.observe(
@@ -1092,6 +1145,26 @@ class ConsensusState(BaseService):
         prevotes = self.votes.prevotes(vote.round)
         maj23 = prevotes.two_thirds_majority()
         if maj23 is not None:
+            if (
+                vote.round > self._quorum_prevote_round
+                and self.proposal is not None
+                and self.proposal.round == vote.round
+                and not self._replay_mode
+            ):
+                # first +2/3 prevote quorum for the proposal's round:
+                # how long after the proposal's timestamp did it land
+                # (metrics.go QuorumPrevoteDelay).  A late quorum for
+                # an older round doesn't belong to this proposal, and
+                # WAL replay would measure against the current wall
+                # clock — both are skipped.
+                self._quorum_prevote_round = vote.round
+                self.metrics.quorum_prevote_delay.labels(
+                    proposer_address=(
+                        self.validators.get_proposer().address.hex()
+                    )
+                ).set(
+                    max(0.0, (now_ns() - self.proposal.timestamp_ns) / 1e9)
+                )
             # Unlock if a newer polka contradicts our lock (state.go:2372)
             if (
                 self.locked_block is not None

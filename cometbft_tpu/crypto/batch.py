@@ -16,6 +16,7 @@ from typing import Callable
 
 from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
+from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 
 # Device availability is probed in a SUBPROCESS: a wedged accelerator
 # plugin can hang `import jax` inside C where the GIL never releases —
@@ -111,11 +112,21 @@ def _device_ndev() -> int:
 
 
 def _ed25519_factory() -> BatchVerifier:
+    # Routing decisions that end at the host verifier are recorded
+    # here, where they are made; a device-capable verifier defers its
+    # decision to batch time (TpuBatchVerifier.verify — it may still
+    # fall back on batch size / calibration).
     if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
+        _crypto_metrics().dispatch_decisions.labels(
+            route="host", reason="disabled"
+        ).inc()
         return _ed.CpuBatchVerifier()
     try:
         ndev = _device_ndev()
         if ndev == 0:
+            _crypto_metrics().dispatch_decisions.labels(
+                route="host", reason="device_unavailable"
+            ).inc()
             return _ed.CpuBatchVerifier()
         if ndev > 1 and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY"):
             # multi-chip: shard the batch over a 1-D mesh — every
@@ -127,6 +138,9 @@ def _ed25519_factory() -> BatchVerifier:
 
         return TpuBatchVerifier()
     except Exception:
+        _crypto_metrics().dispatch_decisions.labels(
+            route="host", reason="device_unavailable"
+        ).inc()
         return _ed.CpuBatchVerifier()
 
 

@@ -18,6 +18,7 @@ correctness fallback mirroring BatchVerifier (ed25519.go:190-222).
 from __future__ import annotations
 
 import os
+import time
 
 from cryptography.exceptions import InvalidSignature
 from cryptography.hazmat.primitives import serialization
@@ -148,6 +149,22 @@ class CpuBatchVerifier(BatchVerifier):
     def verify(self) -> tuple[bool, list[bool]]:
         if not self._entries:
             return False, []
+        from cometbft_tpu.metrics import crypto_metrics as _cm
+        from cometbft_tpu.utils.trace import TRACER as _tracer
+
+        n = len(self._entries)
+        cm = _cm()
+        cm.batch_verify_batch_size.observe(n)
+        t0 = time.perf_counter()
+        with _tracer.span(
+            "host_batch_verify", cat="crypto", batch=n
+        ) as sp:
+            ok, results = self._verify_entries()
+            sp.set(ok=ok)
+        cm.host_verify_time_seconds.observe(time.perf_counter() - t0)
+        return ok, results
+
+    def _verify_entries(self) -> tuple[bool, list[bool]]:
         if len(self._entries) >= self.NATIVE_MIN_BATCH:
             from cometbft_tpu.crypto import ed25519_native as _native
 

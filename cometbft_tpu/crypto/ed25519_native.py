@@ -93,6 +93,9 @@ def rlc_verify(
         zr += z.to_bytes(32, "little")
         c_acc = (c_acc + z * s) % L
     upubs = b"".join(key_ids)  # dict preserves insertion order
+    from cometbft_tpu.metrics import crypto_metrics as _cm
+
+    _cm().batch_verify_launches.labels(kernel="host_rlc").inc()
     rc = lib.cmt_ed25519_rlc_verify(
         upubs, idx, bytes(rs), _B_ENC, bytes(za), bytes(zr),
         c_acc.to_bytes(32, "little"), len(key_ids), n,

@@ -73,6 +73,12 @@ class Inspector:
         )
         all_routes = env.routes()
         self.routes = {k: all_routes[k] for k in _INSPECT_ROUTES}
+        # span-trace dump (utils/trace): in-process spans recorded
+        # while the inspector runs (store reads, RPC handling) as
+        # Chrome trace-event JSON — same shape as the node's /trace
+        from cometbft_tpu.utils.trace import TRACER
+
+        self.routes["trace"] = TRACER.export
         from cometbft_tpu.p2p.netaddr import NetAddress
 
         addr = NetAddress.parse(config.rpc.laddr)

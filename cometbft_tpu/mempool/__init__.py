@@ -379,6 +379,7 @@ class CListMempool:
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on everything left after a block
         (clist_mempool.go recheckTxs)."""
+        self.metrics.recheck_times.inc()
         for key in list(self._txs.keys()):
             mt = self._txs.get(key)
             if mt is None:

@@ -39,6 +39,7 @@ class ConsensusMetrics:
             self.num_txs = self.total_txs = self.block_size_bytes = _NOP
             self.block_interval_seconds = self.committed_height = _NOP
             self.block_parts = self.quorum_prevote_delay = _NOP
+            self.step_duration_seconds = _NOP
             return
         s = "consensus"
         self.height = reg.gauge(s, "height", "Height of the chain.")
@@ -81,6 +82,13 @@ class ConsensusMetrics:
             s, "quorum_prevote_delay",
             "Seconds from proposal timestamp to +2/3 prevote quorum.",
             labels=("proposer_address",),
+        )
+        self.step_duration_seconds = reg.histogram(
+            s, "step_duration_seconds",
+            "Seconds spent in each consensus step "
+            "(metrics.go StepDurationSeconds).",
+            buckets=DEFAULT_TIME_BUCKETS,
+            labels=("step",),
         )
 
 
@@ -162,6 +170,108 @@ class StateMetrics:
         )
 
 
+class CryptoMetrics:
+    """Device-execution-path metrics — the TPU batch-verify plane.
+
+    No metricsgen analog: the reference has no device dispatch to
+    observe.  Names follow its conventions so the series sit naturally
+    next to the consensus/mempool/p2p/state families; the mapping to
+    the reference structs is documented in docs/PARITY.md.
+    """
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.batch_verify_launches = _NOP
+            self.batch_verify_batch_size = _NOP
+            self.dispatch_decisions = _NOP
+            self.kernel_time_seconds = _NOP
+            self.host_verify_time_seconds = _NOP
+            self.key_pool_keys = self.key_pool_capacity = _NOP
+            self.key_pool_builds = self.key_pool_evictions = _NOP
+            self.key_pool_retraces = _NOP
+            self.bytes_transferred = _NOP
+            return
+        s = "crypto"
+        self.batch_verify_launches = reg.counter(
+            s, "batch_verify_launches",
+            "Batch-verify launches by kernel "
+            "(generic | keyed | host_rlc).",
+            labels=("kernel",),
+        )
+        self.batch_verify_batch_size = reg.histogram(
+            s, "batch_verify_batch_size",
+            "Signatures per batch-verify call.",
+            buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384),
+        )
+        self.dispatch_decisions = reg.counter(
+            s, "dispatch_decisions",
+            "Device-vs-host routing decisions, by route and reason "
+            "(calibration | batch_size | msg_too_large | disabled | "
+            "device_unavailable).",
+            labels=("route", "reason"),
+        )
+        self.kernel_time_seconds = reg.histogram(
+            s, "kernel_time_seconds",
+            "Wall seconds per device batch verification "
+            "(dispatch through result fetch).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.host_verify_time_seconds = reg.histogram(
+            s, "host_verify_time_seconds",
+            "Wall seconds per host batch verification.",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.key_pool_keys = reg.gauge(
+            s, "key_pool_keys",
+            "Validator keys resident in the device comb-table pool.",
+            labels=("window_bits",),
+        )
+        self.key_pool_capacity = reg.gauge(
+            s, "key_pool_capacity",
+            "Slot capacity of the device comb-table pool.",
+            labels=("window_bits",),
+        )
+        self.key_pool_builds = reg.counter(
+            s, "key_pool_builds",
+            "Per-key comb-table pages EC-built on device.",
+        )
+        self.key_pool_evictions = reg.counter(
+            s, "key_pool_evictions",
+            "Key pages evicted from the device comb-table pool.",
+        )
+        self.key_pool_retraces = reg.counter(
+            s, "key_pool_retraces",
+            "Pool capacity changes — each one retraces the "
+            "shape-specialized keyed verify kernel.",
+            labels=("window_bits",),
+        )
+        self.bytes_transferred = reg.counter(
+            s, "bytes_transferred",
+            "Bytes moved across the host-device link (h2d | d2h).",
+            labels=("direction",),
+        )
+
+
+#: Process-wide sink for the crypto/device hot paths.  The batch
+#: verifier and table cache are module-level singletons with no node
+#: handle, so unlike the per-node structs above they update whatever is
+#: installed here — a no-op by default; node assembly installs the real
+#: struct when instrumentation is on (last installed wins).
+_CRYPTO = CryptoMetrics(None)
+
+
+def crypto_metrics() -> CryptoMetrics:
+    """The currently installed crypto-plane sink (never None)."""
+    return _CRYPTO
+
+
+def install_crypto_metrics(metrics: CryptoMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide crypto sink (None
+    resets to the no-op)."""
+    global _CRYPTO
+    _CRYPTO = metrics if metrics is not None else CryptoMetrics(None)
+
+
 class NodeMetrics:
     """Bundle wired at node assembly (node/node.go:334)."""
 
@@ -171,12 +281,16 @@ class NodeMetrics:
         self.mempool = MempoolMetrics(reg)
         self.p2p = P2PMetrics(reg)
         self.state = StateMetrics(reg)
+        self.crypto = CryptoMetrics(reg)
 
 
 __all__ = [
     "ConsensusMetrics",
+    "CryptoMetrics",
     "MempoolMetrics",
     "NodeMetrics",
     "P2PMetrics",
     "StateMetrics",
+    "crypto_metrics",
+    "install_crypto_metrics",
 ]

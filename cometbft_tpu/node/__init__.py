@@ -134,7 +134,7 @@ class Node(BaseService):
         self.config = config
 
         # 0. metrics plane (node/node.go:334 metricsProvider)
-        from cometbft_tpu.metrics import NodeMetrics
+        from cometbft_tpu.metrics import NodeMetrics, install_crypto_metrics
         from cometbft_tpu.utils.metrics import MetricsServer, Registry
 
         if config.instrumentation.prometheus:
@@ -145,6 +145,11 @@ class Node(BaseService):
                 config.instrumentation.prometheus_listen_addr,
                 logger=self.logger.with_fields(module="metrics"),
             )
+            # the crypto/device hot paths (batch verifier, table cache)
+            # are module-level singletons: point the process-wide sink
+            # at this node's struct (last installed wins; updates to a
+            # stopped node's registry are harmless)
+            install_crypto_metrics(self.metrics.crypto)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None

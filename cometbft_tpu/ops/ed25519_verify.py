@@ -45,7 +45,9 @@ import jax.numpy as jnp
 
 from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
+from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
+from cometbft_tpu.utils.trace import TRACER as _tracer
 from cometbft_tpu.ops import scalar as SC
 from cometbft_tpu.ops import sha512 as SH
 
@@ -279,7 +281,16 @@ def pack_inputs(
 def _dispatch(pub, sig, msgs, start, end):
     packed, bucket = pack_inputs(pub, sig, msgs, start, end)
     fn = _compiled(packed.shape[-1], bucket)
-    return fn(jax.device_put(packed))
+    cm = _crypto_metrics()
+    cm.batch_verify_launches.labels(kernel="generic").inc()
+    cm.bytes_transferred.labels(direction="h2d").inc(packed.nbytes)
+    # span covers the (async) dispatch, not device compute — the
+    # synchronous wall time is the kernel_time_seconds histogram
+    with _tracer.span(
+        "device_launch", cat="device", kernel="generic",
+        batch=packed.shape[-1], bucket=bucket,
+    ):
+        return fn(jax.device_put(packed))
 
 
 _keyed_cache: dict[tuple[int, int, int], object] = {}
@@ -328,9 +339,17 @@ def verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs):
         pad = MAX_LAUNCH - batch % MAX_LAUNCH
         packed = np.pad(packed, [(0, 0), (0, pad)])
     fn = _compiled_keyed(bucket, entry.window_bits, MAX_LAUNCH)
-    out = fn(
-        jax.device_put(packed), entry.table, jnp.asarray(entry.valid)
-    )
+    cm = _crypto_metrics()
+    cm.batch_verify_launches.labels(kernel="keyed").inc()
+    cm.bytes_transferred.labels(direction="h2d").inc(packed.nbytes)
+    with _tracer.span(
+        "device_launch", cat="device", kernel="keyed",
+        batch=packed.shape[-1], bucket=bucket,
+        window_bits=entry.window_bits,
+    ):
+        out = fn(
+            jax.device_put(packed), entry.table, jnp.asarray(entry.valid)
+        )
     return [(out, n)]
 
 
@@ -366,7 +385,14 @@ def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
             packed = np.pad(packed, [(0, 0), (0, pad)])
             batch += pad
         fn = _compiled_chunked(batch, bucket, MAX_LAUNCH)
-        return [(fn(jax.device_put(packed)), n)]
+        cm = _crypto_metrics()
+        cm.batch_verify_launches.labels(kernel="generic").inc()
+        cm.bytes_transferred.labels(direction="h2d").inc(packed.nbytes)
+        with _tracer.span(
+            "device_launch", cat="device", kernel="generic",
+            batch=batch, bucket=bucket, chunked=True,
+        ):
+            return [(fn(jax.device_put(packed)), n)]
     parts = []
     for start in range(0, max(n, 1), MAX_LAUNCH):
         end = min(start + MAX_LAUNCH, n)
@@ -383,8 +409,15 @@ def _finish(parts) -> np.ndarray:
     asynchronously and the single fetch pays the RTT once."""
     if len(parts) == 1:
         p, k = parts[0]
-        return np.asarray(p)[:k]
+        out = np.asarray(p)
+        _crypto_metrics().bytes_transferred.labels(
+            direction="d2h"
+        ).inc(out.nbytes)
+        return out[:k]
     combined = np.asarray(jnp.concatenate([p for p, _ in parts]))
+    _crypto_metrics().bytes_transferred.labels(
+        direction="d2h"
+    ).inc(combined.nbytes)
     out = []
     off = 0
     for p, k in parts:
@@ -552,14 +585,26 @@ class TpuBatchVerifier(BatchVerifier):
         n = len(self._pubs)
         if n == 0:
             return False, []
+        cm = _crypto_metrics()
         if n < self._device_min_batch or max(len(m) for m in self._msgs) > _BUCKETS[-1]:
             # Messages beyond the largest device bucket: honor the
             # BatchVerifier contract via the host fallback instead of
-            # raising mid-verify.
+            # raising mid-verify.  The 1<<30 threshold sentinel means
+            # calibration ruled the device out entirely (cpu backend /
+            # unusable link), not that this batch was too small.
+            if n >= self._device_min_batch:
+                reason = "msg_too_large"
+            elif self._device_min_batch >= 1 << 30:
+                reason = "calibration"
+            else:
+                reason = "batch_size"
+            cm.dispatch_decisions.labels(route="host", reason=reason).inc()
             cpu = _ed.CpuBatchVerifier()
             for p, m, s in zip(self._pubs, self._msgs, self._sigs):
                 cpu.add(_ed.Ed25519PubKey(p), m, s)
             return cpu.verify()
+        cm.dispatch_decisions.labels(route="device", reason="batch_size").inc()
+        cm.batch_verify_batch_size.observe(n)
         pub = np.frombuffer(b"".join(self._pubs), dtype=np.uint8).reshape(n, 32)
         sig = np.frombuffer(b"".join(self._sigs), dtype=np.uint8).reshape(n, 64)
         entry = None
@@ -570,13 +615,20 @@ class TpuBatchVerifier(BatchVerifier):
                 entry = _pr.TABLE_CACHE.lookup_or_build(self._pubs)
             except Exception:
                 entry = None  # any device hiccup -> generic kernel
-        if entry is not None:
-            out = self._run_keyed(
-                entry, entry.key_ids(self._pubs), pub, sig, self._msgs
-            )
-        else:
-            out = self._run_generic(pub, sig, self._msgs)
-        results = [bool(v) for v in out]
+        t0 = time.perf_counter()
+        with _tracer.span(
+            "batch_verify", cat="crypto",
+            kernel="keyed" if entry is not None else "generic", batch=n,
+        ) as sp:
+            if entry is not None:
+                out = self._run_keyed(
+                    entry, entry.key_ids(self._pubs), pub, sig, self._msgs
+                )
+            else:
+                out = self._run_generic(pub, sig, self._msgs)
+            results = [bool(v) for v in out]
+            sp.set(ok=all(results))
+        cm.kernel_time_seconds.observe(time.perf_counter() - t0)
         return all(results), results
 
     # dispatch seam: the multi-chip verifier (parallel/mesh.py

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from cometbft_tpu.crypto import edwards as _ref
+from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as F
 from cometbft_tpu.ops.ed25519_verify import _next_pow2
@@ -235,9 +236,11 @@ class _KeyPool:
     (cap * nent entries); a key's page lives at
     ``[slot*nent : (slot+1)*nent]`` so ``comb_mul_keyed``'s
     ``key_id * nent`` indexing works with slot numbers as key ids.
-    Capacity is always a power of two: the compiled keyed-verify kernel
-    specializes on the table shape, so growth only retraces at pow2
-    boundaries (same behavior the per-set design had).
+    Capacity follows the ``_pool_cap`` ladder — powers of two up to
+    4096 slots, then 2048-slot steps: the compiled keyed-verify kernel
+    specializes on the table shape, so growth only retraces at ladder
+    boundaries (a bounded count), while large pools avoid pow2's
+    up-to-2x HBM waste.
     """
 
     def __init__(self, window_bits: int) -> None:
@@ -273,9 +276,12 @@ class _KeyPool:
         self.free.extend(range(self.cap, new_cap))
         self.cap = new_cap
         self.version += 1
+        _crypto_metrics().key_pool_retraces.labels(
+            window_bits=str(self.window_bits)
+        ).inc()
 
     def compact(self) -> None:
-        """Gather live pages into a fresh pow2-capacity array (device
+        """Gather live pages into a fresh ladder-capacity array (device
         gather, no EC recompute) — run after eviction freed enough
         slots that the pool holds mostly dead pages."""
         n_live = len(self.slots)
@@ -305,6 +311,9 @@ class _KeyPool:
         self.free = list(range(n_live, new_cap))
         self.cap = new_cap
         self.version += 1
+        _crypto_metrics().key_pool_retraces.labels(
+            window_bits=str(self.window_bits)
+        ).inc()
 
 
 class KeyTableCache:
@@ -381,7 +390,9 @@ class KeyTableCache:
                         pool.slots[p] = s
                         pool.valid[s] = page_valid[i]
                     self.stats["keys_built"] += len(missing)
+                    _crypto_metrics().key_pool_builds.inc(len(missing))
                     self._evict_over_budget(keep=set(unique))
+                    self._update_pool_gauges()
                     # a concurrent lookup's eviction may have dropped
                     # keys of ours that were present before our build
                     # released the lock — loop to rebuild them if so
@@ -438,8 +449,14 @@ class KeyTableCache:
         if n_pad > n:
             pub[:, n:] = _B_ENC[:, None]
         fn = _compiled_build(n_pad, window_bits)
-        table, valid = fn(jax.device_put(pub))
-        return table, np.asarray(valid)[:n]
+        from cometbft_tpu.utils.trace import TRACER as _tracer
+
+        with _tracer.span(
+            "table_build", cat="device", keys=n, window_bits=window_bits
+        ):
+            table, valid = fn(jax.device_put(pub))
+            valid = np.asarray(valid)[:n]
+        return table, valid
 
     def _evict_over_budget(self, keep: set[bytes]) -> None:
         """Drop LRU keys (never ones in ``keep``) until compaction can
@@ -466,15 +483,26 @@ class KeyTableCache:
                 pool.valid[s] = False
                 pool.free.append(s)
                 self.stats["keys_evicted"] += 1
+                _crypto_metrics().key_pool_evictions.inc()
                 changed = True
         if changed:
             for pool in self._pools.values():
                 pool.compact()
 
+    def _update_pool_gauges(self) -> None:
+        """Refresh the occupancy/capacity gauges for both window
+        widths.  Lock held (reads pool.slots / pool.cap)."""
+        cm = _crypto_metrics()
+        for wb, pool in self._pools.items():
+            lbl = str(wb)
+            cm.key_pool_keys.labels(window_bits=lbl).set(len(pool.slots))
+            cm.key_pool_capacity.labels(window_bits=lbl).set(pool.cap)
+
     def clear(self) -> None:
         with self._lock:
             self._pools = {8: _KeyPool(8), 4: _KeyPool(4)}
             self._entries.clear()
+            self._update_pool_gauges()
 
 
 TABLE_CACHE = KeyTableCache()

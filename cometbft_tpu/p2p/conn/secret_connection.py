@@ -262,8 +262,13 @@ class SecretConnection:
                 # readiness) just end this opportunistic drain; a real
                 # fd error (reset, bad fd) is PARKED and surfaced by
                 # read() once the complete frames already buffered have
-                # been delivered — raising here would strand them
-                if exc.errno not in (
+                # been delivered — raising here would strand them.
+                # errno None means no fd-level error at all
+                # (socket.timeout and friends carry no errno): with a
+                # socket timeout set (it is during handshake), a
+                # spuriously-ready fd would raise timeout here — that
+                # is a transient drain-ender, not a connection failure.
+                if exc.errno is not None and exc.errno not in (
                     errno.EINTR, errno.EAGAIN, errno.EWOULDBLOCK
                 ):
                     self._drain_err = exc

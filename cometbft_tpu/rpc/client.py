@@ -54,18 +54,23 @@ class HTTPClient:
     #: connection BEFORE reading our request, so a resend cannot
     #: double-submit. Timeouts and mid-response failures are NOT here:
     #: the server may already have processed the (non-idempotent) call.
-    _RETRYABLE = None  # set below, needs http.client imported
+    #: NB: no http.client.RemoteDisconnected entry — it subclasses
+    #: ConnectionResetError, so it still matches this tuple; listing it
+    #: was dead weight.  It is raised by getresponse() AFTER the
+    #: request was written (sent=True), so what actually keeps it from
+    #: being retried is the ``not sent`` gate below — that is the
+    #: common stale keep-alive shape (server idle-closed before
+    #: reading; our send lands in the socket buffer, the read gets
+    #: EOF), and it intentionally surfaces to the caller: by then the
+    #: server may have read and processed the call.
+    _RETRYABLE = (
+        BrokenPipeError,
+        ConnectionResetError,
+        ConnectionRefusedError,
+    )
 
     def _request(self, payload: bytes) -> dict:
         import http.client
-
-        if HTTPClient._RETRYABLE is None:
-            HTTPClient._RETRYABLE = (
-                http.client.RemoteDisconnected,
-                BrokenPipeError,
-                ConnectionResetError,
-                ConnectionRefusedError,
-            )
         conn = getattr(self._local, "conn", None)
         reused = conn is not None
         while True:

@@ -21,6 +21,7 @@ from fractions import Fraction
 from cometbft_tpu.crypto import batch as crypto_batch
 from cometbft_tpu.types.block import BlockID, Commit
 from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.utils.trace import TRACER as _tracer
 
 
 class CommitError(Exception):
@@ -167,16 +168,20 @@ def _verify(
                     )
 
     groups = _batch_groups(entries, vals)
-    if len(groups) <= 1:
-        for group in groups:
-            _verify_group(group)
-    else:
-        import concurrent.futures as _futures
+    with _tracer.span(
+        "verify_commit", cat="crypto",
+        height=commit.height, sigs=len(entries), groups=len(groups),
+    ):
+        if len(groups) <= 1:
+            for group in groups:
+                _verify_group(group)
+        else:
+            import concurrent.futures as _futures
 
-        with _futures.ThreadPoolExecutor(len(groups)) as pool:
-            futs = [pool.submit(_verify_group, g) for g in groups]
-            for f in futs:
-                f.result()  # re-raises InvalidCommitSignatures
+            with _futures.ThreadPoolExecutor(len(groups)) as pool:
+                futs = [pool.submit(_verify_group, g) for g in groups]
+                for f in futs:
+                    f.result()  # re-raises InvalidCommitSignatures
 
     for e in entries:
         if e.counts:

@@ -1,0 +1,266 @@
+"""Lightweight span tracing for the device execution path.
+
+The metrics plane (utils/metrics.py) answers "how much / how often";
+this module answers "what happened inside THIS commit".  Spans are
+context managers with thread-local parenting, retained in a bounded
+ring buffer and exported as Chrome trace-event JSON (the
+``traceEvents`` object format) that chrome://tracing and Perfetto load
+directly — the round-4/5 dispatch-calibration incident (mid-size
+batches silently routed to a high-RTT device for a full round) is
+exactly the shape of problem a launch-level timeline makes visible
+without an ad-hoc bench run.
+
+Design constraints, in order:
+
+- **Hot-path cost**: spans wrap whole consensus steps, VerifyCommit
+  calls, and device launches — never per-signature work.  A disabled
+  tracer returns one shared no-op span object, so the disabled path
+  allocates nothing.
+- **Bounded retention**: completed spans land in a ``deque(maxlen=N)``
+  (CMT_TPU_TRACE_RING, default 4096) — a long-running node keeps the
+  most recent window, never an unbounded log.
+- **No dependencies**: stdlib only; importable from every plane
+  (crypto, ops, consensus, tools) without dragging jax in.
+
+Surfaces: the metrics HTTP server serves ``/trace`` next to
+``/metrics``; the Inspector exposes a ``trace`` JSON-RPC route; and
+bench.py / tools/device_campaign.py dump the same JSON next to their
+results for provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+
+
+class _NopSpan:
+    """Shared do-nothing span — the disabled tracer's return value.
+
+    A singleton so ``tracer.span(...)`` allocates nothing when tracing
+    is off (mirrors the metrics plane's ``_Nop``)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **args) -> None:
+        pass
+
+
+_NOP_SPAN = _NopSpan()
+
+#: live tracers whose cached pid must be refreshed in fork children
+_PID_TRACERS: "weakref.WeakSet[SpanTracer]" = weakref.WeakSet()
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(
+        after_in_child=lambda: [
+            setattr(t, "_pid", os.getpid()) for t in _PID_TRACERS
+        ]
+    )
+
+
+class _Span:
+    """One in-flight span; records a complete ("ph": "X") event on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "_parent")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def set(self, **args) -> None:
+        """Attach result data discovered mid-span (e.g. batch verdict)."""
+        self.args.update(args)
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self._parent = stack[-1].name if stack else None
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end = time.perf_counter()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            self.args["error"] = exc_type.__name__
+        self._tracer._record(
+            self.name, self.cat, self._t0, end - self._t0, self.args,
+            self._parent,
+        )
+        return False
+
+
+class SpanTracer:
+    """Bounded ring of completed spans, Chrome-trace-JSON exportable.
+
+    ``span(name, **args)`` is the lexical entry point; spans started on
+    the same thread nest (thread-local parent stack, the parent's name
+    lands in the child's args).  ``add_complete`` records a span after
+    the fact from explicit perf_counter timestamps — used by the
+    consensus state machine, whose steps begin and end at different
+    call sites.
+    """
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        enabled: bool | None = None,
+    ):
+        if capacity is None:
+            capacity = int(os.environ.get("CMT_TPU_TRACE_RING", "4096"))
+        if enabled is None:
+            enabled = os.environ.get("CMT_TPU_TRACE", "1") != "0"
+        self.enabled = enabled
+        self._events: deque[dict] = deque(maxlen=max(capacity, 1))
+        self._mtx = threading.Lock()
+        self._tls = threading.local()
+        #: perf_counter origin; event ts values are microseconds since
+        #: this instant (Chrome traces need any consistent monotonic us)
+        self.epoch = time.perf_counter()
+        self._dropped = 0
+        # getpid() is a real syscall on sandboxed kernels (~10us) —
+        # cache it; _PID_TRACERS refreshes after fork
+        self._pid = os.getpid()
+        #: tid -> thread name, captured at record time — a track must
+        #: keep its name after its thread exits
+        self._thread_names: dict[int, str] = {}
+        _PID_TRACERS.add(self)
+
+    # -- recording -----------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, name: str, cat: str = "app", **args):
+        """A context-manager span; the shared no-op when disabled."""
+        if not self.enabled:
+            return _NOP_SPAN
+        return _Span(self, name, cat, args)
+
+    def add_complete(
+        self,
+        name: str,
+        start: float,
+        duration_s: float,
+        cat: str = "app",
+        args: dict | None = None,
+    ) -> None:
+        """Record a span from explicit ``time.perf_counter()`` values
+        (``start`` in perf_counter time, not trace microseconds)."""
+        if not self.enabled:
+            return
+        self._record(name, cat, start, duration_s, args or {}, None)
+
+    def _record(
+        self,
+        name: str,
+        cat: str,
+        start: float,
+        duration_s: float,
+        args: dict,
+        parent: str | None,
+    ) -> None:
+        if parent is not None:
+            args = dict(args, parent=parent)
+        thread = threading.current_thread()
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round(max(start - self.epoch, 0.0) * 1e6, 1),
+            "dur": round(max(duration_s, 0.0) * 1e6, 1),
+            "pid": self._pid,
+            "tid": thread.ident,
+            "args": args,
+        }
+        with self._mtx:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(event)
+            self._thread_names[thread.ident] = thread.name
+            if len(self._thread_names) > 1024:
+                live = {e["tid"] for e in self._events}
+                self._thread_names = {
+                    t: n
+                    for t, n in self._thread_names.items()
+                    if t in live
+                }
+
+    # -- export --------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Snapshot of retained span events, oldest first."""
+        with self._mtx:
+            return list(self._events)
+
+    def export(self) -> dict:
+        """Chrome trace-event JSON (object form) — load in Perfetto /
+        chrome://tracing.  Thread-name metadata events are synthesized
+        (names captured at record time, so a track keeps its name
+        after its thread exits) so tracks read as thread names, not
+        bare idents."""
+        with self._mtx:
+            events = list(self._events)
+            names = dict(self._thread_names)
+        pid = self._pid
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": names.get(tid, f"thread-{tid}")},
+            }
+            for tid in sorted({e["tid"] for e in events})
+        ]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": self._dropped},
+        }
+
+    def export_json(self) -> str:
+        return json.dumps(self.export(), default=str)
+
+    def dump(self, path: str) -> None:
+        """Atomically write the export to ``path`` (tmp + rename);
+        the shared provenance-dump helper for bench/campaign drivers."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.export_json())
+        os.replace(tmp, path)
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._events.clear()
+            self._thread_names.clear()
+            self._dropped = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = bool(enabled)
+
+
+#: process-wide tracer — all planes record here, all surfaces read here
+TRACER = SpanTracer()
+
+
+__all__ = ["SpanTracer", "TRACER"]

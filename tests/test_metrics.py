@@ -1,12 +1,22 @@
 """Metrics plane tests (reference: the metricsgen-generated structs +
-prometheus endpoint wired at node/node.go:334,594)."""
+prometheus endpoint wired at node/node.go:334,594; plus the crypto/
+device-path struct and span tracer this repo adds —
+docs/observability.md)."""
 
 from __future__ import annotations
 
+import json
 import time
 import urllib.request
 
-from cometbft_tpu.metrics import NodeMetrics
+import pytest
+
+from cometbft_tpu.metrics import (
+    CryptoMetrics,
+    NodeMetrics,
+    crypto_metrics,
+    install_crypto_metrics,
+)
 from cometbft_tpu.utils.metrics import MetricsServer, Registry
 
 
@@ -72,6 +82,157 @@ class TestRegistry:
             srv.stop()
 
 
+class TestCryptoMetrics:
+    """The device-path struct (CryptoMetrics) + the process-wide sink
+    the module-level crypto hot paths update."""
+
+    def _install(self):
+        reg = Registry()
+        m = NodeMetrics(reg)
+        install_crypto_metrics(m.crypto)
+        return reg, m
+
+    def teardown_method(self):
+        install_crypto_metrics(None)  # restore the no-op sink
+
+    def test_exposition_includes_crypto_series(self):
+        reg, m = self._install()
+        m.crypto.batch_verify_batch_size.observe(150)
+        m.crypto.dispatch_decisions.labels(
+            route="host", reason="batch_size"
+        ).inc()
+        m.crypto.key_pool_keys.labels(window_bits="8").set(150)
+        m.crypto.bytes_transferred.labels(direction="h2d").inc(4096)
+        text = reg.expose()
+        assert "# TYPE cometbft_crypto_batch_verify_batch_size histogram" in text
+        assert "cometbft_crypto_batch_verify_batch_size_count 1" in text
+        assert (
+            'cometbft_crypto_dispatch_decisions'
+            '{reason="batch_size",route="host"} 1' in text
+        )
+        assert 'cometbft_crypto_key_pool_keys{window_bits="8"} 150' in text
+        assert (
+            'cometbft_crypto_bytes_transferred{direction="h2d"} 4096'
+            in text
+        )
+        # registered-but-untouched label-less counters still expose
+        assert "cometbft_crypto_key_pool_builds 0" in text
+        # the new consensus histogram is registered alongside
+        assert (
+            "# TYPE cometbft_consensus_step_duration_seconds histogram"
+            in text
+        )
+
+    def test_host_batch_verify_updates_metrics(self):
+        pytest.importorskip("cryptography")
+        from cometbft_tpu.crypto import ed25519 as ed
+
+        reg, m = self._install()
+        priv = ed.priv_key_from_secret(b"crypto-metrics")
+        bv = ed.CpuBatchVerifier()
+        for i in range(3):  # below NATIVE_MIN_BATCH: per-sig host path
+            msg = b"m%d" % i
+            bv.add(priv.pub_key(), msg, priv.sign(msg))
+        ok, results = bv.verify()
+        assert ok and results == [True] * 3
+        text = reg.expose()
+        assert "cometbft_crypto_host_verify_time_seconds_count 1" in text
+        assert "cometbft_crypto_batch_verify_batch_size_count 1" in text
+        assert "cometbft_crypto_batch_verify_batch_size_sum 3" in text
+
+    def test_dispatch_decision_recorded_when_device_disabled(
+        self, monkeypatch
+    ):
+        pytest.importorskip("cryptography")
+        from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.crypto import ed25519 as ed
+
+        reg, m = self._install()
+        monkeypatch.setenv("CMT_TPU_DISABLE_DEVICE_VERIFY", "1")
+        bv = crypto_batch.create_batch_verifier(
+            ed.priv_key_from_secret(b"d").pub_key()
+        )
+        assert isinstance(bv, ed.CpuBatchVerifier)
+        assert (
+            'cometbft_crypto_dispatch_decisions'
+            '{reason="disabled",route="host"} 1' in reg.expose()
+        )
+
+    def test_key_pool_grow_and_evict_update_metrics(self, monkeypatch):
+        pytest.importorskip("cryptography")
+        jax = pytest.importorskip("jax")
+        import numpy as np
+
+        from cometbft_tpu.ops import precompute as PR
+
+        reg, m = self._install()
+        cache = PR.KeyTableCache(cap_bytes=4 << 20)  # ~1 key at 8-bit
+
+        def fake_build(missing, window_bits):
+            # shapes the insert path expects, no EC compute
+            n_pad = max(len(missing), 1)
+            n_pad = 1 << (n_pad - 1).bit_length() if n_pad > 1 else 1
+            nent = 1 << window_bits
+            nwin = 256 // window_bits
+            table = np.zeros((nwin, 4, 26, n_pad * nent), dtype=np.int32)
+            return table, np.ones(len(missing), dtype=bool)
+
+        monkeypatch.setattr(cache, "_build_pages", fake_build)
+        keys = [bytes([i]) * 32 for i in range(1, 4)]
+
+        entry = cache.lookup_or_build(keys[:1])
+        assert entry is not None
+        text = reg.expose()
+        assert 'cometbft_crypto_key_pool_keys{window_bits="8"} 1' in text
+        assert (
+            'cometbft_crypto_key_pool_capacity{window_bits="8"} 1' in text
+        )
+        assert "cometbft_crypto_key_pool_builds 1" in text
+        assert (
+            'cometbft_crypto_key_pool_retraces{window_bits="8"}' in text
+        )
+
+        # a second, disjoint set grows the pool over budget: the first
+        # key is evicted and the pool compacts
+        entry2 = cache.lookup_or_build(keys[1:])
+        assert entry2 is not None
+        assert cache.stats["keys_evicted"] >= 1
+        text = reg.expose()
+        assert "cometbft_crypto_key_pool_builds 3" in text
+        for line in text.splitlines():
+            if line.startswith("cometbft_crypto_key_pool_evictions "):
+                assert float(line.split()[-1]) >= 1
+                break
+        else:
+            raise AssertionError("evictions series missing")
+        assert 'cometbft_crypto_key_pool_keys{window_bits="8"} 2' in text
+
+    def test_nop_crypto_metrics_share_the_singleton(self):
+        """The reg=None branch must stay allocation-free on the hot
+        path: every field IS the module _Nop singleton (no per-call
+        objects), and the default process-wide sink is a no-op."""
+        import cometbft_tpu.metrics as M
+
+        nop = CryptoMetrics(None)
+        for name, field in vars(nop).items():
+            assert field is M._NOP, name
+            # absorbs the full op surface without allocation games
+            field.inc()
+            field.observe(1.0)
+            field.labels(kernel="generic").inc(2)
+        assert isinstance(crypto_metrics(), CryptoMetrics)
+
+
+class TestMetricsLint:
+    def test_every_registered_field_is_referenced(self):
+        """tier-1 hook for `make metrics-lint` (tools/metrics_lint.py):
+        a field registered in cometbft_tpu/metrics but updated nowhere
+        is a permanently-zero series — fail here, not on a dashboard."""
+        from tools.metrics_lint import find_unreferenced
+
+        assert find_unreferenced() == {}
+
+
 class TestNodeMetricsEndToEnd:
     def test_node_serves_prometheus_metrics(self, tmp_path):
         """A running node with instrumentation enabled exposes live
@@ -117,6 +278,47 @@ class TestNodeMetricsEndToEnd:
                     break
             else:
                 raise AssertionError("height series missing")
+            # device-path observability: the crypto series are
+            # registered, and consensus step timing has live samples
+            assert "cometbft_crypto_batch_verify_launches" in body
+            assert "cometbft_crypto_dispatch_decisions" in body
+            assert 'step="Propose"' in body
+            assert 'step="Commit"' in body
+            for line in body.splitlines():
+                if "step_duration_seconds_count" in line and (
+                    'step="Commit"' in line
+                ):
+                    assert float(line.split()[-1]) >= 2
+                    break
+            else:
+                raise AssertionError("step duration series missing")
+            # /trace next to /metrics: Chrome trace-event JSON with
+            # consensus-step spans and a VerifyCommit span nested
+            # inside one (same thread, time-contained)
+            trace_url = (
+                f"http://127.0.0.1:{node.metrics_server.port}/trace"
+            )
+            doc = json.loads(
+                urllib.request.urlopen(trace_url, timeout=5).read()
+            )
+            spans = [
+                e for e in doc["traceEvents"] if e.get("ph") == "X"
+            ]
+            steps = [
+                e for e in spans if e["name"].startswith("consensus/")
+            ]
+            commits = [
+                e for e in steps if e["name"] == "consensus/Commit"
+            ]
+            verifies = [e for e in spans if e["name"] == "verify_commit"]
+            assert commits and verifies
+            assert any(
+                s["tid"] == v["tid"]
+                and s["ts"] <= v["ts"]
+                and v["ts"] + v["dur"] <= s["ts"] + s["dur"]
+                for v in verifies
+                for s in steps
+            ), "verify_commit span not nested in a consensus step span"
         finally:
             node.stop()
 
@@ -133,7 +335,7 @@ class TestNopParity:
 
         for cls in (
             M.ConsensusMetrics, M.MempoolMetrics, M.P2PMetrics,
-            M.StateMetrics,
+            M.StateMetrics, M.CryptoMetrics,
         ):
             real = vars(cls(Registry())).keys()
             nop = vars(cls(None)).keys()

@@ -1,0 +1,149 @@
+"""Span tracer tests (utils/trace): Chrome trace-event export,
+thread-local parenting, bounded retention, the no-op disabled path,
+and the /trace surface on the metrics HTTP server."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from cometbft_tpu.utils import trace as trace_mod
+from cometbft_tpu.utils.trace import SpanTracer
+
+
+class TestSpanTracer:
+    def test_nested_spans_parent_and_containment(self):
+        t = SpanTracer(capacity=64, enabled=True)
+        with t.span("outer", cat="test", k=1):
+            time.sleep(0.001)
+            with t.span("inner", cat="test"):
+                time.sleep(0.001)
+        events = t.events()
+        assert [e["name"] for e in events] == ["inner", "outer"]
+        inner, outer = events
+        assert inner["args"]["parent"] == "outer"
+        assert "parent" not in outer["args"]
+        # time containment (what makes Perfetto nest the slices)
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert inner["tid"] == outer["tid"]
+
+    def test_export_round_trips_to_valid_chrome_trace_json(self):
+        t = SpanTracer(capacity=64, enabled=True)
+        with t.span("a", cat="test", detail="x"):
+            pass
+        t.add_complete("b", time.perf_counter(), 0.01, cat="test")
+        doc = json.loads(t.export_json())
+        events = doc["traceEvents"]
+        assert doc["displayTimeUnit"] == "ms"
+        span_events = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in span_events} == {"a", "b"}
+        for e in span_events:
+            # the Chrome trace-event required fields, correctly typed
+            assert isinstance(e["name"], str)
+            assert isinstance(e["cat"], str)
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert isinstance(e["pid"], int)
+            assert isinstance(e["tid"], int)
+            assert isinstance(e["args"], dict)
+        # thread-name metadata events for every tid present
+        meta_tids = {
+            e["tid"] for e in events if e.get("ph") == "M"
+        }
+        assert {e["tid"] for e in span_events} <= meta_tids
+
+    def test_ring_buffer_bounds_retention(self):
+        t = SpanTracer(capacity=8, enabled=True)
+        for i in range(50):
+            with t.span(f"s{i}", cat="test"):
+                pass
+        events = t.events()
+        assert len(events) == 8
+        # newest retained, oldest dropped
+        assert events[-1]["name"] == "s49"
+        assert t.export()["otherData"]["dropped_spans"] == 42
+
+    def test_disabled_tracer_is_allocation_free(self):
+        t = SpanTracer(capacity=8, enabled=False)
+        spans = [t.span("hot", batch=4096) for _ in range(3)]
+        # one shared no-op object: the disabled hot path allocates
+        # nothing per call
+        assert spans[0] is spans[1] is spans[2]
+        with spans[0] as sp:
+            sp.set(ok=True)
+        t.add_complete("x", time.perf_counter(), 0.1)
+        assert t.events() == []
+
+    def test_spans_on_different_threads_do_not_cross_parent(self):
+        t = SpanTracer(capacity=64, enabled=True)
+        done = threading.Event()
+
+        def other():
+            with t.span("other-thread", cat="test"):
+                pass
+            done.set()
+
+        with t.span("main-thread", cat="test"):
+            th = threading.Thread(target=other)
+            th.start()
+            done.wait(5)
+            th.join(5)
+        by_name = {e["name"]: e for e in t.events()}
+        # the concurrent main-thread span is NOT the other thread's
+        # parent — parenting is thread-local
+        assert "parent" not in by_name["other-thread"]["args"]
+        assert by_name["other-thread"]["tid"] != by_name["main-thread"]["tid"]
+
+    def test_exception_inside_span_still_records_and_tags(self):
+        t = SpanTracer(capacity=8, enabled=True)
+        try:
+            with t.span("boom", cat="test"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        (e,) = t.events()
+        assert e["name"] == "boom"
+        assert e["args"]["error"] == "ValueError"
+        # the stack unwound: a following span has no stale parent
+        with t.span("after", cat="test"):
+            pass
+        assert "parent" not in t.events()[-1]["args"]
+
+
+class TestTraceEndpoint:
+    def test_metrics_server_serves_trace_next_to_metrics(self):
+        from cometbft_tpu.utils.metrics import MetricsServer, Registry
+
+        with trace_mod.TRACER.span("endpoint-test", cat="test"):
+            pass
+        srv = MetricsServer(Registry(), "127.0.0.1:0")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(
+                base + "/trace", timeout=5
+            ).read()
+            doc = json.loads(body)
+            names = {
+                e["name"] for e in doc["traceEvents"] if e["ph"] == "X"
+            }
+            assert "endpoint-test" in names
+            # /metrics still serves the exposition
+            text = urllib.request.urlopen(
+                base + "/metrics", timeout=5
+            ).read().decode()
+            assert text.endswith("\n")
+        finally:
+            srv.stop()
+
+    def test_global_tracer_default_enabled(self):
+        # the process-wide tracer records unless CMT_TPU_TRACE=0
+        before = len(trace_mod.TRACER.events())
+        with trace_mod.TRACER.span("global-check", cat="test"):
+            pass
+        assert len(trace_mod.TRACER.events()) >= min(
+            before + 1, trace_mod.TRACER._events.maxlen
+        )

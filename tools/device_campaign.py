@@ -28,7 +28,21 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "docs", "data", "kernel_ab_r05.json")
+#: campaign-level span trace (utils/trace Chrome trace-event JSON):
+#: one span per probe/step with outcome args — the provenance record
+#: of where a tunnel window's time actually went
+TRACE_OUT = os.path.join(REPO, "docs", "data", "device_campaign_trace.json")
+
+
+def dump_trace() -> None:
+    try:
+        from cometbft_tpu.utils.trace import TRACER
+
+        TRACER.dump(TRACE_OUT)
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        print(f"trace dump failed (ignored): {exc}", file=sys.stderr)
 
 STEPS = {
     "keyed_stack": (
@@ -90,8 +104,17 @@ def probe(timeout: float = 75.0) -> bool:
 
 
 def run_step(name: str, timeout: float) -> dict:
+    from cometbft_tpu.utils.trace import TRACER
+
     env_extra, tool = STEPS[name]
     env = dict(os.environ, **env_extra)
+    with TRACER.span("campaign/" + name, cat="bench", tool=tool) as sp:
+        entry = _run_step_proc(name, tool, env, timeout)
+        sp.set(rc=entry["rc"], wall_s=entry["wall_s"])
+    return entry
+
+
+def _run_step_proc(name: str, tool: str, env: dict, timeout: float) -> dict:
     t0 = time.time()
     try:
         proc = subprocess.run(
@@ -145,6 +168,7 @@ def main() -> int:
         entry["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
         data["results"][name] = entry
         save(data)
+        dump_trace()
         rate = entry.get("sigs_per_sec_device")
         print(f"{name}: " + (f"{rate:,.0f} sigs/s" if rate else
                              f"no rate (rc={entry['rc']})"),
@@ -152,7 +176,9 @@ def main() -> int:
         if not probe(45):
             print("tunnel went away mid-campaign; stopping here",
                   file=sys.stderr)
+            dump_trace()
             return 4
+    dump_trace()
     print(json.dumps(load(), indent=1))
     return 0
 

@@ -1,0 +1,104 @@
+"""metrics-lint: every metric field registered in cometbft_tpu/metrics
+must be referenced by at least one subsystem.
+
+The structs in cometbft_tpu/metrics/__init__.py are hand-maintained
+(the reference generates them with metricsgen); a field that is
+registered but never updated exposes a permanently-zero series — worse
+than no series, because dashboards and alerts trust it.  This checker
+instantiates every struct in no-op mode to enumerate the registered
+field names, then requires an ``.<field>`` attribute reference
+somewhere in the package outside the metrics module itself.
+
+It is a tripwire, not a proof: a generic name like ``size`` is
+trivially satisfied by unrelated attribute access.  New metric names
+are deliberately specific (``key_pool_retraces``), which is where the
+check has teeth.
+
+    python tools/metrics_lint.py        # exit 0 clean, 1 with a report
+
+Run in the tier-1 flow via tests/test_metrics.py::TestMetricsLint and
+standalone via ``make metrics-lint``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: subsystem code scanned for references (tools/ and bench drivers
+#: count: the campaign/bench planes update crypto metrics too)
+SCAN_ROOTS = ("cometbft_tpu", "tools", "bench.py", "bench_all.py")
+#: the registration site itself never counts as a reference
+EXCLUDE = (os.path.join("cometbft_tpu", "metrics", "__init__.py"),)
+
+
+def registered_fields() -> dict[str, list[str]]:
+    """field name -> metric struct(s) registering it."""
+    import cometbft_tpu.metrics as M
+
+    out: dict[str, list[str]] = {}
+    for cls in (
+        M.ConsensusMetrics,
+        M.MempoolMetrics,
+        M.P2PMetrics,
+        M.StateMetrics,
+        M.CryptoMetrics,
+    ):
+        for name in vars(cls(None)):
+            out.setdefault(name, []).append(cls.__name__)
+    return out
+
+
+def _scan_files() -> list[tuple[str, str]]:
+    files: list[tuple[str, str]] = []
+    for root in SCAN_ROOTS:
+        path = os.path.join(REPO, root)
+        if os.path.isfile(path):
+            files.append((root, open(path).read()))
+            continue
+        for dirpath, _, names in os.walk(path):
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, n), REPO)
+                if rel in EXCLUDE:
+                    continue
+                files.append((rel, open(os.path.join(dirpath, n)).read()))
+    return files
+
+
+def find_unreferenced() -> dict[str, list[str]]:
+    """Registered fields with no ``.<field>`` reference in any
+    subsystem — empty dict when the lint is clean."""
+    fields = registered_fields()
+    blobs = _scan_files()
+    missing: dict[str, list[str]] = {}
+    for field, owners in sorted(fields.items()):
+        pat = re.compile(r"\." + re.escape(field) + r"\b")
+        if not any(pat.search(text) for _, text in blobs):
+            missing[field] = owners
+    return missing
+
+
+def main() -> int:
+    missing = find_unreferenced()
+    if not missing:
+        print(f"metrics-lint: {len(registered_fields())} fields, all "
+              "referenced")
+        return 0
+    for field, owners in missing.items():
+        print(
+            f"metrics-lint: {'/'.join(owners)}.{field} is registered "
+            "but never referenced by any subsystem",
+            file=sys.stderr,
+        )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
