@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native metrics-lint
+.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native metrics-lint wire-smoke
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -61,10 +61,16 @@ bench-all:
 bench-micro:
 	$(PY) tools/bench_micro.py
 
-# every registered metric field must be updated by some subsystem
+# every registered metric field must be updated by some subsystem,
+# and every update site must name a registered field (inverse check)
 # (also enforced in the tier-1 flow via tests/test_metrics.py)
 metrics-lint:
 	$(PY) tools/metrics_lint.py
+
+# wire-plane telemetry smoke: the loopback MConnection pair + RPC
+# dispatch + event-bus assertions, standalone (tier-1 runs them too)
+wire-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics.py -k wire -q
 
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \

@@ -38,6 +38,10 @@ _INSPECT_ROUTES = (
     "block_search",
     "validators",
     "consensus_params",
+    # wire-plane snapshot: no live switch in inspect mode, so it
+    # reports an empty peer table — but the route shape matches a
+    # running node's, so tooling probes one endpoint for both modes
+    "wire",
 )
 
 

@@ -54,6 +54,10 @@ class MempoolReactor(Reactor):
         self.mempool = mempool
         self.broadcast = broadcast
         self._wait_sync = threading.Event()
+        # cumulative txs submitted per peer, mirrored into the p2p
+        # num_txs gauge (p2p/metrics.go NumTxs)
+        self._peer_tx_counts: dict[str, int] = {}
+        self._peer_tx_mtx = threading.Lock()
 
     def enable_in_out_txs(self) -> None:
         """Called after state sync completes (reactor.go EnableInOutTxs)."""
@@ -78,6 +82,10 @@ class MempoolReactor(Reactor):
                 daemon=True,
             ).start()
 
+    def remove_peer(self, peer, reason) -> None:
+        with self._peer_tx_mtx:
+            self._peer_tx_counts.pop(peer.id, None)
+
     def receive(self, env: Envelope) -> None:
         """CheckTx every received tx, remembering the sender so we never
         echo a tx back (reactor.go:184 Receive)."""
@@ -88,6 +96,13 @@ class MempoolReactor(Reactor):
             if self.switch is not None:
                 self.switch.stop_peer_for_error(env.src, exc)
             return
+        if txs and self.switch is not None:
+            with self._peer_tx_mtx:
+                count = self._peer_tx_counts.get(env.src.id, 0) + len(txs)
+                self._peer_tx_counts[env.src.id] = count
+            self.switch.metrics.num_txs.labels(peer_id=env.src.id).set(
+                count
+            )
         for tx in txs:
             try:
                 self.mempool.check_tx(tx, sender=env.src.id)

@@ -25,6 +25,14 @@ class _Nop:
     def labels(self, **kv):
         return self
 
+    def remove(self, **kv) -> None:
+        pass
+
+    def __bool__(self) -> bool:
+        # falsy so hot paths can skip work that only feeds gauges
+        # (e.g. EventBus queue-depth mirroring) when metrics are off
+        return False
+
 
 _NOP = _Nop()
 
@@ -121,23 +129,184 @@ class MempoolMetrics:
 
 
 class P2PMetrics:
-    """(p2p/metrics.go Metrics)"""
+    """(p2p/metrics.go Metrics) — wire-plane telemetry.
+
+    Reference parity (peers, per-peer/per-type message bytes, pending
+    send bytes, per-peer txs) plus the queue-depth/backpressure series
+    the reference keeps internal to MConnection: per-channel send-queue
+    gauges, send timeout/failure counters, ping RTT, flowrate
+    throughput, and SecretConnection handshake/frame accounting.
+    """
 
     def __init__(self, reg: Registry | None = None):
         if reg is None:
             self.peers = _NOP
             self.message_receive_bytes_total = _NOP
             self.message_send_bytes_total = _NOP
+            self.peer_pending_send_bytes = _NOP
+            self.num_txs = _NOP
+            self.ping_rtt_seconds = _NOP
+            self.send_queue_size = self.send_queue_bytes = _NOP
+            self.send_timeouts = self.try_send_failures = _NOP
+            self.send_rate_bytes = self.recv_rate_bytes = _NOP
+            self.handshake_duration_seconds = _NOP
+            self.secret_frames_total = _NOP
             return
         s = "p2p"
         self.peers = reg.gauge(s, "peers", "Number of connected peers.")
         self.message_receive_bytes_total = reg.counter(
             s, "message_receive_bytes_total",
-            "Bytes received per channel.", labels=("chID",),
+            "Bytes received per message type (channel owner), channel "
+            "and peer.",
+            labels=("chID", "message_type", "peer_id"),
         )
         self.message_send_bytes_total = reg.counter(
             s, "message_send_bytes_total",
-            "Bytes sent per channel.", labels=("chID",),
+            "Bytes enqueued for send per message type (channel owner), "
+            "channel and peer.",
+            labels=("chID", "message_type", "peer_id"),
+        )
+        self.peer_pending_send_bytes = reg.gauge(
+            s, "peer_pending_send_bytes",
+            "Bytes queued (all channels + in-flight message remainder) "
+            "awaiting the peer's send routine.",
+            labels=("peer_id",),
+        )
+        self.num_txs = reg.gauge(
+            s, "num_txs",
+            "Transactions submitted by each peer.",
+            labels=("peer_id",),
+        )
+        self.ping_rtt_seconds = reg.histogram(
+            s, "ping_rtt_seconds",
+            "Round-trip of the keepalive ping (sent in _ping_routine, "
+            "observed on the matching pong).",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5),
+            labels=("peer_id",),
+        )
+        self.send_queue_size = reg.gauge(
+            s, "send_queue_size",
+            "Messages waiting in a channel's send queue.",
+            labels=("peer_id", "chID"),
+        )
+        self.send_queue_bytes = reg.gauge(
+            s, "send_queue_bytes",
+            "Bytes waiting in a channel's send queue (incl. the "
+            "unsent remainder of the in-flight message).",
+            labels=("peer_id", "chID"),
+        )
+        self.send_timeouts = reg.counter(
+            s, "send_timeouts",
+            "Blocking sends that timed out on a full channel queue.",
+            labels=("peer_id", "chID"),
+        )
+        self.try_send_failures = reg.counter(
+            s, "try_send_failures",
+            "Non-blocking sends dropped on a full channel queue "
+            "(async-broadcast backpressure).",
+            labels=("peer_id", "chID"),
+        )
+        self.send_rate_bytes = reg.gauge(
+            s, "send_rate_bytes",
+            "Flowrate EMA send throughput (Monitor.status rate_avg), "
+            "sampled each ping interval.",
+            labels=("peer_id",),
+        )
+        self.recv_rate_bytes = reg.gauge(
+            s, "recv_rate_bytes",
+            "Flowrate EMA receive throughput (Monitor.status "
+            "rate_avg), sampled each ping interval.",
+            labels=("peer_id",),
+        )
+        self.handshake_duration_seconds = reg.histogram(
+            s, "handshake_duration_seconds",
+            "SecretConnection handshake wall time (DH + HKDF + "
+            "challenge signatures).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.secret_frames_total = reg.counter(
+            s, "secret_frames_total",
+            "AEAD frames sealed/opened by SecretConnection "
+            "(direction: seal | open).",
+            labels=("direction",),
+        )
+
+
+class RPCMetrics:
+    """API-plane telemetry (no metricsgen analog: the reference leaves
+    rpc/jsonrpc unmeasured).  Updated by JSONRPCServer._dispatch, the
+    WS loop, and Environment's subscription bookkeeping."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.requests_total = _NOP
+            self.request_duration_seconds = _NOP
+            self.requests_in_flight = _NOP
+            self.response_size_bytes = _NOP
+            self.ws_connections = _NOP
+            self.ws_subscriptions = _NOP
+            return
+        s = "rpc"
+        self.requests_total = reg.counter(
+            s, "requests_total",
+            "JSON-RPC requests dispatched, by route and outcome "
+            "(unknown routes collapse to route=\"_unknown\").",
+            labels=("route", "status"),
+        )
+        self.request_duration_seconds = reg.histogram(
+            s, "request_duration_seconds",
+            "Wall seconds per JSON-RPC dispatch, by route.",
+            buckets=DEFAULT_TIME_BUCKETS,
+            labels=("route",),
+        )
+        self.requests_in_flight = reg.gauge(
+            s, "requests_in_flight",
+            "JSON-RPC requests currently being dispatched.",
+        )
+        self.response_size_bytes = reg.histogram(
+            s, "response_size_bytes",
+            "HTTP response body sizes.",
+            buckets=(64, 256, 1024, 4096, 16384, 65536, 262144,
+                     1048576, 4194304),
+        )
+        self.ws_connections = reg.gauge(
+            s, "ws_connections", "Open WebSocket sessions."
+        )
+        self.ws_subscriptions = reg.gauge(
+            s, "ws_subscriptions",
+            "Live event subscriptions across WebSocket clients.",
+        )
+
+
+class EventBusMetrics:
+    """Event-bus publish latency and subscriber backpressure (no
+    reference analog; event_bus.go publishes unmeasured)."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.publish_duration_seconds = _NOP
+            self.subscriber_queue_depth = _NOP
+            self.subscriber_dropped_total = _NOP
+            return
+        s = "event_bus"
+        self.publish_duration_seconds = reg.histogram(
+            s, "publish_duration_seconds",
+            "Wall seconds per event publish (query matching + "
+            "delivery to every subscriber queue).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.subscriber_queue_depth = reg.gauge(
+            s, "subscriber_queue_depth",
+            "Deepest undelivered-message queue per subscriber client.",
+            labels=("client_id",),
+        )
+        self.subscriber_dropped_total = reg.counter(
+            s, "subscriber_dropped_total",
+            "Subscriptions canceled out-of-capacity (slow consumer). "
+            "Label-less on purpose: client ids are per-connection, so "
+            "labeling would leak counter children under WS churn — "
+            "the canceled client is named in the event-bus log line.",
         )
 
 
@@ -272,6 +441,26 @@ def install_crypto_metrics(metrics: CryptoMetrics | None) -> None:
     _CRYPTO = metrics if metrics is not None else CryptoMetrics(None)
 
 
+#: Process-wide sink for wire-plane code with no node handle —
+#: SecretConnection seals/opens frames deep under the transport, where
+#: threading a per-node struct through would contort the handshake
+#: path.  Same contract as the crypto sink: no-op by default, node
+#: assembly installs the real struct, last installed wins.
+_P2P = P2PMetrics(None)
+
+
+def p2p_metrics() -> P2PMetrics:
+    """The currently installed wire-plane sink (never None)."""
+    return _P2P
+
+
+def install_p2p_metrics(metrics: P2PMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide p2p sink (None resets
+    to the no-op)."""
+    global _P2P
+    _P2P = metrics if metrics is not None else P2PMetrics(None)
+
+
 class NodeMetrics:
     """Bundle wired at node assembly (node/node.go:334)."""
 
@@ -282,15 +471,21 @@ class NodeMetrics:
         self.p2p = P2PMetrics(reg)
         self.state = StateMetrics(reg)
         self.crypto = CryptoMetrics(reg)
+        self.rpc = RPCMetrics(reg)
+        self.event_bus = EventBusMetrics(reg)
 
 
 __all__ = [
     "ConsensusMetrics",
     "CryptoMetrics",
+    "EventBusMetrics",
     "MempoolMetrics",
     "NodeMetrics",
     "P2PMetrics",
+    "RPCMetrics",
     "StateMetrics",
     "crypto_metrics",
     "install_crypto_metrics",
+    "install_p2p_metrics",
+    "p2p_metrics",
 ]

@@ -134,7 +134,11 @@ class Node(BaseService):
         self.config = config
 
         # 0. metrics plane (node/node.go:334 metricsProvider)
-        from cometbft_tpu.metrics import NodeMetrics, install_crypto_metrics
+        from cometbft_tpu.metrics import (
+            NodeMetrics,
+            install_crypto_metrics,
+            install_p2p_metrics,
+        )
         from cometbft_tpu.utils.metrics import MetricsServer, Registry
 
         if config.instrumentation.prometheus:
@@ -148,8 +152,11 @@ class Node(BaseService):
             # the crypto/device hot paths (batch verifier, table cache)
             # are module-level singletons: point the process-wide sink
             # at this node's struct (last installed wins; updates to a
-            # stopped node's registry are harmless)
+            # stopped node's registry are harmless).  SecretConnection
+            # (handshake/frame accounting under the transport) uses the
+            # analogous p2p sink.
             install_crypto_metrics(self.metrics.crypto)
+            install_p2p_metrics(self.metrics.p2p)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
@@ -188,7 +195,7 @@ class Node(BaseService):
         self.proxy_app.set_on_error(self._stop_for_app_error)
 
         # 4. event bus + indexer (setup.go:181,190)
-        self.event_bus = EventBus()
+        self.event_bus = EventBus(metrics=self.metrics.event_bus)
         from cometbft_tpu.state.txindex import build_indexers
 
         (
@@ -493,6 +500,7 @@ class Node(BaseService):
             blocksync_reactor=self.blocksync_reactor,
             statesync_reactor=self.statesync_reactor,
             unsafe=config.rpc.unsafe,
+            metrics=self.metrics.rpc,
         )
         self.rpc_server: JSONRPCServer | None = None
         if config.rpc.laddr:
@@ -503,6 +511,7 @@ class Node(BaseService):
                 host=rpc_addr.host,
                 port=rpc_addr.port,
                 on_ws_disconnect=self.rpc_env.drop_client,
+                metrics=self.metrics.rpc,
                 logger=self.logger.with_fields(module="rpc"),
             )
 

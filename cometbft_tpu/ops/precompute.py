@@ -258,12 +258,46 @@ class _KeyPool:
     def nbytes(self) -> int:
         return self.cap * self.key_bytes
 
-    def ensure_capacity(self, nkeys: int) -> None:
+    def stage_growth(
+        self, version: int, table, cap: int, nkeys: int
+    ):
+        """Build the grown table array from a (version, table, cap)
+        snapshot WITHOUT the cache lock held — the jnp.pad is a device
+        copy of the whole pool, and doing it under the lock stalls
+        every concurrent cached-set lookup for the copy's duration
+        (ADVICE round 5).  Returns (snapshot_version, new_cap,
+        grown_table), or None when the snapshot needs no growth;
+        ``ensure_capacity(..., staged=...)`` applies it only if the
+        pool version is still the snapshot's."""
+        if cap >= nkeys:
+            return None
+        new_cap = _pool_cap(nkeys)
+        shape = (self.nwin, 4, F.NLIMBS, new_cap * self.nent)
+        if table is None:
+            grown = jnp.zeros(shape, dtype=jnp.int32)
+        else:
+            pad = (new_cap - cap) * self.nent
+            grown = jnp.pad(table, [(0, 0), (0, 0), (0, 0), (0, pad)])
+        return (version, new_cap, grown)
+
+    def ensure_capacity(self, nkeys: int, staged=None) -> None:
+        """Grow to the ladder capacity for ``nkeys``.  Lock held.  A
+        ``staged`` pre-grown array (from stage_growth) is swapped in
+        when its snapshot version still matches and it is big enough;
+        otherwise (concurrent build/compact moved the pool — rare) the
+        pad runs here as before."""
         if self.cap >= nkeys:
             return
         new_cap = _pool_cap(nkeys)
-        shape = (self.nwin, 4, F.NLIMBS, new_cap * self.nent)
-        if self.table is None:
+        if (
+            staged is not None
+            and staged[0] == self.version
+            and staged[1] >= new_cap
+        ):
+            new_cap = staged[1]
+            self.table = staged[2]
+        elif self.table is None:
+            shape = (self.nwin, 4, F.NLIMBS, new_cap * self.nent)
             self.table = jnp.zeros(shape, dtype=jnp.int32)
         else:
             pad = (new_cap - self.cap) * self.nent
@@ -374,8 +408,17 @@ class KeyTableCache:
                 continue
             try:
                 pages, page_valid = self._build_pages(missing, window_bits)
+                # stage any pool growth outside the lock: the pad is a
+                # device copy of the whole table, and cached-set
+                # lookups must not queue behind it
                 with self._lock:
-                    pool.ensure_capacity(len(pool.slots) + len(missing))
+                    snap = (pool.version, pool.table, pool.cap)
+                    need = len(pool.slots) + len(missing)
+                staged = pool.stage_growth(*snap, need)
+                with self._lock:
+                    pool.ensure_capacity(
+                        len(pool.slots) + len(missing), staged=staged
+                    )
                     slots = [pool.free.pop() for _ in missing]
                     idx = (
                         np.asarray(slots, dtype=np.int64)[:, None]

@@ -19,6 +19,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from cometbft_tpu.utils.flowrate import Monitor
@@ -30,6 +31,7 @@ from cometbft_tpu.utils.protoio import (
     read_uvarint_from,
 )
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.trace import TRACER
 
 MAX_PACKET_PAYLOAD = 1024          # connection.go defaultMaxPacketMsgPayloadSize
 FLUSH_THROTTLE = 0.010             # connection.go:43 flushThrottle 10ms
@@ -114,9 +116,15 @@ def decode_packet(data: bytes):
 
 
 class _Channel:
-    """(connection.go:640 channel) — send queue + recv reassembly."""
+    """(connection.go:640 channel) — send queue + recv reassembly.
 
-    def __init__(self, desc: ChannelDescriptor):
+    Tracks ``queued_bytes`` (queue contents + the unsent remainder of
+    the in-flight message) and mirrors queue depth/bytes into the
+    per-(peer, channel) gauges — the backpressure signal the wire
+    plane exposes on /metrics and /net_info.
+    """
+
+    def __init__(self, desc: ChannelDescriptor, metrics, peer_id: str):
         self.desc = desc
         self.send_queue: queue.Queue[bytes] = queue.Queue(
             desc.send_queue_capacity
@@ -125,9 +133,44 @@ class _Channel:
         self.sent_pos = 0
         self.recently_sent = 0  # decayed by send routine
         self.recving = bytearray()
+        self.queued_bytes = 0
+        self._qb_mtx = threading.Lock()
+        # label children resolved once: the hot path updates plain
+        # counters/gauges, never a labels() dict lookup
+        lbl = {"peer_id": peer_id, "chID": f"{desc.id:#x}"}
+        self.m_send_queue_size = metrics.send_queue_size.labels(**lbl)
+        self.m_send_queue_bytes = metrics.send_queue_bytes.labels(**lbl)
+        self.m_send_timeouts = metrics.send_timeouts.labels(**lbl)
+        self.m_try_send_failures = metrics.try_send_failures.labels(**lbl)
 
     def is_send_pending(self) -> bool:
         return self.sending is not None or not self.send_queue.empty()
+
+    def note_enqueued(self, nbytes: int) -> None:
+        """Account ``nbytes`` (negative to revert a failed put).  Must
+        run BEFORE the queue put: the send routine wakes on a timer,
+        so a post-put accounting could land after the message was
+        already popped, sent, and clamp-decremented — permanently
+        inflating the gauge.  Callers refresh the gauges after the
+        put, when qsize() is accurate."""
+        with self._qb_mtx:
+            self.queued_bytes = max(self.queued_bytes + nbytes, 0)
+
+    def _note_sent(self, nbytes: int, final: bool) -> None:
+        with self._qb_mtx:
+            self.queued_bytes = max(self.queued_bytes - nbytes, 0)
+        # per-chunk gauge writes are pure overhead at scrape cadence;
+        # refresh once per completed message
+        if final:
+            self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        self.m_send_queue_size.set(self.send_queue.qsize())
+        self.m_send_queue_bytes.set(self.queued_bytes)
+
+    def fill_ratio(self) -> float:
+        cap = max(self.desc.send_queue_capacity, 1)
+        return self.send_queue.qsize() / cap
 
     def next_packet(self, max_payload: int) -> tuple[bool, bytes]:
         """Pop the next chunk of the in-flight message -> (eof, data)."""
@@ -140,6 +183,7 @@ class _Channel:
         if eof:
             self.sending = None
             self.sent_pos = 0
+        self._note_sent(len(chunk), eof)
         return eof, chunk
 
 
@@ -158,20 +202,44 @@ class MConnection(BaseService):
         on_receive,
         on_error=None,
         config: MConnConfig | None = None,
+        metrics=None,
+        peer_id: str = "",
         logger: Logger | None = None,
     ):
         super().__init__(
             name="mconn", logger=logger or default_logger().with_fields(module="mconn")
         )
+        from cometbft_tpu.metrics import P2PMetrics
+
         self.conn = conn
         self.config = config or MConnConfig()
         self.on_receive = on_receive
         self.on_error = on_error
+        self.metrics = metrics if metrics is not None else P2PMetrics()
+        self.peer_id = peer_id
         self.channels: dict[int, _Channel] = {
-            d.id: _Channel(d) for d in channels
+            d.id: _Channel(d, self.metrics, peer_id) for d in channels
         }
+        self._m_pending = self.metrics.peer_pending_send_bytes.labels(
+            peer_id=peer_id
+        )
+        self._m_rtt = self.metrics.ping_rtt_seconds.labels(peer_id=peer_id)
+        self._m_send_rate = self.metrics.send_rate_bytes.labels(
+            peer_id=peer_id
+        )
+        self._m_recv_rate = self.metrics.recv_rate_bytes.labels(
+            peer_id=peer_id
+        )
         self._send_signal = threading.Event()
         self._last_pong = time.monotonic()
+        # FIFO of outstanding-ping send times: TCP ordering means the
+        # nth pong answers the nth ping, so popping the OLDEST stamp
+        # attributes RTTs correctly even when RTT > ping_interval (a
+        # single latest-stamp slot would report RTT mod ping_interval
+        # on exactly the degraded links the metric exists to expose)
+        self._ping_sent_q: deque[float] = deque()
+        self.last_rtt: float | None = None
+        self.last_error: str | None = None
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
         self._send_thread: threading.Thread | None = None
@@ -199,12 +267,19 @@ class MConnection(BaseService):
         self._send_monitor.done()
         self._recv_monitor.done()
         self._send_signal.set()
+        # a dead connection must not leave stale backpressure gauges
+        # pointing at queues nobody will ever drain
+        self._m_pending.set(0)
+        for ch in self.channels.values():
+            ch.m_send_queue_size.set(0)
+            ch.m_send_queue_bytes.set(0)
         self.conn.close()
 
     def _stop_for_error(self, err: Exception) -> None:
         if self._errored.is_set():
             return
         self._errored.set()
+        self.last_error = repr(err)
         self.logger.debug("connection error", err=repr(err))
         try:
             if self.is_running():
@@ -223,10 +298,19 @@ class MConnection(BaseService):
             raise MConnError(f"unknown channel {ch_id:#x}")
         if not self.is_running():
             return False
-        try:
-            ch.send_queue.put(msg, timeout=timeout)
-        except queue.Full:
-            return False
+        with TRACER.span(
+            "channel_enqueue", cat="p2p", ch=f"{ch_id:#x}", bytes=len(msg)
+        ) as sp:
+            ch.note_enqueued(len(msg))
+            try:
+                ch.send_queue.put(msg, timeout=timeout)
+            except queue.Full:
+                ch.note_enqueued(-len(msg))
+                ch.m_send_timeouts.inc()
+                sp.set(dropped="timeout")
+                return False
+        ch._update_gauges()
+        self._update_pending_gauge()
         self._send_signal.set()
         return True
 
@@ -237,12 +321,28 @@ class MConnection(BaseService):
             raise MConnError(f"unknown channel {ch_id:#x}")
         if not self.is_running():
             return False
-        try:
-            ch.send_queue.put_nowait(msg)
-        except queue.Full:
-            return False
+        with TRACER.span(
+            "channel_enqueue", cat="p2p", ch=f"{ch_id:#x}", bytes=len(msg)
+        ) as sp:
+            ch.note_enqueued(len(msg))
+            try:
+                ch.send_queue.put_nowait(msg)
+            except queue.Full:
+                ch.note_enqueued(-len(msg))
+                ch.m_try_send_failures.inc()
+                sp.set(dropped="full")
+                return False
+        ch._update_gauges()
+        self._update_pending_gauge()
         self._send_signal.set()
         return True
+
+    def pending_send_bytes(self) -> int:
+        """Bytes across all channels still awaiting the send routine."""
+        return sum(ch.queued_bytes for ch in self.channels.values())
+
+    def _update_pending_gauge(self) -> None:
+        self._m_pending.set(self.pending_send_bytes())
 
     def _select_channel(self) -> _Channel | None:
         """Lowest recently-sent/priority ratio wins (connection.go:549)."""
@@ -277,6 +377,11 @@ class MConnection(BaseService):
                 framed = encode_uvarint(len(pkt)) + pkt
                 buf += framed
                 ch.recently_sent += len(framed)
+                if eof:
+                    # per-chunk gauge refresh is O(channels) locked
+                    # work in the frame pump; once per message loses
+                    # nothing at Prometheus scrape cadence
+                    self._update_pending_gauge()
                 self._send_monitor.limit(len(framed), cfg.send_rate)
                 self._send_monitor.update(len(framed))
                 now = time.monotonic()
@@ -290,7 +395,8 @@ class MConnection(BaseService):
 
     def _flush(self, buf: bytearray) -> None:
         if buf:
-            self.conn.write(bytes(buf))
+            with TRACER.span("frame_pump", cat="p2p", bytes=len(buf)):
+                self.conn.write(bytes(buf))
 
     def _decay_recently_sent(self) -> None:
         for ch in self.channels.values():
@@ -308,13 +414,23 @@ class MConnection(BaseService):
         cfg = self.config
         while not self._quit.wait(cfg.ping_interval):
             try:
+                # stamp BEFORE the write so socket backpressure on
+                # the ping itself counts into the observed RTT
+                self._ping_sent_q.append(time.monotonic())
                 self.send_ping()
             except Exception as exc:  # noqa: BLE001
                 self._stop_for_error(exc)
                 return
+            self._sample_flowrate()
             if time.monotonic() - self._last_pong > cfg.pong_timeout:
                 self._stop_for_error(MConnError("pong timeout"))
                 return
+
+    def _sample_flowrate(self) -> None:
+        """Mirror the flowrate monitors into the per-peer throughput
+        gauges (Monitor.status() EMA, sampled at keepalive cadence)."""
+        self._m_send_rate.set(self._send_monitor.status()["rate_avg"])
+        self._m_recv_rate.set(self._recv_monitor.status()["rate_avg"])
 
     # -- receiving (connection.go:590 recvRoutine) ----------------------
 
@@ -337,6 +453,11 @@ class MConnection(BaseService):
                     self._send_pong()
                 elif pkt[0] == "pong":
                     self._last_pong = time.monotonic()
+                    if self._ping_sent_q:
+                        self.last_rtt = (
+                            self._last_pong - self._ping_sent_q.popleft()
+                        )
+                        self._m_rtt.observe(self.last_rtt)
                 else:
                     _, ch_id, eof, payload = pkt
                     ch = self.channels.get(ch_id)
@@ -357,15 +478,25 @@ class MConnection(BaseService):
     # -- introspection --------------------------------------------------
 
     def status(self) -> dict:
+        """(connection.go Status) — live connection snapshot: flowrate
+        monitors, ping RTT, queue state per channel, and — so
+        /net_info shows WHY a peer connection died, not just that it
+        did — the last error recorded by ``_stop_for_error``."""
         return {
             "send": self._send_monitor.status(),
             "recv": self._recv_monitor.status(),
+            "ping_rtt": self.last_rtt,
+            "pending_send_bytes": self.pending_send_bytes(),
+            "last_error": self.last_error,
             "channels": [
                 {
                     "id": ch.desc.id,
                     "priority": ch.desc.priority,
                     "recently_sent": ch.recently_sent,
                     "send_queue_size": ch.send_queue.qsize(),
+                    "send_queue_capacity": ch.desc.send_queue_capacity,
+                    "send_queue_bytes": ch.queued_bytes,
+                    "fill_ratio": round(ch.fill_ratio(), 4),
                 }
                 for ch in self.channels.values()
             ],

@@ -23,6 +23,7 @@ import errno
 import select
 import struct
 import threading
+import time
 
 from cryptography.hazmat.primitives.asymmetric.x25519 import (
     X25519PrivateKey,
@@ -32,6 +33,7 @@ from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.exceptions import InvalidTag
 
 from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
+from cometbft_tpu.metrics import p2p_metrics as _p2p_metrics
 from cometbft_tpu.p2p.conn import frame_native
 
 # Load (and if needed compile) the native frame pump at import time —
@@ -103,6 +105,7 @@ class SecretConnection:
     """
 
     def __init__(self, sock, priv_key: Ed25519PrivKey):
+        handshake_t0 = time.perf_counter()
         self._sock = sock
         self._send_mtx = threading.Lock()
         self._recv_mtx = threading.Lock()
@@ -175,6 +178,12 @@ class SecretConnection:
         if not their_pub.verify_signature(challenge, their_sig):
             raise AuthError("peer failed challenge signature")
         self.remote_pubkey = their_pub
+        # only a COMPLETED handshake is observed — a failed one raised
+        # above, and its latency would skew the histogram with peer
+        # misbehavior rather than our DH/HKDF/signature cost
+        _p2p_metrics().handshake_duration_seconds.observe(
+            time.perf_counter() - handshake_t0
+        )
 
     # -- framed I/O (secret_connection.go:210 Write / :250 Read) --------
 
@@ -195,6 +204,9 @@ class SecretConnection:
         total = len(data)
         with self._send_mtx:
             nframes = frame_native.frame_count(total)
+            _p2p_metrics().secret_frames_total.labels(
+                direction="seal"
+            ).inc(nframes)
             # measured crossover (tools/bench_frames.py): the pump wins
             # 2-5x on multi-frame bursts, but a single frame pays more
             # in call overhead than it saves — route those to the
@@ -326,6 +338,9 @@ class SecretConnection:
                     sealed,
                 )
                 self._recv_nonce.take(opened)
+                _p2p_metrics().secret_frames_total.labels(
+                    direction="open"
+                ).inc(opened)
                 if err is not None:
                     # sequential semantics: everything a frame-by-frame
                     # reader would have delivered before the bad frame
@@ -340,6 +355,9 @@ class SecretConnection:
                 )
             except InvalidTag as exc:
                 raise SecretConnectionError("frame auth failed") from exc
+            _p2p_metrics().secret_frames_total.labels(
+                direction="open"
+            ).inc()
             (length,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
             if length > DATA_MAX_SIZE:
                 raise SecretConnectionError("invalid frame length")

@@ -35,6 +35,8 @@ class Peer(BaseService):
         persistent: bool = False,
         socket_addr: NetAddress | None = None,
         mconn_config: MConnConfig | None = None,
+        metrics=None,
+        channel_names: dict[int, str] | None = None,
         logger: Logger | None = None,
     ):
         super().__init__(
@@ -42,10 +44,14 @@ class Peer(BaseService):
             logger=logger
             or default_logger().with_fields(module="peer", peer=node_info.node_id[:8]),
         )
+        from cometbft_tpu.metrics import P2PMetrics
+
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
         self.socket_addr = socket_addr
+        self.metrics = metrics if metrics is not None else P2PMetrics()
+        self._channel_names = channel_names or {}
         self._data: dict[str, object] = {}
         self._data_mtx = threading.Lock()
         self.mconn = MConnection(
@@ -54,6 +60,8 @@ class Peer(BaseService):
             on_receive=lambda ch_id, msg: on_receive(self, ch_id, msg),
             on_error=(lambda err: on_error(self, err)) if on_error else None,
             config=mconn_config,
+            metrics=self.metrics,
+            peer_id=node_info.node_id,
             logger=self.logger,
         )
 
@@ -83,12 +91,27 @@ class Peer(BaseService):
     def send(self, ch_id: int, msg: bytes) -> bool:
         if not self.is_running() or not self.node_info.has_channel(ch_id):
             return False
-        return self.mconn.send(ch_id, msg)
+        ok = self.mconn.send(ch_id, msg)
+        if ok:
+            self._count_send(ch_id, len(msg))
+        return ok
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
         if not self.is_running() or not self.node_info.has_channel(ch_id):
             return False
-        return self.mconn.try_send(ch_id, msg)
+        ok = self.mconn.try_send(ch_id, msg)
+        if ok:
+            self._count_send(ch_id, len(msg))
+        return ok
+
+    def _count_send(self, ch_id: int, nbytes: int) -> None:
+        """Only successful enqueues count: a dropped try_send shows up
+        in try_send_failures, not in bytes the peer never got."""
+        self.metrics.message_send_bytes_total.labels(
+            chID=f"{ch_id:#x}",
+            message_type=self._channel_names.get(ch_id, ""),
+            peer_id=self.id,
+        ).inc(nbytes)
 
     # -- per-reactor annotations (peer.go Set/Get) ----------------------
 

@@ -23,6 +23,7 @@ from cometbft_tpu.p2p.peer import Peer, PeerSet
 from cometbft_tpu.p2p.transport import MultiplexTransport, RejectedError
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.trace import TRACER
 
 RECONNECT_ATTEMPTS = 20          # switch.go reconnectAttempts
 RECONNECT_BASE_INTERVAL = 0.5    # (shortened from 5s for test cadence; prod sets via config)
@@ -56,6 +57,11 @@ class Switch(BaseService):
         self.reactors: dict[str, Reactor] = {}
         self._channels: list[ChannelDescriptor] = []
         self._reactor_by_channel: dict[int, Reactor] = {}
+        #: channel id -> owning reactor's registration name; the
+        #: message_type label on the byte counters (per-channel
+        #: granularity — the closest analog to the reference's
+        #: per-proto-message label without decoding payloads here)
+        self.channel_names: dict[int, str] = {}
         self._dialing: set[str] = set()
         self._reconnecting: set[str] = set()
         self._persistent_addrs: dict[str, NetAddress] = {}
@@ -75,6 +81,7 @@ class Switch(BaseService):
                 )
             self._channels.append(desc)
             self._reactor_by_channel[desc.id] = reactor
+            self.channel_names[desc.id] = name
         self.reactors[name] = reactor
         reactor.set_switch(self)
         return reactor
@@ -220,6 +227,8 @@ class Switch(BaseService):
             persistent=persistent,
             socket_addr=addr,
             mconn_config=self.mconn_config,
+            metrics=self.metrics,
+            channel_names=self.channel_names,
             logger=self.logger.with_fields(peer=ni.node_id[:8]),
         )
         for reactor in self.reactors.values():
@@ -246,14 +255,25 @@ class Switch(BaseService):
         return True
 
     def _dispatch(self, peer: Peer, ch_id: int, msg: bytes) -> None:
-        self.metrics.message_receive_bytes_total.labels(
-            chID=f"{ch_id:#x}"
-        ).inc(len(msg))
         reactor = self._reactor_by_channel.get(ch_id)
         if reactor is None:
+            # don't count first: an unregistered chID would mint a
+            # counter child _drop_peer_gauges can never retire (it
+            # iterates channel_names), letting a byzantine peer leak
+            # one series per bogus channel
             self.stop_peer_for_error(peer, f"unknown channel {ch_id:#x}")
             return
-        reactor.receive(Envelope(channel_id=ch_id, src=peer, message=msg))
+        name = self.channel_names.get(ch_id, "")
+        self.metrics.message_receive_bytes_total.labels(
+            chID=f"{ch_id:#x}", message_type=name, peer_id=peer.id
+        ).inc(len(msg))
+        with TRACER.span(
+            "switch_dispatch", cat="p2p", ch=f"{ch_id:#x}",
+            reactor=name, bytes=len(msg),
+        ):
+            reactor.receive(
+                Envelope(channel_id=ch_id, src=peer, message=msg)
+            )
 
     def _on_peer_error(self, peer: Peer, err) -> None:
         self.stop_peer_for_error(peer, err)
@@ -284,20 +304,57 @@ class Switch(BaseService):
                 peer.stop()
         except Exception:  # noqa: BLE001 — teardown is best-effort
             pass
+        self._drop_peer_gauges(peer)
         for reactor in self.reactors.values():
             reactor.remove_peer(peer, reason)
+
+    def _drop_peer_gauges(self, peer: Peer) -> None:
+        """Retire EVERY peer_id-labeled child of the departed peer — a
+        reconnect re-creates them; leaving any (gauges, the RTT
+        histogram, the per-channel counters) would grow label
+        cardinality forever under peer churn.  Counter removal reads
+        as a reset to Prometheus, which rate() already tolerates."""
+        # the recv thread may still be mid-dispatch for an already-read
+        # message; let it exit first or its .labels() calls re-mint the
+        # children removed below (skip when we ARE that thread — the
+        # error path stops the peer from inside its own recv loop)
+        t = peer.mconn._recv_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=0.5)
+        m = self.metrics
+        m.peer_pending_send_bytes.remove(peer_id=peer.id)
+        m.send_rate_bytes.remove(peer_id=peer.id)
+        m.recv_rate_bytes.remove(peer_id=peer.id)
+        m.num_txs.remove(peer_id=peer.id)
+        m.ping_rtt_seconds.remove(peer_id=peer.id)
+        for ch_id, name in self.channel_names.items():
+            cid = f"{ch_id:#x}"
+            m.send_queue_size.remove(peer_id=peer.id, chID=cid)
+            m.send_queue_bytes.remove(peer_id=peer.id, chID=cid)
+            m.send_timeouts.remove(peer_id=peer.id, chID=cid)
+            m.try_send_failures.remove(peer_id=peer.id, chID=cid)
+            m.message_send_bytes_total.remove(
+                peer_id=peer.id, chID=cid, message_type=name
+            )
+            m.message_receive_bytes_total.remove(
+                peer_id=peer.id, chID=cid, message_type=name
+            )
 
     # -- fan-out (switch.go:269 Broadcast) ------------------------------
 
     def broadcast(self, ch_id: int, msg: bytes) -> None:
         """Fire-and-forget to every peer via the per-channel send
         queues — a full queue drops rather than blocks, matching the
-        reference's async Broadcast semantics."""
-        self.metrics.message_send_bytes_total.labels(
-            chID=f"{ch_id:#x}"
-        ).inc(len(msg) * self.peers.size())
-        for peer in self.peers.copy():
-            peer.try_send(ch_id, msg)
+        reference's async Broadcast semantics.  Byte accounting lives
+        in Peer._count_send so only peers that actually accepted the
+        message count (a dropped try_send is a try_send_failure)."""
+        peers = self.peers.copy()
+        with TRACER.span(
+            "switch_broadcast", cat="p2p", ch=f"{ch_id:#x}",
+            bytes=len(msg), peers=len(peers),
+        ):
+            for peer in peers:
+                peer.try_send(ch_id, msg)
 
     def num_peers(self) -> dict:
         peers = self.peers.copy()

@@ -87,7 +87,10 @@ class Environment:
         blocksync_reactor=None,
         statesync_reactor=None,
         unsafe=False,
+        metrics=None,
     ):
+        from cometbft_tpu.metrics import RPCMetrics
+
         self.block_store = block_store
         self.state_store = state_store
         self.consensus = consensus
@@ -106,6 +109,7 @@ class Environment:
         self.blocksync_reactor = blocksync_reactor
         self.statesync_reactor = statesync_reactor
         self.unsafe = unsafe
+        self.metrics = metrics if metrics is not None else RPCMetrics()
         self._gen_chunks: list[str] | None = None  # lazy (env.go InitGenesisChunks)
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
         self._subs_mtx = threading.Lock()
@@ -148,6 +152,7 @@ class Environment:
             "abci_info": self.abci_info,
             "genesis_chunked": self.genesis_chunked,
             "check_tx": self.check_tx,
+            "wire": self.wire,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -242,7 +247,9 @@ class Environment:
         return str(val.voting_power) if val else "0"
 
     def net_info(self) -> dict:
-        """(rpc/core/net.go NetInfo)"""
+        """(rpc/core/net.go NetInfo) — each peer carries its live
+        ``connection_status`` (MConnection.status(): flowrate monitors,
+        ping RTT, per-channel queue state, last error)."""
         peers = []
         if self.switch is not None:
             for peer in self.switch.peers.copy():
@@ -255,6 +262,7 @@ class Environment:
                             "network": peer.node_info.network,
                         },
                         "is_outbound": peer.is_outbound(),
+                        "connection_status": peer.status(),
                         "remote_ip": (
                             peer.socket_addr.host if peer.socket_addr else ""
                         ),
@@ -271,6 +279,26 @@ class Environment:
             "n_peers": str(len(peers)),
             "peers": peers,
         }
+
+    def wire(self) -> dict:
+        """Live wire-plane snapshot (no reference analog): the peer
+        table with per-channel queue depth/bytes/fill ratio, pending
+        send bytes, ping RTT, flowrate throughput, and the last
+        connection error — the /net_info subset an operator greps
+        when a peer stalls (docs/observability.md runbook)."""
+        peers = []
+        if self.switch is not None:
+            for peer in self.switch.peers.copy():
+                peers.append(
+                    {
+                        "peer_id": peer.id,
+                        "moniker": peer.node_info.moniker,
+                        "is_outbound": peer.is_outbound(),
+                        "is_persistent": peer.is_persistent(),
+                        "connection_status": peer.status(),
+                    }
+                )
+        return {"n_peers": str(len(peers)), "peers": peers}
 
     def genesis_route(self) -> dict:
         import json as _json
@@ -779,6 +807,7 @@ class Environment:
         )
         with self._subs_mtx:
             self._subs.setdefault(_ws_ctx.client_id, {})[query] = sub
+            self._set_ws_subscriptions_locked()
         threading.Thread(
             target=self._pump_subscription,
             args=(sub, q, _ws_ctx, query),
@@ -787,6 +816,22 @@ class Environment:
         return {}
 
     def _pump_subscription(self, sub, q, ws_ctx, query_str) -> None:
+        try:
+            self._pump_subscription_loop(sub, ws_ctx, query_str)
+        finally:
+            # a pubsub-canceled subscription (slow consumer) must come
+            # off the books too, or ws_subscriptions keeps counting it
+            # as live while subscriber_dropped_total says otherwise;
+            # idempotent vs unsubscribe/drop_client (both pop first)
+            with self._subs_mtx:
+                qs = self._subs.get(ws_ctx.client_id)
+                if qs is not None and qs.get(query_str) is sub:
+                    del qs[query_str]
+                    if not qs:
+                        del self._subs[ws_ctx.client_id]
+                    self._set_ws_subscriptions_locked()
+
+    def _pump_subscription_loop(self, sub, ws_ctx, query_str) -> None:
         while ws_ctx.alive:
             try:
                 msg = sub.next(timeout=0.2)
@@ -809,11 +854,17 @@ class Environment:
             if not ws_ctx.send(payload):
                 return
 
+    def _set_ws_subscriptions_locked(self) -> None:
+        self.metrics.ws_subscriptions.set(
+            sum(len(qs) for qs in self._subs.values())
+        )
+
     def unsubscribe(self, query=None, _ws_ctx=None) -> dict:
         if _ws_ctx is None:
             raise RPCError(-32603, "unsubscribe requires a websocket")
         with self._subs_mtx:
             self._subs.get(_ws_ctx.client_id, {}).pop(query, None)
+            self._set_ws_subscriptions_locked()
         self.event_bus.unsubscribe(_ws_ctx.client_id, Query.parse(query))
         return {}
 
@@ -826,6 +877,7 @@ class Environment:
     def drop_client(self, client_id: str) -> None:
         with self._subs_mtx:
             self._subs.pop(client_id, None)
+            self._set_ws_subscriptions_locked()
         try:
             self.event_bus.unsubscribe_all(client_id)
         except Exception:  # noqa: BLE001

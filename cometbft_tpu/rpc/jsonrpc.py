@@ -17,11 +17,13 @@ import json
 import socket
 import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
 
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.trace import TRACER
 
 # JSON-RPC error codes (rpc/jsonrpc/types/types.go)
 ERR_PARSE = -32700
@@ -131,15 +133,19 @@ class JSONRPCServer(BaseService):
         host: str = "127.0.0.1",
         port: int = 0,
         on_ws_disconnect=None,
+        metrics=None,
         logger: Logger | None = None,
     ):
         super().__init__(
             name="jsonrpc",
             logger=logger or default_logger().with_fields(module="rpc-server"),
         )
+        from cometbft_tpu.metrics import RPCMetrics
+
         self.routes = routes
         self.ws_routes = ws_routes or {}
         self.on_ws_disconnect = on_ws_disconnect
+        self.metrics = metrics if metrics is not None else RPCMetrics()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -154,6 +160,7 @@ class JSONRPCServer(BaseService):
 
             def _send_json(self, obj, status=200):
                 body = json.dumps(obj).encode()
+                outer.metrics.response_size_bytes.observe(len(body))
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -219,6 +226,36 @@ class JSONRPCServer(BaseService):
     # -- dispatch ---------------------------------------------------------
 
     def _dispatch(self, req: dict, ws_ctx=None) -> dict:
+        """Instrumented wrapper: in-flight gauge, per-route latency and
+        outcome, and an rpc_dispatch span around the handler.  Unknown
+        methods collapse to route="_unknown" so a client probing random
+        names can't mint unbounded label children."""
+        method = req.get("method", "") if isinstance(req, dict) else ""
+        known = isinstance(method, str) and (
+            method in self.routes or method in self.ws_routes
+        )
+        route = method if known else "_unknown"
+        m = self.metrics
+        m.requests_in_flight.inc()
+        t0 = time.perf_counter()
+        # default covers handlers that raise something other than
+        # RPCError/TypeError: the exception propagates, but the route
+        # must still count (else requests_total and the duration
+        # histogram permanently disagree for crashed requests)
+        status = "error"
+        try:
+            with TRACER.span("rpc_dispatch", cat="rpc", route=route):
+                resp = self._dispatch_inner(req, ws_ctx)
+            status = "error" if "error" in resp else "ok"
+            return resp
+        finally:
+            m.requests_in_flight.inc(-1)
+            m.request_duration_seconds.labels(route=route).observe(
+                time.perf_counter() - t0
+            )
+            m.requests_total.labels(route=route, status=status).inc()
+
+    def _dispatch_inner(self, req: dict, ws_ctx=None) -> dict:
         # the body may decode to null / a scalar / a list element that
         # isn't an object — answer Invalid Request, never crash the
         # connection (fuzz: rpc_jsonrpc_server_test.go)
@@ -306,6 +343,7 @@ class JSONRPCServer(BaseService):
                     return False
 
         ctx = WSContext()
+        self.metrics.ws_connections.inc()
         try:
             while not self._quit.is_set():
                 frame = ws_read_frame(handler.rfile)
@@ -330,6 +368,7 @@ class JSONRPCServer(BaseService):
             pass
         finally:
             ctx.alive = False
+            self.metrics.ws_connections.inc(-1)
             if self.on_ws_disconnect is not None:
                 try:
                     self.on_ws_disconnect(client_id)

@@ -9,6 +9,8 @@ on app-defined attributes.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -125,9 +127,29 @@ class EventDataEvidence:
 class EventBus(BaseService):
     """(types/event_bus.go:34)"""
 
-    def __init__(self, capacity: int = 1000):
+    def __init__(self, capacity: int = 1000, metrics=None):
         super().__init__(name="EventBus")
-        self._server = Server(capacity=capacity)
+        from cometbft_tpu.metrics import EventBusMetrics
+
+        self.metrics = (
+            metrics if metrics is not None else EventBusMetrics()
+        )
+        self._server = Server(capacity=capacity, on_drop=self._on_drop)
+        #: clients currently holding a queue-depth gauge child, so a
+        #: departed client's series is retired instead of lingering;
+        #: the sweep is serialized (publish thread vs RPC unsubscribe)
+        #: or a race could re-mint a child after its retirement and
+        #: leak the series forever (per-connection ids never return)
+        self._gauged_clients: set[str] = set()
+        self._gauged_mtx = threading.Lock()
+
+    def _on_drop(self, client_id: str) -> None:
+        # per-client attribution lives in the log (client ids are
+        # per-connection; labeling the counter would leak children)
+        self.logger.info(
+            "slow subscriber canceled", client=client_id
+        )
+        self.metrics.subscriber_dropped_total.inc()
 
     def on_start(self) -> None:
         pass
@@ -144,9 +166,16 @@ class EventBus(BaseService):
 
     def unsubscribe(self, client_id: str, query: Query | str) -> None:
         self._server.unsubscribe(client_id, query)
+        # retire the departed client's gauge child NOW — waiting for
+        # the next publish leaves a stale depth on /metrics exactly
+        # when the bus goes idle (e.g. a halted chain mid-incident)
+        if self.metrics.subscriber_queue_depth:
+            self._update_queue_gauges()
 
     def unsubscribe_all(self, client_id: str) -> None:
         self._server.unsubscribe_all(client_id)
+        if self.metrics.subscriber_queue_depth:
+            self._update_queue_gauges()
 
     def num_clients(self) -> int:
         return self._server.num_clients()
@@ -161,7 +190,29 @@ class EventBus(BaseService):
         if events:
             for k, v in events.items():
                 base.setdefault(k, []).extend(v)
+        t0 = time.perf_counter()
         self._server.publish(data, base)
+        self.metrics.publish_duration_seconds.observe(
+            time.perf_counter() - t0
+        )
+        # the depth sweep re-locks the pubsub server and walks every
+        # subscription — skip it entirely when nothing consumes it
+        # (the no-op sink is falsy)
+        if self.metrics.subscriber_queue_depth:
+            self._update_queue_gauges()
+
+    def _update_queue_gauges(self) -> None:
+        """Mirror per-subscriber backlog into the queue-depth gauge and
+        retire children of clients that have unsubscribed/been dropped
+        (label-cardinality hygiene under WS client churn)."""
+        with self._gauged_mtx:
+            depths = self._server.queue_depths()
+            gauge = self.metrics.subscriber_queue_depth
+            for client_id, depth in depths.items():
+                gauge.labels(client_id=client_id).set(depth)
+            for client_id in self._gauged_clients - set(depths):
+                gauge.remove(client_id=client_id)
+            self._gauged_clients = set(depths)
 
     def publish_new_block(self, data: EventDataNewBlock) -> None:
         events = {BLOCK_HEIGHT_KEY: [str(data.block.header.height)]}

@@ -27,6 +27,7 @@ class Monitor:
         self.start = time.monotonic()
         self.bytes_total = 0
         self.rate_avg = 0.0  # EMA bytes/sec
+        self.rate_peak = 0.0  # highest EMA sample seen
         self._sample_bytes = 0
         self._sample_start = self.start
         self._window = window
@@ -48,6 +49,8 @@ class Monitor:
         while elapsed >= self._sample_period:
             rate = self._sample_bytes / self._sample_period
             self.rate_avg += self._alpha * (rate - self.rate_avg)
+            if self.rate_avg > self.rate_peak:
+                self.rate_peak = self.rate_avg
             self._sample_bytes = 0
             self._sample_start += self._sample_period
             elapsed -= self._sample_period
@@ -68,6 +71,7 @@ class Monitor:
                 "bytes": self.bytes_total,
                 "duration": dur,
                 "rate_avg": self.rate_avg,
+                "rate_peak": self.rate_peak,
                 "rate_mean": self.bytes_total / dur,
             }
 

@@ -215,11 +215,16 @@ class Subscription:
 
 class Server:
     """Pub/sub hub (pubsub.go Server).  Slow subscribers are canceled
-    rather than blocking publishers (out-of-capacity policy)."""
+    rather than blocking publishers (out-of-capacity policy).
 
-    def __init__(self, capacity: int = 100):
+    ``on_drop(client_id)`` fires once per out-of-capacity cancellation
+    — the event bus feeds it into the subscriber-drop counter without
+    this module depending on the metrics plane."""
+
+    def __init__(self, capacity: int = 100, on_drop=None):
         self._mtx = threading.RLock()
         self._capacity = capacity
+        self._on_drop = on_drop
         self._subs: dict[tuple[str, Query], Subscription] = {}
 
     def subscribe(
@@ -267,6 +272,23 @@ class Server:
                         dead.append(key)
             for key in dead:
                 del self._subs[key]
+        for key in dead:
+            if self._on_drop is not None:
+                try:
+                    self._on_drop(key[0])
+                except Exception:  # noqa: BLE001 — telemetry must not kill publish
+                    pass
+
+    def queue_depths(self) -> dict[str, int]:
+        """Deepest undelivered-message queue per client id — the
+        backpressure signal the event-bus gauge exposes."""
+        with self._mtx:
+            out: dict[str, int] = {}
+            for (cid, _), sub in self._subs.items():
+                depth = sub._q.qsize()
+                if depth > out.get(cid, -1):
+                    out[cid] = depth
+            return out
 
     def num_clients(self) -> int:
         with self._mtx:

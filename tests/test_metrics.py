@@ -292,6 +292,43 @@ class TestNodeMetricsEndToEnd:
                     break
             else:
                 raise AssertionError("step duration series missing")
+            # wire-plane families (PR-2): every new series name is
+            # exposed (HELP/TYPE emit even before a labelset exists),
+            # and the event bus has live publish samples from the
+            # blocks committed above
+            wire_series = [
+                "cometbft_p2p_peer_pending_send_bytes",
+                "cometbft_p2p_num_txs",
+                "cometbft_p2p_ping_rtt_seconds",
+                "cometbft_p2p_send_queue_size",
+                "cometbft_p2p_send_queue_bytes",
+                "cometbft_p2p_send_timeouts",
+                "cometbft_p2p_try_send_failures",
+                "cometbft_p2p_send_rate_bytes",
+                "cometbft_p2p_recv_rate_bytes",
+                "cometbft_p2p_handshake_duration_seconds",
+                "cometbft_p2p_secret_frames_total",
+                "cometbft_rpc_requests_total",
+                "cometbft_rpc_request_duration_seconds",
+                "cometbft_rpc_requests_in_flight",
+                "cometbft_rpc_response_size_bytes",
+                "cometbft_rpc_ws_connections",
+                "cometbft_rpc_ws_subscriptions",
+                "cometbft_event_bus_publish_duration_seconds",
+                "cometbft_event_bus_subscriber_queue_depth",
+                "cometbft_event_bus_subscriber_dropped_total",
+            ]
+            missing = [s for s in wire_series if s not in body]
+            assert not missing, f"wire series missing: {missing}"
+            assert len(wire_series) >= 12
+            for line in body.splitlines():
+                if line.startswith(
+                    "cometbft_event_bus_publish_duration_seconds_count"
+                ):
+                    assert float(line.split()[-1]) >= 1
+                    break
+            else:
+                raise AssertionError("event bus publish count missing")
             # /trace next to /metrics: Chrome trace-event JSON with
             # consensus-step spans and a VerifyCommit span nested
             # inside one (same thread, time-contained)
@@ -335,7 +372,8 @@ class TestNopParity:
 
         for cls in (
             M.ConsensusMetrics, M.MempoolMetrics, M.P2PMetrics,
-            M.StateMetrics, M.CryptoMetrics,
+            M.StateMetrics, M.CryptoMetrics, M.RPCMetrics,
+            M.EventBusMetrics,
         ):
             real = vars(cls(Registry())).keys()
             nop = vars(cls(None)).keys()
@@ -357,3 +395,462 @@ class TestNopParity:
                 field.set(1.0)
                 field.observe(0.25)
                 field.labels(peer_id="p", chID="0x0").inc()
+
+
+# -- wire-plane telemetry (PR-2; `make wire-smoke` runs -k wire) --------
+
+def _wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _gauge_value(reg, name, **labels):
+    """Read one series value out of the text exposition (None if the
+    series is absent)."""
+    import re as _re
+
+    text = reg.expose()
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        m = _re.match(r"(\{[^}]*\})?\s+(\S+)$", rest)
+        if m is None:
+            continue
+        lbl = m.group(1) or ""
+        if all(f'{k}="{v}"' in lbl for k, v in labels.items()):
+            return float(m.group(2))
+    return None
+
+
+class _PlainConn:
+    """Raw-socket conn wrapper for loopback MConnection tests (the
+    write/read_exact/close surface MConnection needs).  ``gate``: an
+    Event writes block on (backpressure); ``writes_entered >
+    writes_done`` <=> a writer thread is currently parked inside the
+    gate — the deterministic "send routine is stuck" signal the
+    backpressure test waits for."""
+
+    def __init__(self, sock, gate=None):
+        self.sock = sock
+        self.gate = gate
+        self.writes_entered = 0
+        self.writes_done = 0
+
+    def write(self, b):
+        self.writes_entered += 1
+        if self.gate is not None:
+            self.gate.wait()
+        self.sock.sendall(b)
+        self.writes_done += 1
+        return len(b)
+
+    def read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.sock.close()
+
+
+class TestWireMetrics:
+    """Loopback MConnection pair, RPC dispatch, and event-bus
+    backpressure — the wire-plane layer (docs/observability.md)."""
+
+    def _mconn_over_socketpair(self, m, chs=None, gate=None, **cfg_kw):
+        """One instrumented MConnection (peer 'wire-a') talking to a
+        plain echo-side MConnection over a socketpair.  ``gate``: an
+        Event the instrumented side's writes block on (backpressure)."""
+        import socket
+
+        from cometbft_tpu.p2p.conn.connection import (
+            ChannelDescriptor,
+            MConnConfig,
+            MConnection,
+        )
+
+        chs = chs or [ChannelDescriptor(id=0x01, priority=1)]
+        s1, s2 = socket.socketpair()
+        recv_a, recv_b = [], []
+        cfg = MConnConfig(**cfg_kw) if cfg_kw else None
+        ma = MConnection(
+            _PlainConn(s1, gate), chs,
+            lambda ch, msg: recv_a.append((ch, msg)),
+            config=cfg, metrics=m.p2p, peer_id="wire-a",
+        )
+        mb = MConnection(
+            _PlainConn(s2), chs,
+            lambda ch, msg: recv_b.append((ch, msg)),
+            config=cfg,
+        )
+        ma.start()
+        mb.start()
+        return ma, mb, recv_a, recv_b
+
+    def test_wire_queue_gauges_rise_and_drain(self):
+        pytest.importorskip("cryptography")
+        import threading as _threading
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        gate = _threading.Event()  # closed: writes block
+        ma, mb, _, recv_b = self._mconn_over_socketpair(m, gate=gate)
+        try:
+            payload = b"Q" * 2000
+            for _ in range(4):
+                assert ma.send(0x01, payload, timeout=1.0)
+            # the first message is in flight (stuck in the gated
+            # write); the rest queue up behind it
+            assert _wait_until(
+                lambda: (_gauge_value(
+                    reg, "cometbft_p2p_send_queue_size",
+                    peer_id="wire-a", chID="0x1",
+                ) or 0) >= 2
+            )
+            assert (_gauge_value(
+                reg, "cometbft_p2p_send_queue_bytes",
+                peer_id="wire-a", chID="0x1",
+            ) or 0) > 0
+            assert ma.pending_send_bytes() > 0
+            gate.set()  # open the pipe: everything drains
+            assert _wait_until(lambda: len(recv_b) == 4)
+            assert _wait_until(
+                lambda: _gauge_value(
+                    reg, "cometbft_p2p_peer_pending_send_bytes",
+                    peer_id="wire-a",
+                ) == 0.0
+            ), "peer_pending_send_bytes did not return to 0 after flush"
+            assert _gauge_value(
+                reg, "cometbft_p2p_send_queue_size",
+                peer_id="wire-a", chID="0x1",
+            ) == 0.0
+            assert _gauge_value(
+                reg, "cometbft_p2p_send_queue_bytes",
+                peer_id="wire-a", chID="0x1",
+            ) == 0.0
+        finally:
+            gate.set()
+            ma.stop()
+            mb.stop()
+
+    def test_wire_ping_rtt_observed_and_in_status(self):
+        pytest.importorskip("cryptography")
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        ma, mb, _, _ = self._mconn_over_socketpair(
+            m, ping_interval=0.05
+        )
+        try:
+            assert _wait_until(
+                lambda: (_gauge_value(
+                    reg, "cometbft_p2p_ping_rtt_seconds_count",
+                    peer_id="wire-a",
+                ) or 0) >= 1,
+            ), "no ping RTT observed"
+            st = ma.status()
+            assert st["ping_rtt"] is not None and st["ping_rtt"] >= 0
+            # flowrate gauges sampled on the same cadence
+            assert _gauge_value(
+                reg, "cometbft_p2p_send_rate_bytes", peer_id="wire-a"
+            ) is not None
+        finally:
+            ma.stop()
+            mb.stop()
+
+    def test_wire_backpressure_counters(self):
+        pytest.importorskip("cryptography")
+        import threading as _threading
+
+        from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        gate = _threading.Event()
+        ma, mb, _, _ = self._mconn_over_socketpair(
+            m,
+            chs=[ChannelDescriptor(id=0x01, priority=1,
+                                   send_queue_capacity=1)],
+            gate=gate,
+        )
+        try:
+            # prime the pump, then wait until it is provably parked in
+            # the gated write — from then on nothing drains the queue,
+            # so the fill below is deterministic
+            assert ma.try_send(0x01, b"x")
+            assert _wait_until(
+                lambda: ma.conn.writes_entered > ma.conn.writes_done
+            ), "send routine never reached the gated write"
+            while ma.try_send(0x01, b"x"):
+                pass
+            assert (_gauge_value(
+                reg, "cometbft_p2p_try_send_failures",
+                peer_id="wire-a", chID="0x1",
+            ) or 0) >= 1
+            assert not ma.send(0x01, b"y", timeout=0.02)
+            assert (_gauge_value(
+                reg, "cometbft_p2p_send_timeouts",
+                peer_id="wire-a", chID="0x1",
+            ) or 0) >= 1
+        finally:
+            gate.set()
+            ma.stop()
+            mb.stop()
+
+    def test_wire_status_carries_last_error_and_fill_ratio(self):
+        pytest.importorskip("cryptography")
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        ma, mb, _, _ = self._mconn_over_socketpair(m)
+        try:
+            st = ma.status()
+            assert st["last_error"] is None
+            ch = st["channels"][0]
+            assert {"fill_ratio", "send_queue_bytes",
+                    "send_queue_capacity"} <= set(ch)
+            ma._stop_for_error(ValueError("boom"))
+            st = ma.status()
+            assert "boom" in st["last_error"]
+        finally:
+            mb.stop()
+            if ma.is_running():
+                ma.stop()
+
+    def test_wire_switch_dispatch_labels_and_span(self):
+        pytest.importorskip("cryptography")
+        from cometbft_tpu.p2p.base_reactor import Reactor
+        from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+        from cometbft_tpu.p2p.switch import Switch
+        from cometbft_tpu.utils.trace import TRACER
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        got = []
+
+        class Sink(Reactor):
+            def __init__(self):
+                super().__init__(name="sink")
+
+            def get_channels(self):
+                return [ChannelDescriptor(id=0x7A, priority=1)]
+
+            def receive(self, env):
+                got.append(env)
+
+        sw = Switch(transport=object(), metrics=m.p2p)
+        sw.add_reactor("SINK", sw_r := Sink())
+        assert sw.channel_names[0x7A] == "SINK"
+
+        class StubPeer:
+            id = "stub-peer"
+
+        TRACER.clear()
+        sw._dispatch(StubPeer(), 0x7A, b"hello-wire")
+        assert len(got) == 1
+        assert _gauge_value(
+            reg, "cometbft_p2p_message_receive_bytes_total",
+            peer_id="stub-peer", chID="0x7a", message_type="SINK",
+        ) == float(len(b"hello-wire"))
+        names = [e["name"] for e in TRACER.events()]
+        assert "switch_dispatch" in names
+
+    def test_wire_broadcast_span_nesting_and_frame_pump(self):
+        """A gossiped message crosses switch -> channel -> frame pump;
+        the trace export shows switch_broadcast parenting
+        channel_enqueue, with frame_pump spans from the send thread."""
+        pytest.importorskip("cryptography")
+        import socket
+
+        from cometbft_tpu.p2p.base_reactor import Reactor
+        from cometbft_tpu.p2p.conn.connection import (
+            ChannelDescriptor,
+            MConnection,
+        )
+        from cometbft_tpu.p2p.node_info import NodeInfo
+        from cometbft_tpu.p2p.peer import Peer
+        from cometbft_tpu.p2p.switch import Switch
+        from cometbft_tpu.utils.trace import TRACER
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+
+        class Sink(Reactor):
+            def __init__(self):
+                super().__init__(name="sink")
+
+            def get_channels(self):
+                return [ChannelDescriptor(id=0x01, priority=1)]
+
+            def receive(self, env):
+                pass
+
+        sw = Switch(transport=object(), metrics=m.p2p)
+        sw.add_reactor("SINK", Sink())
+
+        s1, s2 = socket.socketpair()
+        ni = NodeInfo(
+            node_id="f" * 40, listen_addr="tcp://0:0",
+            network="wire-net", channels=bytes([0x01]), moniker="w",
+        )
+        recv = []
+        peer = Peer(
+            _PlainConn(s1), ni, sw._channels,
+            on_receive=lambda p, ch, msg: None,
+            metrics=m.p2p, channel_names=sw.channel_names,
+        )
+        other = MConnection(
+            _PlainConn(s2), [ChannelDescriptor(id=0x01, priority=1)],
+            lambda ch, msg: recv.append(msg),
+        )
+        sw.peers.add(peer)
+        peer.start()
+        other.start()
+        try:
+            TRACER.clear()
+            sw.broadcast(0x01, b"G" * 3000)
+            assert _wait_until(lambda: len(recv) == 1)
+            events = TRACER.events()
+            by_name = {}
+            for e in events:
+                by_name.setdefault(e["name"], []).append(e)
+            assert "switch_broadcast" in by_name
+            enq = by_name.get("channel_enqueue", [])
+            assert any(
+                e["args"].get("parent") == "switch_broadcast"
+                for e in enq
+            ), "channel_enqueue span not nested under switch_broadcast"
+            assert "frame_pump" in by_name, "no frame_pump span"
+            # send bytes counted per peer + message type
+            assert _gauge_value(
+                reg, "cometbft_p2p_message_send_bytes_total",
+                peer_id=ni.node_id, chID="0x1", message_type="SINK",
+            ) == 3000.0
+        finally:
+            peer.stop()
+            other.stop()
+
+    def test_wire_rpc_dispatch_metrics(self):
+        """Latency histogram + in-flight gauge + outcome counter +
+        unknown-route collapse, via JSONRPCServer._dispatch."""
+        pytest.importorskip("cryptography")  # rpc package import chain
+        from cometbft_tpu.rpc.jsonrpc import JSONRPCServer, RPCError
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        seen_inflight = []
+
+        def ping(**kw):
+            seen_inflight.append(
+                _gauge_value(reg, "cometbft_rpc_requests_in_flight")
+            )
+            return {"pong": True}
+
+        def boom(**kw):
+            raise RPCError(-32603, "nope")
+
+        srv = JSONRPCServer(
+            {"ping": ping, "boom": boom}, host="127.0.0.1", port=0,
+            metrics=m.rpc,
+        )
+        try:
+            resp = srv._dispatch(
+                {"jsonrpc": "2.0", "id": 1, "method": "ping"}
+            )
+            assert resp["result"] == {"pong": True}
+            assert seen_inflight == [1.0]  # gauge was up during dispatch
+            assert _gauge_value(
+                reg, "cometbft_rpc_requests_in_flight"
+            ) == 0.0
+            assert _gauge_value(
+                reg, "cometbft_rpc_requests_total",
+                route="ping", status="ok",
+            ) == 1.0
+            assert _gauge_value(
+                reg, "cometbft_rpc_request_duration_seconds_count",
+                route="ping",
+            ) == 1.0
+            srv._dispatch({"jsonrpc": "2.0", "id": 2, "method": "boom"})
+            assert _gauge_value(
+                reg, "cometbft_rpc_requests_total",
+                route="boom", status="error",
+            ) == 1.0
+            # unknown methods collapse to one label child
+            srv._dispatch({"jsonrpc": "2.0", "id": 3, "method": "zzz"})
+            srv._dispatch({"jsonrpc": "2.0", "id": 4, "method": "yyy"})
+            assert _gauge_value(
+                reg, "cometbft_rpc_requests_total",
+                route="_unknown", status="error",
+            ) == 2.0
+        finally:
+            srv._httpd.server_close()
+
+    def test_wire_event_bus_latency_depth_and_drops(self):
+        pytest.importorskip("cryptography")  # types package import chain
+        from cometbft_tpu.types.event_bus import (
+            EventBus,
+            EventDataRoundState,
+        )
+
+        reg = Registry()
+        from cometbft_tpu.metrics import NodeMetrics as NM
+
+        m = NM(reg)
+        bus = EventBus(metrics=m.event_bus)
+        bus.start()
+        try:
+            sub = bus.subscribe(
+                "slow-client", "tm.event='NewRoundStep'", capacity=1
+            )
+            data = EventDataRoundState(height=1, round=0, step="x")
+            bus.publish_new_round_step(data)  # fills the queue
+            assert _gauge_value(
+                reg,
+                "cometbft_event_bus_publish_duration_seconds_count",
+            ) >= 1.0
+            assert _gauge_value(
+                reg, "cometbft_event_bus_subscriber_queue_depth",
+                client_id="slow-client",
+            ) == 1.0
+            bus.publish_new_round_step(data)  # overflow: canceled
+            assert sub.canceled
+            assert _gauge_value(
+                reg, "cometbft_event_bus_subscriber_dropped_total",
+            ) == 1.0
+            # the departed client's depth gauge child is retired
+            bus.publish_new_round_step(data)
+            assert _gauge_value(
+                reg, "cometbft_event_bus_subscriber_queue_depth",
+                client_id="slow-client",
+            ) is None
+        finally:
+            bus.stop()
+
+    def test_wire_metric_child_remove(self):
+        reg = Registry()
+        g = reg.gauge("p2p", "x_demo", "demo", labels=("peer_id",))
+        g.labels(peer_id="a").set(5)
+        assert _gauge_value(reg, "cometbft_p2p_x_demo", peer_id="a") == 5.0
+        g.remove(peer_id="a")
+        assert _gauge_value(reg, "cometbft_p2p_x_demo", peer_id="a") is None

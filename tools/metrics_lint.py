@@ -1,18 +1,27 @@
-"""metrics-lint: every metric field registered in cometbft_tpu/metrics
-must be referenced by at least one subsystem.
+"""metrics-lint: the registered metric fields and the update sites in
+subsystem code must agree, in BOTH directions.
 
 The structs in cometbft_tpu/metrics/__init__.py are hand-maintained
-(the reference generates them with metricsgen); a field that is
-registered but never updated exposes a permanently-zero series — worse
-than no series, because dashboards and alerts trust it.  This checker
-instantiates every struct in no-op mode to enumerate the registered
-field names, then requires an ``.<field>`` attribute reference
-somewhere in the package outside the metrics module itself.
+(the reference generates them with metricsgen), so two failure modes
+exist:
 
-It is a tripwire, not a proof: a generic name like ``size`` is
+- **registered, never updated** — a permanently-zero series; worse
+  than no series, because dashboards and alerts trust it.  Checked by
+  ``find_unreferenced``: every field enumerated from the no-op structs
+  needs an ``.<field>`` attribute reference somewhere in the package
+  outside the metrics module itself.
+- **updated, never registered** — the inverse: an update site whose
+  field name matches nothing any struct registers (a typo, or a field
+  deleted while its call sites survive) silently updates a fresh
+  ``_Nop``/attribute and no series ever appears.  Checked by
+  ``find_unregistered``: every update-shaped attribute chain
+  (``.name.inc(`` / ``.observe(`` / ``.labels(`` / ``.set(<args>)``)
+  must resolve to a registered field.
+
+Both are tripwires, not proofs: a generic name like ``size`` is
 trivially satisfied by unrelated attribute access.  New metric names
 are deliberately specific (``key_pool_retraces``), which is where the
-check has teeth.
+checks have teeth.
 
     python tools/metrics_lint.py        # exit 0 clean, 1 with a report
 
@@ -33,8 +42,12 @@ if REPO not in sys.path:
 #: subsystem code scanned for references (tools/ and bench drivers
 #: count: the campaign/bench planes update crypto metrics too)
 SCAN_ROOTS = ("cometbft_tpu", "tools", "bench.py", "bench_all.py")
-#: the registration site itself never counts as a reference
-EXCLUDE = (os.path.join("cometbft_tpu", "metrics", "__init__.py"),)
+#: the registration site itself never counts as a reference, and this
+#: checker's own pattern literals must not feed the inverse scan
+EXCLUDE = (
+    os.path.join("cometbft_tpu", "metrics", "__init__.py"),
+    os.path.join("tools", "metrics_lint.py"),
+)
 
 
 def registered_fields() -> dict[str, list[str]]:
@@ -48,6 +61,8 @@ def registered_fields() -> dict[str, list[str]]:
         M.P2PMetrics,
         M.StateMetrics,
         M.CryptoMetrics,
+        M.RPCMetrics,
+        M.EventBusMetrics,
     ):
         for name in vars(cls(None)):
             out.setdefault(name, []).append(cls.__name__)
@@ -85,16 +100,63 @@ def find_unreferenced() -> dict[str, list[str]]:
     return missing
 
 
+#: update-shaped attribute chains: ``.name.inc(`` / ``.name.observe(``
+#: / ``.name.labels(`` always mean metrics in this codebase;
+#: ``.name.set(`` only with arguments (``Event.set()`` takes none) —
+#: names starting with ``_`` (private state like ``_canceled``) never
+#: match the leading ``[a-z]``.
+_UPDATE_PAT = re.compile(
+    r"\.([a-z][a-z0-9_]*)\.(?:inc|observe|labels)\("
+    r"|\.([a-z][a-z0-9_]*)\.set\((?!\s*\))"
+)
+
+#: update-shaped chains that are NOT metrics (audited; extend when a
+#: new non-metric ``.x.set(value)`` idiom appears): ``db.set(k, v)`` is
+#: the KV-store put.
+_NON_METRIC_UPDATES = frozenset({"db"})
+
+
+def find_unregistered() -> dict[str, list[str]]:
+    """Update sites whose field name no struct registers (field name ->
+    files updating it) — empty dict when the lint is clean.
+
+    Hot paths cache resolved label children under ``m_<field>``
+    (``_Channel.m_send_queue_size`` holds
+    ``send_queue_size.labels(...)``); the suffix must still name a
+    registered field, so a typo'd handle is caught the same as a
+    direct update."""
+    fields = registered_fields()
+    missing: dict[str, list[str]] = {}
+    for rel, text in _scan_files():
+        for m in _UPDATE_PAT.finditer(text):
+            name = m.group(1) or m.group(2)
+            if name.startswith("m_"):
+                name = name[2:]
+            if name in fields or name in _NON_METRIC_UPDATES:
+                continue
+            files = missing.setdefault(name, [])
+            if rel not in files:
+                files.append(rel)
+    return missing
+
+
 def main() -> int:
     missing = find_unreferenced()
-    if not missing:
+    unregistered = find_unregistered()
+    if not missing and not unregistered:
         print(f"metrics-lint: {len(registered_fields())} fields, all "
-              "referenced")
+              "referenced; no unregistered update sites")
         return 0
     for field, owners in missing.items():
         print(
             f"metrics-lint: {'/'.join(owners)}.{field} is registered "
             "but never referenced by any subsystem",
+            file=sys.stderr,
+        )
+    for field, files in sorted(unregistered.items()):
+        print(
+            f"metrics-lint: .{field} is updated in {', '.join(files)} "
+            "but registered by no metrics struct",
             file=sys.stderr,
         )
     return 1
