@@ -4,14 +4,17 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-e2e bench bench-all bench-micro native metrics-lint wire-smoke
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck wire-smoke
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
 # randomized-manifest e2e, interpret-mode pallas trace) are skipped;
 # target <15 min single-core (reference analog: tests.mk:66-87 CI
 # package splits). The r4 default gate had grown to 48 min.
-test:
+# Both lints gate the default flow — metrics-lint runs lockcheck too,
+# so one prerequisite covers both (and both run inside tier-1 via
+# tests/test_metrics.py + tests/test_lockcheck.py).
+test: metrics-lint
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -61,11 +64,29 @@ bench-all:
 bench-micro:
 	$(PY) tools/bench_micro.py
 
+# go test -race analog: the tier-1 concurrency suites under both the
+# lock-order graph (every cmtsync acquire feeds a global acquisition-
+# order graph; cycles raise LockOrderError with both stacks) and race
+# mode (unguarded cross-thread writes to _GUARDED_BY fields raise
+# RaceError).  Scoped to the lock-bearing planes for the same reason
+# test-deadlock is.
+test-race:
+	CMT_TPU_LOCKGRAPH=1 CMT_TPU_RACE=1 \
+		$(PY) -m pytest tests/test_lockcheck.py tests/test_sync_tools.py \
+		tests/test_metrics.py tests/test_reactors.py -q
+
 # every registered metric field must be updated by some subsystem,
-# and every update site must name a registered field (inverse check)
+# and every update site must name a registered field (inverse check);
+# ALSO runs lockcheck so one command gates both lints
 # (also enforced in the tier-1 flow via tests/test_metrics.py)
 metrics-lint:
 	$(PY) tools/metrics_lint.py
+
+# static guarded-by lint + lock-seam check (docs/concurrency.md):
+# guarded fields accessed under their lock, annotations name real
+# locks, no raw threading.Lock() in core packages
+lockcheck:
+	$(PY) tools/lockcheck.py
 
 # wire-plane telemetry smoke: the loopback MConnection pair + RPC
 # dispatch + event-bus assertions, standalone (tier-1 runs them too)

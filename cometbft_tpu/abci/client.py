@@ -28,6 +28,7 @@ from cometbft_tpu.abci.server import MAX_MSG_SIZE, parse_addr
 from cometbft_tpu.proxy import AbciClientError
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import encode_uvarint, read_uvarint_from
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class SocketClient:
@@ -44,7 +45,7 @@ class SocketClient:
         self.logger = logger or default_logger().with_fields(
             module="abci-client"
         )
-        self._lock = threading.Lock()
+        self._lock = cmtsync.Mutex()
         self._sock: socket.socket | None = None
         self._file = None
         self._error: BaseException | None = None

@@ -26,6 +26,7 @@ by exactly one Commit before the next height begins.
 from __future__ import annotations
 
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 #: calls the grammar tracks (consensus + statesync connections); the
 #: info/mempool connections (echo/info/query/check_tx) interleave
@@ -145,7 +146,7 @@ class RecordingApp:
     def __init__(self, inner: Application):
         self.inner = inner
         self.calls: list[tuple[str, int]] = []
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     def _record(self, method: str, req) -> None:
         if method in TRACKED:

@@ -26,6 +26,7 @@ from cometbft_tpu.abci import types as T
 from cometbft_tpu.proxy import AbciClientError
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils import sync as cmtsync
 
 SERVICE = "cometbft.abci.v1.ABCIService"
 
@@ -84,7 +85,7 @@ class GrpcServer(BaseService):
         )
         self.app = app
         self.addr = _parse_grpc_addr(addr)
-        self._app_mtx = threading.Lock()
+        self._app_mtx = cmtsync.Mutex()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers)
         )
@@ -179,7 +180,7 @@ class GrpcClient:
                     ) from exc
         self._request_timeout = request_timeout
         self._channel: grpc.Channel | None = None
-        self._lock = threading.Lock()
+        self._lock = cmtsync.Mutex()
         self._closed = False
         self._error: BaseException | None = None
 

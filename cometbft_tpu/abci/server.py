@@ -23,6 +23,7 @@ from cometbft_tpu.abci.types import Application
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import encode_uvarint, read_uvarint_from
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils import sync as cmtsync
 
 MAX_MSG_SIZE = 64 << 20  # generous: FinalizeBlock carries whole blocks
 
@@ -71,10 +72,10 @@ class SocketServer(BaseService):
         self.logger = logger or default_logger().with_fields(
             module="abci-server"
         )
-        self._app_lock = threading.Lock()
+        self._app_lock = cmtsync.Mutex()
         self._listener: socket.socket | None = None
         self._conns: list[socket.socket] = []
-        self._conns_mtx = threading.Lock()
+        self._conns_mtx = cmtsync.Mutex()
         self._unix_path: str | None = None
 
     # -- lifecycle -------------------------------------------------------

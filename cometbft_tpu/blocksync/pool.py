@@ -16,6 +16,7 @@ import time
 
 from cometbft_tpu.types.block import Block
 from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils import sync as cmtsync
 
 REQUEST_WINDOW = 400          # pool.go:36 maxPendingRequests
 REQUEST_TIMEOUT = 15.0        # pool.go requestTimeout
@@ -70,7 +71,7 @@ class BlockPool:
         logger: Logger | None = None,
     ):
         self.logger = logger or default_logger().with_fields(module="blockpool")
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self.height = start_height  # next height to pop
         self.start_height = start_height
         self._peers: dict[str, _BSPeer] = {}

@@ -17,6 +17,7 @@ from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.types.vote import Vote
 from cometbft_tpu.types.vote_set import VoteSet
 from cometbft_tpu.utils.bit_array import BitArray
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class HeightVoteSetError(Exception):
@@ -35,7 +36,7 @@ class HeightVoteSet:
         self.height = height
         self.val_set = val_set
         self.extensions_enabled = extensions_enabled
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._round = 0
         self._round_vote_sets: dict[int, tuple[VoteSet, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}

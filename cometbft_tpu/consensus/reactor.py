@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import random
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass, field
 
 from cometbft_tpu.consensus.messages import (
@@ -128,7 +129,7 @@ class PeerState:
 
     def __init__(self, peer_id: str):
         self.peer_id = peer_id
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self.prs = PeerRoundState()
 
     def snapshot(self) -> PeerRoundState:

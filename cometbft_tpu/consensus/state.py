@@ -115,8 +115,45 @@ def decode_timeout_info(data: bytes) -> TimeoutInfo:
     )
 
 
+@cmtsync.guarded
 class ConsensusState(BaseService):
     """(internal/consensus/state.go:72 State)"""
+
+    #: Round state (round_state.go RoundState) — every field is
+    #: guarded by _rs_mtx: written only by the receive routine (and the
+    #: pre-start/handoff paths, which take the lock too), read by
+    #: gossip/RPC through the locked round_state() snapshot.  Runtime
+    #: registry for CMT_TPU_RACE mode; tools/lockcheck.py verifies the
+    #: same contract statically (the transition methods below carry
+    #: `# holds _rs_mtx` caller-holds markers).
+    _GUARDED_BY = {
+        "height": "_rs_mtx",
+        "round": "_rs_mtx",
+        "step": "_rs_mtx",
+        "_step_start": "_rs_mtx",
+        "_step_hr": "_rs_mtx",
+        "_quorum_prevote_round": "_rs_mtx",
+        "start_time_ns": "_rs_mtx",
+        "commit_time_ns": "_rs_mtx",
+        "validators": "_rs_mtx",
+        "proposal": "_rs_mtx",
+        "proposal_block": "_rs_mtx",
+        "proposal_block_parts": "_rs_mtx",
+        "_proposal_recv_time_ns": "_rs_mtx",
+        "locked_round": "_rs_mtx",
+        "locked_block": "_rs_mtx",
+        "locked_block_parts": "_rs_mtx",
+        "valid_round": "_rs_mtx",
+        "valid_block": "_rs_mtx",
+        "valid_block_parts": "_rs_mtx",
+        "votes": "_rs_mtx",
+        "commit_round": "_rs_mtx",
+        "last_commit": "_rs_mtx",
+        "last_validators": "_rs_mtx",
+        "triggered_timeout_precommit": "_rs_mtx",
+        "_early_parts": "_rs_mtx",
+        "state": "_rs_mtx",
+    }
 
     def __init__(
         self,
@@ -250,12 +287,17 @@ class ConsensusState(BaseService):
     def on_start(self) -> None:
         self._check_double_signing_risk()
         self._ticker.start()
-        self._catchup_replay()
+        # the ticker (and, below, the receive routine) is live from
+        # here on: replay and round-0 scheduling touch round state, so
+        # they need the lock like any other writer (lockcheck)
+        with self._rs_mtx:
+            self._catchup_replay()
         self._thread = threading.Thread(
             target=self._receive_routine, name="cs-receive", daemon=True
         )
         self._thread.start()
-        self._schedule_round_0()
+        with self._rs_mtx:
+            self._schedule_round_0()
 
     def _check_double_signing_risk(self) -> None:
         """(state.go:2643 checkDoubleSigningRisk) — with
@@ -298,14 +340,15 @@ class ConsensusState(BaseService):
     def update_state_and_start(self, state: State) -> None:
         """Adopt a post-sync state and begin consensus — the blocksync →
         consensus handoff (reactor.go SwitchToConsensus)."""
-        self.state = state
-        self._update_to_state(state)
+        with self._rs_mtx:
+            self.state = state
+            self._update_to_state(state)
         if not self.is_running():
             self.start()
 
     # -- WAL replay (replay.go:95 catchupReplay) -------------------------
 
-    def _catchup_replay(self) -> None:
+    def _catchup_replay(self) -> None:  # holds _rs_mtx
         records = self.wal.search_for_end_height(self.height - 1)
         if records is None:
             # No anchor for the in-flight height (fresh WAL, or the node
@@ -414,7 +457,7 @@ class ConsensusState(BaseService):
 
     # -- state setup -----------------------------------------------------
 
-    def _update_to_state(self, state: State) -> None:
+    def _update_to_state(self, state: State) -> None:  # holds _rs_mtx
         """(state.go:652 updateToState)"""
         if self.commit_round > -1 and 0 < self.height != state.last_block_height:
             raise ConsensusError(
@@ -478,13 +521,13 @@ class ConsensusState(BaseService):
         self.triggered_timeout_precommit = False
         self.state = state
 
-    def _schedule_round_0(self) -> None:
+    def _schedule_round_0(self) -> None:  # holds _rs_mtx
         sleep = max(self.start_time_ns - now_ns(), 0)
         self._ticker.schedule(
             TimeoutInfo(sleep, self.height, 0, STEP_NEW_HEIGHT)
         )
 
-    def _set_step(self, step: int) -> None:
+    def _set_step(self, step: int) -> None:  # holds _rs_mtx
         """Advance ``self.step``, closing out the previous step's
         observability: its duration lands in the
         ``consensus_step_duration_seconds`` histogram and as a
@@ -519,20 +562,20 @@ class ConsensusState(BaseService):
         self._step_hr = (self.height, self.round)
         self.step = step
 
-    def _new_step(self) -> None:
+    def _new_step(self) -> None:  # holds _rs_mtx
         if self.event_bus is not None and not self._replay_mode:
             self.event_bus.publish_new_round_step(self._rs_event())
         if self.on_new_step is not None:
             self.on_new_step(self.round_state())
 
-    def _rs_event(self) -> EventDataRoundState:
+    def _rs_event(self) -> EventDataRoundState:  # holds _rs_mtx
         return EventDataRoundState(
             height=self.height, round=self.round, step=STEP_NAMES[self.step]
         )
 
     # -- transitions -----------------------------------------------------
 
-    def _enter_new_round(self, height: int, round_: int) -> None:
+    def _enter_new_round(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1063)"""
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.step != STEP_NEW_HEIGHT
@@ -566,7 +609,7 @@ class ConsensusState(BaseService):
             )
         self._enter_propose(height, round_)
 
-    def _enter_propose(self, height: int, round_: int) -> None:
+    def _enter_propose(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1152)"""
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.step >= STEP_PROPOSE
@@ -589,7 +632,7 @@ class ConsensusState(BaseService):
         if self._is_proposal_complete():
             self._enter_prevote(height, round_)
 
-    def _is_proposer(self) -> bool:
+    def _is_proposer(self) -> bool:  # holds _rs_mtx
         if self.priv_validator is None:
             return False
         return (
@@ -597,7 +640,7 @@ class ConsensusState(BaseService):
             == self.priv_validator.address
         )
 
-    def _decide_proposal(self, height: int, round_: int) -> None:
+    def _decide_proposal(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1226 defaultDecideProposal)"""
         if self.valid_block is not None:
             block, parts = self.valid_block, self.valid_block_parts
@@ -676,7 +719,7 @@ class ConsensusState(BaseService):
             hash=block.hash().hex()[:12],
         )
 
-    def _is_proposal_complete(self) -> bool:
+    def _is_proposal_complete(self) -> bool:  # holds _rs_mtx
         """(state.go isProposalComplete)"""
         if self.proposal is None or self.proposal_block is None:
             return False
@@ -687,7 +730,7 @@ class ConsensusState(BaseService):
 
     # -- proposal handling ------------------------------------------------
 
-    def _set_proposal(self, proposal: Proposal) -> None:
+    def _set_proposal(self, proposal: Proposal) -> None:  # holds _rs_mtx
         """(state.go:2048 defaultSetProposal)"""
         if self.proposal is not None:
             return
@@ -734,7 +777,7 @@ class ConsensusState(BaseService):
 
     def _add_proposal_block_part(
         self, msg: BlockPartMessage, peer_id: str
-    ) -> bool:
+    ) -> bool:  # holds _rs_mtx
         """(state.go:2123 addProposalBlockPart)"""
         if msg.height != self.height:
             return False
@@ -776,7 +819,7 @@ class ConsensusState(BaseService):
                 )
         return added
 
-    def _handle_complete_proposal(self, height: int) -> None:
+    def _handle_complete_proposal(self, height: int) -> None:  # holds _rs_mtx
         """(state.go handleCompleteProposal)"""
         prevotes = self.votes.prevotes(self.round)
         maj23 = prevotes.two_thirds_majority() if prevotes else None
@@ -798,7 +841,7 @@ class ConsensusState(BaseService):
 
     # -- prevote ---------------------------------------------------------
 
-    def _enter_prevote(self, height: int, round_: int) -> None:
+    def _enter_prevote(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1345)"""
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.step >= STEP_PREVOTE
@@ -809,7 +852,7 @@ class ConsensusState(BaseService):
         self._new_step()
         self._do_prevote(height, round_)
 
-    def _do_prevote(self, height: int, round_: int) -> None:
+    def _do_prevote(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1387 defaultDoPrevote)"""
         if self.locked_block is not None:
             self._sign_add_vote(PREVOTE_TYPE, self.locked_block)
@@ -836,7 +879,7 @@ class ConsensusState(BaseService):
             PREVOTE_TYPE, self.proposal_block if accepted else None
         )
 
-    def _proposal_is_timely(self) -> bool:
+    def _proposal_is_timely(self) -> bool:  # holds _rs_mtx
         """PBTS timeliness (types/vote.go IsTimely), measured against the
         proposal's receive time so scheduling delay between receive and
         prevote cannot flip the verdict."""
@@ -849,7 +892,7 @@ class ConsensusState(BaseService):
 
     # -- precommit -------------------------------------------------------
 
-    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:  # holds _rs_mtx
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.step >= STEP_PREVOTE_WAIT
         ):
@@ -866,7 +909,7 @@ class ConsensusState(BaseService):
             )
         )
 
-    def _enter_precommit(self, height: int, round_: int) -> None:
+    def _enter_precommit(self, height: int, round_: int) -> None:  # holds _rs_mtx
         """(state.go:1609)"""
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.step >= STEP_PRECOMMIT
@@ -928,7 +971,7 @@ class ConsensusState(BaseService):
             self.proposal_block_parts = PartSet(maj23.part_set_header)
         self._sign_add_vote(PRECOMMIT_TYPE, None)
 
-    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:  # holds _rs_mtx
         if self.height != height or round_ < self.round or (
             self.round == round_ and self.triggered_timeout_precommit
         ):
@@ -945,7 +988,7 @@ class ConsensusState(BaseService):
 
     # -- commit ----------------------------------------------------------
 
-    def _enter_commit(self, height: int, commit_round: int) -> None:
+    def _enter_commit(self, height: int, commit_round: int) -> None:  # holds _rs_mtx
         """(state.go:1743)"""
         if self.height != height or self.step >= STEP_COMMIT:
             return
@@ -991,7 +1034,7 @@ class ConsensusState(BaseService):
                     return  # wait for parts via gossip
         self._try_finalize_commit(height)
 
-    def _try_finalize_commit(self, height: int) -> None:
+    def _try_finalize_commit(self, height: int) -> None:  # holds _rs_mtx
         """(state.go:1806)"""
         if self.height != height:
             return
@@ -1006,7 +1049,7 @@ class ConsensusState(BaseService):
             return  # don't have the block yet
         self._finalize_commit(height)
 
-    def _finalize_commit(self, height: int) -> None:
+    def _finalize_commit(self, height: int) -> None:  # holds _rs_mtx
         """(state.go:1834) SaveBlock → WAL EndHeight → ApplyBlock →
         next height."""
         if self.step != STEP_COMMIT:
@@ -1067,7 +1110,7 @@ class ConsensusState(BaseService):
 
     # -- votes -----------------------------------------------------------
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> None:  # holds _rs_mtx
         """(state.go:2243 tryAddVote)"""
         try:
             self._add_vote(vote, peer_id)
@@ -1087,7 +1130,7 @@ class ConsensusState(BaseService):
         except Exception as exc:  # noqa: BLE001
             self.logger.debug("failed adding vote", err=repr(exc))
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:  # holds _rs_mtx
         """(state.go:2294 addVote)"""
         # Precommit for the previous height (LastCommit catchup)
         if (
@@ -1141,7 +1184,7 @@ class ConsensusState(BaseService):
             self._on_precommit_added(vote)
         return True
 
-    def _on_prevote_added(self, vote: Vote) -> None:
+    def _on_prevote_added(self, vote: Vote) -> None:  # holds _rs_mtx
         prevotes = self.votes.prevotes(vote.round)
         maj23 = prevotes.two_thirds_majority()
         if maj23 is not None:
@@ -1210,7 +1253,7 @@ class ConsensusState(BaseService):
             if self._is_proposal_complete():
                 self._enter_prevote(self.height, self.round)
 
-    def _on_precommit_added(self, vote: Vote) -> None:
+    def _on_precommit_added(self, vote: Vote) -> None:  # holds _rs_mtx
         precommits = self.votes.precommits(vote.round)
         maj23 = precommits.two_thirds_majority()
         if maj23 is not None:
@@ -1226,7 +1269,7 @@ class ConsensusState(BaseService):
             self._enter_new_round(self.height, vote.round)
             self._enter_precommit_wait(self.height, vote.round)
 
-    def _sign_vote(self, vote_type: int, block: Block | None) -> Vote | None:
+    def _sign_vote(self, vote_type: int, block: Block | None) -> Vote | None:  # holds _rs_mtx
         if self.priv_validator is None:
             return None
         addr = self.priv_validator.address
@@ -1281,7 +1324,7 @@ class ConsensusState(BaseService):
             self.logger.error("failed signing vote", err=repr(exc))
             return None
 
-    def _sign_add_vote(self, vote_type: int, block: Block | None) -> None:
+    def _sign_add_vote(self, vote_type: int, block: Block | None) -> None:  # holds _rs_mtx
         vote = self._sign_vote(vote_type, block)
         if vote is not None:
             self._send_internal(VoteMessage(vote))

@@ -17,6 +17,7 @@ from typing import Callable
 from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
+from cometbft_tpu.utils import sync as cmtsync
 
 # Device availability is probed in a SUBPROCESS: a wedged accelerator
 # plugin can hang `import jax` inside C where the GIL never releases —
@@ -27,7 +28,7 @@ from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 # liveness beats batch speed.  When jax is already imported (tests,
 # benches, the dryrun), the inline fast path keeps selection
 # deterministic.  A failed probe retries after _PROBE_RETRY_S.
-_probe_lock = threading.Lock()
+_probe_lock = cmtsync.Mutex()
 _device_state = {"status": "unknown", "ndev": 0, "failed_at": 0.0}
 _PROBE_TIMEOUT_S = float(os.environ.get("CMT_TPU_PROBE_TIMEOUT_S", 20))
 _PROBE_RETRY_S = float(os.environ.get("CMT_TPU_PROBE_RETRY_S", 120))

@@ -50,8 +50,13 @@ def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
     return prefix + height.to_bytes(8, "big") + ev_hash
 
 
+@cmtsync.guarded
 class Pool:
     """(internal/evidence/pool.go:24 Pool)"""
+
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically
+    _GUARDED_BY = {"_consensus_buffer": "_mtx"}
 
     def __init__(
         self,

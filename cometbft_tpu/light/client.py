@@ -10,6 +10,7 @@ persists trusted blocks.  The 10k-header verification benchmark
 from __future__ import annotations
 
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass
 from fractions import Fraction
 
@@ -100,7 +101,7 @@ class Client:
         self.max_clock_drift_ns = max_clock_drift_ns
         self.pruning_size = pruning_size
         self.logger = logger or default_logger().with_fields(module="light")
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._initialize()
 
     # -- initialization (client.go:265 initializeWithTrustOptions) -------

@@ -6,6 +6,7 @@ import threading
 
 from cometbft_tpu.types.light_block import LightBlock
 from cometbft_tpu.utils.db import DB
+from cometbft_tpu.utils import sync as cmtsync
 
 _PREFIX = b"lb/"
 
@@ -19,7 +20,7 @@ class LightStore:
 
     def __init__(self, db: DB):
         self.db = db
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     def save(self, lb: LightBlock) -> None:
         with self._mtx:

@@ -21,6 +21,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
+from cometbft_tpu.utils import sync as cmtsync
 
 _MAGIC = b"lt"
 
@@ -146,7 +147,7 @@ class Loader:
         self.sent = 0
         self.errors = 0
         self._seq = 0
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     def _next_seq(self) -> int:
         with self._mtx:

@@ -50,8 +50,11 @@ class _MempoolTx:
     senders: set = field(default_factory=set)  # peers we got it from
 
 
+@cmtsync.guarded
 class TxCache:
     """Fixed-size LRU of recently seen tx hashes (mempool/cache.go)."""
+
+    _GUARDED_BY = {"_map": "_mtx"}
 
     def __init__(self, size: int):
         self._size = size
@@ -122,8 +125,23 @@ def post_check_max_gas(max_gas: int) -> PostCheckFunc:
     return check
 
 
+@cmtsync.guarded
 class CListMempool:
     """The production mempool (mempool/clist_mempool.go:29)."""
+
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically.  pre_check/post_check are
+    #: swapped under the lock in update() but read lock-free on the
+    #: CheckTx hot path (audited waivers below).
+    _GUARDED_BY = {
+        "_txs": "_mtx",
+        "_txs_bytes": "_mtx",
+        "_seq": "_mtx",
+        "_height": "_mtx",
+        "_notified_available": "_mtx",
+        "pre_check": "_mtx",
+        "post_check": "_mtx",
+    }
 
     def __init__(
         self,
@@ -195,8 +213,8 @@ class CListMempool:
             raise TxTooLargeError(
                 f"tx size {len(tx)} exceeds max {self._max_tx_bytes}"
             )
-        if self.pre_check is not None:
-            self.pre_check(tx)
+        if self.pre_check is not None:  # unguarded: callable ref, swapped atomically under lock in update()
+            self.pre_check(tx)  # unguarded: same audited read as line above
         if self.is_full(len(tx)):
             raise MempoolFullError(
                 f"mempool is full: {self.size()} txs"
@@ -226,9 +244,9 @@ class CListMempool:
     ) -> None:
         """(clist_mempool.go:328 handleCheckTxResponse)"""
         post_err = None
-        if self.post_check is not None:
+        if self.post_check is not None:  # unguarded: callable ref, swapped atomically under lock in update()
             try:
-                self.post_check(tx, res)
+                self.post_check(tx, res)  # unguarded: same audited read as line above
             except MempoolError as e:
                 post_err = e
         if res.code != 0 or post_err is not None:
@@ -262,7 +280,7 @@ class CListMempool:
             self._notify_available()
             self._new_tx_cond.notify_all()
 
-    def _notify_available(self) -> None:
+    def _notify_available(self) -> None:  # holds _mtx
         if not self._notified_available and len(self._txs) > 0:
             self._notified_available = True
             self._tx_available.set()
@@ -346,7 +364,7 @@ class CListMempool:
         tx_results: list,
         new_pre_check: PreCheckFunc | None = None,
         new_post_check: PostCheckFunc | None = None,
-    ) -> None:
+    ) -> None:  # holds _mtx
         """Remove committed txs + recheck the rest.  Caller must hold
         the lock (clist_mempool.go:Update contract)."""
         self._height = height
@@ -376,7 +394,7 @@ class CListMempool:
         if self._txs:
             self._notify_available()
 
-    def _recheck_txs(self) -> None:
+    def _recheck_txs(self) -> None:  # holds _mtx
         """Re-run CheckTx on everything left after a block
         (clist_mempool.go recheckTxs)."""
         self.metrics.recheck_times.inc()

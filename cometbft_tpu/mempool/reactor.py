@@ -18,6 +18,7 @@ from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes
+from cometbft_tpu.utils import sync as cmtsync
 
 MEMPOOL_CHANNEL = 0x30
 
@@ -57,7 +58,7 @@ class MempoolReactor(Reactor):
         # cumulative txs submitted per peer, mirrored into the p2p
         # num_txs gauge (p2p/metrics.go NumTxs)
         self._peer_tx_counts: dict[str, int] = {}
-        self._peer_tx_mtx = threading.Lock()
+        self._peer_tx_mtx = cmtsync.Mutex()
 
     def enable_in_out_txs(self) -> None:
         """Called after state sync completes (reactor.go EnableInOutTxs)."""

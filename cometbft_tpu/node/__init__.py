@@ -576,7 +576,8 @@ class Node(BaseService):
         self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(state.last_block_height, commit)
         self.state = state
-        self.consensus.state = state
+        with self.consensus._rs_mtx:  # guarded field (lockcheck)
+            self.consensus.state = state
         self.mempool_reactor.enable_in_out_txs()
         self.logger.info(
             "state sync complete", height=state.last_block_height
@@ -670,8 +671,11 @@ class Node(BaseService):
             logger=self.logger.with_fields(module="handshake"),
         )
         self.state = hs.handshake(self.proxy_app)
-        self.consensus.state = self.state
-        self.consensus._update_to_state(self.state)
+        # round state is guarded; the ticker/receive threads aren't
+        # running yet, but race mode judges by lock, not by luck
+        with self.consensus._rs_mtx:
+            self.consensus.state = self.state
+            self.consensus._update_to_state(self.state)
         # blocksync validates against the post-handshake state (its
         # app_hash reflects InitChain / replayed blocks)
         self.blocksync_reactor.state = self.state
@@ -691,12 +695,15 @@ class Node(BaseService):
 
         if isinstance(self.mempool, CListMempool):
             max_bytes = self.state.consensus_params.block.max_bytes
-            self.mempool.pre_check = pre_check_max_bytes(
-                max_bytes if max_bytes > 0 else 104857600
-            )
-            self.mempool.post_check = post_check_max_gas(
-                self.state.consensus_params.block.max_gas
-            )
+            # the RPC server above is already serving CheckTx: the
+            # hook swap must hold the mempool lock like update() does
+            with self.mempool._mtx:
+                self.mempool.pre_check = pre_check_max_bytes(
+                    max_bytes if max_bytes > 0 else 104857600
+                )
+                self.mempool.post_check = post_check_max_gas(
+                    self.state.consensus_params.block.max_gas
+                )
 
         if isinstance(self.wal, WAL):
             self.wal.start()

@@ -50,6 +50,7 @@ from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as F
 from cometbft_tpu.ops.ed25519_verify import _next_pow2
+from cometbft_tpu.utils import sync as cmtsync
 
 #: largest set that gets 8-bit per-key combs (3.4 MB/key on device)
 KEY8_MAX = int(os.environ.get("CMT_TPU_KEY8_MAX", 256))
@@ -61,7 +62,7 @@ TABLE_CACHE_MB = int(os.environ.get("CMT_TPU_TABLE_CACHE_MB", 6144))
 
 # -- fixed-base 8-bit comb (host-built, shared) ------------------------
 
-_B8_LOCK = threading.Lock()
+_B8_LOCK = cmtsync.Mutex()
 _B8: np.ndarray | None = None
 
 
@@ -364,7 +365,7 @@ class KeyTableCache:
 
     def __init__(self, cap_bytes: int = TABLE_CACHE_MB << 20) -> None:
         self._cap = cap_bytes
-        self._lock = threading.Lock()
+        self._lock = cmtsync.Mutex()
         self._pools = {8: _KeyPool(8), 4: _KeyPool(4)}
         # pubkey-level build latches: concurrent misses on overlapping
         # keys (consensus addVote + light client racing on a rotation)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -115,6 +116,7 @@ def decode_packet(data: bytes):
     raise MConnError("unknown packet")
 
 
+@cmtsync.guarded
 class _Channel:
     """(connection.go:640 channel) — send queue + recv reassembly.
 
@@ -123,6 +125,10 @@ class _Channel:
     per-(peer, channel) gauges — the backpressure signal the wire
     plane exposes on /metrics and /net_info.
     """
+
+    #: enqueue paths race the send routine on the byte ledger; the
+    #:  qsize-only reads (fill_ratio, status) stay lock-free
+    _GUARDED_BY = {"queued_bytes": "_qb_mtx"}
 
     def __init__(self, desc: ChannelDescriptor, metrics, peer_id: str):
         self.desc = desc
@@ -134,7 +140,7 @@ class _Channel:
         self.recently_sent = 0  # decayed by send routine
         self.recving = bytearray()
         self.queued_bytes = 0
-        self._qb_mtx = threading.Lock()
+        self._qb_mtx = cmtsync.Mutex()
         # label children resolved once: the hot path updates plain
         # counters/gauges, never a labels() dict lookup
         lbl = {"peer_id": peer_id, "chID": f"{desc.id:#x}"}
@@ -166,7 +172,7 @@ class _Channel:
 
     def _update_gauges(self) -> None:
         self.m_send_queue_size.set(self.send_queue.qsize())
-        self.m_send_queue_bytes.set(self.queued_bytes)
+        self.m_send_queue_bytes.set(self.queued_bytes)  # unguarded: gauge snapshot, int read can't tear
 
     def fill_ratio(self) -> float:
         cap = max(self.desc.send_queue_capacity, 1)

@@ -23,6 +23,7 @@ import errno
 import select
 import struct
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 import time
 
 from cryptography.hazmat.primitives.asymmetric.x25519 import (
@@ -107,8 +108,8 @@ class SecretConnection:
     def __init__(self, sock, priv_key: Ed25519PrivKey):
         handshake_t0 = time.perf_counter()
         self._sock = sock
-        self._send_mtx = threading.Lock()
-        self._recv_mtx = threading.Lock()
+        self._send_mtx = cmtsync.Mutex()
+        self._recv_mtx = cmtsync.Mutex()
         self._recv_buf = b""
         self.remote_pubkey: Ed25519PubKey | None = None
 

@@ -9,6 +9,7 @@ to the owning reactor.  Reactors attach per-peer state via ``set``/
 from __future__ import annotations
 
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 from cometbft_tpu.p2p.conn.connection import (
     ChannelDescriptor,
@@ -53,7 +54,7 @@ class Peer(BaseService):
         self.metrics = metrics if metrics is not None else P2PMetrics()
         self._channel_names = channel_names or {}
         self._data: dict[str, object] = {}
-        self._data_mtx = threading.Lock()
+        self._data_mtx = cmtsync.Mutex()
         self.mconn = MConnection(
             conn,
             channels,
@@ -135,7 +136,7 @@ class PeerSet:
     """Thread-safe peer registry (p2p/peer_set.go)."""
 
     def __init__(self) -> None:
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._by_id: dict[str, Peer] = {}
 
     def add(self, peer: Peer) -> None:

@@ -21,6 +21,7 @@ import time
 from cometbft_tpu.p2p.netaddr import NetAddress
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils import sync as cmtsync
 
 # Layout constants (addrbook.go:160-190 bucket parameters).
 NEW_BUCKET_COUNT = 256
@@ -141,7 +142,7 @@ class AddrBook(BaseService):
         self.logger = logger or default_logger().with_fields(
             module="addrbook"
         )
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._addrs: dict[str, KnownAddress] = {}  # node id -> ka
         self._new: list[set[str]] = [
             set() for _ in range(NEW_BUCKET_COUNT)
@@ -154,7 +155,7 @@ class AddrBook(BaseService):
         self._our_ids: set[str] = set()
         self._private_ids: set[str] = set()
         self._dirty = False
-        self._save_mtx = threading.Lock()  # serializes file writes
+        self._save_mtx = cmtsync.Mutex()  # serializes file writes
 
     # -- lifecycle -------------------------------------------------------
 

@@ -19,6 +19,7 @@ from cometbft_tpu.p2p.pex.addrbook import AddrBook
 from cometbft_tpu.utils.log import default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
+from cometbft_tpu.utils import sync as cmtsync
 
 PEX_CHANNEL = 0x00
 
@@ -84,7 +85,7 @@ class PexReactor(Reactor):
         self.seeds = list(seeds or [])
         self.seed_mode = seed_mode
         self.ensure_interval = ensure_interval
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._last_request_from: dict[str, float] = {}
         self._last_request_to: dict[str, float] = {}
         self._requested_of: set[str] = set()

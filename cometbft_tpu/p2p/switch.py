@@ -33,8 +33,17 @@ class SwitchError(Exception):
     pass
 
 
+@cmtsync.guarded
 class Switch(BaseService):
     """(p2p/switch.go:72 Switch)"""
+
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically
+    _GUARDED_BY = {
+        "_dialing": "_mtx",
+        "_reconnecting": "_mtx",
+        "_persistent_addrs": "_mtx",
+    }
 
     def __init__(
         self,
@@ -358,10 +367,12 @@ class Switch(BaseService):
 
     def num_peers(self) -> dict:
         peers = self.peers.copy()
+        with self._mtx:  # lockcheck: _dialing is guarded
+            dialing = len(self._dialing)
         return {
             "outbound": sum(1 for p in peers if p.outbound),
             "inbound": sum(1 for p in peers if not p.outbound),
-            "dialing": len(self._dialing),
+            "dialing": dialing,
         }
 
 

@@ -21,6 +21,7 @@ from cometbft_tpu.crypto import PrivKey, PubKey
 from cometbft_tpu.crypto import ed25519 as ed
 from cometbft_tpu.types import canonical
 from cometbft_tpu.types.vote import Proposal, Vote
+from cometbft_tpu.utils import sync as cmtsync
 
 # Sign-step ordering within a round (privval/file.go:47-51)
 STEP_PROPOSE = 1
@@ -69,7 +70,7 @@ class FilePV:
         self._priv_key = priv_key
         self._key_path = key_file_path
         self._state_path = state_file_path
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         # last sign state (privval/file.go:60 FilePVLastSignState)
         self.height = 0
         self.round = 0

@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 import time
 
 from cometbft_tpu.crypto import ed25519 as ed
@@ -187,7 +188,7 @@ class SignerListenerEndpoint(BaseService):
         self._listener: socket.socket | None = None
         self._conn: socket.socket | None = None
         self._file = None
-        self._mtx = threading.Lock()  # serializes request()
+        self._mtx = cmtsync.Mutex()  # serializes request()
         self._conn_ready = threading.Event()
         self._unix_path: str | None = None
 

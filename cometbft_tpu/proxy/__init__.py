@@ -17,6 +17,7 @@ from typing import Callable
 
 from cometbft_tpu.abci.types import Application
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class AbciClientError(Exception):
@@ -131,7 +132,7 @@ class ClientCreator:
 
     def __init__(self, app: Application, sync: bool = True):
         self._app = app
-        self._lock = threading.RLock() if sync else _NopLock()
+        self._lock = cmtsync.RMutex() if sync else _NopLock()
         self._shared_error: list = []
         self._on_error = None
 
@@ -228,7 +229,7 @@ class AppConns(BaseService):
         self.query = creator.new_client()
         self.snapshot = creator.new_client()
         self._on_error = None
-        self._fire_lock = threading.Lock()
+        self._fire_lock = cmtsync.Mutex()
         self._sync_hook = False
         self._watch_stop = threading.Event()
         self._watcher: threading.Thread | None = None

@@ -20,6 +20,7 @@ import socket
 import threading
 
 from cometbft_tpu.rpc.jsonrpc import RPCError
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class HTTPClient:
@@ -254,7 +255,7 @@ class WSClient:
         self._next_id = 0
         self._pending: dict[int, queue.Queue] = {}
         self._subs: dict[str, WSSubscription] = {}
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()

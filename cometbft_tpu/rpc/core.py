@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import base64
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 from cometbft_tpu.abci.types import CheckTxRequest, InfoRequest, QueryRequest
 from cometbft_tpu.rpc.jsonrpc import QuotedStr, RPCError
@@ -112,7 +113,7 @@ class Environment:
         self.metrics = metrics if metrics is not None else RPCMetrics()
         self._gen_chunks: list[str] | None = None  # lazy (env.go InitGenesisChunks)
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
-        self._subs_mtx = threading.Lock()
+        self._subs_mtx = cmtsync.Mutex()
 
     # -- route tables (routes.go:15-63) ---------------------------------
 

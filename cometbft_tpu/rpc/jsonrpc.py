@@ -24,6 +24,7 @@ from urllib.parse import parse_qsl, urlparse
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.trace import TRACER
+from cometbft_tpu.utils import sync as cmtsync
 
 # JSON-RPC error codes (rpc/jsonrpc/types/types.go)
 ERR_PARSE = -32700
@@ -323,7 +324,7 @@ class JSONRPCServer(BaseService):
     # -- websocket session (ws_handler.go wsConnection) -------------------
 
     def _serve_websocket(self, handler) -> None:
-        send_mtx = threading.Lock()
+        send_mtx = cmtsync.Mutex()
         client_id = f"ws-{id(handler)}"
 
         class WSContext:

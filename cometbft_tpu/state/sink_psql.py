@@ -21,6 +21,7 @@ import time
 from datetime import datetime, timezone
 
 from cometbft_tpu.types.block import tx_hash as _tx_hash
+from cometbft_tpu.utils import sync as cmtsync
 
 _SCHEMA_PG = """
 CREATE TABLE IF NOT EXISTS blocks (
@@ -104,7 +105,7 @@ class PsqlEventSink:
         self.chain_id = chain_id
         self.dialect = dialect
         self._conn = connect()
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._ph = "%s" if dialect == "postgres" else "?"
         self._index_quoted = '"index"' if dialect == "sqlite" else "index"
 

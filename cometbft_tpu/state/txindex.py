@@ -13,6 +13,7 @@ transfer.amount = '100'``) — the same language the event bus uses.
 from __future__ import annotations
 
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 from cometbft_tpu.abci.types import ExecTxResult
 from cometbft_tpu.types.block import tx_hash
@@ -105,7 +106,7 @@ class TxIndexer:
 
     def __init__(self, db: DB):
         self.db = db
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     def index(self, height: int, index: int, tx: bytes,
               result: ExecTxResult) -> None:
@@ -206,7 +207,7 @@ class BlockIndexer:
 
     def __init__(self, db: DB):
         self.db = db
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     def index(self, height: int, finalize_events) -> None:
         events = flatten_abci_events(

@@ -11,6 +11,7 @@ hand back the trusted state + commit for the node to bootstrap with.
 from __future__ import annotations
 
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 import time
 from dataclasses import dataclass, field
 
@@ -65,7 +66,7 @@ class SnapshotPool:
     """Snapshots and which peers can serve them (snapshots.go:37)."""
 
     def __init__(self) -> None:
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._snapshots: dict[tuple, Snapshot] = {}
         self._peers: dict[tuple, set[str]] = {}
         self._rejected: set[tuple] = set()
@@ -119,7 +120,7 @@ class ChunkQueue:
 
     def __init__(self, snapshot: Snapshot):
         self.snapshot = snapshot
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         self._chunks: dict[int, bytes] = {}
         self._arrived = threading.Condition(self._mtx)
 
@@ -173,7 +174,7 @@ class Syncer:
         self.logger = logger or default_logger().with_fields(module="statesync")
         self.pool = SnapshotPool()
         self._chunk_queue: ChunkQueue | None = None
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
 
     # -- inbound from reactor --------------------------------------------
 

@@ -42,8 +42,13 @@ class BlockStoreError(Exception):
     pass
 
 
+@cmtsync.guarded
 class BlockStore:
     """Contiguous range [base, height] of blocks (store/store.go:37-46)."""
+
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically
+    _GUARDED_BY = {"_base": "_mtx", "_height": "_mtx"}
 
     def __init__(self, db: DB):
         self._db = db
@@ -59,7 +64,7 @@ class BlockStore:
         f = ProtoReader(raw).to_dict()
         return int(f.get(1, [0])[0]), int(f.get(2, [0])[0])
 
-    def _save_state_ops(self) -> tuple[bytes, bytes]:
+    def _save_state_ops(self) -> tuple[bytes, bytes]:  # holds _mtx
         w = ProtoWriter()
         w.varint(1, self._base)
         w.varint(2, self._height)

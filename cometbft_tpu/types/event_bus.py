@@ -16,6 +16,7 @@ from typing import Any
 
 from cometbft_tpu.utils.pubsub import Query, Server, Subscription
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils import sync as cmtsync
 
 # Event type values (types/events.go)
 EVENT_NEW_BLOCK = "NewBlock"
@@ -124,8 +125,13 @@ class EventDataEvidence:
     height: int
 
 
+@cmtsync.guarded
 class EventBus(BaseService):
     """(types/event_bus.go:34)"""
+
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically
+    _GUARDED_BY = {"_gauged_clients": "_gauged_mtx"}
 
     def __init__(self, capacity: int = 1000, metrics=None):
         super().__init__(name="EventBus")
@@ -141,7 +147,7 @@ class EventBus(BaseService):
         #: or a race could re-mint a child after its retirement and
         #: leak the series forever (per-connection ids never return)
         self._gauged_clients: set[str] = set()
-        self._gauged_mtx = threading.Lock()
+        self._gauged_mtx = cmtsync.Mutex()
 
     def _on_drop(self, client_id: str) -> None:
         # per-client attribution lives in the log (client ids are

@@ -47,7 +47,19 @@ class _BlockVotes:
     sum: int
 
 
+@cmtsync.guarded
 class VoteSet:
+    #: runtime registry for CMT_TPU_RACE mode; tools/lockcheck.py
+    #: verifies the same contract statically
+    _GUARDED_BY = {
+        "_votes_bit_array": "_mtx",
+        "_votes": "_mtx",
+        "_sum": "_mtx",
+        "_maj23": "_mtx",
+        "_votes_by_block": "_mtx",
+        "_peer_maj23s": "_mtx",
+    }
+
     def __init__(
         self,
         chain_id: str,
@@ -85,7 +97,7 @@ class VoteSet:
         with self._mtx:
             return self._add_vote_locked(vote)
 
-    def _add_vote_locked(self, vote: Vote) -> bool:
+    def _add_vote_locked(self, vote: Vote) -> bool:  # holds _mtx
         val_idx = vote.validator_index
         if val_idx < 0:
             raise VoteSetError("vote has negative validator index")
@@ -269,7 +281,7 @@ class VoteSet:
     def __repr__(self) -> str:
         return (
             f"VoteSet(h={self.height} r={self.round} t={self.signed_msg_type} "
-            f"sum={self._sum})"
+            f"sum={self._sum})"  # unguarded: repr snapshot, int read can't tear
         )
 
 

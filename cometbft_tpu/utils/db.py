@@ -15,6 +15,7 @@ import bisect
 import sqlite3
 import threading
 from typing import Iterator
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class DBError(Exception):
@@ -79,7 +80,7 @@ class MemDB(DB):
     """Sorted in-memory backend (cometbft-db memdb)."""
 
     def __init__(self):
-        self._mtx = threading.RLock()
+        self._mtx = cmtsync.RMutex()
         self._keys: list[bytes] = []
         self._data: dict[bytes, bytes] = {}
 
@@ -148,7 +149,7 @@ class SQLiteDB(DB):
         self._path = path
         self._local = threading.local()
         self._conns: list[sqlite3.Connection] = []
-        self._conns_mtx = threading.Lock()
+        self._conns_mtx = cmtsync.Mutex()
         conn = self._conn()
         with conn:
             conn.execute(

@@ -14,6 +14,7 @@ import re
 import threading
 from dataclasses import dataclass, field
 from typing import Any
+from cometbft_tpu.utils import sync as cmtsync
 
 
 class PubSubError(Exception):
@@ -222,7 +223,7 @@ class Server:
     this module depending on the metrics plane."""
 
     def __init__(self, capacity: int = 100, on_drop=None):
-        self._mtx = threading.RLock()
+        self._mtx = cmtsync.RMutex()
         self._capacity = capacity
         self._on_drop = on_drop
         self._subs: dict[tuple[str, Query], Subscription] = {}

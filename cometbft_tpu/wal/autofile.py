@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import re
 import threading
+from cometbft_tpu.utils import sync as cmtsync
 
 DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # group.go:26
 DEFAULT_TOTAL_SIZE_LIMIT = 1024 * 1024 * 1024  # group.go:27
@@ -28,7 +29,7 @@ class Group:
         self.head_path = head_path
         self.head_size_limit = head_size_limit
         self.total_size_limit = total_size_limit
-        self._mtx = threading.Lock()
+        self._mtx = cmtsync.Mutex()
         os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
         self._head = open(head_path, "ab")
         self._min_index, self._max_index = self._scan_indexes()

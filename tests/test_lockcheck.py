@@ -1,0 +1,467 @@
+"""Concurrency correctness toolchain: the static guarded-by lint
+(tools/lockcheck.py), the runtime lock-order graph (CMT_TPU_LOCKGRAPH),
+and race mode (CMT_TPU_RACE) — the Python analog of `go test -race` +
+go-deadlock (SURVEY.md §5, docs/concurrency.md)."""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+
+import pytest
+
+from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.sync import LockOrderError, RaceError
+
+import tools.lockcheck as lockcheck
+
+
+def lint(src: str, rel: str = "cometbft_tpu/fixture.py"):
+    return lockcheck.check_source(textwrap.dedent(src), rel)
+
+
+class TestGuardedLint:
+    """AST fixture cases: clean / violation / waiver / inverse."""
+
+    def test_clean_class_passes(self):
+        rep = lint(
+            """
+            class Clean:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+
+                def bump(self):
+                    with self._mtx:
+                        self._x += 1
+
+                def get(self):
+                    with self._mtx:
+                        return self._x
+            """
+        )
+        assert rep.ok and rep.guarded_fields == 1 and not rep.waivers
+
+    def test_unguarded_access_flagged_with_file_line(self):
+        rep = lint(
+            """
+            class Bad:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+
+                def bump(self):
+                    self._x += 1
+            """
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert v.file == "cometbft_tpu/fixture.py" and v.line == 10
+        assert "_x" in v.message and "_mtx" in v.message
+
+    def test_comment_annotation_form(self):
+        rep = lint(
+            """
+            class Commented:
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._items = []  # guarded by _mtx
+
+                def peek(self):
+                    return self._items[0]
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "_items" in rep.violations[0].message
+
+    def test_holds_marker_allows_caller_locked_methods(self):
+        rep = lint(
+            """
+            class Marked:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+
+                def outer(self):
+                    with self._mtx:
+                        self._bump_locked()
+
+                def _bump_locked(self):  # holds _mtx
+                    self._x += 1
+            """
+        )
+        assert rep.ok
+
+    def test_waiver_counted_not_flagged(self):
+        rep = lint(
+            """
+            class Waived:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+
+                def snapshot(self):
+                    return self._x  # unguarded: stat snapshot
+            """
+        )
+        assert rep.ok
+        assert len(rep.waivers) == 1
+        assert rep.waivers[0].reason == "stat snapshot"
+
+    def test_inverse_check_guard_never_created(self):
+        """An annotation naming a lock the class never creates would
+        silently verify nothing — hard error."""
+        rep = lint(
+            """
+            class Typo:
+                _GUARDED_BY = {"_x": "_mtxx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "never creates self._mtxx" in rep.violations[0].message
+
+    def test_condition_alias_counts_as_lock(self):
+        rep = lint(
+            """
+            class Cond:
+                _GUARDED_BY = {"_q": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.RMutex()
+                    self._cond = threading.Condition(self._mtx)
+                    self._q = []
+
+                def pop(self):
+                    with self._cond:
+                        return self._q.pop()
+            """
+        )
+        assert rep.ok
+
+    def test_init_exempt(self):
+        rep = lint(
+            """
+            class InitOnly:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+                    self._x = self._x + 1
+            """
+        )
+        assert rep.ok
+
+    def test_deferred_closure_does_not_inherit_with_block(self):
+        """A thread target defined inside `with self._mtx:` runs LATER,
+        without the lock — the closure body must not inherit the
+        enclosing with-block's held set."""
+        rep = lint(
+            """
+            class Deferred:
+                _GUARDED_BY = {"_x": "_mtx"}
+
+                def __init__(self):
+                    self._mtx = cmtsync.Mutex()
+                    self._x = 0
+
+                def spawn(self):
+                    with self._mtx:
+                        def worker():
+                            self._x += 1
+                        threading.Thread(target=worker).start()
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "worker()" in rep.violations[0].message
+
+    def test_raw_lock_flagged_in_core(self):
+        rep = lint(
+            """
+            import threading
+            _L = threading.Lock()
+            """,
+            rel="cometbft_tpu/somepkg/mod.py",
+        )
+        assert len(rep.violations) == 1
+        assert "cmtsync seam" in rep.violations[0].message
+
+    def test_raw_lock_allowed_in_leaf_files(self):
+        rep = lint(
+            "import threading\n_L = threading.RLock()\n",
+            rel="cometbft_tpu/utils/bit_array.py",
+        )
+        assert rep.ok
+
+
+class TestLockcheckTree:
+    """Tier-1 wiring: the real annotated tree must lint clean — the
+    same gate `make lockcheck` and tools/metrics_lint.py main() run."""
+
+    def test_repo_is_clean(self):
+        rep = lockcheck.check_tree()
+        assert rep.ok, "\n".join(str(v) for v in rep.violations)
+        # the annotation sweep is real, not vestigial
+        assert rep.classes >= 8
+        assert rep.guarded_fields >= 40
+
+    def test_main_exit_zero(self, capsys):
+        assert lockcheck.main([]) == 0
+        assert "guarded fields" in capsys.readouterr().out
+
+
+class TestLockGraph:
+    """CMT_TPU_LOCKGRAPH: acquisition-order cycle detection."""
+
+    @pytest.fixture(autouse=True)
+    def lockgraph_mode(self, monkeypatch):
+        monkeypatch.setattr(cmtsync, "_LOCKGRAPH", True)
+        cmtsync._reset_lock_graph()
+        yield
+        cmtsync._reset_lock_graph()
+
+    def test_abba_cycle_reported_with_both_stacks(self):
+        a = cmtsync.Mutex()
+        b = cmtsync.Mutex()
+
+        def first_order():
+            with a:
+                with b:
+                    pass
+
+        def second_order():
+            with b:
+                with a:  # ABBA — never actually deadlocks here
+                    pass
+
+        first_order()
+        with pytest.raises(LockOrderError) as exc:
+            second_order()
+        msg = str(exc.value)
+        assert "LOCK-ORDER CYCLE" in msg
+        # both acquisition stacks, à la go-deadlock
+        assert "this acquisition" in msg and "prior acquisition" in msg
+        assert "second_order" in msg and "first_order" in msg
+
+    def test_consistent_order_is_clean(self):
+        a, b = cmtsync.Mutex(), cmtsync.Mutex()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert len(cmtsync.lock_order_edges()) == 1
+
+    def test_reentrant_rlock_no_self_edge(self):
+        r = cmtsync.RMutex()
+        with r:
+            with r:
+                pass
+        assert cmtsync.lock_order_edges() == []
+
+    def test_cross_thread_cycle_detected_without_hanging(self):
+        """The go-deadlock pitch: the cycle is caught even when the
+        interleaving that would actually deadlock never happens."""
+        a, b = cmtsync.Mutex(), cmtsync.Mutex()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join(timeout=10)
+        with pytest.raises(LockOrderError):
+            with b:
+                with a:
+                    pass
+
+
+class TestRaceMode:
+    """CMT_TPU_RACE: unguarded cross-thread writes on guarded fields."""
+
+    @pytest.fixture(autouse=True)
+    def race_mode(self, monkeypatch):
+        monkeypatch.setattr(cmtsync, "_RACE", True)
+        cmtsync._reset_race_state()
+        yield
+        cmtsync._reset_race_state()
+
+    def _fixture_cls(self):
+        @cmtsync.guarded
+        class Counter:
+            _GUARDED_BY = {"value": "_mtx"}
+
+            def __init__(self):
+                self._mtx = cmtsync.Mutex()
+                self.value = 0
+
+            def bump_guarded(self):
+                with self._mtx:
+                    self.value += 1
+
+            def bump_unguarded(self):
+                self.value += 1
+
+        return Counter
+
+    def test_cross_thread_unguarded_write_raises_with_both_stacks(self):
+        """Seeded race: a concurrent thread touched the field (guarded),
+        and we write it unguarded while that thread is still live.  A
+        JOINED thread would not count — join is a happens-before edge,
+        exactly like TSan (see test below)."""
+        c = self._fixture_cls()()
+        wrote = threading.Event()
+        release = threading.Event()
+
+        def writer():
+            c.bump_guarded()
+            wrote.set()
+            release.wait(timeout=10)
+
+        t = threading.Thread(target=writer, name="writer")
+        t.start()
+        try:
+            assert wrote.wait(timeout=10)
+            with pytest.raises(RaceError) as exc:
+                c.bump_unguarded()
+        finally:
+            release.set()
+            t.join(timeout=10)
+        msg = str(exc.value)
+        assert "Counter.value" in msg and "_mtx" in msg
+        assert "this access" in msg and "previous access" in msg
+        assert "writer" in msg  # the other thread's identity
+
+    def test_joined_thread_is_happens_before(self):
+        """start(); join(); mutate — sequential by construction, so no
+        report even though two thread idents touched the field."""
+        c = self._fixture_cls()()
+        t = threading.Thread(target=c.bump_guarded)
+        t.start()
+        t.join(timeout=10)
+        c.bump_unguarded()  # no RaceError: the writer exited
+        assert c.value == 2
+
+    def test_guarded_cross_thread_writes_clean(self):
+        c = self._fixture_cls()()
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(50):
+                    c.bump_guarded()
+            except RaceError as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+        # unguarded READ after the joins: never trips the checker —
+        # reads are the static lint's domain (docs/concurrency.md)
+        assert c.value == 200
+
+    def test_single_thread_unguarded_is_clean(self):
+        c = self._fixture_cls()()
+        for _ in range(10):
+            c.bump_unguarded()
+        assert c.value == 10
+
+    def test_cond_wait_in_nested_rlock_keeps_held_tracking(self):
+        """Condition.wait on an RMutex held at depth 2 releases every
+        recursion level and must restore the held-set to the same
+        depth — a guarded write right after wait() returning must not
+        be misjudged as unguarded (false RaceError)."""
+
+        @cmtsync.guarded
+        class Box:
+            _GUARDED_BY = {"v": "_mtx"}
+
+            def __init__(self):
+                self._mtx = cmtsync.RMutex()
+                self._cond = threading.Condition(self._mtx)
+                self.v = 0
+
+        b = Box()
+        done = threading.Event()
+        errs = []
+
+        def waiter():
+            try:
+                with b._mtx:           # depth 1
+                    with b._cond:      # depth 2, same lock
+                        b._cond.wait(timeout=10)
+                        b.v += 1       # still guarded after restore
+            except RaceError as e:  # pragma: no cover
+                errs.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=waiter, name="cond-waiter")
+        t.start()
+        deadline = 50
+        while not done.is_set() and deadline > 0:
+            with b._mtx:
+                b.v += 1               # guarded write racing the waiter
+                b._cond.notify_all()
+            done.wait(timeout=0.1)
+            deadline -= 1
+        t.join(timeout=10)
+        assert not errs, errs
+        assert b.v >= 2
+
+    def test_real_class_operates_clean_under_race_mode(self):
+        """A production guarded class (TxCache), hammered from multiple
+        threads through its locked API, must not trip the checker."""
+        from cometbft_tpu.mempool import TxCache
+
+        cache = cmtsync.guarded(TxCache)(64)
+        errs = []
+
+        def worker(seed: int):
+            try:
+                for i in range(40):
+                    cache.push(b"%d-%d" % (seed, i))
+                    cache.has(b"%d-%d" % (seed, i))
+            except RaceError as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+
+
+class TestDisabledModesZeroCost:
+    def test_factories_return_plain_locks(self, monkeypatch):
+        monkeypatch.setattr(cmtsync, "_ENABLED", False)
+        monkeypatch.setattr(cmtsync, "_LOCKGRAPH", False)
+        monkeypatch.setattr(cmtsync, "_RACE", False)
+        assert isinstance(cmtsync.Mutex(), type(threading.Lock()))
+
+    def test_guarded_is_identity_when_off(self, monkeypatch):
+        monkeypatch.setattr(cmtsync, "_RACE", False)
+
+        class C:
+            _GUARDED_BY = {"x": "_mtx"}
+
+        assert cmtsync.guarded(C) is C

@@ -459,12 +459,32 @@ class _PlainConn:
         return buf
 
     def close(self):
+        import socket as _socket
+
+        # close() alone does NOT wake a thread parked in recv() on the
+        # same fd — the recv routine would leak (the wire suites gate
+        # on thread leaks); shutdown delivers EOF to it first
+        try:
+            self.sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
         self.sock.close()
 
 
 class TestWireMetrics:
     """Loopback MConnection pair, RPC dispatch, and event-bus
     backpressure — the wire-plane layer (docs/observability.md)."""
+
+    @pytest.fixture(autouse=True)
+    def _gate_on_thread_leaks(self):
+        """leaktest analog for the wire plane: every loopback suite
+        must wind down its MConnection send/recv/ping (and any switch
+        accept) threads — daemons included, which the default leak
+        check ignores (docs/concurrency.md)."""
+        from cometbft_tpu.utils.sync import assert_no_thread_leaks
+
+        with assert_no_thread_leaks(grace=5.0, daemons_too=True):
+            yield
 
     def _mconn_over_socketpair(self, m, chs=None, gate=None, **cfg_kw):
         """One instrumented MConnection (peer 'wire-a') talking to a

@@ -11,6 +11,7 @@ import pytest
 
 from cometbft_tpu.utils import sync as cmtsync
 from cometbft_tpu.utils.sync import (
+    LockOrderError,
     PotentialDeadlock,
     _WatchdogLock,
     assert_no_thread_leaks,
@@ -28,7 +29,11 @@ class TestWatchdogLock:
 
     def test_ab_ba_deadlock_detected_not_hung(self):
         """The classic lock-ordering deadlock raises with stack dumps
-        instead of hanging both threads forever."""
+        instead of hanging both threads forever.  Under
+        CMT_TPU_LOCKGRAPH=1 (make test-race) the order graph raises
+        LockOrderError BEFORE either thread blocks; otherwise the
+        watchdog times out with PotentialDeadlock — either way, no
+        hang and no silent pass."""
         a = _WatchdogLock(threading.Lock(), timeout=0.5)
         b = _WatchdogLock(threading.Lock(), timeout=0.5)
         errs = []
@@ -40,7 +45,7 @@ class TestWatchdogLock:
                     barrier.wait()
                     with b:
                         pass
-            except PotentialDeadlock as exc:
+            except (PotentialDeadlock, LockOrderError) as exc:
                 errs.append(exc)
 
         def t2():
@@ -49,7 +54,7 @@ class TestWatchdogLock:
                     barrier.wait()
                     with a:
                         pass
-            except PotentialDeadlock as exc:
+            except (PotentialDeadlock, LockOrderError) as exc:
                 errs.append(exc)
 
         th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
@@ -57,13 +62,16 @@ class TestWatchdogLock:
         th1.join(timeout=10); th2.join(timeout=10)
         assert not th1.is_alive() and not th2.is_alive()
         assert errs, "deadlock went undetected"
-        assert "last acquired at" in str(errs[0])
+        msg = str(errs[0])
+        assert "last acquired at" in msg or "LOCK-ORDER CYCLE" in msg
 
     def test_factory_returns_plain_lock_when_disabled(self, monkeypatch):
         # the deadlock LANE itself runs with CMT_TPU_DEADLOCK=1 (and
         # the module latches the env at import), so assert against the
         # latched flag rather than assuming the plain-mode environment
         monkeypatch.setattr(cmtsync, "_ENABLED", False)
+        monkeypatch.setattr(cmtsync, "_LOCKGRAPH", False)
+        monkeypatch.setattr(cmtsync, "_RACE", False)
         lk = cmtsync.Mutex()
         assert isinstance(lk, type(threading.Lock()))
         monkeypatch.setattr(cmtsync, "_ENABLED", True)

@@ -1,0 +1,430 @@
+"""lockcheck: static guarded-by lint — the compile-time half of the
+concurrency toolchain (runtime half: CMT_TPU_LOCKGRAPH / CMT_TPU_RACE
+in cometbft_tpu/utils/sync.py; docs/concurrency.md is the manual).
+
+The reference keeps its threaded core honest with ``go test -race``
+and go-deadlock; neither exists for Python, so this AST pass enforces
+the documented locking discipline instead:
+
+1. **Guarded-field check.**  A class declares which lock protects
+   which attribute, either with a trailing ``# guarded by <lock>``
+   comment on the ``self.<field> = ...`` assignment or with a
+   class-level ``_GUARDED_BY = {"field": "_lock"}`` registry (the
+   registry also feeds the runtime race checker via
+   ``@cmtsync.guarded``).  Every ``self.<field>`` access in the class
+   must then occur lexically inside a ``with self.<lock>:`` block, or
+   in a method whose ``def`` line (or the line above it) carries a
+   ``# holds <lock>`` marker (the caller-holds-lock contract), or on
+   a line carrying an explicit ``# unguarded: <reason>`` waiver —
+   waivers are counted and reported so they stay auditable.
+   ``__init__`` bodies are exempt (the object cannot have escaped).
+   ``with self.<cond>:`` counts for the lock when the class creates
+   ``self.<cond> = threading.Condition(self.<lock>)``.
+
+2. **Inverse annotation check.**  An annotation naming a lock
+   attribute the class never assigns is an error — a typo'd guard
+   name would otherwise silently verify nothing.
+
+3. **Seam check.**  Raw ``threading.Lock()`` / ``threading.RLock()``
+   construction in core packages bypasses the ``cmtsync`` seam, so
+   the deadlock watchdog, the lock-order graph, and race mode cannot
+   see those locks.  Only the audited leaf-lock files in
+   ``RAW_LOCK_OK`` (fine-grained locks under which no other lock is
+   ever acquired — see docs/concurrency.md) may construct raw locks.
+
+Known static limits (the runtime modes cover these): accesses through
+a non-``self`` receiver (``other._field``), dynamic ``getattr``, and
+callers of a ``# holds`` method are not verified.
+
+    python tools/lockcheck.py           # exit 0 clean, 1 with a report
+    python tools/lockcheck.py -v        # also list waivers
+
+Run in the tier-1 flow via tests/test_lockcheck.py and standalone via
+``make lockcheck``; tools/metrics_lint.py main() gates on it too.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: packages whose lock constructions must go through cmtsync
+SCAN_ROOT = "cometbft_tpu"
+
+#: audited leaf-lock files allowed to construct raw threading locks:
+#: the seam itself, plus fine-grained primitives whose locks are never
+#: held across another acquire (see docs/concurrency.md "leaf locks")
+RAW_LOCK_OK = frozenset(
+    {
+        os.path.join("cometbft_tpu", "utils", "sync.py"),
+        os.path.join("cometbft_tpu", "utils", "log.py"),
+        os.path.join("cometbft_tpu", "utils", "metrics.py"),
+        os.path.join("cometbft_tpu", "utils", "trace.py"),
+        os.path.join("cometbft_tpu", "utils", "flowrate.py"),
+        os.path.join("cometbft_tpu", "utils", "bit_array.py"),
+        os.path.join("cometbft_tpu", "utils", "native_build.py"),
+        os.path.join("cometbft_tpu", "utils", "kv_native.py"),
+        os.path.join("cometbft_tpu", "utils", "service.py"),
+    }
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded\s+by\s+([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*(?:caller[\s-]holds|holds)[:\s]+([A-Za-z_]\w*)")
+_WAIVER_RE = re.compile(r"#\s*unguarded:\s*(\S.*)")
+
+
+@dataclass
+class Violation:
+    file: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.message}"
+
+
+@dataclass
+class Waiver:
+    file: str
+    line: int
+    cls: str
+    field: str
+    reason: str
+
+    def __str__(self) -> str:
+        return (
+            f"{self.file}:{self.line}: {self.cls}.{self.field} "
+            f"unguarded — {self.reason}"
+        )
+
+
+@dataclass
+class Report:
+    violations: list[Violation] = field(default_factory=list)
+    waivers: list[Waiver] = field(default_factory=list)
+    guarded_fields: int = 0
+    classes: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def merge(self, other: "Report") -> None:
+        self.violations.extend(other.violations)
+        self.waivers.extend(other.waivers)
+        self.guarded_fields += other.guarded_fields
+        self.classes += other.classes
+
+
+def _comments_by_line(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    """``cmtsync.Mutex()`` / ``Mutex()`` / ``threading.Lock()`` etc."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    return name in {"Mutex", "RMutex", "Lock", "RLock"}
+
+
+def _is_raw_lock_ctor(node: ast.expr) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` specifically."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id == "threading" and fn.attr in {"Lock", "RLock"}
+    return False
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _condition_alias(node: ast.expr) -> str | None:
+    """RHS ``threading.Condition(self.<lock>)`` -> the lock attr."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name != "Condition":
+        return None
+    return _self_attr(node.args[0])
+
+
+class _ClassChecker:
+    def __init__(
+        self,
+        rel: str,
+        cls: ast.ClassDef,
+        comments: dict[int, str],
+        report: Report,
+    ):
+        self.rel = rel
+        self.cls = cls
+        self.comments = comments
+        self.report = report
+        self.guarded: dict[str, str] = {}       # field -> lock attr
+        self.guard_lines: dict[str, int] = {}   # field -> annotation line
+        self.assigned_attrs: set[str] = set()   # every self.X = ... target
+        self.cond_alias: dict[str, str] = {}    # cond attr -> lock attr
+
+    def run(self) -> None:
+        self._collect()
+        if not self.guarded:
+            return
+        self.report.classes += 1
+        self.report.guarded_fields += len(self.guarded)
+        self._check_inverse()
+        for item in self.cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if item.name == "__init__":
+                    continue
+                self._check_method(item)
+
+    # -- annotation collection -----------------------------------------
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.cls):
+            # registry: _GUARDED_BY = {"field": "_mtx", ...}
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id == "_GUARDED_BY"
+                        and isinstance(node.value, ast.Dict)
+                    ):
+                        for k, v in zip(node.value.keys, node.value.values):
+                            if isinstance(k, ast.Constant) and isinstance(
+                                v, ast.Constant
+                            ):
+                                self.guarded[str(k.value)] = str(v.value)
+                                self.guard_lines[str(k.value)] = node.lineno
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], None
+            for tgt in targets:
+                # tuple targets: self._a, self._b = ...
+                elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                for el in elts:
+                    attr = _self_attr(el)
+                    if attr is None:
+                        continue
+                    self.assigned_attrs.add(attr)
+                    comment = self.comments.get(el.lineno, "")
+                    m = _GUARDED_RE.search(comment)
+                    if m:
+                        self.guarded[attr] = m.group(1)
+                        self.guard_lines[attr] = el.lineno
+            if value is not None and targets:
+                alias = _condition_alias(value)
+                attr = _self_attr(targets[0])
+                if alias and attr:
+                    self.cond_alias[attr] = alias
+
+    def _check_inverse(self) -> None:
+        for fname, lock in sorted(self.guarded.items()):
+            if lock not in self.assigned_attrs:
+                self.report.violations.append(
+                    Violation(
+                        self.rel,
+                        self.guard_lines.get(fname, self.cls.lineno),
+                        f"{self.cls.name}.{fname} is annotated "
+                        f"'guarded by {lock}' but the class never "
+                        f"creates self.{lock}",
+                    )
+                )
+
+    # -- per-method access verification --------------------------------
+
+    def _holds_marker(self, fn: ast.FunctionDef) -> set[str]:
+        """``# holds <lock>`` on the line above ``def``, or anywhere on
+        the (possibly multi-line) signature up to the first body
+        statement."""
+        held: set[str] = set()
+        body_start = fn.body[0].lineno if fn.body else fn.lineno + 1
+        for line in range(fn.lineno - 1, body_start):
+            m = _HOLDS_RE.search(self.comments.get(line, ""))
+            if m:
+                held.add(m.group(1))
+        return held
+
+    def _check_method(self, fn: ast.FunctionDef) -> None:
+        base_held = self._holds_marker(fn)
+        self._walk(fn.body, base_held, fn.name)
+
+    def _resolve(self, attr: str) -> str:
+        """A with-context attr: the lock itself, or a Condition alias."""
+        return self.cond_alias.get(attr, attr)
+
+    def _walk(self, body: list[ast.stmt], held: set[str], where: str) -> None:
+        for stmt in body:
+            self._visit(stmt, held, where)
+
+    def _visit(self, node: ast.AST, held: set[str], where: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def is (potentially) deferred — a thread target
+            # or callback runs WITHOUT the enclosing with-block's lock,
+            # so it starts from only its own `# holds` markers
+            for default in node.args.defaults + node.args.kw_defaults:
+                if default is not None:
+                    self._visit(default, held, where)
+            self._walk(node.body, self._holds_marker(node), node.name)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit(node.body, set(), f"{where}.<lambda>")
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    inner.add(self._resolve(attr))
+            for expr in (i.context_expr for i in node.items):
+                self._visit(expr, held, where)
+            self._walk(node.body, inner, where)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and attr in self.guarded:
+                self._check_access(node, attr, held, where)
+            # keep walking (chained attributes)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, where)
+
+    def _check_access(
+        self, node: ast.Attribute, attr: str, held: set[str], where: str
+    ) -> None:
+        lock = self.guarded[attr]
+        if lock in held:
+            return
+        m = _WAIVER_RE.search(self.comments.get(node.lineno, ""))
+        if m:
+            self.report.waivers.append(
+                Waiver(
+                    self.rel, node.lineno, self.cls.name, attr,
+                    m.group(1).strip(),
+                )
+            )
+            return
+        kind = (
+            "written" if isinstance(node.ctx, (ast.Store, ast.Del))
+            else "read"
+        )
+        self.report.violations.append(
+            Violation(
+                self.rel,
+                node.lineno,
+                f"{self.cls.name}.{attr} (guarded by {lock}) {kind} in "
+                f"{where}() without holding self.{lock} — wrap in "
+                f"'with self.{lock}:', mark the method '# holds {lock}', "
+                "or waive with '# unguarded: <reason>'",
+            )
+        )
+
+
+def check_source(source: str, rel: str) -> Report:
+    """Lint one file's source; ``rel`` is the path used in reports."""
+    report = Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        report.violations.append(
+            Violation(rel, exc.lineno or 0, f"syntax error: {exc.msg}")
+        )
+        return report
+    comments = _comments_by_line(source)
+
+    if rel not in RAW_LOCK_OK:
+        for node in ast.walk(tree):
+            if _is_raw_lock_ctor(node):
+                report.violations.append(
+                    Violation(
+                        rel,
+                        node.lineno,
+                        "raw threading.Lock()/RLock() bypasses the "
+                        "cmtsync seam (deadlock watchdog, lock-order "
+                        "graph, and race mode cannot see it) — use "
+                        "cmtsync.Mutex()/RMutex(), or add this audited "
+                        "leaf-lock file to RAW_LOCK_OK",
+                    )
+                )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _ClassChecker(rel, node, comments, report).run()
+    return report
+
+
+def check_tree(root: str = SCAN_ROOT) -> Report:
+    report = Report()
+    base = os.path.join(REPO, root)
+    for dirpath, dirnames, names in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for n in sorted(names):
+            if not n.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, n)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as fh:
+                report.merge(check_source(fh.read(), rel))
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    verbose = "-v" in argv
+    report = check_tree()
+    for v in report.violations:
+        print(f"lockcheck: {v}", file=sys.stderr)
+    if verbose:
+        for w in report.waivers:
+            print(f"lockcheck: waiver: {w}")
+    if report.ok:
+        print(
+            f"lockcheck: {report.guarded_fields} guarded fields across "
+            f"{report.classes} classes verified; "
+            f"{len(report.waivers)} audited unguarded waivers"
+        )
+        return 0
+    print(
+        f"lockcheck: {len(report.violations)} violations "
+        f"({len(report.waivers)} waivers)",
+        file=sys.stderr,
+    )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,10 +143,12 @@ def find_unregistered() -> dict[str, list[str]]:
 def main() -> int:
     missing = find_unreferenced()
     unregistered = find_unregistered()
+    rc = 0
     if not missing and not unregistered:
         print(f"metrics-lint: {len(registered_fields())} fields, all "
               "referenced; no unregistered update sites")
-        return 0
+    else:
+        rc = 1
     for field, owners in missing.items():
         print(
             f"metrics-lint: {'/'.join(owners)}.{field} is registered "
@@ -159,7 +161,13 @@ def main() -> int:
             "but registered by no metrics struct",
             file=sys.stderr,
         )
-    return 1
+    # one command gates both lints: the guarded-by/lock-seam check
+    # (tools/lockcheck.py) runs here too, so CI needs a single entry
+    from tools import lockcheck  # REPO is on sys.path (above)
+
+    if lockcheck.main([]) != 0:
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
