@@ -4,16 +4,17 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck wire-smoke
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
 # randomized-manifest e2e, interpret-mode pallas trace) are skipped;
 # target <15 min single-core (reference analog: tests.mk:66-87 CI
 # package splits). The r4 default gate had grown to 48 min.
-# Both lints gate the default flow — metrics-lint runs lockcheck too,
-# so one prerequisite covers both (and both run inside tier-1 via
-# tests/test_metrics.py + tests/test_lockcheck.py).
+# All three lints gate the default flow — metrics-lint runs lockcheck
+# AND jitcheck too, so one prerequisite covers them (and all run
+# inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
+# tests/test_jitcheck.py).
 test: metrics-lint
 	$(PY) -m pytest tests/ -x -q
 
@@ -87,6 +88,20 @@ metrics-lint:
 # locks, no raw threading.Lock() in core packages
 lockcheck:
 	$(PY) tools/lockcheck.py
+
+# static device-path lint (docs/device_contracts.md): jax.jit only
+# through registered memoized seams keyed on the shape ladder, no jit
+# closures over mutable module globals, audited host-sync waivers,
+# kernel shape/dtype contracts declared and well-formed
+jitcheck:
+	$(PY) tools/jitcheck.py
+
+# go test -race analog for the DEVICE plane: the jit/contract suite
+# under CMT_TPU_JITGUARD=1 — a post-warmup retrace raises RetraceError
+# with both compile-site stacks; an implicit host<->device transfer in
+# the sealed verify window raises at the offending line
+test-jitguard:
+	CMT_TPU_JITGUARD=1 $(PY) -m pytest tests/test_jitcheck.py -q
 
 # wire-plane telemetry smoke: the loopback MConnection pair + RPC
 # dispatch + event-bus assertions, standalone (tier-1 runs them too)

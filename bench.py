@@ -97,6 +97,7 @@ def main(checkpoint=None) -> dict:
 
     from cometbft_tpu.utils.trace import TRACER as _tr
     from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.ops import jitguard as _jg
     from cometbft_tpu.ops.ed25519_verify import (
         _finish,
         verify_arrays,
@@ -105,6 +106,24 @@ def main(checkpoint=None) -> dict:
     )
 
     import numpy as np
+
+    from contextlib import contextmanager
+
+    # provenance: per-seam compile counts during warmup, and any
+    # compile observed DURING a measured steady-state section — the
+    # number future perf PRs assert to be zero (a steady-state retrace
+    # is a silent multi-second stall; docs/device_contracts.md)
+    steady_retraces: dict[str, int] = {}
+
+    @contextmanager
+    def _measured(section: str):
+        before = sum(_jg.compile_counts().values())
+        yield
+        delta = sum(_jg.compile_counts().values()) - before
+        steady_retraces[section] = steady_retraces.get(section, 0) + delta
+        if delta:
+            log(f"WARNING: {delta} recompile(s) during measured "
+                f"section '{section}' — steady state is not steady")
 
     dev = jax.devices()[0]
     log(f"device: {dev}")
@@ -160,6 +179,7 @@ def main(checkpoint=None) -> dict:
             )
             + ")"
         )
+        result["jit_compiles"] = _jg.compile_counts()  # empty: no device
         return result
 
     n = int(os.environ.get("CMT_BENCH_N", "4096"))
@@ -256,13 +276,14 @@ def main(checkpoint=None) -> dict:
             for trial in range(3):
                 t0 = time.time()
                 total = 0
-                for res in verify_stream(
-                    ((kpubs, ksigs, kmsgs) for _ in range(nchunks)),
-                    max_in_flight=nchunks,
-                    dispatch=keyed_dispatch,
-                ):
-                    assert bool(res.all())
-                    total += len(res)
+                with _measured(f"keyed_{label}"):
+                    for res in verify_stream(
+                        ((kpubs, ksigs, kmsgs) for _ in range(nchunks)),
+                        max_in_flight=nchunks,
+                        dispatch=keyed_dispatch,
+                    ):
+                        assert bool(res.all())
+                        total += len(res)
                 dt = time.time() - t0
                 rate = total / dt
                 log(
@@ -380,12 +401,13 @@ def main(checkpoint=None) -> dict:
         t0 = time.time()
         total = 0
         with _tr.span("bench/generic_pipelined", cat="bench", trial=trial):
-            for res in verify_stream(
-                ((pubs, sigs, msgs) for _ in range(nchunks)),
-                max_in_flight=nchunks,
-            ):
-                assert bool(res.all())
-                total += len(res)
+            with _measured("generic_pipelined"):
+                for res in verify_stream(
+                    ((pubs, sigs, msgs) for _ in range(nchunks)),
+                    max_in_flight=nchunks,
+                ):
+                    assert bool(res.all())
+                    total += len(res)
         dt = time.time() - t0
         rate = total / dt
         log(
@@ -397,6 +419,10 @@ def main(checkpoint=None) -> dict:
     result = make_result(generic_best, keyed_best, note)
     if keyed_cfg is not None and keyed_best > generic_best:
         result["keyed_cols_impl"] = keyed_cfg
+    # warmup-phase compile counts per seam + recompiles seen inside
+    # measured sections (assertable steady-state provenance)
+    result["jit_compiles"] = _jg.compile_counts()
+    result["steady_retraces"] = steady_retraces
     return result
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+from cometbft_tpu.utils.toml_compat import tomllib
 from dataclasses import dataclass, field, fields
 
 _NS = {

@@ -19,7 +19,7 @@ is operator-data loss.
 from __future__ import annotations
 
 import os
-import tomllib
+from cometbft_tpu.utils.toml_compat import tomllib
 from dataclasses import dataclass
 
 from cometbft_tpu.config import Config, ConfigError, default_config

@@ -5,6 +5,12 @@ type: the TPU (JAX/XLA) batch kernel when a device is usable, else the
 CPU fallback. The selection is behind this single seam so every caller
 (VerifyCommit, light client, blocksync replay, consensus addVote) gets
 the device path for free.
+
+This file sits in tools/jitcheck.py's host-sync scan scope (with
+ops/ and parallel/): any np.asarray / .item() / device fetch added on
+the dispatch path must carry an audited ``# host sync:`` waiver
+(docs/device_contracts.md) — today it has none, by design: all device
+I/O lives behind the verifier seams it selects.
 """
 
 from __future__ import annotations

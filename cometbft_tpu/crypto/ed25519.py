@@ -20,9 +20,15 @@ from __future__ import annotations
 import os
 import time
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ed25519 as _ced
+try:  # gated optional dep: environments without `cryptography` fall
+    # back to the pure-Python ZIP-215 oracle for every operation —
+    # slower (~5 ms/op) but bit-identical semantics (the oracle IS the
+    # ground truth the fast path is differentially tested against)
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ced
+except ImportError:  # pragma: no cover - environment-dependent
+    InvalidSignature = serialization = _ced = None
 
 from cometbft_tpu.crypto import BatchVerifier, PrivKey, PubKey, tmhash
 from cometbft_tpu.crypto import edwards
@@ -60,7 +66,7 @@ class Ed25519PubKey(PubKey):
                 self._lib_key = _ced.Ed25519PublicKey.from_public_bytes(
                     self._bytes
                 )
-            except Exception:
+            except Exception:  # incl. _ced=None (no `cryptography`)
                 self._lib_key = False
         if self._lib_key:
             try:
@@ -85,6 +91,10 @@ class Ed25519PrivKey(PrivKey):
         if len(data) != SEED_SIZE:
             raise ValueError("ed25519 private key must be 32 or 64 bytes")
         self._seed = bytes(data)
+        if _ced is None:  # no `cryptography`: pure-Python oracle path
+            self._lib_key = None
+            self._pub = Ed25519PubKey(edwards.public_key(self._seed))
+            return
         self._lib_key = _ced.Ed25519PrivateKey.from_private_bytes(self._seed)
         self._pub = Ed25519PubKey(
             self._lib_key.public_key().public_bytes(
@@ -97,6 +107,8 @@ class Ed25519PrivKey(PrivKey):
         return self._seed + self._pub.bytes()
 
     def sign(self, msg: bytes) -> bytes:
+        if self._lib_key is None:
+            return edwards.sign(self._seed, msg)
         return self._lib_key.sign(msg)
 
     def pub_key(self) -> Ed25519PubKey:

@@ -359,6 +359,7 @@ class CryptoMetrics:
             self.key_pool_builds = self.key_pool_evictions = _NOP
             self.key_pool_retraces = _NOP
             self.bytes_transferred = _NOP
+            self.jit_cache_misses = self.guard_trips = _NOP
             return
         s = "crypto"
         self.batch_verify_launches = reg.counter(
@@ -418,6 +419,20 @@ class CryptoMetrics:
             s, "bytes_transferred",
             "Bytes moved across the host-device link (h2d | d2h).",
             labels=("direction",),
+        )
+        self.jit_cache_misses = reg.counter(
+            s, "jit_cache_misses",
+            "Compile-cache misses per registered jit seam "
+            "(generic | chunked | keyed | table_build | sharded) — "
+            "steady state should add zero (ops/jitguard.py).",
+            labels=("seam",),
+        )
+        self.guard_trips = reg.counter(
+            s, "guard_trips",
+            "CMT_TPU_JITGUARD trips: a post-warmup retrace or a "
+            "disallowed implicit host-device transfer in the verify "
+            "window (kind: retrace | transfer).",
+            labels=("kind",),
         )
 
 

@@ -253,3 +253,74 @@ def window_mul(k_nibbles, p):
 def mul8(p):
     """[8]P — the cofactor clearing in the ZIP-215 equation."""
     return pt_double(pt_double(pt_double(p)))
+
+
+#: kernel shape/dtype contracts (grammar: ops/contracts.py; verified
+#: statically by tools/jitcheck.py, swept devicelessly by
+#: tests/test_jitcheck.py).  An extended point is four i32 (NLIMBS, B)
+#: coordinate planes (X, Y, Z, T).
+_CONTRACTS = {
+    "decompress": {
+        "args": {"enc": ("u8", (32, "B"))},
+        "static": (),
+        "out": [
+            [
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+            ],
+            ("bool", ("B",)),
+        ],
+    },
+    "nibbles_from_bytes_le": {
+        "args": {"b": ("u8", (32, "B"))},
+        "static": (),
+        "out": ("i32", (64, "B")),
+    },
+    "comb_mul_base": {
+        "args": {"s_nibbles": ("i32", (64, "B"))},
+        "static": (),
+        "out": [
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+        ],
+    },
+    "window_mul": {
+        "args": {
+            "k_nibbles": ("i32", (64, "B")),
+            "p": [
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+            ],
+        },
+        "static": (),
+        "out": [
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+        ],
+    },
+    "mul8": {
+        "args": {
+            "p": [
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+                ("i32", ("NLIMBS", "B")),
+            ],
+        },
+        "static": (),
+        "out": [
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+        ],
+    },
+}

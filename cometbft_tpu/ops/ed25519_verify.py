@@ -47,6 +47,8 @@ from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
+from cometbft_tpu.ops import field as _F
+from cometbft_tpu.ops import jitguard as _jitguard
 from cometbft_tpu.utils.trace import TRACER as _tracer
 from cometbft_tpu.ops import scalar as SC
 from cometbft_tpu.ops import sha512 as SH
@@ -61,6 +63,17 @@ _MIN_BATCH = 8
 #: 8192 sustains peak device rate; 65536 in one launch hits an
 #: XLA memory cliff.
 MAX_LAUNCH = int(os.environ.get("CMT_TPU_MAX_LAUNCH", 8192))
+
+
+def nblocks_for_bucket(bucket: int) -> int:
+    """SHA-512 block count for a message bucket: 64 bytes of R||A
+    prefix + the bucket + 17 bytes of minimal padding (0x80 marker +
+    16-byte length), in 128-byte blocks.  The ONE definition shared by
+    the compile seams and the contract sweep (ops/contracts.ladder_env)
+    — a layout change must move both together.
+    """
+    return (64 + bucket + 17 + 127) // 128
+
 
 
 def build_padded_input(r_enc, a_enc, msg, msglen, nblocks: int):
@@ -196,10 +209,15 @@ _kernel_cache: dict[tuple[int, int], object] = {}
 
 
 def _compiled(batch: int, bucket: int):
-    key = (batch, bucket)
+    # F.trace_config() in the key: program-shaping flags (COLS_IMPL /
+    # SQUARE_IMPL / _DEBUG_CHECKS) flipping mid-process must recompile
+    # (counted, and raised after jitguard.seal()), never silently
+    # serve the stale program
+    key = (batch, bucket, _F.trace_config())
     fn = _kernel_cache.get(key)
     if fn is None:
-        nblocks = (64 + bucket + 17 + 127) // 128
+        _jitguard.note_compile("generic", key)
+        nblocks = nblocks_for_bucket(bucket)
         fn = jax.jit(lambda b: verify_kernel_packed(b, bucket, nblocks))
         _kernel_cache[key] = fn
     return fn
@@ -214,10 +232,11 @@ def _compiled_chunked(batch: int, bucket: int, chunk: int):
     cliff never hits) while the whole batch costs ONE dispatch and
     ONE result fetch — the winning trade on a high-RTT tunneled
     backend where every launch/fetch pays ~70ms."""
-    key = (batch, bucket, chunk)
+    key = (batch, bucket, chunk, _F.trace_config())
     fn = _chunked_cache.get(key)
     if fn is None:
-        nblocks = (64 + bucket + 17 + 127) // 128
+        _jitguard.note_compile("chunked", key)
+        nblocks = nblocks_for_bucket(bucket)
         k = batch // chunk
 
         def run(buf):
@@ -302,10 +321,11 @@ def _compiled_keyed(bucket: int, window_bits: int, chunk: int):
     shape; table widths are pow2-padded by the table cache so the
     variant count stays small).  Batches wider than ``chunk`` process
     in lax.map slices — bounded working set, one dispatch."""
-    key = (bucket, window_bits, chunk)
+    key = (bucket, window_bits, chunk, _F.trace_config())
     fn = _keyed_cache.get(key)
     if fn is None:
-        nblocks = (64 + bucket + 17 + 127) // 128
+        _jitguard.note_compile("keyed", key)
+        nblocks = nblocks_for_bucket(bucket)
 
         def run(buf, table, key_valid):
             batch = buf.shape[-1]
@@ -347,8 +367,11 @@ def verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs):
         batch=packed.shape[-1], bucket=bucket,
         window_bits=entry.window_bits,
     ):
+        # valid_device(): the per-entry device copy of the validity
+        # mask — a jnp.asarray here paid an implicit h2d transfer per
+        # LAUNCH (caught by the CMT_TPU_JITGUARD transfer window)
         out = fn(
-            jax.device_put(packed), entry.table, jnp.asarray(entry.valid)
+            jax.device_put(packed), entry.table, entry.valid_device()
         )
     return [(out, n)]
 
@@ -359,9 +382,10 @@ def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     as ONE chunked launch (lax.map over MAX_LAUNCH-wide slices inside
     a single XLA program — bounded working set, single dispatch);
     CMT_TPU_MULTI_LAUNCH=1 restores the multi-launch split for
-    comparison.  Call ``np.asarray`` on the parts (or use
-    verify_stream) to synchronize.  Each device array is pow2/chunk
-    padded — slice to its chunk_len."""
+    comparison.  Synchronize through ``_finish`` (or verify_stream) —
+    one explicit ``jax.device_get`` per batch, the idiom the
+    CMT_TPU_JITGUARD transfer window admits.  Each device array is
+    pow2/chunk padded — slice to its chunk_len."""
     n = len(msgs)
     homogeneous = n > MAX_LAUNCH and not os.environ.get(
         "CMT_TPU_MULTI_LAUNCH"
@@ -404,17 +428,21 @@ def _finish(parts) -> np.ndarray:
     """Synchronize a list of (device_array, chunk_len) parts with ONE
     device->host transfer: results are concatenated ON DEVICE first.
     On a tunneled PJRT backend every blocking fetch pays a full round
-    trip (~70ms measured on axon), so per-chunk np.asarray calls
-    would dominate wall time; one eager jnp.concatenate dispatches
-    asynchronously and the single fetch pays the RTT once."""
+    trip (~70ms measured on axon), so per-chunk fetches would dominate
+    wall time; one eager jnp.concatenate dispatches asynchronously and
+    the single EXPLICIT ``jax.device_get`` pays the RTT once (explicit
+    so the CMT_TPU_JITGUARD transfer window — which disallows implicit
+    transfers — recognizes it as the audited fetch)."""
     if len(parts) == 1:
         p, k = parts[0]
-        out = np.asarray(p)
+        out = jax.device_get(p)  # host sync: the one audited per-batch result fetch
         _crypto_metrics().bytes_transferred.labels(
             direction="d2h"
         ).inc(out.nbytes)
         return out[:k]
-    combined = np.asarray(jnp.concatenate([p for p, _ in parts]))
+    combined = jax.device_get(  # host sync: single combined fetch for all parts
+        jnp.concatenate([p for p, _ in parts])
+    )
     _crypto_metrics().bytes_transferred.labels(
         direction="d2h"
     ).inc(combined.nbytes)
@@ -510,7 +538,7 @@ def _measure_link_rtt() -> float:
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        np.asarray(jax.device_put(probe))
+        np.asarray(jax.device_put(probe))  # host sync: deliberate RTT probe — the round trip IS the measurement
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -620,12 +648,18 @@ class TpuBatchVerifier(BatchVerifier):
             "batch_verify", cat="crypto",
             kernel="keyed" if entry is not None else "generic", batch=n,
         ) as sp:
-            if entry is not None:
-                out = self._run_keyed(
-                    entry, entry.key_ids(self._pubs), pub, sig, self._msgs
-                )
-            else:
-                out = self._run_generic(pub, sig, self._msgs)
+            # steady-state window: once jitguard is armed and sealed,
+            # an implicit host<->device transfer anywhere in the
+            # dispatch raises at the offending line instead of
+            # silently paying the link RTT per batch
+            with _jitguard.transfer_window():
+                if entry is not None:
+                    out = self._run_keyed(
+                        entry, entry.key_ids(self._pubs), pub, sig,
+                        self._msgs,
+                    )
+                else:
+                    out = self._run_generic(pub, sig, self._msgs)
             results = [bool(v) for v in out]
             sp.set(ok=all(results))
         cm.kernel_time_seconds.observe(time.perf_counter() - t0)
@@ -641,3 +675,62 @@ class TpuBatchVerifier(BatchVerifier):
         return _finish(
             verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs)
         )
+
+
+#: shape/dtype contracts for the public kernels (PURE literals —
+#: tools/jitcheck.py verifies them statically against the signatures;
+#: tests/test_jitcheck.py sweeps them through jax.eval_shape across
+#: the bucket ladder; grammar in ops/contracts.py).  Dims: B = batch
+#: lanes, M = message bucket width, nblocks = SHA-512 blocks for the
+#: bucket.  The int32-limb / uint8-packed-buffer representation is
+#: load-bearing (docs/device_contracts.md) — a dtype drift here is a
+#: silent perf or correctness regression on device.
+_CONTRACTS = {
+    "build_padded_input": {
+        "args": {
+            "r_enc": ("u8", (32, "B")),
+            "a_enc": ("u8", (32, "B")),
+            "msg": ("u8", ("M", "B")),
+            "msglen": ("i32", ("B",)),
+        },
+        "static": ("nblocks",),
+        "out": [("u8", ("nblocks*128", "B")), ("i64", ("B",))],
+    },
+    "verify_kernel": {
+        "args": {
+            "pub": ("u8", (32, "B")),
+            "sig": ("u8", (64, "B")),
+            "msg": ("u8", ("M", "B")),
+            "msglen": ("i32", ("B",)),
+        },
+        "static": ("nblocks",),
+        "out": ("bool", ("B",)),
+    },
+    "verify_kernel_packed": {
+        "args": {"buf": ("u8", ("100+bucket", "B"))},
+        "static": ("bucket", "nblocks"),
+        "out": ("bool", ("B",)),
+    },
+    "verify_kernel_keyed": {
+        "args": {
+            "pub": ("u8", (32, "B")),
+            "sig": ("u8", (64, "B")),
+            "msg": ("u8", ("M", "B")),
+            "msglen": ("i32", ("B",)),
+            "key_ids": ("i32", ("B",)),
+            "table": ("i32", ("nwin", 4, "NLIMBS", "cap*nent")),
+            "key_valid": ("bool", ("cap",)),
+        },
+        "static": ("nblocks", "window_bits"),
+        "out": ("bool", ("B",)),
+    },
+    "verify_kernel_keyed_packed": {
+        "args": {
+            "buf": ("u8", ("104+bucket", "B")),
+            "table": ("i32", ("nwin", 4, "NLIMBS", "cap*nent")),
+            "key_valid": ("bool", ("cap",)),
+        },
+        "static": ("bucket", "nblocks", "window_bits"),
+        "out": ("bool", ("B",)),
+    },
+}

@@ -74,7 +74,7 @@ def from_int(x: int) -> np.ndarray:
 def to_int(limbs) -> int:
     """(26, ...) limb array -> python int of lane 0 if batched, or of
     the single element (host helper; accepts lazy/signed limbs)."""
-    arr = np.asarray(limbs, dtype=np.int64)
+    arr = np.asarray(limbs, dtype=np.int64)  # host sync: host helper for tests/table generation, never on the verify path
     return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(NLIMBS))
 
 
@@ -146,16 +146,58 @@ SQUARE_IMPL = _os.environ.get("CMT_TPU_SQUARE_IMPL", "fast")
 _DEBUG_CHECKS = bool(_os.environ.get("CMT_TPU_DEBUG_CHECKS"))
 
 
+def trace_config() -> tuple:
+    """The module globals that shape the TRACED program (column
+    strategy, square strategy, the debug-check insertion).  The
+    ``_compiled*`` memoizers (ops/ed25519_verify, ops/precompute,
+    parallel/mesh) fold this tuple into their cache keys: flipping any
+    of these flags mid-process then used to silently serve the STALE
+    compiled program (the memoizer key was shape-only); now it is a
+    counted — and, under CMT_TPU_JITGUARD after seal(), loudly raised
+    — recompile instead.  Debug builds therefore cannot silently run
+    without their checks, and A/B flips (bench.py stack16 section)
+    cannot silently run the old core."""
+    return (COLS_IMPL, SQUARE_IMPL, _DEBUG_CHECKS)
+
+
+#: latched copy of a debug-guard failure: on asynchronously-dispatched
+#: backends the OverflowError raised inside the callback surfaces as a
+#: generic XlaRuntimeError at sync time — ``consume_debug_failures()``
+#: recovers the real report (bounded: newest _MAX_DEBUG_FAILURES kept)
+_debug_failures: list[str] = []
+_MAX_DEBUG_FAILURES = 8
+
+
+def consume_debug_failures() -> list[str]:
+    """Drain the latched CMT_TPU_DEBUG_CHECKS guard reports.  Call
+    after a sync that raised a generic XlaRuntimeError to recover the
+    real limb-overflow message(s) the async dispatch swallowed."""
+    out = _debug_failures[:]
+    _debug_failures.clear()
+    return out
+
+
 def _limb_magnitude_check(maxabs) -> None:
     """Host-side guard behind CMT_TPU_DEBUG_CHECKS: stack16 narrows
     limbs to int16, valid only under the documented 2^13 magnitude
-    budget — fail loudly instead of wrapping to wrong arithmetic."""
+    budget — fail loudly instead of wrapping to wrong arithmetic.
+
+    Runs as a ``jax.debug.callback`` so it is jit-safe (traceable
+    inside the compiled kernel, including under lax.scan/fori_loop
+    bodies); the raise propagates synchronously on the CPU backend and
+    is latched into ``_debug_failures`` for backends where dispatch is
+    async and the exception would otherwise be swallowed into a
+    generic runtime error."""
     if int(maxabs) >= 1 << 15:
-        raise OverflowError(
+        msg = (
             f"stack16 limb overflow: max |limb| = {int(maxabs)} >= 2^15; "
             "an operand exceeded the 2-chained-add budget (field.py "
             "module docstring)"
         )
+        while len(_debug_failures) >= _MAX_DEBUG_FAILURES:
+            _debug_failures.pop(0)
+        _debug_failures.append(msg)
+        raise OverflowError(msg)
 
 
 def _tree_sum(terms):
@@ -182,7 +224,10 @@ def _columns_stack(a, b, stack_dtype=DTYPE):
     budget would silently wrap to WRONG field arithmetic;
     CMT_TPU_DEBUG_CHECKS=1 turns the cast into a loud failure."""
     if stack_dtype != DTYPE and _DEBUG_CHECKS:
-        jax.debug.callback(_limb_magnitude_check, jnp.max(jnp.abs(b)))
+        # debug builds insert this callback into the traced program —
+        # visible (not silent) because trace_config() is part of every
+        # compile-cache key
+        jax.debug.callback(_limb_magnitude_check, jnp.max(jnp.abs(b)))  # host sync: debug-only limb-magnitude guard (CMT_TPU_DEBUG_CHECKS)
     pad = [(NLIMBS - 1, NLIMBS - 1)] + [(0, 0)] * (b.ndim - 1)
     bp = jnp.pad(b.astype(stack_dtype), pad)  # (76, *batch)
     s = jnp.stack(
@@ -539,6 +584,42 @@ def pow22523(z):
     t0 = mul(t1, t0)                    # z^(2^250-1)
     t0 = _pow2k(t0, 2)                  # z^(2^252-4)
     return mul(t0, z)                   # z^(2^252-3) = z^((p-5)/8)
+
+
+#: kernel shape/dtype contracts (grammar: ops/contracts.py; verified
+#: statically by tools/jitcheck.py, swept devicelessly by
+#: tests/test_jitcheck.py).  int32 limbs are load-bearing: int64 would
+#: be emulated at ~6.6x on the TPU VPU (module docstring).
+_CONTRACTS = {
+    "from_bytes_le": {
+        "args": {"b": ("u8", (32, "B"))},
+        "static": (),
+        "out": ("i32", ("NLIMBS", "B")),
+    },
+    "to_bytes_le": {
+        "args": {"a": ("i32", ("NLIMBS", "B"))},
+        "static": (),
+        "out": ("u8", (32, "B")),
+    },
+    "reduce_full": {
+        "args": {"a": ("i32", ("NLIMBS", "B"))},
+        "static": (),
+        "out": ("i32", ("NLIMBS", "B")),
+    },
+    "mul": {
+        "args": {
+            "a": ("i32", ("NLIMBS", "B")),
+            "b": ("i32", ("NLIMBS", "B")),
+        },
+        "static": (),
+        "out": ("i32", ("NLIMBS", "B")),
+    },
+    "square": {
+        "args": {"a": ("i32", ("NLIMBS", "B"))},
+        "static": (),
+        "out": ("i32", ("NLIMBS", "B")),
+    },
+}
 
 
 def invert(z):

@@ -49,6 +49,7 @@ from cometbft_tpu.crypto import edwards as _ref
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as F
+from cometbft_tpu.ops import jitguard
 from cometbft_tpu.ops.ed25519_verify import _next_pow2
 from cometbft_tpu.utils import sync as cmtsync
 
@@ -165,9 +166,10 @@ _build_cache: dict[tuple[int, int], object] = {}
 
 
 def _compiled_build(n: int, window_bits: int):
-    key = (n, window_bits)
+    key = (n, window_bits, F.trace_config())
     fn = _build_cache.get(key)
     if fn is None:
+        jitguard.note_compile("table_build", key)
         fn = jax.jit(lambda p: build_tables_kernel(p, window_bits))
         _build_cache[key] = fn
     return fn
@@ -209,11 +211,21 @@ class KeySetTables:
     valid: np.ndarray            # (cap,) bool
     nbytes: int                  # bytes of ``table`` (whole pool)
     set_nbytes: int = 0          # bytes attributable to this set's keys
+    _valid_dev: object = None    # lazy device copy of ``valid``
 
     def key_ids(self, pubs: list[bytes]) -> np.ndarray:
         return np.fromiter(
             (self.key_index[p] for p in pubs), dtype=np.int32, count=len(pubs)
         )
+
+    def valid_device(self):
+        """The validity mask as a device array, transferred EXPLICITLY
+        once per entry — the keyed dispatch previously jnp.asarray'd it
+        per launch, an implicit h2d transfer the CMT_TPU_JITGUARD
+        window flags (and a wasted transfer per steady-state batch)."""
+        if self._valid_dev is None:
+            self._valid_dev = jax.device_put(self.valid)
+        return self._valid_dev
 
 
 _B_ENC = np.frombuffer(_ref.encode_point(_ref.B_POINT), dtype=np.uint8)
@@ -422,7 +434,7 @@ class KeyTableCache:
                     )
                     slots = [pool.free.pop() for _ in missing]
                     idx = (
-                        np.asarray(slots, dtype=np.int64)[:, None]
+                        np.array(slots, dtype=np.int64)[:, None]
                         * pool.nent
                         + np.arange(pool.nent)
                     ).ravel()
@@ -499,7 +511,7 @@ class KeyTableCache:
             "table_build", cat="device", keys=n, window_bits=window_bits
         ):
             table, valid = fn(jax.device_put(pub))
-            valid = np.asarray(valid)[:n]
+            valid = jax.device_get(valid)[:n]  # host sync: per-build validity fetch (build path, not the verify hot loop)
         return table, valid
 
     def _evict_over_budget(self, keep: set[bytes]) -> None:
@@ -550,3 +562,43 @@ class KeyTableCache:
 
 
 TABLE_CACHE = KeyTableCache()
+
+
+#: kernel shape/dtype contracts (grammar: ops/contracts.py; verified
+#: statically by tools/jitcheck.py, swept devicelessly by
+#: tests/test_jitcheck.py).  ``windows`` for comb_mul_keyed is the LE
+#: digit decomposition of the scalar — one digit per comb window.
+_CONTRACTS = {
+    "build_tables_kernel": {
+        "args": {"pub": ("u8", (32, "B"))},
+        "static": ("window_bits",),
+        "out": [
+            ("i32", ("nwin", 4, "NLIMBS", "B*nent")),
+            ("bool", ("B",)),
+        ],
+    },
+    "comb_mul_base8": {
+        "args": {"s_bytes": ("u8", (32, "B"))},
+        "static": (),
+        "out": [
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+        ],
+    },
+    "comb_mul_keyed": {
+        "args": {
+            "table": ("i32", ("nwin", 4, "NLIMBS", "cap*nent")),
+            "key_ids": ("i32", ("B",)),
+            "windows": ("i32", ("nwin", "B")),
+        },
+        "static": ("window_bits",),
+        "out": [
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+            ("i32", ("NLIMBS", "B")),
+        ],
+    },
+}

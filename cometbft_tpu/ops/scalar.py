@@ -143,3 +143,32 @@ def limbs_to_nibbles(limbs16):
     )
     nib = (limbs16[:, None] >> shifts) & 0xF
     return nib.reshape(64, *limbs16.shape[1:]).astype(jnp.int32)
+
+
+#: kernel shape/dtype contracts (grammar: ops/contracts.py; verified
+#: statically by tools/jitcheck.py, swept devicelessly by
+#: tests/test_jitcheck.py).  Scalar limbs are SIGNED int64 base-2^16
+#: (module docstring) — an i32 drift here silently truncates the
+#: digest reduction.
+_CONTRACTS = {
+    "reduce_digest": {
+        "args": {"digest_le": ("u8", (64, "B"))},
+        "static": (),
+        "out": ("i64", (16, "B")),
+    },
+    "bytes_lt_l": {
+        "args": {"s_bytes": ("u8", (32, "B"))},
+        "static": (),
+        "out": ("bool", ("B",)),
+    },
+    "limbs_to_windows8": {
+        "args": {"limbs16": ("i64", (16, "B"))},
+        "static": (),
+        "out": ("i32", (32, "B")),
+    },
+    "limbs_to_nibbles": {
+        "args": {"limbs16": ("i64", (16, "B"))},
+        "static": (),
+        "out": ("i32", (64, "B")),
+    },
+}

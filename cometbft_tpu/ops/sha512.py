@@ -138,6 +138,31 @@ def sha512_padded(buf, nblocks: int, nblocks_lane=None):
     return words_to_bytes(state)
 
 
+#: kernel shape/dtype contracts (grammar: ops/contracts.py; verified
+#: statically by tools/jitcheck.py, swept devicelessly by
+#: tests/test_jitcheck.py).
+_CONTRACTS = {
+    "sha512_padded": {
+        "args": {
+            "buf": ("u8", ("nblocks*128", "B")),
+            "nblocks_lane": ("i64", ("B",)),
+        },
+        "static": ("nblocks",),
+        "out": ("u8", (64, "B")),
+    },
+    "bytes_to_words": {
+        "args": {"buf": ("u8", ("nblocks*128", "B"))},
+        "static": (),
+        "out": ("u64", ("nblocks*16", "B")),
+    },
+    "words_to_bytes": {
+        "args": {"words": ("u64", (8, "B"))},
+        "static": (),
+        "out": ("u8", (64, "B")),
+    },
+}
+
+
 def pad_message(msg_bytes: bytes) -> tuple[np.ndarray, int]:
     """Host-side reference padding (tests): returns (padded, nblocks)."""
     n = len(msg_bytes)

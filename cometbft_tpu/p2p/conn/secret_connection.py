@@ -26,12 +26,24 @@ import threading
 from cometbft_tpu.utils import sync as cmtsync
 import time
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.exceptions import InvalidTag
+try:  # gated optional dep: without `cryptography`, the handshake and
+    # per-frame AEAD come from crypto_fallback (pure-Python X25519 +
+    # the native frame pump's ChaCha20Poly1305) — same wire semantics
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.exceptions import InvalidTag
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - environment-dependent
+    from cometbft_tpu.p2p.conn.crypto_fallback import (
+        ChaCha20Poly1305,
+        InvalidTag,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    _HAVE_CRYPTOGRAPHY = False
 
 from cometbft_tpu.crypto.ed25519 import Ed25519PrivKey, Ed25519PubKey
 from cometbft_tpu.metrics import p2p_metrics as _p2p_metrics
@@ -64,6 +76,10 @@ class AuthError(SecretConnectionError):
 def _hkdf(secret: bytes, info: bytes, length: int = 96) -> bytes:
     """HKDF-SHA256 (RFC 5869); replaces the reference's Merlin
     transcript KDF (secret_connection.go:88)."""
+    if not _HAVE_CRYPTOGRAPHY:
+        from cometbft_tpu.p2p.conn.crypto_fallback import hkdf_sha256
+
+        return hkdf_sha256(secret, info, length)
     from cryptography.hazmat.primitives.hashes import SHA256
     from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 

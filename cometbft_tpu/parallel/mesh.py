@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cometbft_tpu.ops import field as _field
+from cometbft_tpu.ops import jitguard as _jitguard
 from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier, verify_kernel
 
 BLOCK_AXIS = "blocks"
@@ -45,6 +47,9 @@ def shard_batch(mesh: Mesh, arr, axes: tuple[str | None, ...]):
     return jax.device_put(arr, NamedSharding(mesh, P(*axes)))
 
 
+_sharded_cache: dict[tuple, object] = {}
+
+
 def sharded_verify_fn(mesh: Mesh, nblocks: int = 2):
     """jit of the batch-verify kernel over feature-first arrays with a
     (blocks, sigs) trailing batch: byte arrays are (nbytes, H, V) with H
@@ -55,7 +60,17 @@ def sharded_verify_fn(mesh: Mesh, nblocks: int = 2):
     it with zero cross-chip collectives — each chip verifies its shard of
     the validator set; only consumers that reduce to a scalar verdict
     trigger communication.
+
+    Memoized on (mesh, nblocks): a fresh ``jax.jit`` wrapper per call
+    would retrace per CALLER even at identical shapes (jit caches on
+    wrapper identity) — the silent-retrace failure mode jitcheck and
+    CMT_TPU_JITGUARD exist to catch.
     """
+    key = (mesh, nblocks, _field.trace_config())
+    fn = _sharded_cache.get(key)
+    if fn is not None:
+        return fn
+    _jitguard.note_compile("sharded", (tuple(mesh.shape.items()), nblocks))
     data_spec = P(BLOCK_AXIS, SIG_AXIS)
 
     def step(pub, sig, msg, msglen):
@@ -64,11 +79,13 @@ def sharded_verify_fn(mesh: Mesh, nblocks: int = 2):
     in_shardings = tuple(
         NamedSharding(mesh, P(None, BLOCK_AXIS, SIG_AXIS)) for _ in range(3)
     ) + (NamedSharding(mesh, data_spec),)
-    return jax.jit(
+    fn = jax.jit(
         step,
         in_shardings=in_shardings,
         out_shardings=NamedSharding(mesh, data_spec),
     )
+    _sharded_cache[key] = fn
+    return fn
 
 
 def all_valid(results) -> jax.Array:
@@ -156,7 +173,7 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         else:
             fn = _compiled(batch, bucket)
         out = fn(jax.device_put(packed, self._sharding(None, DATA_AXIS)))
-        return np.asarray(out)[: len(msgs)]
+        return jax.device_get(out)[: len(msgs)]  # host sync: single per-batch result gather off the mesh
 
     def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
         from cometbft_tpu.ops.ed25519_verify import (
@@ -171,14 +188,15 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         fn = _compiled_keyed(bucket, entry.window_bits, chunk)
         repl = getattr(entry, "_replicated", None)
         if repl is None or repl[0] != self._mesh:
+            # device_put takes the host ndarray directly — an
+            # intermediate jnp.asarray here paid an extra IMPLICIT
+            # (unsharded) h2d transfer before the replicated placement
             repl = (
                 self._mesh,
                 jax.device_put(
                     entry.table, self._sharding(None, None, None, None)
                 ),
-                jax.device_put(
-                    jnp.asarray(entry.valid), self._sharding(None)
-                ),
+                jax.device_put(entry.valid, self._sharding(None)),
             )
             entry._replicated = repl
         out = fn(
@@ -186,4 +204,4 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
             repl[1],
             repl[2],
         )
-        return np.asarray(out)[: len(msgs)]
+        return jax.device_get(out)[: len(msgs)]  # host sync: single per-batch result gather off the mesh

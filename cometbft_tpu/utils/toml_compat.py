@@ -1,0 +1,15 @@
+"""TOML reader compat: stdlib ``tomllib`` (Python >= 3.11) or the
+``tomli`` backport it was vendored from (identical API).  One shim so
+the version gate lives in exactly one place:
+
+    from cometbft_tpu.utils.toml_compat import tomllib
+"""
+
+from __future__ import annotations
+
+try:
+    import tomllib
+except ImportError:  # pragma: no cover - Python < 3.11
+    import tomli as tomllib  # type: ignore[no-redef]
+
+__all__ = ["tomllib"]

@@ -44,15 +44,72 @@ def pytest_configure(config):
     )
 
 
+def _have_fast_crypto() -> bool:
+    """True when the optional `cryptography` (OpenSSL) package is
+    importable.  Without it the gated pure-Python ed25519/X25519
+    fallback is ~100x slower per op — correct, and fine for the unit
+    suites, but the multi-node localnet/e2e suites assume native
+    signing speed and blow the tier-1 wall-clock budget."""
+    try:
+        import cryptography  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+#: modules whose tests spin multi-node localnets (block production =
+#: continuous signing) — skipped without `cryptography`, runnable
+#: anywhere it is installed
+_LOCALNET_MODULES = {
+    "test_blocksync",
+    "test_consensus",
+    "test_e2e_wan",
+    "test_grpc",
+    "test_light_proxy",
+    "test_pbts",
+    "test_reactors",
+    "test_rpc",
+    "test_statesync",
+}
+
+#: individual localnet tests inside otherwise-fast modules (the
+#: e2e_perturb entries are its three longest node-rotation scenarios —
+#: ~220s combined under pure-Python signing)
+_LOCALNET_TESTS = {
+    "test_node_prunes_behind_app_retain_height",
+    "test_chain_commits_through_external_process",
+    "test_fresh_node_discovers_localnet_via_seed",
+    "test_validator_signs_via_external_signer_process",
+    "test_wipe_and_resync_twice",
+    "test_wiped_node_restores_via_statesync",
+    "test_live_equivocation_detected_and_committed",
+}
+
+
 def pytest_collection_modifyitems(config, items):
-    if os.environ.get("CMT_TPU_SLOW_TESTS"):
-        return
-    skip = pytest.mark.skip(
+    slow_ok = os.environ.get("CMT_TPU_SLOW_TESTS")
+    skip_slow = pytest.mark.skip(
         reason="soak tier; run with CMT_TPU_SLOW_TESTS=1 (make test-slow)"
     )
+    skip_localnet = pytest.mark.skip(
+        reason="localnet suite needs native-speed signing: install the "
+        "optional `cryptography` package (pure-Python fallback is "
+        "~100x slower and breaks the suite's timing budget)"
+    )
+    fast_crypto = _have_fast_crypto()
     for item in items:
-        if item.get_closest_marker("slow"):
-            item.add_marker(skip)
+        if not slow_ok and item.get_closest_marker("slow"):
+            item.add_marker(skip_slow)
+        if fast_crypto:
+            continue
+        mod = getattr(item, "module", None)
+        modname = mod.__name__.rpartition(".")[2] if mod else ""
+        if (
+            modname in _LOCALNET_MODULES
+            or item.name.split("[")[0] in _LOCALNET_TESTS
+        ):
+            item.add_marker(skip_localnet)
 
 
 @pytest.fixture

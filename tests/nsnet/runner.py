@@ -21,7 +21,7 @@ import signal
 import subprocess
 import sys
 import time
-import tomllib
+from cometbft_tpu.utils.toml_compat import tomllib
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -27,7 +27,11 @@ pytestmark = pytest.mark.skipif(
 
 
 def _py_seal_frame(key: bytes, counter: int, chunk: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    # differential oracle: OpenSSL via `cryptography` — the tests using
+    # this skip when the (gated, optional) package is absent
+    ChaCha20Poly1305 = pytest.importorskip(
+        "cryptography.hazmat.primitives.ciphers.aead"
+    ).ChaCha20Poly1305
 
     frame = struct.pack("<I", len(chunk)) + chunk
     frame += b"\x00" * (1028 - len(frame))
@@ -36,7 +40,9 @@ def _py_seal_frame(key: bytes, counter: int, chunk: bytes) -> bytes:
 
 
 def _py_open_frame(key: bytes, counter: int, sealed: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    ChaCha20Poly1305 = pytest.importorskip(
+        "cryptography.hazmat.primitives.ciphers.aead"
+    ).ChaCha20Poly1305
 
     nonce = b"\x00\x00\x00\x00" + struct.pack("<Q", counter)
     frame = ChaCha20Poly1305(key).decrypt(nonce, sealed, None)

@@ -90,6 +90,15 @@ def main():
         f"{nsigs / best:,.0f} sigs/s device-side ({best * 1e3:.1f} ms/launch)",
         flush=True,
     )
+    # provenance line device_campaign.py scrapes into the step entry:
+    # the warmup compile count per seam (steady trials above should
+    # have added none — docs/device_contracts.md)
+    import json
+
+    from cometbft_tpu.ops import jitguard
+
+    print(f"JITGUARD compiles: {json.dumps(jitguard.compile_counts())}",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -68,6 +68,9 @@ STEPS = {
 }
 
 RATE_RE = re.compile(r"([\d,]+) sigs/s device-side")
+#: per-seam warmup compile counts the bench tools print (BENCH
+#: provenance: future perf PRs assert steady state compiled nothing)
+COMPILES_RE = re.compile(r"JITGUARD compiles: (\{.*\})")
 
 
 def load() -> dict:
@@ -130,6 +133,12 @@ def _run_step_proc(name: str, tool: str, env: dict, timeout: float) -> dict:
         }
         if m:
             entry["sigs_per_sec_device"] = float(m.group(1).replace(",", ""))
+        mc = COMPILES_RE.search(out)
+        if mc:
+            try:
+                entry["warmup_compiles"] = json.loads(mc.group(1))
+            except ValueError:
+                pass
         return entry
     except subprocess.TimeoutExpired as exc:
         out = ((exc.stdout or b"").decode(errors="replace") if

@@ -161,11 +161,14 @@ def main() -> int:
             "but registered by no metrics struct",
             file=sys.stderr,
         )
-    # one command gates both lints: the guarded-by/lock-seam check
-    # (tools/lockcheck.py) runs here too, so CI needs a single entry
-    from tools import lockcheck  # REPO is on sys.path (above)
+    # one command gates all three lints: the guarded-by/lock-seam
+    # check (tools/lockcheck.py) and the device-path jit/contract
+    # check (tools/jitcheck.py) run here too, so CI needs one entry
+    from tools import jitcheck, lockcheck  # REPO is on sys.path (above)
 
     if lockcheck.main([]) != 0:
+        rc = 1
+    if jitcheck.main([]) != 0:
         rc = 1
     return rc
 
