@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint
+test: metrics-lint flight-smoke
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -107,6 +107,14 @@ test-jitguard:
 # dispatch + event-bus assertions, standalone (tier-1 runs them too)
 wire-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics.py -k wire -q
+
+# replication-plane smoke: boots a node stub, commits heights, scrapes
+# /metrics + /debug/flight, and asserts the blocksync/statesync/proxy/
+# WAL families and the flight ring are live (tier-1 runs these too;
+# `make test` gates on this target alongside the three lints)
+flight-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics.py \
+		-k "flight or replication" -q
 
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \

@@ -67,6 +67,80 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+#: span-name prefixes that make up the height pipeline
+#: (docs/observability.md "Reading a height pipeline trace")
+_PIPELINE_PREFIXES = (
+    "height/", "consensus/", "exec/", "abci/", "wal/", "store/",
+    "indexer/",
+)
+
+
+def _height_pipeline_provenance(n_heights: int = 3) -> dict:
+    """Boot a single-validator node stub, commit ``n_heights``, and
+    aggregate the per-stage height-pipeline spans into
+    ``{stage: {count, total_ms, mean_ms}}`` — the BENCH provenance
+    answer to "where does a committed height spend its time" on this
+    machine (set CMT_BENCH_PIPELINE=0 to skip).  Best-effort: any
+    failure is reported in the dict, never raised."""
+    import tempfile
+
+    try:
+        from cometbft_tpu.abci.kvstore import KVStoreApp
+        from cometbft_tpu.config import test_config
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+        from cometbft_tpu.utils.time import now_ns
+        from cometbft_tpu.utils.trace import TRACER
+
+        with tempfile.TemporaryDirectory(prefix="bench-pipeline-") as home:
+            pv = FilePV(ed.priv_key_from_secret(b"bench-pipeline"))
+            gen = GenesisDoc(
+                chain_id="bench-pipeline",
+                genesis_time_ns=now_ns(),
+                validators=(GenesisValidator(pv.pub_key, 10),),
+            )
+            cfg = test_config(home)
+            cfg.base.db_backend = "sqlite"  # real WAL -> wal/* spans
+            cfg.ensure_dirs()
+            # time cutoff, not a length offset: the bounded ring may
+            # already be full of the bench's own crypto spans, and a
+            # length mark misaligns as soon as it wraps
+            cutoff_us = (time.perf_counter() - TRACER.epoch) * 1e6
+            node = Node(cfg, app=KVStoreApp(), genesis=gen,
+                        priv_validator=pv)
+            node.start()
+            try:
+                deadline = time.time() + 60
+                while time.time() < deadline and node.height() < n_heights:
+                    time.sleep(0.05)
+                reached = node.height()
+            finally:
+                node.stop()
+            stages: dict[str, dict] = {}
+            for ev in TRACER.events():
+                if ev.get("ts", 0.0) < cutoff_us:
+                    continue
+                name = ev.get("name", "")
+                if not name.startswith(_PIPELINE_PREFIXES):
+                    continue
+                st = stages.setdefault(
+                    name, {"count": 0, "total_ms": 0.0}
+                )
+                st["count"] += 1
+                st["total_ms"] += ev.get("dur", 0.0) / 1e3
+            for st in stages.values():
+                st["total_ms"] = round(st["total_ms"], 3)
+                st["mean_ms"] = round(st["total_ms"] / st["count"], 3)
+            return {"heights": reached, "stages": stages}
+    except Exception as exc:  # noqa: BLE001 — provenance must not
+        return {"error": f"{type(exc).__name__}: {exc}"}  # fail the bench
+
+
 def _base_result(value: float, platform: str) -> dict:
     """The headline JSON shape — ONE definition for every path."""
     return {
@@ -180,6 +254,8 @@ def main(checkpoint=None) -> dict:
             + ")"
         )
         result["jit_compiles"] = _jg.compile_counts()  # empty: no device
+        if os.environ.get("CMT_BENCH_PIPELINE", "1") != "0":
+            result["height_pipeline"] = _height_pipeline_provenance()
         return result
 
     n = int(os.environ.get("CMT_BENCH_N", "4096"))
@@ -423,6 +499,10 @@ def main(checkpoint=None) -> dict:
     # measured sections (assertable steady-state provenance)
     result["jit_compiles"] = _jg.compile_counts()
     result["steady_retraces"] = steady_retraces
+    if os.environ.get("CMT_BENCH_PIPELINE", "1") != "0":
+        # per-stage height-pipeline breakdown on this machine (the
+        # replication-plane analog of the per-seam compile counts)
+        result["height_pipeline"] = _height_pipeline_provenance()
     return result
 
 

@@ -15,6 +15,7 @@ import threading
 import time
 
 from cometbft_tpu.types.block import Block
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils import sync as cmtsync
 
@@ -69,8 +70,12 @@ class BlockPool:
         send_request,
         send_error,
         logger: Logger | None = None,
+        metrics=None,
     ):
+        from cometbft_tpu.metrics import BlockSyncMetrics
+
         self.logger = logger or default_logger().with_fields(module="blockpool")
+        self.metrics = metrics if metrics is not None else BlockSyncMetrics()
         self._mtx = cmtsync.Mutex()
         self.height = start_height  # next height to pop
         self.start_height = start_height
@@ -150,9 +155,19 @@ class BlockPool:
                 if peer.first_request_time is None:
                     peer.first_request_time = now
                 to_send.append((peer.id, h))
+            self.metrics.request_pipeline_depth.set(
+                sum(
+                    1
+                    for r in self._requesters.values()
+                    if r.block is None and r.peer_id
+                )
+            )
         for peer_id in to_error:
+            self.metrics.peer_timeouts.inc()
+            FLIGHT.record("blocksync_timeout", peer=peer_id)
             self._send_error(peer_id, "block request timeout")
         for peer_id, h in to_send:
+            FLIGHT.record("blocksync_request", peer=peer_id, height=h)
             self._send_request(peer_id, h)
 
     def _pick_peer_locked(self, height: int) -> _BSPeer | None:
@@ -228,6 +243,11 @@ class BlockPool:
             if req is None:
                 return ""
             peer_id = req.peer_id
+            if peer_id and peer_id in self._peers:
+                self.metrics.peer_evictions.inc()
+                FLIGHT.record(
+                    "blocksync_evict", peer=peer_id, height=height
+                )
             self._peers.pop(peer_id, None)
             # orphan every in-flight request assigned to the removed
             # peer, or they'd sit out the full request timeout

@@ -22,6 +22,7 @@ from cometbft_tpu.types import codec
 from cometbft_tpu.types.block import BlockID
 from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
 from cometbft_tpu.types.validation import verify_commit_light
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
@@ -125,11 +126,27 @@ class BlocksyncReactor(Reactor):
         consensus_reactor=None,  # for SwitchToConsensus
         local_addr=b"",  # bytes | Callable[[], bytes] (lazy resolver)
         logger: Logger | None = None,
+        metrics=None,
+        statesync_metrics=None,
     ):
         super().__init__(
             name="blocksync",
             logger=logger or default_logger().with_fields(module="blocksync"),
         )
+        from cometbft_tpu.metrics import BlockSyncMetrics, StateSyncMetrics
+
+        self.metrics = metrics if metrics is not None else BlockSyncMetrics()
+        #: blocks applied after a statesync handoff close the
+        #: snapshot-to-head gap — they count as that plane's
+        #: backfilled_blocks (statesync/metrics.go BackFilledBlocks,
+        #: loose mapping: ours counts forward gap-fill, not the
+        #: evidence-window backfill the reference runs)
+        self.statesync_metrics = (
+            statesync_metrics
+            if statesync_metrics is not None
+            else StateSyncMetrics()
+        )
+        self._backfilling = False
         self.initial_state = state
         self.state = state
         self.local_addr = local_addr
@@ -147,8 +164,10 @@ class BlocksyncReactor(Reactor):
             send_request=self._send_block_request,
             send_error=self._on_pool_error,
             logger=self.logger,
+            metrics=self.metrics,
         )
         self._caught_up_since: float | None = None
+        self.metrics.syncing.set(1 if block_sync else 0)
 
     def is_syncing(self) -> bool:
         return self.block_sync.is_set()
@@ -178,7 +197,12 @@ class BlocksyncReactor(Reactor):
             return
         self.state = state
         self.pool.height = state.last_block_height + 1
+        self._backfilling = True  # closing the statesync gap
         self.block_sync.set()
+        self.metrics.syncing.set(1)
+        FLIGHT.record(
+            "blocksync_start", height=self.pool.height, backfill=True
+        )
         threading.Thread(
             target=self._pool_routine, name="blocksync-pool", daemon=True
         ).start()
@@ -285,9 +309,8 @@ class BlocksyncReactor(Reactor):
         first, second = self.pool.peek_two_blocks()
         if first is None or second is None:
             return False
-        first_parts = PartSet.from_bytes(
-            codec.encode_block(first), BLOCK_PART_SIZE_BYTES
-        )
+        first_bytes = codec.encode_block(first)
+        first_parts = PartSet.from_bytes(first_bytes, BLOCK_PART_SIZE_BYTES)
         first_id = BlockID(
             hash=first.hash(), part_set_header=first_parts.header
         )
@@ -352,6 +375,17 @@ class BlocksyncReactor(Reactor):
             syncing_to_height=self.pool.max_peer_height(),
         )
         self.pool.pop_request()
+        m = self.metrics
+        m.latest_block_height.set(first.header.height)
+        m.num_txs.set(len(first.data.txs))
+        m.total_txs.inc(len(first.data.txs))
+        m.block_size_bytes.set(len(first_bytes))
+        if self._backfilling:
+            self.statesync_metrics.backfilled_blocks.inc()
+        FLIGHT.record(
+            "blocksync_apply", height=first.header.height,
+            num_txs=len(first.data.txs),
+        )
         return True
 
     def _extended_votes_valid(self, block, block_id, votes) -> bool:
@@ -449,6 +483,12 @@ class BlocksyncReactor(Reactor):
 
     def _switch_now(self) -> None:
         self.block_sync.clear()
+        self.metrics.syncing.set(0)
+        self._backfilling = False
+        FLIGHT.record(
+            "blocksync_done", height=self.pool.height,
+            blocks_synced=self.pool.blocks_synced,
+        )
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(self.state)
 

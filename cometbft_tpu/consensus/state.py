@@ -61,8 +61,9 @@ from cometbft_tpu.types.vote import Proposal, Vote
 from cometbft_tpu.types.vote_set import ConflictingVoteError, VoteSet
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.time import now_ns
-from cometbft_tpu.utils.trace import TRACER as _tracer
+from cometbft_tpu.utils.trace import NOP_SPAN, TRACER as _tracer
 from cometbft_tpu.wal import KIND_MSG_INFO, KIND_TIMEOUT, NopWAL, WALRecord
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 
@@ -132,6 +133,7 @@ class ConsensusState(BaseService):
         "step": "_rs_mtx",
         "_step_start": "_rs_mtx",
         "_step_hr": "_rs_mtx",
+        "_height_t0": "_rs_mtx",
         "_quorum_prevote_round": "_rs_mtx",
         "start_time_ns": "_rs_mtx",
         "commit_time_ns": "_rs_mtx",
@@ -189,6 +191,7 @@ class ConsensusState(BaseService):
         self.step = STEP_NEW_HEIGHT
         self._step_start = time.perf_counter()
         self._step_hr = (0, 0)  # (height, round) at step entry
+        self._height_t0 = time.perf_counter()  # height-pipeline span root
         self._quorum_prevote_round = -1
         self.start_time_ns = 0
         self.commit_time_ns = 0
@@ -399,11 +402,20 @@ class ConsensusState(BaseService):
                     self.wal.write(KIND_TIMEOUT, encode_timeout_info(payload))
                     self._handle_timeout(payload)
             except Exception as exc:  # noqa: BLE001 — the loop must survive
+                # consensus panic path: the flight recorder tail IS the
+                # post-mortem — the last ~2k replication events before
+                # this input wedged the state machine survive in the
+                # ring (scrape /debug/flight) and the immediate tail
+                # lands in the log next to the error
+                FLIGHT.record(
+                    "consensus_panic", err=repr(exc), input_kind=kind
+                )
                 self.logger.error(
                     "error processing consensus input",
                     err=repr(exc),
                     kind=kind,
                 )
+                self.logger.error(FLIGHT.format_tail(20))
 
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
@@ -520,6 +532,10 @@ class ConsensusState(BaseService):
         self.last_validators = state.last_validators
         self.triggered_timeout_precommit = False
         self.state = state
+        # the new height's pipeline root starts here (proposal receipt,
+        # quorum marks, and the commit pipeline all parent to the
+        # "height/pipeline" span recorded at finalize)
+        self._height_t0 = time.perf_counter()
 
     def _schedule_round_0(self) -> None:  # holds _rs_mtx
         sleep = max(self.start_time_ns - now_ns(), 0)
@@ -556,7 +572,15 @@ class ConsensusState(BaseService):
                 self._step_start,
                 now - self._step_start,
                 cat="consensus",
-                args={"height": height, "round": round_},
+                args={
+                    "height": height,
+                    "round": round_,
+                    "parent": "height/pipeline",
+                },
+            )
+            FLIGHT.record(
+                "step", height=self.height, round=self.round,
+                step=STEP_NAMES[step],
             )
         self._step_start = now
         self._step_hr = (self.height, self.round)
@@ -768,6 +792,22 @@ class ConsensusState(BaseService):
                     )
                 except Exception:  # noqa: BLE001 — bad proofs skipped
                     continue
+        if not self._replay_mode:
+            # zero-duration mark: where in the height's timeline the
+            # proposal landed (docs/observability.md height pipeline)
+            _tracer.add_complete(
+                "height/proposal_received", time.perf_counter(), 0.0,
+                cat="height",
+                args={
+                    "height": proposal.height,
+                    "round": proposal.round,
+                    "parent": "height/pipeline",
+                },
+            )
+            FLIGHT.record(
+                "proposal", height=proposal.height, round=proposal.round,
+                hash=proposal.block_id.hash.hex()[:12],
+            )
         self.logger.info(
             "received proposal",
             height=proposal.height,
@@ -995,6 +1035,16 @@ class ConsensusState(BaseService):
         self.commit_round = commit_round
         self.commit_time_ns = now_ns()
         self._set_step(STEP_COMMIT)
+        if not self._replay_mode:
+            _tracer.add_complete(
+                "height/quorum_precommit", time.perf_counter(), 0.0,
+                cat="height",
+                args={
+                    "height": height,
+                    "round": commit_round,
+                    "parent": "height/pipeline",
+                },
+            )
         self._new_step()
         precommits = self.votes.precommits(commit_round)
         maj23 = precommits.two_thirds_majority()
@@ -1060,27 +1110,47 @@ class ConsensusState(BaseService):
         if not parts.has_header(block_id.part_set_header):
             raise ConsensusError("commit partset header mismatch")
 
-        if self.block_store.height() < block.header.height:
-            seen_commit = precommits.make_commit()
-            extended = None
-            if self.state.consensus_params.vote_extensions_enabled(height):
-                # keep the precommits WITH extensions — atomically with
-                # the block, so a crash can't strand a stored block
-                # whose extensions the height+1 proposer then silently
-                # lacks (store.go SaveBlockWithExtendedCommit)
-                extended = precommits.votes()
-            self.block_store.save_block(
-                block, parts, seen_commit, extended_votes=extended
+        # One height is ONE span tree ("height/pipeline" root, recorded
+        # below once the height closes): the commit pipeline — store
+        # save, WAL height boundary, ABCI FinalizeBlock/Commit — runs
+        # inside this lexical span, so its children nest under it via
+        # thread-local parenting.  Replay re-commits don't observe.
+        commit_round = self.commit_round
+        pipeline_t0 = self._height_t0
+        pipeline_span = (
+            _tracer.span(
+                "height/commit_pipeline", cat="height",
+                parent="height/pipeline", height=height,
+                round=commit_round,
             )
-        # Height boundary: the block is durably stored; a crash after this
-        # replays from handshake, not the WAL (wal.go EndHeightMessage).
-        self.wal.write_end_height(height)
-
-        new_state = self.block_exec.apply_block(
-            self.state,
-            BlockID(hash=block.hash(), part_set_header=parts.header),
-            block,
+            if not self._replay_mode
+            else NOP_SPAN
         )
+        with pipeline_span:
+            if self.block_store.height() < block.header.height:
+                seen_commit = precommits.make_commit()
+                extended = None
+                if self.state.consensus_params.vote_extensions_enabled(
+                    height
+                ):
+                    # keep the precommits WITH extensions — atomically
+                    # with the block, so a crash can't strand a stored
+                    # block whose extensions the height+1 proposer then
+                    # silently lacks (store.go SaveBlockWithExtendedCommit)
+                    extended = precommits.votes()
+                self.block_store.save_block(
+                    block, parts, seen_commit, extended_votes=extended
+                )
+            # Height boundary: the block is durably stored; a crash after
+            # this replays from handshake, not the WAL (wal.go
+            # EndHeightMessage).
+            self.wal.write_end_height(height)
+
+            new_state = self.block_exec.apply_block(
+                self.state,
+                BlockID(hash=block.hash(), part_set_header=parts.header),
+                block,
+            )
         self.logger.info(
             "committed block",
             height=height,
@@ -1106,6 +1176,21 @@ class ConsensusState(BaseService):
                 max(0.0, (block.header.time_ns - prev.header.time_ns) / 1e9)
             )
         self._update_to_state(new_state)
+        if not self._replay_mode:
+            # the height's root span: NewHeight entry → commit applied.
+            # Children (consensus/<Step>, the receipt/quorum marks, the
+            # commit pipeline) all carry parent="height/pipeline".
+            _tracer.add_complete(
+                "height/pipeline", pipeline_t0,
+                time.perf_counter() - pipeline_t0,
+                cat="height",
+                args={"height": height, "round": commit_round},
+            )
+            FLIGHT.record(
+                "commit", height=height, round=commit_round,
+                num_txs=len(block.data.txs),
+                hash=(block.hash() or b"").hex()[:12],
+            )
         self._schedule_round_0()
 
     # -- votes -----------------------------------------------------------
@@ -1207,6 +1292,15 @@ class ConsensusState(BaseService):
                     )
                 ).set(
                     max(0.0, (now_ns() - self.proposal.timestamp_ns) / 1e9)
+                )
+                _tracer.add_complete(
+                    "height/quorum_prevote", time.perf_counter(), 0.0,
+                    cat="height",
+                    args={
+                        "height": self.height,
+                        "round": vote.round,
+                        "parent": "height/pipeline",
+                    },
                 )
             # Unlock if a newer polka contradicts our lock (state.go:2372)
             if (

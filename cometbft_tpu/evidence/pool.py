@@ -26,6 +26,7 @@ from cometbft_tpu.types.evidence import (
 )
 from cometbft_tpu.types.vote import Vote
 from cometbft_tpu.utils.db import DB
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.time import now_ns
 
@@ -64,11 +65,15 @@ class Pool:
         state_store,
         block_store,
         logger: Logger | None = None,
+        metrics=None,
     ):
+        from cometbft_tpu.metrics import EvidenceMetrics
+
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
         self.logger = logger or default_logger().with_fields(module="evidence")
+        self.metrics = metrics if metrics is not None else EvidenceMetrics()
         self._mtx = cmtsync.Mutex()
         # conflicting vote pairs reported by consensus, turned into
         # evidence at the next Update when block time/val set are known
@@ -80,6 +85,22 @@ class Pool:
 
     def _current_state(self) -> State:
         return self.state_store.load()
+
+    def _observe_pool_locked(self) -> None:
+        """Refresh the size/age gauges (evidence volumes are tiny, so
+        the pending scan is cheap; called on add/commit/prune)."""
+        count, oldest_ns = 0, None
+        for _, raw in self.db.prefix_iterator(_PREFIX_PENDING):
+            count += 1
+            ev = codec.decode_evidence(bytes(raw))
+            if oldest_ns is None or ev.timestamp_ns < oldest_ns:
+                oldest_ns = ev.timestamp_ns
+        self.metrics.pool_size.set(count)
+        self.metrics.oldest_age_seconds.set(
+            max(0.0, (now_ns() - oldest_ns) / 1e9)
+            if oldest_ns is not None
+            else 0.0
+        )
 
     # -- verification (internal/evidence/verify.go:19) -------------------
 
@@ -316,7 +337,12 @@ class Pool:
         self.verify(ev)
         with self._mtx:
             self._add_pending_locked(ev)
+            self._observe_pool_locked()
             self._new_evidence_cond.notify_all()
+        FLIGHT.record(
+            "evidence_added", height=ev.height,
+            hash=ev.hash().hex()[:12],
+        )
         self.logger.info(
             "verified new evidence", height=ev.height,
             hash=ev.hash().hex()[:12],
@@ -372,6 +398,8 @@ class Pool:
                 self._mark_committed_locked(ev)
         self._process_consensus_buffer(state)
         self._prune_expired(state)
+        with self._mtx:
+            self._observe_pool_locked()
 
     def _process_consensus_buffer(self, state: State) -> None:
         """(pool.go:271 processConsensusBuffer)"""
@@ -401,6 +429,8 @@ class Pool:
             with self._mtx:
                 if self._is_pending(ev) or self._is_committed(ev):
                     continue
+                # no gauge refresh here: the sole caller (update) runs
+                # _observe_pool_locked once after the buffer drains
                 self._add_pending_locked(ev)
                 self._new_evidence_cond.notify_all()
             self.logger.info(

@@ -42,6 +42,10 @@ _INSPECT_ROUTES = (
     # reports an empty peer table — but the route shape matches a
     # running node's, so tooling probes one endpoint for both modes
     "wire",
+    # flight-recorder dump: in-process events recorded while the
+    # inspector runs (store reads, RPC handling) — same shape as a
+    # live node's /debug/flight
+    "debug/flight",
 )
 
 

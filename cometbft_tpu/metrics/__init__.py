@@ -339,6 +339,199 @@ class StateMetrics:
         )
 
 
+class BlockSyncMetrics:
+    """(internal/blocksync/metrics.go Metrics) — the fast-sync plane.
+
+    Reference parity (syncing, num_txs, total_txs, block_size_bytes,
+    latest_block_height) plus the request-pipeline depth and peer
+    timeout/evict counters the reference keeps internal to BlockPool.
+    """
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.syncing = self.latest_block_height = _NOP
+            self.num_txs = self.total_txs = self.block_size_bytes = _NOP
+            self.request_pipeline_depth = _NOP
+            self.peer_timeouts = self.peer_evictions = _NOP
+            return
+        s = "blocksync"
+        self.syncing = reg.gauge(
+            s, "syncing",
+            "1 while the node is fast-syncing blocks, 0 otherwise.",
+        )
+        self.latest_block_height = reg.gauge(
+            s, "latest_block_height",
+            "Latest height applied by the block syncer.",
+        )
+        self.num_txs = reg.gauge(
+            s, "num_txs",
+            "Transactions in the latest synced block.",
+        )
+        self.total_txs = reg.counter(
+            s, "total_txs",
+            "Total transactions applied by the block syncer.",
+        )
+        self.block_size_bytes = reg.gauge(
+            s, "block_size_bytes",
+            "Size of the latest synced block in bytes.",
+        )
+        self.request_pipeline_depth = reg.gauge(
+            s, "request_pipeline_depth",
+            "Block requests currently in flight across peers "
+            "(pool.go maxPendingRequests window occupancy).",
+        )
+        self.peer_timeouts = reg.counter(
+            s, "peer_timeouts",
+            "Peers dropped for letting a block request exceed the "
+            "request timeout.",
+        )
+        self.peer_evictions = reg.counter(
+            s, "peer_evictions",
+            "Peers evicted from the pool for serving an invalid "
+            "block (RedoRequest path).",
+        )
+
+
+class StateSyncMetrics:
+    """(statesync/metrics.go Metrics) — the snapshot-restore plane."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.syncing = self.total_snapshots = _NOP
+            self.chunk_process_time = _NOP
+            self.snapshot_height = self.snapshot_chunk = _NOP
+            self.snapshot_chunk_total = self.backfilled_blocks = _NOP
+            return
+        s = "statesync"
+        self.syncing = reg.gauge(
+            s, "syncing",
+            "1 while the node is restoring a state snapshot, 0 "
+            "otherwise.",
+        )
+        self.total_snapshots = reg.counter(
+            s, "total_snapshots",
+            "Distinct snapshots discovered from peers.",
+        )
+        self.chunk_process_time = reg.histogram(
+            s, "chunk_process_time",
+            "Seconds per ApplySnapshotChunk round-trip to the app.",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.snapshot_height = reg.gauge(
+            s, "snapshot_height", "Height of the snapshot being restored."
+        )
+        self.snapshot_chunk = reg.gauge(
+            s, "snapshot_chunk", "Chunks applied so far."
+        )
+        self.snapshot_chunk_total = reg.gauge(
+            s, "snapshot_chunk_total",
+            "Total chunks in the snapshot being restored "
+            "(metrics.go SnapshotChunkTotal).",
+        )
+        self.backfilled_blocks = reg.counter(
+            s, "backfilled_blocks",
+            "Blocks fetched to close the snapshot-to-head gap after a "
+            "snapshot restore (blocksync running in the post-statesync "
+            "handoff).",
+        )
+
+
+class ProxyMetrics:
+    """(proxy/metrics.go Metrics) — every ABCI call on all four
+    logical connections, timed at the proxy seam."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.method_timing_seconds = _NOP
+            return
+        self.method_timing_seconds = reg.histogram(
+            "abci", "method_timing_seconds",
+            "Wall seconds per ABCI call, by method and logical "
+            "connection (consensus | mempool | query | snapshot) — "
+            "proxy/metrics.go MethodTiming.",
+            buckets=(0.0001, 0.0004, 0.002, 0.009, 0.02, 0.1, 0.65, 2,
+                     6, 25),
+            labels=("method", "connection"),
+        )
+
+
+class WALMetrics:
+    """Consensus WAL accounting (no metricsgen analog: wal.go logs
+    unmeasured) — write volume, fsync latency, and group rotations."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.write_bytes = _NOP
+            self.fsync_duration_seconds = _NOP
+            self.rotations = _NOP
+            return
+        s = "wal"
+        self.write_bytes = reg.counter(
+            s, "write_bytes",
+            "Framed record bytes appended to the consensus WAL.",
+        )
+        self.fsync_duration_seconds = reg.histogram(
+            s, "fsync_duration_seconds",
+            "Seconds per WAL fsync (our own votes/proposals and "
+            "height boundaries sync; a slow disk shows up here "
+            "before it shows up as commit latency).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.rotations = reg.counter(
+            s, "rotations",
+            "Autofile group head rotations (size-limit reached).",
+        )
+
+
+class StoreMetrics:
+    """Block-store persistence timings (no metricsgen analog; the
+    reference leaves store/store.go unmeasured)."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.block_save_seconds = _NOP
+            self.block_load_seconds = _NOP
+            self.block_prune_seconds = _NOP
+            return
+        s = "store"
+        self.block_save_seconds = reg.histogram(
+            s, "block_save_seconds",
+            "Seconds per SaveBlock batch (parts + meta + commits, "
+            "one atomic write group).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.block_load_seconds = reg.histogram(
+            s, "block_load_seconds",
+            "Seconds per LoadBlock (meta + parts + decode).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.block_prune_seconds = reg.histogram(
+            s, "block_prune_seconds",
+            "Seconds per PruneBlocks batch.",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+
+
+class EvidenceMetrics:
+    """Evidence pool occupancy (no metricsgen analog)."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.pool_size = _NOP
+            self.oldest_age_seconds = _NOP
+            return
+        s = "evidence"
+        self.pool_size = reg.gauge(
+            s, "pool_size", "Pending (uncommitted) evidence items."
+        )
+        self.oldest_age_seconds = reg.gauge(
+            s, "oldest_age_seconds",
+            "Age of the oldest pending evidence (0 when the pool is "
+            "empty) — evidence aging toward the expiry window without "
+            "being committed means proposers are not reaping it.",
+        )
+
+
 class CryptoMetrics:
     """Device-execution-path metrics — the TPU batch-verify plane.
 
@@ -488,17 +681,29 @@ class NodeMetrics:
         self.crypto = CryptoMetrics(reg)
         self.rpc = RPCMetrics(reg)
         self.event_bus = EventBusMetrics(reg)
+        self.blocksync = BlockSyncMetrics(reg)
+        self.statesync = StateSyncMetrics(reg)
+        self.abci = ProxyMetrics(reg)
+        self.wal = WALMetrics(reg)
+        self.store = StoreMetrics(reg)
+        self.evidence = EvidenceMetrics(reg)
 
 
 __all__ = [
+    "BlockSyncMetrics",
     "ConsensusMetrics",
     "CryptoMetrics",
     "EventBusMetrics",
+    "EvidenceMetrics",
     "MempoolMetrics",
     "NodeMetrics",
     "P2PMetrics",
+    "ProxyMetrics",
     "RPCMetrics",
     "StateMetrics",
+    "StateSyncMetrics",
+    "StoreMetrics",
+    "WALMetrics",
     "crypto_metrics",
     "install_crypto_metrics",
     "install_p2p_metrics",

@@ -166,7 +166,9 @@ class Node(BaseService):
         db_dir = config.db_dir
         self.block_store_db = open_db("blockstore", backend, db_dir)
         self.state_db = open_db("state", backend, db_dir)
-        self.block_store = BlockStore(self.block_store_db)
+        self.block_store = BlockStore(
+            self.block_store_db, metrics=self.metrics.store
+        )
         self.state_store = StateStore(self.state_db)
 
         # 2. genesis + state (node.go:329)
@@ -183,10 +185,15 @@ class Node(BaseService):
             ("tcp://", "unix://", "grpc://")
         ):
             self.app = None
-            self.proxy_app = AppConns(default_client_creator(proxy_addr))
+            self.proxy_app = AppConns(
+                default_client_creator(proxy_addr),
+                metrics=self.metrics.abci,
+            )
         else:
             self.app = app if app is not None else default_app(config)
-            self.proxy_app = AppConns(local_client_creator(self.app))
+            self.proxy_app = AppConns(
+                local_client_creator(self.app), metrics=self.metrics.abci
+            )
         # fail-stop on the first fatal app/client error (multiAppConn
         # killChan semantics): an app whose state is unknown takes the
         # node down instead of leaving a poisoned proxy that answers
@@ -261,6 +268,7 @@ class Node(BaseService):
             self.state_store,
             self.block_store,
             logger=self.logger.with_fields(module="evidence"),
+            metrics=self.metrics.evidence,
         )
 
         # 9. block executor (node.go:447)
@@ -325,7 +333,7 @@ class Node(BaseService):
         if config.base.db_backend == "memdb":
             self.wal = NopWAL()
         else:
-            self.wal = WAL(config.wal_path)
+            self.wal = WAL(config.wal_path, metrics=self.metrics.wal)
         self.consensus = ConsensusState(
             config.consensus,
             state,
@@ -370,6 +378,8 @@ class Node(BaseService):
             # whole accept timeout — probe the listener first
             local_addr=self._make_local_addr_resolver(priv_validator),
             logger=self.logger.with_fields(module="blocksync"),
+            metrics=self.metrics.blocksync,
+            statesync_metrics=self.metrics.statesync,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool,
@@ -395,6 +405,7 @@ class Node(BaseService):
             on_complete=self._on_statesync_complete,
             discovery_time=config.statesync.discovery_time_ns / 1e9,
             logger=self.logger.with_fields(module="statesync"),
+            metrics=self.metrics.statesync,
         )
 
         reactors = {

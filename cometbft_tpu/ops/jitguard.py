@@ -93,6 +93,8 @@ def note_compile(seam: str, key) -> None:
             seam, (None, "<no compile before seal()>")
         )
         _crypto_metrics().guard_trips.labels(kind="retrace").inc()
+        from cometbft_tpu.utils.flight import flight_tail
+
         raise RetraceError(
             f"RETRACE after warmup at seam '{seam}': key {key!r} has no "
             f"compiled program (cache warmed with e.g. {prior_key!r}).\n"
@@ -100,6 +102,7 @@ def note_compile(seam: str, key) -> None:
             "pow2/bucket/chunk ladder — see docs/device_contracts.md.\n"
             f"--- this compile request:\n{stack}"
             f"--- previous compile at seam '{seam}':\n{prior_stack}"
+            + flight_tail()
         )
     _last_site[seam] = (key, stack)
 

@@ -291,6 +291,11 @@ class Switch(BaseService):
         """(switch.go:322 StopPeerForError)"""
         if not self.peers.has(peer.id):
             return
+        from cometbft_tpu.utils.flight import FLIGHT
+
+        FLIGHT.record(
+            "peer_error", peer=peer.id[:10], reason=str(reason)[:120]
+        )
         self.logger.info("stopping peer for error", peer=peer.id[:10],
                          err=str(reason))
         self._stop_and_remove_peer(peer, reason)

@@ -13,11 +13,14 @@ client, used by apps that do their own locking).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from cometbft_tpu.abci.types import Application
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.trace import TRACER
 
 
 class AbciClientError(Exception):
@@ -127,6 +130,73 @@ class _NopLock:
         return False
 
 
+#: the ABCI method surface timed at the proxy seam — every call on any
+#: of the four logical connections lands in
+#: abci_method_timing_seconds{method,connection} (proxy/metrics.go
+#: MethodTiming) plus an abci/<method> span and a flight-recorder event
+_TIMED_METHODS = frozenset(
+    {
+        "info",
+        "query",
+        "check_tx",
+        "flush",
+        "init_chain",
+        "prepare_proposal",
+        "process_proposal",
+        "finalize_block",
+        "extend_vote",
+        "verify_vote_extension",
+        "commit",
+        "list_snapshots",
+        "offer_snapshot",
+        "load_snapshot_chunk",
+        "apply_snapshot_chunk",
+    }
+)
+
+
+class _TimedConn:
+    """Wraps one logical ABCI connection, timing every method into the
+    proxy metrics struct (local AND remote clients get the same
+    instrumentation, since the wrap happens at the AppConns seam).
+    Non-ABCI attributes (``ensure_connected``, ``error``, ``close``)
+    pass through untouched."""
+
+    def __init__(self, client, connection: str, metrics):
+        self._client = client
+        self._connection = connection
+        self._metrics = metrics
+
+    def __getattr__(self, name):
+        attr = getattr(self._client, name)
+        if name not in _TIMED_METHODS or not callable(attr):
+            return attr
+        connection, metrics = self._connection, self._metrics
+
+        def call(*args, **kwargs):
+            t0 = time.perf_counter()
+            with TRACER.span(
+                f"abci/{name}", cat="abci", connection=connection
+            ):
+                try:
+                    return attr(*args, **kwargs)
+                finally:
+                    elapsed = time.perf_counter() - t0
+                    metrics.method_timing_seconds.labels(
+                        method=name, connection=connection
+                    ).observe(elapsed)
+                    FLIGHT.record(
+                        "abci", method=name, connection=connection,
+                        ms=round(elapsed * 1e3, 3),
+                    )
+
+        call.__name__ = name
+        # cache: later lookups hit the instance dict, skipping
+        # __getattr__ and the closure rebuild
+        self.__dict__[name] = call
+        return call
+
+
 class ClientCreator:
     """Builds one client per logical connection (proxy/client.go)."""
 
@@ -219,15 +289,26 @@ def default_client_creator(proxy_app: str, app: Application | None = None):
 
 
 class AppConns(BaseService):
-    """The four typed connections (proxy/multi_app_conn.go:42)."""
+    """The four typed connections (proxy/multi_app_conn.go:42), each
+    wrapped in method timing (`abci_method_timing_seconds`) labeled by
+    its logical connection name."""
 
-    def __init__(self, creator: ClientCreator):
+    def __init__(self, creator: ClientCreator, metrics=None):
         super().__init__(name="proxyApp")
+        from cometbft_tpu.metrics import ProxyMetrics
+
         self._creator = creator
-        self.consensus = creator.new_client()
-        self.mempool = creator.new_client()
-        self.query = creator.new_client()
-        self.snapshot = creator.new_client()
+        self.metrics = metrics if metrics is not None else ProxyMetrics()
+        self.consensus = _TimedConn(
+            creator.new_client(), "consensus", self.metrics
+        )
+        self.mempool = _TimedConn(
+            creator.new_client(), "mempool", self.metrics
+        )
+        self.query = _TimedConn(creator.new_client(), "query", self.metrics)
+        self.snapshot = _TimedConn(
+            creator.new_client(), "snapshot", self.metrics
+        )
         self._on_error = None
         self._fire_lock = cmtsync.Mutex()
         self._sync_hook = False
@@ -301,5 +382,5 @@ class AppConns(BaseService):
                     pass
 
 
-def new_app_conns(creator: ClientCreator) -> AppConns:
-    return AppConns(creator)
+def new_app_conns(creator: ClientCreator, metrics=None) -> AppConns:
+    return AppConns(creator, metrics=metrics)

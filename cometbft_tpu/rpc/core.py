@@ -154,6 +154,9 @@ class Environment:
             "genesis_chunked": self.genesis_chunked,
             "check_tx": self.check_tx,
             "wire": self.wire,
+            # GET /debug/flight (the path strips to this route name):
+            # the always-on flight recorder's recent replication events
+            "debug/flight": self.debug_flight,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -300,6 +303,16 @@ class Environment:
                     }
                 )
         return {"n_peers": str(len(peers)), "peers": peers}
+
+    def debug_flight(self) -> dict:
+        """The flight recorder's bounded ring of recent replication
+        events (utils/flight.py) — step transitions, WAL writes, ABCI
+        calls, blocksync requests, peer errors.  Served on a live node
+        AND in inspect mode, so the last ~2k events before a wedge are
+        one curl away (docs/observability.md)."""
+        from cometbft_tpu.utils.flight import FLIGHT
+
+        return FLIGHT.export()
 
     def genesis_route(self) -> dict:
         import json as _json

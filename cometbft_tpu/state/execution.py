@@ -42,6 +42,7 @@ from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.utils.fail import fail_point
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.utils.trace import TRACER
 from cometbft_tpu.version import BLOCK_PROTOCOL
 
 MAX_OVERHEAD_FOR_BLOCK = 11
@@ -485,6 +486,20 @@ class BlockExecutor:
     ) -> State:
         """Validate → FinalizeBlock → persist → Commit → events
         (state/execution.go:224 ApplyBlock)."""
+        with TRACER.span(
+            "exec/apply_block", cat="exec", height=block.header.height
+        ):
+            return self._apply_block_inner(
+                state, block_id, block, syncing_to_height
+            )
+
+    def _apply_block_inner(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        syncing_to_height: int = 0,
+    ) -> State:
         self.validate_block(state, block)
 
         start = now_ns()

@@ -339,11 +339,19 @@ class IndexerService(BaseService):
                     self.logger.error("indexing failed", err=repr(exc))
 
     def _on_block(self, data) -> None:
+        from cometbft_tpu.utils.trace import TRACER
+
         height = data.block.header.height
         events = ()
         if data.result_finalize_block is not None:
             events = data.result_finalize_block.events
-        self.block_indexer.index(height, events)
+        # runs on the indexer thread: explicit parent arg links it into
+        # the height's span tree (the stack can't — different thread)
+        with TRACER.span(
+            "indexer/index_block", cat="indexer", height=height,
+            parent="height/pipeline",
+        ):
+            self.block_indexer.index(height, events)
 
     def _on_tx(self, data) -> None:
         self.tx_indexer.index(data.height, data.index, data.tx, data.result)

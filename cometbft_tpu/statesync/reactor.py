@@ -42,15 +42,19 @@ class StatesyncReactor(Reactor):
         on_complete=None,  # (state, commit) -> None
         discovery_time: float = 5.0,
         logger: Logger | None = None,
+        metrics=None,
     ):
         super().__init__(
             name="statesync",
             logger=logger or default_logger().with_fields(module="statesync"),
         )
+        from cometbft_tpu.metrics import StateSyncMetrics
+
         self.app = app_conn_snapshot
         self.enabled = enabled
         self.on_complete = on_complete
         self.discovery_time = discovery_time
+        self.metrics = metrics if metrics is not None else StateSyncMetrics()
         self.syncer: Syncer | None = None
         if enabled:
             if state_provider is None:
@@ -61,6 +65,7 @@ class StatesyncReactor(Reactor):
                 request_snapshots=self._broadcast_snapshots_request,
                 request_chunk=self._request_chunk,
                 logger=self.logger,
+                metrics=self.metrics,
             )
         self.sync_done = threading.Event()
         self.sync_error: Exception | None = None
@@ -88,6 +93,7 @@ class StatesyncReactor(Reactor):
             ).start()
 
     def _sync_routine(self) -> None:
+        self.metrics.syncing.set(1)
         try:
             state, commit = self.syncer.sync_any(
                 discovery_time=self.discovery_time
@@ -95,6 +101,7 @@ class StatesyncReactor(Reactor):
         except Exception as exc:  # noqa: BLE001 — surfaced via sync_error
             self.logger.error("state sync failed", err=repr(exc))
             self.sync_error = exc
+            self.metrics.syncing.set(0)
             self.sync_done.set()
             return
         try:
@@ -106,6 +113,7 @@ class StatesyncReactor(Reactor):
             self.sync_error = exc
         finally:
             self.enabled = False
+            self.metrics.syncing.set(0)
             self.sync_done.set()
 
     # -- peer lifecycle ---------------------------------------------------

@@ -27,7 +27,9 @@ from cometbft_tpu.statesync.stateprovider import (
     StateProvider,
     StateProviderError,
 )
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.trace import TRACER
 
 CHUNK_TIMEOUT = 10.0        # config chunk_request_timeout
 RETRIES_PER_CHUNK = 3
@@ -166,12 +168,16 @@ class Syncer:
         request_snapshots,
         request_chunk,
         logger: Logger | None = None,
+        metrics=None,
     ):
+        from cometbft_tpu.metrics import StateSyncMetrics
+
         self.app = app_conn_snapshot
         self.state_provider = state_provider
         self.request_snapshots = request_snapshots
         self.request_chunk = request_chunk
         self.logger = logger or default_logger().with_fields(module="statesync")
+        self.metrics = metrics if metrics is not None else StateSyncMetrics()
         self.pool = SnapshotPool()
         self._chunk_queue: ChunkQueue | None = None
         self._mtx = cmtsync.Mutex()
@@ -180,6 +186,11 @@ class Syncer:
 
     def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> None:
         if self.pool.add(peer_id, snapshot):
+            self.metrics.total_snapshots.inc()
+            FLIGHT.record(
+                "statesync_snapshot", peer=peer_id,
+                height=snapshot.height, chunks=snapshot.chunks,
+            )
             self.logger.info(
                 "discovered snapshot", height=snapshot.height,
                 fmt=snapshot.format, chunks=snapshot.chunks,
@@ -239,6 +250,13 @@ class Syncer:
 
     def _sync_one(self, snapshot: Snapshot):
         """(syncer.go:234 Sync)"""
+        self.metrics.snapshot_height.set(snapshot.height)
+        self.metrics.snapshot_chunk_total.set(snapshot.chunks)
+        self.metrics.snapshot_chunk.set(0)
+        FLIGHT.record(
+            "statesync_offer", height=snapshot.height,
+            chunks=snapshot.chunks,
+        )
         # trusted app hash BEFORE offering (syncer.go verifies upfront)
         trusted_app_hash = self.state_provider.app_hash(snapshot.height)
 
@@ -301,14 +319,27 @@ class Syncer:
             chunk = q.get(index)
             if chunk is None:
                 chunk = self._fetch_chunk(snapshot, index, peers)
-            result = self.app.apply_snapshot_chunk(
-                ApplySnapshotChunkRequest(
-                    index=index, chunk=chunk, sender=""
+            t0 = time.perf_counter()
+            with TRACER.span(
+                "statesync/apply_chunk", cat="statesync",
+                height=snapshot.height, index=index,
+            ):
+                result = self.app.apply_snapshot_chunk(
+                    ApplySnapshotChunkRequest(
+                        index=index, chunk=chunk, sender=""
+                    )
                 )
+            self.metrics.chunk_process_time.observe(
+                time.perf_counter() - t0
+            )
+            FLIGHT.record(
+                "statesync_chunk", height=snapshot.height, index=index,
+                result=str(result.result),
             )
             if result.result == ApplySnapshotChunkResult.ACCEPT:
                 applied += 1
                 index += 1
+                self.metrics.snapshot_chunk.set(applied)
             elif result.result == ApplySnapshotChunkResult.RETRY:
                 q.discard(index)
             elif result.result == ApplySnapshotChunkResult.RETRY_SNAPSHOT:

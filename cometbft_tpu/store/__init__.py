@@ -9,7 +9,11 @@ precommits this node itself observed, which may differ in round).
 
 from __future__ import annotations
 
+import time
+
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.flight import FLIGHT
+from cometbft_tpu.utils.trace import TRACER
 
 from cometbft_tpu.types import codec
 from cometbft_tpu.types.block import Block, Commit
@@ -50,8 +54,11 @@ class BlockStore:
     #: verifies the same contract statically
     _GUARDED_BY = {"_base": "_mtx", "_height": "_mtx"}
 
-    def __init__(self, db: DB):
+    def __init__(self, db: DB, metrics=None):
+        from cometbft_tpu.metrics import StoreMetrics
+
         self._db = db
+        self.metrics = metrics if metrics is not None else StoreMetrics()
         self._mtx = cmtsync.RMutex()
         self._base, self._height = self._load_state()
 
@@ -89,6 +96,7 @@ class BlockStore:
         return BlockMeta.decode(raw) if raw is not None else None
 
     def load_block(self, height: int) -> Block | None:
+        t0 = time.perf_counter()
         meta = self.load_block_meta(height)
         if meta is None:
             return None
@@ -100,7 +108,9 @@ class BlockStore:
                     f"missing part {i} of block {height}"
                 )
             buf += part.bytes
-        return codec.decode_block(bytes(buf))
+        block = codec.decode_block(bytes(buf))
+        self.metrics.block_load_seconds.observe(time.perf_counter() - t0)
+        return block
 
     def load_block_by_hash(self, block_hash: bytes) -> Block | None:
         raw = self._db.get(_HASH + block_hash)
@@ -137,7 +147,13 @@ class BlockStore:
         if block is None or not part_set.is_complete():
             raise BlockStoreError("cannot save incomplete block")
         height = block.header.height
-        with self._mtx:
+        with self._mtx, TRACER.span(
+            "store/save_block", cat="store", height=height
+        ):
+            # timer starts INSIDE the lock (and the span enters after
+            # it): the histogram measures the write batch, not
+            # contention on _mtx
+            t0 = time.perf_counter()
             expected = self._height + 1 if self._height > 0 else height
             if height != expected:
                 raise BlockStoreError(
@@ -176,6 +192,10 @@ class BlockStore:
             except BaseException:
                 self._base, self._height = prev_base, prev_height
                 raise
+        self.metrics.block_save_seconds.observe(time.perf_counter() - t0)
+        FLIGHT.record(
+            "store_save", height=height, parts=part_set.header.total
+        )
 
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self._db.set(_hkey(_SEEN_COMMIT, height), codec.encode_commit(commit))
@@ -260,6 +280,7 @@ class BlockStore:
         """Remove blocks below ``retain_height``; returns count pruned
         (store/store.go PruneBlocks)."""
         with self._mtx:
+            t0 = time.perf_counter()  # batch time, not lock-wait
             if retain_height <= self._base:
                 return 0
             if retain_height > self._height:
@@ -288,4 +309,8 @@ class BlockStore:
             except BaseException:
                 self._base = prev_base
                 raise
-            return pruned
+        self.metrics.block_prune_seconds.observe(time.perf_counter() - t0)
+        FLIGHT.record(
+            "store_prune", retain_height=retain_height, pruned=pruned
+        )
+        return pruned

@@ -1,0 +1,155 @@
+"""Always-on flight recorder for the replication plane.
+
+The metrics plane answers "how much / how often", the span tracer
+answers "what happened inside THIS commit" — but both lose the story
+when the node wedges: a dashboard shows the stall started, a trace ring
+full of healthy heights shows nothing.  This module keeps a bounded
+ring of the most recent replication EVENTS — consensus step
+transitions, WAL writes/fsyncs, ABCI calls, blocksync requests,
+statesync chunks, store saves, peer errors — so the last ~2k things the
+node did before a wedge survive to the post-mortem.
+
+Design constraints, in order:
+
+- **Always on**: unlike tracing there is no off switch — by the time
+  you know you needed it, it is too late to enable.  That forces the
+  record path to be as cheap as possible.
+- **Lock-cheap**: the ring is a ``deque(maxlen=N)``; ``append`` on a
+  bounded deque is atomic under the GIL, so ``record()`` takes NO lock
+  (the ``recorded_total`` counter is best-effort under concurrency —
+  it is diagnostics, not accounting).
+- **Bounded**: depth from ``CMT_TPU_FLIGHT_DEPTH`` (default 2048,
+  validated); a long-running node keeps a sliding window, never an
+  unbounded log.
+- **No dependencies**: stdlib only, importable from every plane
+  (``utils/sync.py`` attaches the tail to LockOrderError/RaceError
+  reports, ``ops/jitguard.py`` to RetraceError) without cycles.
+
+Surfaces: the metrics HTTP server serves ``/debug/flight`` next to
+``/metrics`` and ``/trace``; the JSON-RPC server exposes a
+``debug/flight`` route (inspect mode included); and the error classes
+above carry ``format_tail()`` in their messages.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+DEFAULT_DEPTH = 2048
+
+
+def ring_size_from_env(var: str, default: int, minimum: int = 16) -> int:
+    """Shared ring-size validator for CMT_TPU_FLIGHT_DEPTH and
+    CMT_TPU_TRACE_RING (one contract, documented together in
+    docs/observability.md): a positive integer >= ``minimum`` (smaller
+    rings can't hold even one height's worth of events); anything else
+    fails loudly at import with the variable and constraint named."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        size = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var} must be an integer >= {minimum}, got {raw!r}"
+        ) from None
+    if size < minimum:
+        raise ValueError(f"{var} must be >= {minimum}, got {size}")
+    return size
+
+
+class FlightRecorder:
+    """Bounded lock-free ring of recent replication events."""
+
+    def __init__(self, depth: int | None = None):
+        if depth is None:
+            depth = ring_size_from_env("CMT_TPU_FLIGHT_DEPTH", DEFAULT_DEPTH)
+        elif depth < 1:
+            raise ValueError(f"flight depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._ring: deque[dict] = deque(maxlen=depth)
+        # best-effort under concurrency (unlocked += is not atomic);
+        # used for the dropped-events estimate, not accounting
+        self.recorded_total = 0
+
+    # -- recording -------------------------------------------------------
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event.  ``fields`` must be JSON-able primitives
+        (call sites hex() bytes); the hot path builds one dict and
+        appends — no lock, no I/O."""
+        self._ring.append(
+            {
+                "t": time.time(),
+                "thread": threading.current_thread().name,
+                "kind": kind,
+                **fields,
+            }
+        )
+        self.recorded_total += 1
+
+    # -- reading ---------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Snapshot, oldest first."""
+        return list(self._ring)
+
+    def tail(self, n: int = 20) -> list[dict]:
+        return self.events()[-n:]
+
+    def export(self) -> dict:
+        """The ``/debug/flight`` payload."""
+        events = self.events()
+        return {
+            "depth": self.depth,
+            "recorded_total": self.recorded_total,
+            "dropped": max(0, self.recorded_total - len(events)),
+            "events": events,
+        }
+
+    def format_tail(self, n: int = 20) -> str:
+        """Human-readable tail for attaching to error reports
+        (RetraceError / LockOrderError / RaceError, consensus panic
+        log lines)."""
+        lines = [f"--- flight recorder tail (last {n} of "
+                 f"{self.recorded_total} events) ---"]
+        for ev in self.tail(n):
+            extra = " ".join(
+                f"{k}={v}"
+                for k, v in ev.items()
+                if k not in ("t", "thread", "kind")
+            )
+            lines.append(
+                f"  {ev['t']:.6f} [{ev['thread']}] {ev['kind']}"
+                + (f" {extra}" if extra else "")
+            )
+        if len(lines) == 1:
+            lines.append("  <empty>")
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.recorded_total = 0
+
+
+#: process-wide recorder — every plane records here, all surfaces read
+#: here (mirrors utils/trace.TRACER)
+FLIGHT = FlightRecorder()
+
+
+def flight_tail(n: int = 20) -> str:
+    """Convenience for error constructors: a newline-prefixed tail that
+    can be appended to any message (empty-ring safe)."""
+    return "\n" + FLIGHT.format_tail(n)
+
+
+__all__ = [
+    "DEFAULT_DEPTH",
+    "FLIGHT",
+    "FlightRecorder",
+    "flight_tail",
+    "ring_size_from_env",
+]

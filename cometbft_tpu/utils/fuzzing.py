@@ -112,7 +112,12 @@ def _acquire_tool_id() -> int | None:
     global _tool_id
     if _tool_id is not None:
         return _tool_id
-    mon = sys.monitoring
+    # sys.monitoring is 3.12+ (PEP 669); on older interpreters the
+    # sensor degrades to hits=0 (pure random fuzzing), same as when
+    # every tool id is taken
+    mon = getattr(sys, "monitoring", None)
+    if mon is None:
+        return None
     candidates = [mon.COVERAGE_ID] + [
         i for i in range(6) if i != mon.COVERAGE_ID
     ]

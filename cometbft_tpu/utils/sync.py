@@ -186,6 +186,11 @@ def _note_order(lock, held: list) -> None:
                     f"--- prior acquisition of {_lock_name(path[1])} "
                     f"under {_lock_name(path[0])}:\n{prior}"
                 )
+                # the flight recorder tail rides along: what the node
+                # was DOING when the cycle appeared (utils/flight.py)
+                from cometbft_tpu.utils.flight import flight_tail
+
+                msg += flight_tail()
                 sys.stderr.write(msg + "\n")
                 raise LockOrderError(msg)
             if len(_order_edge_stacks) >= _MAX_EDGES:
@@ -294,6 +299,9 @@ def _race_note(obj, field: str, lockname: str, is_write: bool) -> None:
                     f"--- this access ({tname}):\n{stack}"
                     f"--- previous access ({o_name}):\n{o_stack}"
                 )
+                from cometbft_tpu.utils.flight import flight_tail
+
+                msg += flight_tail()
                 sys.stderr.write(msg + "\n")
                 raise RaceError(msg)
         if len(records) >= _MAX_THREADS_PER_FIELD:

@@ -58,6 +58,12 @@ class _NopSpan:
 
 _NOP_SPAN = _NopSpan()
 
+#: the shared no-op span, for call sites that need an explicitly inert
+#: context manager (e.g. consensus skipping spans during WAL replay)
+NOP_SPAN = _NOP_SPAN
+
+_DEFAULT_RING = 4096
+
 #: live tracers whose cached pid must be refreshed in fork children
 _PID_TRACERS: "weakref.WeakSet[SpanTracer]" = weakref.WeakSet()
 
@@ -122,7 +128,12 @@ class SpanTracer:
         enabled: bool | None = None,
     ):
         if capacity is None:
-            capacity = int(os.environ.get("CMT_TPU_TRACE_RING", "4096"))
+            # same validation contract as CMT_TPU_FLIGHT_DEPTH
+            from cometbft_tpu.utils.flight import ring_size_from_env
+
+            capacity = ring_size_from_env(
+                "CMT_TPU_TRACE_RING", _DEFAULT_RING
+            )
         if enabled is None:
             enabled = os.environ.get("CMT_TPU_TRACE", "1") != "0"
         self.enabled = enabled
@@ -263,4 +274,4 @@ class SpanTracer:
 TRACER = SpanTracer()
 
 
-__all__ = ["SpanTracer", "TRACER"]
+__all__ = ["NOP_SPAN", "SpanTracer", "TRACER"]

@@ -19,9 +19,13 @@ import struct
 import zlib
 from dataclasses import dataclass
 
+import time
+
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.utils.trace import TRACER
 from cometbft_tpu.wal.autofile import Group
 
 # Tagged record kinds (wal.go WALMessage union members)
@@ -114,8 +118,12 @@ class WAL(BaseService):
         path: str,
         head_size_limit: int = 10 * 1024 * 1024,
         total_size_limit: int = 1024 * 1024 * 1024,
+        metrics=None,
     ):
         super().__init__(name="WAL")
+        from cometbft_tpu.metrics import WALMetrics
+
+        self.metrics = metrics if metrics is not None else WALMetrics()
         self._group = Group(
             path,
             head_size_limit=head_size_limit,
@@ -129,7 +137,19 @@ class WAL(BaseService):
         if not self.is_running():
             return
         rec = WALRecord(time_ns=now_ns(), kind=kind, data=data)
-        self._group.write(encode_record(rec))
+        framed = encode_record(rec)
+        self._group.write(framed)
+        self.metrics.write_bytes.inc(len(framed))
+        FLIGHT.record("wal_write", rec_kind=kind, bytes=len(framed))
+
+    def _sync(self) -> None:
+        """fsync the head, timed (the replication plane's disk-latency
+        tripwire: a slow fsync here IS commit latency)."""
+        t0 = time.perf_counter()
+        self._group.sync()
+        elapsed = time.perf_counter() - t0
+        self.metrics.fsync_duration_seconds.observe(elapsed)
+        FLIGHT.record("wal_fsync", ms=round(elapsed * 1e3, 3))
 
     def write_sync(self, kind: int, data: bytes) -> None:
         """Write + fsync — used for our OWN messages (votes, proposals),
@@ -138,17 +158,20 @@ class WAL(BaseService):
         if not self.is_running():
             return
         self.write(kind, data)
-        self._group.sync()
+        self._sync()
 
     def write_end_height(self, height: int) -> None:
         """Height-boundary marker; fsynced (wal.go:85 EndHeightMessage)."""
         if not self.is_running():
             return
-        self.write_sync(KIND_END_HEIGHT, height.to_bytes(8, "big"))
-        self._group.maybe_rotate()
+        with TRACER.span("wal/write_end_height", cat="wal", height=height):
+            self.write_sync(KIND_END_HEIGHT, height.to_bytes(8, "big"))
+            if self._group.maybe_rotate():
+                self.metrics.rotations.inc()
+                FLIGHT.record("wal_rotate", height=height)
 
     def flush_and_sync(self) -> None:
-        self._group.sync()
+        self._sync()
 
     # -- reads -----------------------------------------------------------
 

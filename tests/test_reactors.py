@@ -86,8 +86,22 @@ def connect_star(nodes, timeout=10.0):
     raise TimeoutError("localnet failed to connect")
 
 
+def _crypto_speed_factor() -> float:
+    """Pure-Python signing is ~100x slower than `cryptography`; the
+    localnet-lite tests that still run without it (conftest only skips
+    the heavy suites) sit right against the default height-wait budget
+    on a contended core (docs/known_failures.md).  Scale waits, don't
+    skip: a pass at 45 s beats a flaky timeout at 30 s."""
+    try:
+        import cryptography  # noqa: F401
+
+        return 1.0
+    except ImportError:
+        return 4.0
+
+
 def wait_all_height(nodes, h, timeout=30.0):
-    deadline = time.monotonic() + timeout
+    deadline = time.monotonic() + timeout * _crypto_speed_factor()
     while time.monotonic() < deadline:
         if all(n.height() >= h for n in nodes):
             return

@@ -50,20 +50,54 @@ EXCLUDE = (
 )
 
 
-def registered_fields() -> dict[str, list[str]]:
-    """field name -> metric struct(s) registering it."""
+#: struct -> exposition subsystem prefix (the series name is
+#: ``<subsystem>_<field>``); keep in sync with the ``s = "..."``
+#: literals in cometbft_tpu/metrics/__init__.py
+SUBSYSTEMS = {
+    "ConsensusMetrics": "consensus",
+    "MempoolMetrics": "mempool",
+    "P2PMetrics": "p2p",
+    "StateMetrics": "state",
+    "CryptoMetrics": "crypto",
+    "RPCMetrics": "rpc",
+    "EventBusMetrics": "event_bus",
+    "BlockSyncMetrics": "blocksync",
+    "StateSyncMetrics": "statesync",
+    "ProxyMetrics": "abci",
+    "WALMetrics": "wal",
+    "StoreMetrics": "store",
+    "EvidenceMetrics": "evidence",
+}
+
+#: structs whose every field must ALSO be documented in
+#: docs/observability.md and mapped (or marked beyond-parity) in
+#: docs/PARITY.md — the replication-plane structs start the list;
+#: extend as older planes get back-documented
+DOC_CHECKED = (
+    "BlockSyncMetrics",
+    "StateSyncMetrics",
+    "ProxyMetrics",
+    "WALMetrics",
+    "StoreMetrics",
+    "EvidenceMetrics",
+)
+
+DOC_FILES = (
+    os.path.join("docs", "observability.md"),
+    os.path.join("docs", "PARITY.md"),
+)
+
+
+def _metric_structs():
     import cometbft_tpu.metrics as M
 
+    return tuple(getattr(M, name) for name in SUBSYSTEMS)
+
+
+def registered_fields() -> dict[str, list[str]]:
+    """field name -> metric struct(s) registering it."""
     out: dict[str, list[str]] = {}
-    for cls in (
-        M.ConsensusMetrics,
-        M.MempoolMetrics,
-        M.P2PMetrics,
-        M.StateMetrics,
-        M.CryptoMetrics,
-        M.RPCMetrics,
-        M.EventBusMetrics,
-    ):
+    for cls in _metric_structs():
         for name in vars(cls(None)):
             out.setdefault(name, []).append(cls.__name__)
     return out
@@ -140,13 +174,140 @@ def find_unregistered() -> dict[str, list[str]]:
     return missing
 
 
+def _series_by_subsystem() -> dict[str, set[str]]:
+    """subsystem prefix -> registered field names."""
+    out: dict[str, set[str]] = {}
+    for cls in _metric_structs():
+        sub = SUBSYSTEMS[cls.__name__]
+        out.setdefault(sub, set()).update(vars(cls(None)))
+    return out
+
+
+def _doc_texts() -> list[tuple[str, str]]:
+    return [
+        (rel, open(os.path.join(REPO, rel)).read()) for rel in DOC_FILES
+    ]
+
+
+def find_undocumented() -> dict[str, list[str]]:
+    """DOC_CHECKED fields whose series name (``<subsystem>_<field>``)
+    appears in neither/only one of the doc files — series name ->
+    doc files missing it.  A field shipped without docs is a series
+    operators can't interpret; docs/observability.md describes it,
+    docs/PARITY.md maps it to the reference struct (or marks it
+    beyond-parity)."""
+    import cometbft_tpu.metrics as M
+
+    docs = _doc_texts()
+    missing: dict[str, list[str]] = {}
+    for cls_name in DOC_CHECKED:
+        sub = SUBSYSTEMS[cls_name]
+        for field in vars(getattr(M, cls_name)(None)):
+            series = f"{sub}_{field}"
+            absent = [rel for rel, text in docs if series not in text]
+            if absent:
+                missing[series] = absent
+    return missing
+
+
+#: inline-backticked tokens in the docs that LOOK like one of our
+#: series names; trailing ``{label,...}`` groups are stripped, inner
+#: ``{a,b}`` alternation groups expanded, optional ``cometbft_``
+#: namespace and histogram ``_count|_sum|_bucket`` suffixes tolerated
+_DOC_TOKEN_PAT = re.compile(r"`([^`\s]+)`")
+_TRAILING_LABELS = re.compile(r"\{[^{}]*\}$")
+_ALTERNATION = re.compile(r"\{([a-z0-9_]+(?:,[a-z0-9_]+)+)\}")
+
+
+def _strip_trailing_labels(token: str) -> str:
+    while True:
+        stripped = _TRAILING_LABELS.sub("", token)
+        if stripped == token:
+            return token
+        token = stripped
+
+
+def _expand_alternations(token: str) -> list[str]:
+    m = _ALTERNATION.search(token)
+    if m is None:
+        return [token]
+    out: list[str] = []
+    for alt in m.group(1).split(","):
+        out.extend(
+            _expand_alternations(
+                token[: m.start()] + alt + token[m.end():]
+            )
+        )
+    return out
+
+
+def _doc_token_candidates(raw: str) -> set[str]:
+    """All plausible series names a doc token could denote.  A trailing
+    ``{a,b}`` group is ambiguous — labels (`{route,reason}`) or
+    brace-alternation (`key_pool_{keys,capacity}`) — so BOTH
+    interpretations (strip-labels-first and expand-first) are
+    candidates; the token is fine if ANY candidate is registered."""
+    out: set[str] = set()
+    for token in _expand_alternations(_strip_trailing_labels(raw)):
+        out.add(_strip_trailing_labels(token))
+    for token in _expand_alternations(raw):
+        out.add(_strip_trailing_labels(token))
+    return out
+
+
+def find_doc_unregistered() -> dict[str, list[str]]:
+    """Inverse doc check: series-shaped tokens in the docs that no
+    struct registers (stale docs after a rename/removal) — token ->
+    doc files naming it."""
+    by_sub = _series_by_subsystem()
+    # longest prefix first so event_bus_* can't parse under a shorter
+    # (unknown) prefix
+    subs = sorted(by_sub, key=len, reverse=True)
+
+    def resolves(candidate: str) -> bool | None:
+        """True registered / False series-shaped-but-unknown / None
+        not series-shaped."""
+        if candidate.startswith("cometbft_"):
+            candidate = candidate[len("cometbft_"):]
+        candidate = re.sub(r"_(count|sum|bucket)$", "", candidate)
+        for sub in subs:
+            if not candidate.startswith(sub + "_"):
+                continue
+            field = candidate[len(sub) + 1:]
+            if not re.fullmatch(r"[a-z0-9]+(?:_[a-z0-9]+)*", field):
+                return None
+            return field in by_sub[sub]
+        return None
+
+    stale: dict[str, list[str]] = {}
+    for rel, text in _doc_texts():
+        for raw in _DOC_TOKEN_PAT.findall(text):
+            if "*" in raw:
+                continue  # family globs like `p2p_*`
+            verdicts = [
+                v
+                for v in map(resolves, _doc_token_candidates(raw))
+                if v is not None
+            ]
+            if verdicts and not any(verdicts):
+                stale.setdefault(raw, [])
+                if rel not in stale[raw]:
+                    stale[raw].append(rel)
+    return stale
+
+
 def main() -> int:
     missing = find_unreferenced()
     unregistered = find_unregistered()
+    undocumented = find_undocumented()
+    doc_stale = find_doc_unregistered()
     rc = 0
-    if not missing and not unregistered:
+    if not missing and not unregistered and not undocumented and (
+        not doc_stale
+    ):
         print(f"metrics-lint: {len(registered_fields())} fields, all "
-              "referenced; no unregistered update sites")
+              "referenced; no unregistered update sites; replication-"
+              "plane fields documented, no stale doc series")
     else:
         rc = 1
     for field, owners in missing.items():
@@ -159,6 +320,18 @@ def main() -> int:
         print(
             f"metrics-lint: .{field} is updated in {', '.join(files)} "
             "but registered by no metrics struct",
+            file=sys.stderr,
+        )
+    for series, files in sorted(undocumented.items()):
+        print(
+            f"metrics-lint: {series} is registered but undocumented "
+            f"in {', '.join(files)}",
+            file=sys.stderr,
+        )
+    for token, files in sorted(doc_stale.items()):
+        print(
+            f"metrics-lint: docs name series {token} "
+            f"({', '.join(files)}) but no struct registers it",
             file=sys.stderr,
         )
     # one command gates all three lints: the guarded-by/lock-seam
