@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke
+test: metrics-lint flight-smoke mesh-smoke
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -115,6 +115,17 @@ wire-smoke:
 flight-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics.py \
 		-k "flight or replication" -q
+
+# forced-8-host-device mesh equivalence: the sharded KEYED tier must
+# bit-match the single-device keyed path (padded-tail + partial-key-set
+# cases included) with zero steady-state retraces, and the
+# keyed-by-default promotion must route warm small batches to the
+# keyed tier (conftest forces the 8-device virtual CPU mesh; tier-1
+# runs these too — `make test` gates on this target alongside the
+# three lints)
+mesh-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_parallel.py \
+		-k "ShardedKeyed or KeyedWarm or KeyPoolMesh" -q
 
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \

@@ -122,11 +122,15 @@ def _ed25519_factory() -> BatchVerifier:
     # Routing decisions that end at the host verifier are recorded
     # here, where they are made; a device-capable verifier defers its
     # decision to batch time (TpuBatchVerifier.verify — it may still
-    # fall back on batch size / calibration).
+    # fall back on batch size / calibration).  A factory-routed host
+    # verifier can only ever run the host tier, so its
+    # crypto_dispatch_tier count is recorded here too; device-capable
+    # verifiers record the tier ACTUALLY used per batch in verify().
     if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
         _crypto_metrics().dispatch_decisions.labels(
             route="host", reason="disabled"
         ).inc()
+        _crypto_metrics().dispatch_tier.labels(tier="host").inc()
         return _ed.CpuBatchVerifier()
     try:
         ndev = _device_ndev()
@@ -134,6 +138,7 @@ def _ed25519_factory() -> BatchVerifier:
             _crypto_metrics().dispatch_decisions.labels(
                 route="host", reason="device_unavailable"
             ).inc()
+            _crypto_metrics().dispatch_tier.labels(tier="host").inc()
             return _ed.CpuBatchVerifier()
         if ndev > 1 and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY"):
             # multi-chip: shard the batch over a 1-D mesh — every
@@ -148,6 +153,7 @@ def _ed25519_factory() -> BatchVerifier:
         _crypto_metrics().dispatch_decisions.labels(
             route="host", reason="device_unavailable"
         ).inc()
+        _crypto_metrics().dispatch_tier.labels(tier="host").inc()
         return _ed.CpuBatchVerifier()
 
 

@@ -546,6 +546,7 @@ class CryptoMetrics:
             self.batch_verify_launches = _NOP
             self.batch_verify_batch_size = _NOP
             self.dispatch_decisions = _NOP
+            self.dispatch_tier = _NOP
             self.kernel_time_seconds = _NOP
             self.host_verify_time_seconds = _NOP
             self.key_pool_keys = self.key_pool_capacity = _NOP
@@ -569,9 +570,18 @@ class CryptoMetrics:
         self.dispatch_decisions = reg.counter(
             s, "dispatch_decisions",
             "Device-vs-host routing decisions, by route and reason "
-            "(calibration | batch_size | msg_too_large | disabled | "
-            "device_unavailable).",
+            "(calibration | batch_size | keyed_warm | msg_too_large | "
+            "disabled | device_unavailable).",
             labels=("route", "reason"),
+        )
+        self.dispatch_tier = reg.counter(
+            s, "dispatch_tier",
+            "Dispatch-ladder tier ACTUALLY used per batch-verify call "
+            "(keyed_mesh | keyed | generic_mesh | generic | host) — "
+            "recorded at batch time, not factory time, so a warm "
+            "key-set table failing to promote the batch to the keyed "
+            "tier is visible as a generic/host count.",
+            labels=("tier",),
         )
         self.kernel_time_seconds = reg.histogram(
             s, "kernel_time_seconds",

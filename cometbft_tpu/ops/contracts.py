@@ -46,9 +46,12 @@ DTYPES = {
 }
 
 #: symbols a dim expression may reference (jitcheck enforces this
-#: statically; ladder_env binds them for the eval_shape sweep)
+#: statically; ladder_env binds them for the eval_shape sweep).
+#: ``ndev`` is the mesh device count — shard-local kernel contracts
+#: (parallel/mesh.py) express their dims as global//ndev.
 DIM_SYMBOLS = frozenset(
-    {"B", "bucket", "nblocks", "NLIMBS", "nwin", "nent", "cap", "M"}
+    {"B", "bucket", "nblocks", "NLIMBS", "nwin", "nent", "cap", "M",
+     "ndev"}
 )
 
 
@@ -122,11 +125,13 @@ def _build(spec, env: dict):
 
 
 def ladder_env(batch: int, bucket: int = 128, window_bits: int = 8,
-               cap: int | None = None) -> dict:
+               cap: int | None = None, ndev: int = 1) -> dict:
     """The dim bindings for one rung of the batch/bucket ladder —
     exactly the quantities the dispatch path derives (ed25519_verify:
     nblocks from the bucket; precompute: nwin/nent from the window
-    width; cap from the pool ladder)."""
+    width; cap from the pool ladder; parallel/mesh: ndev the mesh
+    device count, which must divide ``batch`` and ``cap`` the way the
+    lane router / table placement pad them)."""
     from cometbft_tpu.ops import field as F
     from cometbft_tpu.ops.ed25519_verify import nblocks_for_bucket
 
@@ -140,6 +145,7 @@ def ladder_env(batch: int, bucket: int = 128, window_bits: int = 8,
         "nwin": 256 // window_bits,
         "nent": 1 << window_bits,
         "cap": cap if cap is not None else batch,
+        "ndev": ndev,
     }
 
 

@@ -596,6 +596,10 @@ class TpuBatchVerifier(BatchVerifier):
         self._pubs: list[bytes] = []
         self._msgs: list[bytes] = []
         self._sigs: list[bytes] = []
+        # dispatch-ladder tier the last batch ACTUALLY ran on, set by
+        # the _run_* seam that executed (mesh subclasses report their
+        # own tiers); verify() feeds it to crypto_dispatch_tier
+        self._last_tier: str | None = None
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key.type() != _ed.KEY_TYPE:
@@ -614,7 +618,38 @@ class TpuBatchVerifier(BatchVerifier):
         if n == 0:
             return False, []
         cm = _crypto_metrics()
-        if n < self._device_min_batch or max(len(m) for m in self._msgs) > _BUCKETS[-1]:
+        device_usable = self._device_min_batch < 1 << 30
+        msg_fits = max(len(m) for m in self._msgs) <= _BUCKETS[-1]
+        entry = None
+        reason = "batch_size"
+        if device_usable and msg_fits and not os.environ.get(
+            "CMT_TPU_DISABLE_PRECOMPUTE"
+        ):
+            from cometbft_tpu.ops import precompute as _pr
+
+            try:
+                if n >= self._device_min_batch:
+                    entry = _pr.TABLE_CACHE.lookup_or_build(self._pubs)
+                elif n >= DEVICE_MIN_BATCH:
+                    # KEYED-BY-DEFAULT promotion: below the generic
+                    # device threshold, a batch whose key-set tables
+                    # are already WARM still takes the keyed tier —
+                    # the calibrated threshold models the generic
+                    # kernel's cost, and with hot tables the device
+                    # does only SHA-512 + R decompress + comb adds.
+                    # peek() never builds, so a cold set is not
+                    # stalled behind an EC build it didn't ask for.
+                    # The static DEVICE_MIN_BATCH floor still applies:
+                    # the per-launch link RTT is unchanged by warm
+                    # tables, so a tiny batch (a 2-sig evidence check)
+                    # must never trade a ~30us host verify for a
+                    # ~70ms tunneled launch.
+                    entry = _pr.TABLE_CACHE.peek(self._pubs)
+                    if entry is not None:
+                        reason = "keyed_warm"
+            except Exception:
+                entry = None  # any device hiccup -> generic/host path
+        if (n < self._device_min_batch and entry is None) or not msg_fits:
             # Messages beyond the largest device bucket: honor the
             # BatchVerifier contract via the host fallback instead of
             # raising mid-verify.  The 1<<30 threshold sentinel means
@@ -622,28 +657,24 @@ class TpuBatchVerifier(BatchVerifier):
             # unusable link), not that this batch was too small.
             if n >= self._device_min_batch:
                 reason = "msg_too_large"
-            elif self._device_min_batch >= 1 << 30:
+            elif not device_usable:
                 reason = "calibration"
+            elif not msg_fits:
+                reason = "msg_too_large"
             else:
                 reason = "batch_size"
             cm.dispatch_decisions.labels(route="host", reason=reason).inc()
+            cm.dispatch_tier.labels(tier="host").inc()
             cpu = _ed.CpuBatchVerifier()
             for p, m, s in zip(self._pubs, self._msgs, self._sigs):
                 cpu.add(_ed.Ed25519PubKey(p), m, s)
             return cpu.verify()
-        cm.dispatch_decisions.labels(route="device", reason="batch_size").inc()
+        cm.dispatch_decisions.labels(route="device", reason=reason).inc()
         cm.batch_verify_batch_size.observe(n)
         pub = np.frombuffer(b"".join(self._pubs), dtype=np.uint8).reshape(n, 32)
         sig = np.frombuffer(b"".join(self._sigs), dtype=np.uint8).reshape(n, 64)
-        entry = None
-        if not os.environ.get("CMT_TPU_DISABLE_PRECOMPUTE"):
-            from cometbft_tpu.ops import precompute as _pr
-
-            try:
-                entry = _pr.TABLE_CACHE.lookup_or_build(self._pubs)
-            except Exception:
-                entry = None  # any device hiccup -> generic kernel
         t0 = time.perf_counter()
+        self._last_tier = None
         with _tracer.span(
             "batch_verify", cat="crypto",
             kernel="keyed" if entry is not None else "generic", batch=n,
@@ -661,7 +692,11 @@ class TpuBatchVerifier(BatchVerifier):
                 else:
                     out = self._run_generic(pub, sig, self._msgs)
             results = [bool(v) for v in out]
-            sp.set(ok=all(results))
+            tier = self._last_tier or (
+                "keyed" if entry is not None else "generic"
+            )
+            cm.dispatch_tier.labels(tier=tier).inc()
+            sp.set(ok=all(results), tier=tier)
         cm.kernel_time_seconds.observe(time.perf_counter() - t0)
         return all(results), results
 
@@ -669,9 +704,11 @@ class TpuBatchVerifier(BatchVerifier):
     # ShardedTpuBatchVerifier) overrides these two with mesh-sharded
     # launches; callers only ever see the BatchVerifier interface.
     def _run_generic(self, pub, sig, msgs) -> np.ndarray:
+        self._last_tier = "generic"
         return _finish(verify_arrays_async(pub, sig, msgs))
 
     def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
+        self._last_tier = "keyed"
         return _finish(
             verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs)
         )

@@ -37,7 +37,7 @@ import hashlib
 import os
 import threading
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -212,6 +212,18 @@ class KeySetTables:
     nbytes: int                  # bytes of ``table`` (whole pool)
     set_nbytes: int = 0          # bytes attributable to this set's keys
     _valid_dev: object = None    # lazy device copy of ``valid``
+    #: per-mesh device placements hung off this entry (sharded shards,
+    #: replicated copies): placement key -> (value, device bytes).
+    #: These are EXTRA device copies beyond the base pool array, so the
+    #: cache's budget accounting sums them (``placement_bytes``) —
+    #: before this, an 8-chip replicated placement held 8x the pool
+    #: bytes in HBM that the TABLE_CACHE_MB budget never saw.
+    #: Guarded by ``_mtx``: verify threads place concurrently with the
+    #: cache's budget sweep reading the dict under the cache lock (the
+    #: entry lock is always innermost, never taken around cache calls,
+    #: so the cache-lock -> entry-lock order is acyclic).
+    placements: dict = field(default_factory=dict)
+    _mtx: object = field(default_factory=cmtsync.Mutex)
 
     def key_ids(self, pubs: list[bytes]) -> np.ndarray:
         return np.fromiter(
@@ -226,6 +238,86 @@ class KeySetTables:
         if self._valid_dev is None:
             self._valid_dev = jax.device_put(self.valid)
         return self._valid_dev
+
+    def placement_bytes(self) -> int:
+        """Device bytes held by this entry's mesh placements (counted
+        against TABLE_CACHE_MB alongside the base pools)."""
+        with self._mtx:
+            return sum(n for _, n in self.placements.values())
+
+    def sharded_tables(self, mesh, table_sharding, valid_sharding,
+                       ndev: int):
+        """Per-chip shards of this set's table (and validity mask),
+        device-resident under the given ``NamedSharding``s — built once
+        per (entry, mesh) and cached on the entry.
+
+        Slot ownership is STRIDED round-robin — device ``d`` owns slots
+        ``{d, d+ndev, d+2*ndev, ...}`` — because live slots cluster in
+        ``[0, n_live)`` after compaction: contiguous block ownership
+        would leave the high-block devices with only dead slots (150
+        live keys in a 256-slot pool on 8 chips would idle 3 of them
+        every launch).  The pages are gathered into per-device
+        contiguous order ONCE here (a device gather per placement, same
+        cost class as the pad), so on the minor (cap*nent) axis device
+        ``d``'s shard block holds its strided slots at LOCAL positions
+        ``slot // ndev`` — the shard-local gather with rebased ids
+        touches only local HBM and the sharded keyed kernel runs with
+        zero collectives.  Returns ``(table, valid, per_cap)``; the
+        placement's device bytes are recorded for the cache's budget
+        accounting.
+
+        Locking follows the stage_growth pattern: the pool-sized
+        device work (pad + gather + sharded device_put, seconds at 10k
+        keys on a tunneled link) runs OUTSIDE ``_mtx`` so the cache's
+        budget sweep — which reads placement_bytes() under the global
+        cache lock — never queues every lookup behind a placement
+        build; ``_mtx`` guards only the dict swap.  Two threads racing
+        a cold placement may both build; the loser's copy is dropped
+        and freed (a transient, bounded duplicate — the same trade
+        stage_growth makes)."""
+        key = ("sharded", mesh)
+        with self._mtx:
+            placed = self.placements.get(key)
+        if placed is None:
+            nent = 1 << self.window_bits
+            cap = len(self.valid)
+            per_cap = -(-cap // ndev)
+            shard_cap = per_cap * ndev
+            # A post-seal placement build (validator rotation) runs
+            # inside the armed CMT_TPU_JITGUARD transfer window, whose
+            # job is catching silent PER-LAUNCH transfers.  This is
+            # deliberate ONE-TIME staging per (entry, mesh) — pad
+            # constants, the gather-index upload, and the sharded
+            # device_puts all move data on purpose — so it opens an
+            # audited allow scope the same way warmup does.
+            with jax.transfer_guard("allow"):
+                table, valid = self.table, self.valid
+                if shard_cap > cap:
+                    table = jnp.pad(
+                        table,
+                        [(0, 0), (0, 0), (0, 0),
+                         (0, (shard_cap - cap) * nent)],
+                    )
+                    valid = np.pad(valid, (0, shard_cap - cap))
+                # strided -> per-device-contiguous page permutation:
+                # position (d*per_cap + j) <- slot (j*ndev + d)
+                slot_perm = (
+                    np.arange(shard_cap).reshape(per_cap, ndev).T.ravel()
+                )
+                idx = (
+                    slot_perm[:, None] * nent + np.arange(nent)
+                ).ravel()
+                table = table[..., jax.device_put(idx)]
+                valid = valid[slot_perm]
+                table = jax.device_put(table, table_sharding)
+                valid = jax.device_put(valid, valid_sharding)
+            built = (
+                (table, valid, per_cap),
+                int(table.nbytes) + int(valid.nbytes),
+            )
+            with self._mtx:
+                placed = self.placements.setdefault(key, built)
+        return placed[0]
 
 
 _B_ENC = np.frombuffer(_ref.encode_point(_ref.B_POINT), dtype=np.uint8)
@@ -391,17 +483,45 @@ class KeyTableCache:
         )
         self.stats = {"keys_built": 0, "keys_evicted": 0}
 
-    def lookup_or_build(self, pubs: list[bytes]) -> KeySetTables | None:
-        """An entry covering every key in ``pubs``, building pages only
-        for keys not already pooled; None when the unique-key count is
-        out of policy."""
+    def _set_key(self, pubs: list[bytes]):
+        """The dispatch-policy prologue shared by peek and
+        lookup_or_build: (unique keys, window pool, set hash), or None
+        when the unique-key count is out of table policy.  ONE
+        implementation so the size gate / window-width choice / hash
+        can never drift between the warm probe and the build path —
+        a divergence would make peek probe the wrong pool and silently
+        demote warm batches off the keyed tier."""
         unique = sorted(set(pubs))
         n = len(unique)
         if n == 0 or n > TABLE_MAX_KEYS:
             return None
-        window_bits = 8 if n <= KEY8_MAX else 4
-        pool = self._pools[window_bits]
-        h = hashlib.sha256(b"".join(unique)).digest()
+        pool = self._pools[8 if n <= KEY8_MAX else 4]
+        return unique, pool, hashlib.sha256(b"".join(unique)).digest()
+
+    def peek(self, pubs: list[bytes]) -> KeySetTables | None:
+        """An entry iff EVERY key is already resident — no builds, no
+        waiting on in-flight builds.  This is the keyed-by-default
+        dispatch probe: a batch below the generic device threshold
+        still takes the keyed tier when its tables are warm, and the
+        probe must never stall a small batch behind an EC build."""
+        sk = self._set_key(pubs)
+        if sk is None:
+            return None
+        unique, pool, h = sk
+        with self._lock:
+            if any(p not in pool.slots for p in unique):
+                return None
+            return self._finish_lookup(h, pool, unique)
+
+    def lookup_or_build(self, pubs: list[bytes]) -> KeySetTables | None:
+        """An entry covering every key in ``pubs``, building pages only
+        for keys not already pooled; None when the unique-key count is
+        out of policy."""
+        sk = self._set_key(pubs)
+        if sk is None:
+            return None
+        unique, pool, h = sk
+        window_bits = pool.window_bits
         while True:
             with self._lock:
                 waits = [
@@ -473,12 +593,7 @@ class KeyTableCache:
         # stale-version entries so the memo never holds device arrays
         # beyond the two live pools (a 64-count bound alone would pin
         # ~64 pool-sized snapshots across rotations — an HBM leak)
-        for k in [
-            k
-            for k, (v, e) in self._entries.items()
-            if v != self._pools[e.window_bits].version
-        ]:
-            del self._entries[k]
+        self._sweep_stale_entries()
         entry = KeySetTables(
             sethash=h,
             window_bits=pool.window_bits,
@@ -514,17 +629,60 @@ class KeyTableCache:
             valid = jax.device_get(valid)[:n]  # host sync: per-build validity fetch (build path, not the verify hot loop)
         return table, valid
 
+    def _sweep_stale_entries(self) -> None:
+        """Drop memoized entries whose pool version moved on.  Lock
+        held.  Besides un-pinning stale pool-array snapshots, this also
+        releases the entries' mesh PLACEMENTS (sharded shards /
+        replicated copies) so their device bytes leave the budget."""
+        for k in [
+            k
+            for k, (v, e) in self._entries.items()
+            if v != self._pools[e.window_bits].version
+        ]:
+            del self._entries[k]
+
+    def placement_bytes(self) -> int:
+        """Device bytes held by live memoized entries' mesh placements
+        — the per-device sharded/replicated table copies that exist in
+        HBM beyond the base pool arrays.  Lock held."""
+        return sum(e.placement_bytes() for _, e in self._entries.values())
+
     def _evict_over_budget(self, keep: set[bytes]) -> None:
         """Drop LRU keys (never ones in ``keep``) until compaction can
         bring the pools under budget, then compact. Lock held. A single
         set larger than the budget stays resident: the ACTIVE set must
         always fit. Eviction is minimal — LRU-first, stopping as soon
-        as the post-compaction footprint fits."""
+        as the post-compaction footprint fits.
+
+        The OVER-BUDGET TRIGGER counts the base pool arrays PLUS live
+        entries' mesh placements (placement_bytes): on an 8-chip mesh a
+        replicated placement alone is 8x the pool bytes, so ignoring it
+        (the pre-mesh accounting) let the real HBM footprint run ~9x
+        past TABLE_CACHE_MB.  The eviction loop's STOP condition,
+        however, compares only the post-compaction pool footprint:
+        compaction bumps the pool versions, staling every memoized
+        entry, and the sweep below releases the placements those
+        entries pinned — so counting ``placed`` (a term key eviction
+        can never reduce) in the stop condition would evict EVERY
+        evictable key on each over-budget rotation instead of the
+        minimal LRU set.  Steady-state placement overhead is bounded:
+        the sharded placement is ~1x the active pool (vs ndev-x for
+        the replaced replicated path), one per mesh per live entry."""
 
         def compacted_bytes(p: _KeyPool) -> int:
             return min(p.cap, _pool_cap(len(p.slots))) * p.key_bytes
 
-        if sum(p.nbytes() for p in self._pools.values()) <= self._cap:
+        # release placements pinned by already-stale entries FIRST:
+        # they are garbage awaiting the sweep, not working set, and
+        # dropping them is often enough to get back under budget with
+        # zero key evictions (a live entry's placement is the active
+        # working set and — like the active key set — stays resident)
+        self._sweep_stale_entries()
+        placed = self.placement_bytes()
+        if (
+            sum(p.nbytes() for p in self._pools.values()) + placed
+            <= self._cap
+        ):
             return
         changed = False
         for pool in self._pools.values():
@@ -544,6 +702,9 @@ class KeyTableCache:
         if changed:
             for pool in self._pools.values():
                 pool.compact()
+            # compaction bumped versions: stale entries (and the
+            # placement bytes they pinned) can go now
+            self._sweep_stale_entries()
 
     def _update_pool_gauges(self) -> None:
         """Refresh the occupancy/capacity gauges for both window

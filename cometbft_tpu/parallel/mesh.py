@@ -4,9 +4,25 @@ Capability parity note: the reference's concurrency for this workload is
 a single machine's batch verifier (crypto/ed25519/ed25519.go:190) — the
 multi-chip path here is the designed-for-TPU replacement, scaling the
 same BatchVerifier seam over ICI instead of SIMD lanes.
+
+The KEYED mesh path (``_compiled_keyed_mesh`` + ``verify_keyed_shard``)
+shards the per-validator comb TABLE itself — not just the batch —
+across the 1-D data mesh: device ``d`` holds the comb pages of pool
+slots ``{d, d+ndev, d+2*ndev, ...}`` (strided round-robin ownership,
+gathered into per-device-contiguous order at placement time) under a
+``NamedSharding`` (precompute.KeySetTables.sharded_tables), the host
+routes each batch lane to the device owning its key's shard (rebasing
+ids to shard-local slots), and a ``shard_map``-wrapped jit with
+explicit ``in_shardings``/``out_shardings`` and ``donate_argnums`` on
+the packed tuple buffer runs the whole launch with ZERO collectives
+and no per-launch buffer copy.  Where ``shard_map`` is unavailable (or
+the mesh is a single device) the ladder falls back one tier to the
+single-device keyed path — see docs/device_kernel_perf.md §3.95.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -14,9 +30,22 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # the pjit in/out-shardings + shard_map fallback seam needs it
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax: keyed_mesh tier off
+    _shard_map = None
+
+from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import field as _field
 from cometbft_tpu.ops import jitguard as _jitguard
-from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier, verify_kernel
+from cometbft_tpu.ops.ed25519_verify import (
+    TpuBatchVerifier,
+    _next_pow2,
+    nblocks_for_bucket,
+    verify_kernel,
+    verify_kernel_keyed_packed,
+)
+from cometbft_tpu.utils.trace import TRACER as _tracer
 
 BLOCK_AXIS = "blocks"
 SIG_AXIS = "sigs"
@@ -98,6 +127,88 @@ def all_valid(results) -> jax.Array:
 DATA_AXIS = "d"
 
 
+def verify_keyed_shard(
+    buf, table, key_valid, bucket: int, nblocks: int, window_bits: int
+):
+    """Shard-local body of the sharded keyed kernel: one device's slice
+    of the batch against ITS resident table shard.  ``buf`` rows are
+    the keyed packed layout (pub | sig | msg | msglen_le | key_id_le)
+    with key ids REBASED to shard-local slots (``slot - d*per_cap``) by
+    the host-side lane routing, so the comb gather touches only local
+    HBM — zero collectives across the mesh."""
+    return verify_kernel_keyed_packed(
+        buf, table, key_valid, bucket, nblocks, window_bits
+    )
+
+
+_keyed_mesh_cache: dict[tuple, object] = {}
+
+
+def _compiled_keyed_mesh(mesh: Mesh, bucket: int, window_bits: int,
+                         chunk: int):
+    """jit of the sharded keyed kernel over (buf, table, key_valid):
+    the batch shards on its lane axis, the TABLE shards on its minor
+    (cap*nent) axis — contiguous per-device slot blocks — and the
+    shard-local body runs under ``shard_map`` so the comb gather stays
+    local (the SPMD partitioner would otherwise all-gather the table
+    per launch).  Explicit ``in_shardings``/``out_shardings`` on the
+    jit wrapper keep placements canonical (the pjit pattern of
+    SNIPPETS.md [2]), and ``donate_argnums=(0,)`` donates the packed
+    tuple buffer — the one big per-launch operand — so XLA reuses its
+    pages instead of copying.  Batch shapes retrace inside the one
+    wrapper (pow2 shard widths bound the variant count, like
+    _compiled_keyed); per-device slices wider than ``chunk`` process in
+    lax.map slices."""
+    key = (mesh, bucket, window_bits, chunk, _field.trace_config())
+    fn = _keyed_mesh_cache.get(key)
+    if fn is not None:
+        return fn
+    _jitguard.note_compile(
+        "keyed_mesh",
+        (tuple(mesh.shape.items()), bucket, window_bits, chunk),
+    )
+    nblocks = nblocks_for_bucket(bucket)
+
+    def local(buf, table, key_valid):
+        batch = buf.shape[-1]
+        if batch <= chunk:
+            return verify_keyed_shard(
+                buf, table, key_valid, bucket, nblocks, window_bits
+            )
+        k = batch // chunk
+        chunks = buf.reshape(buf.shape[0], k, chunk).transpose(1, 0, 2)
+        out = jax.lax.map(
+            lambda c: verify_keyed_shard(
+                c, table, key_valid, bucket, nblocks, window_bits
+            ),
+            chunks,
+        )
+        return out.reshape(batch)
+
+    in_specs = (
+        P(None, DATA_AXIS),
+        P(None, None, None, DATA_AXIS),
+        P(DATA_AXIS),
+    )
+    out_spec = P(DATA_AXIS)
+    body = _shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
+        check_rep=False,
+    )
+    # the virtual-CPU test mesh cannot donate (XLA:CPU keeps the input
+    # alive) and would warn per compile; real accelerator meshes reuse
+    # the donated buffer's pages instead of copying them per launch
+    donate = () if mesh.devices.flat[0].platform == "cpu" else (0,)
+    fn = jax.jit(
+        body,
+        in_shardings=tuple(NamedSharding(mesh, s) for s in in_specs),
+        out_shardings=NamedSharding(mesh, out_spec),
+        donate_argnums=donate,
+    )
+    _keyed_mesh_cache[key] = fn
+    return fn
+
+
 _FLAT_MESH: Mesh | None = None
 
 
@@ -106,7 +217,8 @@ def flat_mesh(devices=None) -> Mesh:
     BatchVerifier seam shards its flat signature batch over.  The
     all-devices mesh is cached: verifiers are constructed per
     VerifyCommit, and a fresh Mesh per call would defeat the
-    table-replication cache keyed on it."""
+    per-mesh table-shard placements and the keyed_mesh compile cache
+    keyed on it."""
     global _FLAT_MESH
     if devices is not None:
         return Mesh(np.array(list(devices)), (DATA_AXIS,))
@@ -126,8 +238,11 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
     one device is visible, so every caller (VerifyCommit, light client,
     blocksync replay) scales across chips through the same seam the
     reference routes through crypto/batch/batch.go:10.  Per-validator
-    precompute tables are replicated across the mesh (they are the
-    small, hot operand; the batch is the big one).
+    precompute tables SHARD across the mesh with the batch lanes routed
+    to their key's owning chip (see _run_keyed / the module docstring);
+    each chip holds 1/ndev of the table instead of a full replica, so a
+    10k-validator 4-bit pool (~4.4 GB) costs ~550 MB of HBM per chip
+    rather than 4.4 GB on every one.
     """
 
     def __init__(self, mesh: Mesh | None = None, **kwargs) -> None:
@@ -173,35 +288,103 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         else:
             fn = _compiled(batch, bucket)
         out = fn(jax.device_put(packed, self._sharding(None, DATA_AXIS)))
+        self._last_tier = "generic_mesh"
         return jax.device_get(out)[: len(msgs)]  # host sync: single per-batch result gather off the mesh
 
     def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
         from cometbft_tpu.ops.ed25519_verify import (
             MAX_LAUNCH,
-            _compiled_keyed,
             pack_inputs,
         )
 
-        packed, bucket = pack_inputs(pub, sig, msgs, key_ids=key_ids)
-        chunk = MAX_LAUNCH * self._ndev
-        packed = self._pad_cols(packed, chunk=chunk)
-        fn = _compiled_keyed(bucket, entry.window_bits, chunk)
-        repl = getattr(entry, "_replicated", None)
-        if repl is None or repl[0] != self._mesh:
-            # device_put takes the host ndarray directly — an
-            # intermediate jnp.asarray here paid an extra IMPLICIT
-            # (unsharded) h2d transfer before the replicated placement
-            repl = (
-                self._mesh,
-                jax.device_put(
-                    entry.table, self._sharding(None, None, None, None)
-                ),
-                jax.device_put(entry.valid, self._sharding(None)),
-            )
-            entry._replicated = repl
-        out = fn(
-            jax.device_put(packed, self._sharding(None, DATA_AXIS)),
-            repl[1],
-            repl[2],
+        ndev = self._ndev
+        if (
+            ndev <= 1
+            or _shard_map is None
+            or os.environ.get("CMT_TPU_DISABLE_SHARDED_KEYED")
+        ):
+            # one rung down the ladder: the single-device keyed path
+            # (tables on the default device, no shard routing)
+            return super()._run_keyed(entry, key_ids, pub, sig, msgs)
+        # per-chip shards of the table (and validity mask), resident
+        # under a NamedSharding; built once per (entry, mesh)
+        table, valid, per_cap = entry.sharded_tables(
+            self._mesh,
+            self._sharding(None, None, None, DATA_AXIS),
+            self._sharding(DATA_AXIS),
+            ndev,
         )
-        return jax.device_get(out)[: len(msgs)]  # host sync: single per-batch result gather off the mesh
+        # route each lane to the device whose shard owns its key slot
+        # (STRIDED ownership, slot % ndev — matching the page
+        # permutation sharded_tables applied, and balanced even though
+        # live slots cluster at the low end of the pool), rebasing ids
+        # to shard-local slots; every device gets the same lane count W
+        # (pow2 of the fullest shard, padded lanes are discarded on
+        # unscatter) so the sharded batch stays rectangular
+        n = len(msgs)
+        owner = key_ids % ndev
+        local_ids = (key_ids // ndev).astype(np.int32)
+        counts = np.bincount(owner, minlength=ndev)
+        w = _next_pow2(int(counts.max()))
+        chunk = MAX_LAUNCH
+        if w > chunk and w % chunk:
+            w += chunk - w % chunk
+        order = np.argsort(owner, kind="stable")
+        offs = np.concatenate([[0], np.cumsum(counts)])[:-1]
+        dest = np.empty(n, dtype=np.int64)
+        dest[order] = owner[order] * w + (
+            np.arange(n) - offs[owner[order]]
+        )
+        batch = ndev * w
+        pub_r = np.zeros((batch, 32), dtype=np.uint8)
+        sig_r = np.zeros((batch, 64), dtype=np.uint8)
+        ids_r = np.zeros(batch, dtype=np.int32)
+        msgs_r = [b""] * batch
+        pub_r[dest] = pub
+        sig_r[dest] = sig
+        ids_r[dest] = local_ids
+        for i, d in enumerate(dest):
+            msgs_r[d] = msgs[i]
+        packed, bucket = pack_inputs(pub_r, sig_r, msgs_r, key_ids=ids_r)
+        # pack_inputs pow2-pads past ndev*w on non-pow2 meshes; the
+        # shard boundaries live at multiples of w, so slice back
+        packed = packed[:, :batch]
+        fn = _compiled_keyed_mesh(
+            self._mesh, bucket, entry.window_bits, chunk
+        )
+        cm = _crypto_metrics()
+        cm.batch_verify_launches.labels(kernel="keyed_mesh").inc()
+        cm.bytes_transferred.labels(direction="h2d").inc(packed.nbytes)
+        with _tracer.span(
+            "device_launch", cat="device", kernel="keyed_mesh",
+            batch=batch, bucket=bucket, ndev=ndev,
+            window_bits=entry.window_bits,
+        ):
+            out = fn(
+                jax.device_put(packed, self._sharding(None, DATA_AXIS)),
+                table,
+                valid,
+            )
+        res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
+        cm.bytes_transferred.labels(direction="d2h").inc(res.nbytes)
+        self._last_tier = "keyed_mesh"
+        return res[dest]  # unscatter to original lane order
+
+
+#: shape/dtype contract for the sharded keyed kernel body (grammar:
+#: ops/contracts.py; statically checked by tools/jitcheck.py, swept by
+#: the mesh-shape eval_shape matrix in tests/test_jitcheck.py).  Dims
+#: are SHARD-LOCAL: the global batch B and pool capacity ``cap`` (both
+#: padded to device-count multiples by the lane router / table
+#: placement) divide by the mesh size ``ndev``.
+_CONTRACTS = {
+    "verify_keyed_shard": {
+        "args": {
+            "buf": ("u8", ("104+bucket", "B//ndev")),
+            "table": ("i32", ("nwin", 4, "NLIMBS", "cap*nent//ndev")),
+            "key_valid": ("bool", ("cap//ndev",)),
+        },
+        "static": ("bucket", "nblocks", "window_bits"),
+        "out": ("bool", ("B//ndev",)),
+    },
+}

@@ -69,6 +69,17 @@ class TestJitSeamLint:
                 fn = jax.jit(lambda p: p)
                 _sharded_cache[key] = fn
                 return fn
+
+            def verify_keyed_shard(buf, bucket):
+                return buf
+
+            _CONTRACTS = {
+                "verify_keyed_shard": {
+                    "args": {"buf": ("u8", ("104+bucket", "B//ndev"))},
+                    "static": ("bucket",),
+                    "out": ("u8", ("104+bucket", "B//ndev")),
+                },
+            }
             """,
             rel="cometbft_tpu/parallel/mesh.py",
         )
@@ -182,6 +193,17 @@ class TestJitSeamLint:
                 fn = jax.jit(run)
                 _sharded_cache[(mesh, nblocks)] = fn
                 return fn
+
+            def verify_keyed_shard(buf, bucket):
+                return buf
+
+            _CONTRACTS = {
+                "verify_keyed_shard": {
+                    "args": {"buf": ("u8", ("104+bucket", "B//ndev"))},
+                    "static": ("bucket",),
+                    "out": ("u8", ("104+bucket", "B//ndev")),
+                },
+            }
             """,
             rel="cometbft_tpu/parallel/mesh.py",
         )
@@ -486,6 +508,63 @@ class TestContractEvalShape:
                     )
                 )
         assert not errs, "\n".join(errs)
+
+    def test_sharded_keyed_kernel_across_mesh_shapes(self):
+        """The shard-local keyed kernel's contract (dims are
+        global//ndev), swept across mesh sizes and both window
+        widths — deviceless, no FLOPs."""
+        from cometbft_tpu.parallel import mesh as M
+
+        # three rungs cover: no-mesh, full-mesh at both window widths
+        # (each env is a full abstract trace of the keyed kernel graph
+        # — ~3s apiece, so the matrix stays deliberately small)
+        errs = []
+        for ndev, wb, cap in (
+            (1, 8, 16), (8, 4, 32), (8, 8, 16),
+        ):
+            env = contracts_mod.ladder_env(
+                64, 128, window_bits=wb, cap=cap, ndev=ndev
+            )
+            errs.extend(
+                contracts_mod.check_contract(
+                    M.verify_keyed_shard,
+                    M._CONTRACTS["verify_keyed_shard"],
+                    env,
+                )
+            )
+        assert not errs, "\n".join(errs)
+
+    def test_keyed_mesh_seam_eval_shape_across_mesh_shapes(self):
+        """The whole _compiled_keyed_mesh seam (shard_map + jit with
+        in/out shardings + donation) abstractly evaluated at GLOBAL
+        shapes over 1/2/4/8-device meshes — shape/dtype/sharding
+        plumbing verified without executing a single kernel."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from cometbft_tpu.ops import field as F
+        from cometbft_tpu.parallel import mesh as M
+
+        if M._shard_map is None:
+            pytest.skip("shard_map unavailable in this jax")
+        devs = jax.devices()
+        for ndev in (1, 8):
+            mesh = jax.sharding.Mesh(
+                np.array(devs[:ndev]), (M.DATA_AXIS,)
+            )
+            fn = M._compiled_keyed_mesh(mesh, 128, 8, 8192)
+            batch, cap, nent = 64, 16, 256
+            out = jax.eval_shape(
+                fn,
+                jax.ShapeDtypeStruct((104 + 128, batch), jnp.uint8),
+                jax.ShapeDtypeStruct(
+                    (32, 4, F.NLIMBS, cap * nent), jnp.int32
+                ),
+                jax.ShapeDtypeStruct((cap,), jnp.bool_),
+            )
+            assert tuple(out.shape) == (batch,)
+            assert np.dtype(out.dtype) == np.dtype(bool)
 
     @pytest.mark.slow
     def test_full_matrix(self):

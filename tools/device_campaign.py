@@ -13,8 +13,14 @@ Steps (env = the kernel config under test, tool = what runs):
   keyed_stack     CMT_TPU_COLS_IMPL=stack             bench_keyed
   keyed_stack16   CMT_TPU_COLS_IMPL=stack16 SQ=mul    bench_keyed
   keyed_pallas    CMT_TPU_COLS_IMPL=pallas            bench_keyed
+  keyed_mesh      8-chip sharded keyed tier           bench.py --keyed-mesh
   ab_stack        generic kernel A/B                  bench_kernel_ab
   ab_stack16      generic kernel A/B                  bench_kernel_ab
+
+The keyed_mesh step's JSON line (per-chip + aggregate sigs/s,
+dispatch_tier, per-seam compiles) is scraped into this campaign's
+MULTICHIP entry fields; bench.py itself also merges the full row into
+MULTICHIP_KEYED.json.
 """
 
 from __future__ import annotations
@@ -57,6 +63,7 @@ STEPS = {
         {"CMT_TPU_COLS_IMPL": "pallas", "CMT_TPU_SQUARE_IMPL": "fast"},
         "tools/bench_keyed.py",
     ),
+    "keyed_mesh": ({}, "bench.py --keyed-mesh"),
     "ab_stack": (
         {"CMT_TPU_COLS_IMPL": "stack", "CMT_TPU_SQUARE_IMPL": "fast"},
         "tools/bench_kernel_ab.py",
@@ -121,8 +128,8 @@ def _run_step_proc(name: str, tool: str, env: dict, timeout: float) -> dict:
     t0 = time.time()
     try:
         proc = subprocess.run(
-            [sys.executable, tool], cwd=REPO, env=env, timeout=timeout,
-            capture_output=True, text=True,
+            [sys.executable] + tool.split(), cwd=REPO, env=env,
+            timeout=timeout, capture_output=True, text=True,
         )
         out = proc.stdout + proc.stderr
         m = RATE_RE.search(out)
@@ -139,6 +146,25 @@ def _run_step_proc(name: str, tool: str, env: dict, timeout: float) -> dict:
                 entry["warmup_compiles"] = json.loads(mc.group(1))
             except ValueError:
                 pass
+        # keyed_mesh (and any JSON-line tool): scrape the dispatch
+        # tier + per-chip/aggregate rates into the MULTICHIP entry
+        for line in proc.stdout.splitlines():
+            if not line.startswith("{"):
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if "dispatch_tier" in row:
+                entry["dispatch_tier"] = row["dispatch_tier"]
+            if row.get("metric") == "keyed_mesh_batch_verify_throughput":
+                entry["sigs_per_sec_aggregate"] = row.get("value")
+                entry["sigs_per_sec_per_chip"] = row.get(
+                    "per_chip_sigs_per_sec"
+                )
+                entry["ndev"] = row.get("ndev")
+                entry["jit_compiles"] = row.get("jit_compiles")
+                entry["steady_retraces"] = row.get("steady_retraces")
         return entry
     except subprocess.TimeoutExpired as exc:
         out = ((exc.stdout or b"").decode(errors="replace") if

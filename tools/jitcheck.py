@@ -89,6 +89,8 @@ JIT_SEAMS = frozenset(
          "_compiled_build"),
         (os.path.join("cometbft_tpu", "parallel", "mesh.py"),
          "sharded_verify_fn"),
+        (os.path.join("cometbft_tpu", "parallel", "mesh.py"),
+         "_compiled_keyed_mesh"),
     }
 )
 
@@ -131,6 +133,9 @@ REQUIRED_CONTRACTS = {
     os.path.join("cometbft_tpu", "ops", "precompute.py"): frozenset(
         {"build_tables_kernel", "comb_mul_base8", "comb_mul_keyed"}
     ),
+    os.path.join("cometbft_tpu", "parallel", "mesh.py"): frozenset(
+        {"verify_keyed_shard"}
+    ),
 }
 
 _WAIVER_RE = re.compile(r"#\s*host\s+sync:\s*(\S.*)")
@@ -141,7 +146,8 @@ _WAIVER_RE = re.compile(r"#\s*host\s+sync:\s*(\S.*)")
 #: stay in lockstep.
 DTYPES_OK = frozenset({"u8", "i32", "i64", "u64", "bool"})
 DIM_SYMBOLS = frozenset(
-    {"B", "bucket", "nblocks", "NLIMBS", "nwin", "nent", "cap", "M"}
+    {"B", "bucket", "nblocks", "NLIMBS", "nwin", "nent", "cap", "M",
+     "ndev"}
 )
 STATIC_PARAMS_OK = DIM_SYMBOLS | {"window_bits"}
 

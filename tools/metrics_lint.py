@@ -71,8 +71,10 @@ SUBSYSTEMS = {
 
 #: structs whose every field must ALSO be documented in
 #: docs/observability.md and mapped (or marked beyond-parity) in
-#: docs/PARITY.md — the replication-plane structs start the list;
-#: extend as older planes get back-documented
+#: docs/PARITY.md — the replication-plane structs started the list;
+#: CryptoMetrics joined with the dispatch-tier ladder (PR 6), whose
+#: series operators must be able to interpret to confirm keyed is the
+#: default; extend as older planes get back-documented
 DOC_CHECKED = (
     "BlockSyncMetrics",
     "StateSyncMetrics",
@@ -80,6 +82,7 @@ DOC_CHECKED = (
     "WALMetrics",
     "StoreMetrics",
     "EvidenceMetrics",
+    "CryptoMetrics",
 )
 
 DOC_FILES = (
