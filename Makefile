@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke
+test: metrics-lint flight-smoke mesh-smoke health-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -126,6 +126,27 @@ flight-smoke:
 mesh-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_parallel.py \
 		-k "ShardedKeyed or KeyedWarm or KeyPoolMesh" -q
+
+# device-health smoke: boot the prober against the host tier and
+# assert the healthy gauge + a probe histogram sample land, plus the
+# /debug/perf + /debug index round trips (tier-1 runs these too;
+# `make test` gates on this target alongside the three lints)
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py \
+		-k "HealthSmoke" -q
+
+# perf regression gate: proves perfdiff's calibration on the seeded
+# fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —
+# deterministic, so it gates `make test`.  Compare two real ledger
+# points with `python tools/perfdiff.py OLD NEW`.
+perf-gate:
+	$(PY) tools/perfdiff.py --selftest
+
+# back-fill/refresh docs/data/perf_ledger.json from the historical
+# BENCH_*/MULTICHIP_*/kernel_ab files (bench.py / bench_all.py /
+# device_campaign.py append new points automatically)
+perf-ledger:
+	$(PY) tools/perfledger.py --harvest
 
 native:
 	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \

@@ -603,6 +603,9 @@ def keyed_mesh_main() -> dict:
         device=str(devs[0]),
     )
     log("wrote MULTICHIP_KEYED.json")
+    from tools import perfledger
+
+    perfledger.append_rows([result], source="bench --keyed-mesh")
     install_crypto_metrics(None)
     return result
 
@@ -819,6 +822,19 @@ def run() -> None:
         os.unlink(result_path)
     except OSError:
         pass
+    if result.get("value"):
+        # the headline lands in the perf ledger with its provenance
+        # (tier, per-seam compiles, steady retraces) — perfdiff's gate
+        # input; best-effort, the bench result prints regardless
+        try:
+            from tools import perfledger
+
+            entry = perfledger.headline_entry(result)
+            if not entry.get("measured"):
+                entry["measured"] = time.strftime("%Y-%m-%d %H:%M")
+            perfledger.append([entry])
+        except Exception as exc:  # noqa: BLE001 — provenance only
+            log(f"perf ledger append failed (ignored): {exc}")
     print(json.dumps(result), flush=True)
     if not result.get("value"):
         sys.exit(2)

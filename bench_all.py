@@ -179,9 +179,26 @@ def main() -> None:
     # / generic / host) — BENCH_ALL previously couldn't tell a keyed
     # measurement from a generic one, which is how the perf trajectory
     # kept quoting the generic kernel by accident
+    from cometbft_tpu.ops import jitguard as _jg
+
     cm = CryptoMetrics(Registry())
     install_crypto_metrics(cm)
     tier_seen: dict[str, float] = {}
+    compiles_seen: dict[str, int] = {}
+
+    def compiles_delta() -> dict[str, int]:
+        # per-seam jit compiles since the last record: a nonzero delta
+        # on a row measured AFTER its warmup means the "steady state"
+        # recompiled mid-measurement (docs/device_contracts.md)
+        now = _jg.compile_counts()
+        delta = {
+            s: int(c - compiles_seen.get(s, 0))
+            for s, c in now.items()
+            if c > compiles_seen.get(s, 0)
+        }
+        compiles_seen.clear()
+        compiles_seen.update(now)
+        return delta
 
     def tier_delta() -> dict[str, int]:
         now = {
@@ -229,10 +246,18 @@ def main() -> None:
         if tiers and "dispatch_tier" not in row:
             row["dispatch_tier"] = max(tiers, key=tiers.get)
             row["dispatch_tiers"] = tiers
+        compiles = compiles_delta()
+        if compiles:
+            row["jit_compiles"] = compiles
         row["measured"] = time.strftime("round 6, %Y-%m-%d")
         results.append(row)
         print(json.dumps(row), flush=True)
         checkpoint()
+        # every measured row lands in the perf ledger with its
+        # provenance (tier, compiles) — the regression gate's input
+        from tools import perfledger
+
+        perfledger.append_rows([row], source="bench_all")
 
     # ---- config 1: 64-sig micro-bench --------------------------------
     # PRODUCTION dispatch: the runtime threshold routes a 64-sig batch

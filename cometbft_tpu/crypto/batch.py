@@ -118,6 +118,18 @@ def _device_ndev() -> int:
         return 0
 
 
+def device_status() -> dict:
+    """Read-only snapshot of the device probe state machine for the
+    health plane (/debug/perf): {"status": unknown | probing | ready |
+    failed, "ndev": visible device count}.  Never triggers a probe —
+    the health surfaces must be safe to scrape while the tunnel is
+    wedged (the whole point of the plane)."""
+    return {
+        "status": _device_state["status"],
+        "ndev": _device_state["ndev"],
+    }
+
+
 def _ed25519_factory() -> BatchVerifier:
     # Routing decisions that end at the host verifier are recorded
     # here, where they are made; a device-capable verifier defers its

@@ -46,6 +46,10 @@ _INSPECT_ROUTES = (
     # inspector runs (store reads, RPC handling) — same shape as a
     # live node's /debug/flight
     "debug/flight",
+    # device-health + perf-ledger snapshot: tier health is exactly
+    # what post-mortem inspection of a device-lost node needs, and
+    # the payload is store-free (crypto/health.py)
+    "debug/perf",
 )
 
 

@@ -639,6 +639,93 @@ class CryptoMetrics:
         )
 
 
+class HealthMetrics:
+    """Device-HEALTH plane — is the accelerator alive, and how busy.
+
+    CryptoMetrics measures what the device path DID (launches, bytes,
+    tiers); this family measures whether it is healthy enough to keep
+    doing it: per-tier canary-probe latency and health, hang-watchdog
+    trips, busy/idle occupancy between launches, and the host/device
+    overlap the pipelined paths are supposed to buy.  No metricsgen
+    analog — the reference has no accelerator to lose mid-run (two of
+    five bench rounds did).  Same ``crypto`` subsystem prefix as
+    CryptoMetrics so the series sit next to the dispatch ladder they
+    explain; updated through the process-wide health sink
+    (``health_metrics()``) by cometbft_tpu/crypto/health.py.
+    """
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.tier_probe_seconds = self.tier_healthy = _NOP
+            self.tier_probe_failures_total = _NOP
+            self.device_hangs_total = _NOP
+            self.device_busy_seconds_total = _NOP
+            self.device_idle_seconds_total = _NOP
+            self.launch_queue_wait_seconds = _NOP
+            self.host_device_overlap_ratio = _NOP
+            return
+        s = "crypto"
+        self.tier_probe_seconds = reg.histogram(
+            s, "tier_probe_seconds",
+            "Wall seconds per canary probe of a dispatch tier "
+            "(keyed_mesh | keyed | generic | host) — the health "
+            "prober's lightweight verify against each available tier.",
+            buckets=DEFAULT_TIME_BUCKETS,
+            labels=("tier",),
+        )
+        self.tier_healthy = reg.gauge(
+            s, "tier_healthy",
+            "1 while the tier's last canary probe verified correctly "
+            "within budget, 0 after a failed/hung/mis-verifying probe "
+            "— the signal the dispatch-ladder demotion policy "
+            "(ROADMAP item 5) consumes.",
+            labels=("tier",),
+        )
+        self.tier_probe_failures_total = reg.counter(
+            s, "tier_probe_failures_total",
+            "Canary probes that failed (exception, mis-verify, or "
+            "watchdog overrun), by tier.",
+            labels=("tier",),
+        )
+        self.device_hangs_total = reg.counter(
+            s, "device_hangs_total",
+            "Device launches that exceeded the launch watchdog budget "
+            "(CMT_TPU_LAUNCH_BUDGET_S) — a wedged tunnel becomes this "
+            "counter + a flight-recorder event instead of a silent "
+            "stall.",
+        )
+        self.device_busy_seconds_total = reg.counter(
+            s, "device_busy_seconds_total",
+            "Wall seconds the device spent inside batch-verify "
+            "launches (dispatch through result fetch), per chip "
+            "(device label is the mesh position; \"0\" single-chip).",
+            labels=("device",),
+        )
+        self.device_idle_seconds_total = reg.counter(
+            s, "device_idle_seconds_total",
+            "Wall seconds the device sat idle BETWEEN batch-verify "
+            "launches, per chip — busy/(busy+idle) is the occupancy "
+            "the verify-ahead pipelining (ROADMAP item 2) must raise.",
+            labels=("device",),
+        )
+        self.launch_queue_wait_seconds = reg.histogram(
+            s, "launch_queue_wait_seconds",
+            "Host-side seconds a batch spent between entering "
+            "TpuBatchVerifier.verify and its device dispatch (table "
+            "lookup + packing + routing) — the queue-wait half of the "
+            "queue-wait vs kernel-wall split (the kernel half is "
+            "crypto_kernel_time_seconds).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.host_device_overlap_ratio = reg.gauge(
+            s, "host_device_overlap_ratio",
+            "Fraction of the last launch's device wall time the host "
+            "spent NOT blocked in the result fetch (1 - fetch_wait / "
+            "launch_wall): ~0 means lockstep sync dispatch, ->1 means "
+            "host work fully overlaps device compute.",
+        )
+
+
 #: Process-wide sink for the crypto/device hot paths.  The batch
 #: verifier and table cache are module-level singletons with no node
 #: handle, so unlike the per-node structs above they update whatever is
@@ -657,6 +744,26 @@ def install_crypto_metrics(metrics: CryptoMetrics | None) -> None:
     resets to the no-op)."""
     global _CRYPTO
     _CRYPTO = metrics if metrics is not None else CryptoMetrics(None)
+
+
+#: Process-wide sink for the device-health plane — the watchdog,
+#: usage tracker, and prober (cometbft_tpu/crypto/health.py) are
+#: module-level singletons like the batch verifier they observe.
+#: Same contract as the crypto sink: no-op by default, node assembly
+#: installs the real struct, last installed wins.
+_HEALTH = HealthMetrics(None)
+
+
+def health_metrics() -> HealthMetrics:
+    """The currently installed device-health sink (never None)."""
+    return _HEALTH
+
+
+def install_health_metrics(metrics: HealthMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide health sink (None
+    resets to the no-op)."""
+    global _HEALTH
+    _HEALTH = metrics if metrics is not None else HealthMetrics(None)
 
 
 #: Process-wide sink for wire-plane code with no node handle —
@@ -689,6 +796,7 @@ class NodeMetrics:
         self.p2p = P2PMetrics(reg)
         self.state = StateMetrics(reg)
         self.crypto = CryptoMetrics(reg)
+        self.health = HealthMetrics(reg)
         self.rpc = RPCMetrics(reg)
         self.event_bus = EventBusMetrics(reg)
         self.blocksync = BlockSyncMetrics(reg)
@@ -705,6 +813,7 @@ __all__ = [
     "CryptoMetrics",
     "EventBusMetrics",
     "EvidenceMetrics",
+    "HealthMetrics",
     "MempoolMetrics",
     "NodeMetrics",
     "P2PMetrics",
@@ -715,7 +824,9 @@ __all__ = [
     "StoreMetrics",
     "WALMetrics",
     "crypto_metrics",
+    "health_metrics",
     "install_crypto_metrics",
+    "install_health_metrics",
     "install_p2p_metrics",
     "p2p_metrics",
 ]

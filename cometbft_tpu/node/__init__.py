@@ -137,6 +137,7 @@ class Node(BaseService):
         from cometbft_tpu.metrics import (
             NodeMetrics,
             install_crypto_metrics,
+            install_health_metrics,
             install_p2p_metrics,
         )
         from cometbft_tpu.utils.metrics import MetricsServer, Registry
@@ -157,9 +158,15 @@ class Node(BaseService):
             # analogous p2p sink.
             install_crypto_metrics(self.metrics.crypto)
             install_p2p_metrics(self.metrics.p2p)
+            # the device-health plane (watchdog, prober, utilization —
+            # crypto/health.py) shares the singleton-sink pattern
+            install_health_metrics(self.metrics.health)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
+        #: background tier prober (started with the metrics server;
+        #: CMT_TPU_HEALTH_INTERVAL=0 disables)
+        self.health_prober = None
 
         # 1. stores (node/node.go:320 initDBs)
         backend = config.base.db_backend
@@ -621,6 +628,32 @@ class Node(BaseService):
     def _start_services(self) -> None:
         if self.metrics_server is not None:
             self.metrics_server.start()
+            # device-health prober: periodic canary verifies per
+            # dispatch tier, feeding crypto_tier_healthy{tier} and the
+            # /debug/perf surface.  A malformed CMT_TPU_HEALTH_INTERVAL
+            # raises HERE — the documented fail-loudly contract (same
+            # as the ring-size vars): an operator who configured
+            # probing must not silently get none.  Runtime start
+            # failures beyond that are a diagnostics loss, never a
+            # node-down (same stance as pprof below).
+            from cometbft_tpu.crypto.health import (
+                HealthProber,
+                health_interval_from_env,
+            )
+
+            interval = health_interval_from_env()
+            if interval > 0:
+                try:
+                    self.health_prober = HealthProber(
+                        interval_s=interval,
+                        logger=self.logger.with_fields(module="health"),
+                    )
+                    self.health_prober.start()
+                except Exception as exc:  # noqa: BLE001 — optional
+                    self.health_prober = None  # plane
+                    self.logger.error(
+                        "health prober failed to start", err=repr(exc)
+                    )
         # pprof-analog diagnostics plane (node.go:589 startPprofServer);
         # failures here must never take the node down — it is an
         # optional debug feature.  The SIGUSR1 stack-dump handler is
@@ -771,6 +804,7 @@ class Node(BaseService):
             self.event_bus,
             self.proxy_app,
             self.privval_listener,
+            self.health_prober,
             self.metrics_server,
             getattr(self, "diagnostics_server", None),
         )

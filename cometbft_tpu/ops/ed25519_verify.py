@@ -45,6 +45,7 @@ import jax.numpy as jnp
 
 from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
+from cometbft_tpu.crypto import health as _health
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as _F
@@ -435,14 +436,18 @@ def _finish(parts) -> np.ndarray:
     transfers — recognizes it as the audited fetch)."""
     if len(parts) == 1:
         p, k = parts[0]
-        out = jax.device_get(p)  # host sync: the one audited per-batch result fetch
+        # timed_fetch: the blocking-fetch seconds feed the host/device
+        # overlap ratio (crypto/health.py DeviceUsage)
+        with _health.USAGE.timed_fetch():
+            out = jax.device_get(p)  # host sync: the one audited per-batch result fetch
         _crypto_metrics().bytes_transferred.labels(
             direction="d2h"
         ).inc(out.nbytes)
         return out[:k]
-    combined = jax.device_get(  # host sync: single combined fetch for all parts
-        jnp.concatenate([p for p, _ in parts])
-    )
+    with _health.USAGE.timed_fetch():
+        combined = jax.device_get(  # host sync: single combined fetch for all parts
+            jnp.concatenate([p for p, _ in parts])
+        )
     _crypto_metrics().bytes_transferred.labels(
         direction="d2h"
     ).inc(combined.nbytes)
@@ -600,6 +605,10 @@ class TpuBatchVerifier(BatchVerifier):
         # the _run_* seam that executed (mesh subclasses report their
         # own tiers); verify() feeds it to crypto_dispatch_tier
         self._last_tier: str | None = None
+        # chips a launch occupies, for the per-device busy/idle
+        # accounting (crypto/health.py DeviceUsage); the mesh verifier
+        # overrides this with its device count
+        self._usage_ndev = 1
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key.type() != _ed.KEY_TYPE:
@@ -617,6 +626,7 @@ class TpuBatchVerifier(BatchVerifier):
         n = len(self._pubs)
         if n == 0:
             return False, []
+        t_enter = time.perf_counter()
         cm = _crypto_metrics()
         device_usable = self._device_min_batch < 1 << 30
         msg_fits = max(len(m) for m in self._msgs) <= _BUCKETS[-1]
@@ -684,13 +694,27 @@ class TpuBatchVerifier(BatchVerifier):
             # dispatch raises at the offending line instead of
             # silently paying the link RTT per batch
             with _jitguard.transfer_window():
-                if entry is not None:
-                    out = self._run_keyed(
-                        entry, entry.key_ids(self._pubs), pub, sig,
-                        self._msgs,
-                    )
-                else:
-                    out = self._run_generic(pub, sig, self._msgs)
+                # health seam: queue-wait (host prep before dispatch),
+                # the launch watchdog (a wedged launch becomes
+                # crypto_device_hangs_total + a flight event inside
+                # its budget, not a silent stall), and busy/idle +
+                # overlap accounting over the launch wall
+                intent = "keyed" if entry is not None else "generic"
+                t_launch = time.perf_counter()
+                _health.USAGE.note_queue_wait(t_launch - t_enter)
+                fetch0 = _health.USAGE.fetch_wait()
+                with _health.WATCHDOG.watch(tier=intent, batch=n):
+                    if entry is not None:
+                        out = self._run_keyed(
+                            entry, entry.key_ids(self._pubs), pub, sig,
+                            self._msgs,
+                        )
+                    else:
+                        out = self._run_generic(pub, sig, self._msgs)
+                _health.USAGE.launch_end(
+                    t_launch, ndev=self._usage_ndev,
+                    fetch_wait=_health.USAGE.fetch_wait() - fetch0,
+                )
             results = [bool(v) for v in out]
             tier = self._last_tier or (
                 "keyed" if entry is not None else "generic"

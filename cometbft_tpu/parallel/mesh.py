@@ -35,6 +35,7 @@ try:  # the pjit in/out-shardings + shard_map fallback seam needs it
 except ImportError:  # pragma: no cover - older jax: keyed_mesh tier off
     _shard_map = None
 
+from cometbft_tpu.crypto import health as _health
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import field as _field
 from cometbft_tpu.ops import jitguard as _jitguard
@@ -249,6 +250,8 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         super().__init__(**kwargs)
         self._mesh = mesh or flat_mesh()
         self._ndev = int(self._mesh.devices.size)
+        # per-chip busy/idle attribution (crypto/health.py DeviceUsage)
+        self._usage_ndev = self._ndev
 
     def _pad_cols(
         self, packed: np.ndarray, chunk: int | None = None
@@ -289,7 +292,9 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
             fn = _compiled(batch, bucket)
         out = fn(jax.device_put(packed, self._sharding(None, DATA_AXIS)))
         self._last_tier = "generic_mesh"
-        return jax.device_get(out)[: len(msgs)]  # host sync: single per-batch result gather off the mesh
+        with _health.USAGE.timed_fetch():
+            res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
+        return res[: len(msgs)]
 
     def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
         from cometbft_tpu.ops.ed25519_verify import (
@@ -365,7 +370,8 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
                 table,
                 valid,
             )
-        res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
+        with _health.USAGE.timed_fetch():
+            res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
         cm.bytes_transferred.labels(direction="d2h").inc(res.nbytes)
         self._last_tier = "keyed_mesh"
         return res[dest]  # unscatter to original lane order

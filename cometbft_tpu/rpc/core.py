@@ -157,6 +157,8 @@ class Environment:
             # GET /debug/flight (the path strips to this route name):
             # the always-on flight recorder's recent replication events
             "debug/flight": self.debug_flight,
+            # GET /debug/perf: device-health + perf-ledger snapshot
+            "debug/perf": self.debug_perf,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -313,6 +315,17 @@ class Environment:
         from cometbft_tpu.utils.flight import FLIGHT
 
         return FLIGHT.export()
+
+    def debug_perf(self) -> dict:
+        """Device-health/perf snapshot (crypto/health.py): per-tier
+        canary health + last probe latencies, launch-watchdog state,
+        busy/idle utilization with the host/device overlap ratio, and
+        the perf-ledger tail.  Served on a live node AND in inspect
+        mode — a wedged accelerator is precisely when the node may not
+        be running (docs/observability.md "Device-health plane")."""
+        from cometbft_tpu.crypto.health import debug_perf_payload
+
+        return debug_perf_payload()
 
     def genesis_route(self) -> dict:
         import json as _json

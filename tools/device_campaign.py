@@ -203,6 +203,26 @@ def main() -> int:
         entry["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
         data["results"][name] = entry
         save(data)
+        # the merged store of record is the perf ledger
+        # (tools/perfledger.py): each step's point lands there with
+        # its provenance the moment it is measured, so the trajectory
+        # never again has to be reassembled from per-round files
+        value = entry.get("sigs_per_sec_aggregate") or entry.get(
+            "sigs_per_sec_device"
+        )
+        if value:
+            from tools import perfledger
+
+            perfledger.append_rows(
+                [
+                    dict(
+                        entry, config=name, value=value,
+                        unit="sigs/sec",
+                        measured=entry["measured_at"],
+                    )
+                ],
+                source="device_campaign",
+            )
         dump_trace()
         rate = entry.get("sigs_per_sec_device")
         print(f"{name}: " + (f"{rate:,.0f} sigs/s" if rate else

@@ -59,6 +59,7 @@ SUBSYSTEMS = {
     "P2PMetrics": "p2p",
     "StateMetrics": "state",
     "CryptoMetrics": "crypto",
+    "HealthMetrics": "crypto",
     "RPCMetrics": "rpc",
     "EventBusMetrics": "event_bus",
     "BlockSyncMetrics": "blocksync",
@@ -83,6 +84,8 @@ DOC_CHECKED = (
     "StoreMetrics",
     "EvidenceMetrics",
     "CryptoMetrics",
+    # an undocumented health series is an alert nobody can act on
+    "HealthMetrics",
 )
 
 DOC_FILES = (

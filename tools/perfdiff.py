@@ -1,0 +1,189 @@
+"""perfdiff: compare two perf-ledger points and gate on regression.
+
+    python tools/perfdiff.py OLD.json NEW.json [--threshold 0.10]
+    python tools/perfdiff.py --selftest          # make perf-gate
+
+Inputs are perf-ledger documents (tools/perfledger.py schema) or any
+BENCH_ALL-shaped ``{"results": [...]}`` file; for each ``config``
+present in both, the LATEST entry on each side is compared with a
+noise-aware relative threshold:
+
+- direction comes from the unit: throughput units (sigs/sec, ops/sec,
+  tx/sec...) regress DOWN, latency units (ms, s, ns_per_op) regress
+  UP;
+- the default threshold (10%) sits above the run-to-run noise the
+  bench history shows (repeat trials of the same config vary ~3-5% on
+  this stack: bench.py takes best-of-3 precisely because single runs
+  wobble) and well below any change worth a human's attention — the
+  measured regressions that mattered were 3-5x, not 1.1x;
+- values <= 0 on either side are skipped (a 0 means "the device was
+  down", which the availability entries record separately — gating on
+  it would page on every tunnel outage instead of every code change).
+
+Exit status: 0 clean, 1 when any compared config regressed past the
+threshold, 2 on usage errors.  ``--selftest`` (what ``make perf-gate``
+runs, standalone and in tier-1 via tests/test_health.py) proves the
+gate's calibration against the committed fixture pair in
+tests/data/perf_gate/: a seeded 20% regression MUST fail and seeded
+noise-level (3%) deltas MUST pass — so the gate cannot silently decay
+into always-green or always-red.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_THRESHOLD = 0.10
+
+#: units where SMALLER is better; everything else is throughput-like
+LOWER_BETTER_UNITS = frozenset({"ms", "s", "seconds", "ns_per_op"})
+
+FIXTURE_DIR = os.path.join(REPO, "tests", "data", "perf_gate")
+
+
+def _latest_by_config(doc: dict) -> dict[str, dict]:
+    """config -> last entry, from a ledger or BENCH_ALL-shaped doc."""
+    rows = doc.get("entries")
+    if rows is None:
+        rows = doc.get("results", [])
+    out: dict[str, dict] = {}
+    for row in rows:
+        cfg = row.get("config") or row.get("metric")
+        if cfg is None or row.get("value") is None:
+            continue
+        out[cfg] = row  # later entries win: the ledger is append-order
+    return out
+
+
+def compare(
+    old_doc: dict, new_doc: dict,
+    threshold: float = DEFAULT_THRESHOLD,
+    configs: list[str] | None = None,
+) -> tuple[list[dict], list[dict]]:
+    """Returns (regressions, comparisons): every config compared, and
+    the subset whose delta crossed the threshold in the bad
+    direction."""
+    old = _latest_by_config(old_doc)
+    new = _latest_by_config(new_doc)
+    names = configs or sorted(set(old) & set(new))
+    comparisons: list[dict] = []
+    regressions: list[dict] = []
+    for cfg in names:
+        o, n = old.get(cfg), new.get(cfg)
+        if o is None or n is None:
+            continue
+        try:
+            ov, nv = float(o["value"]), float(n["value"])
+        except (TypeError, ValueError):
+            continue
+        if ov <= 0 or nv <= 0:
+            continue  # availability zeros, not perf points
+        unit = n.get("unit") or o.get("unit") or ""
+        lower_better = unit in LOWER_BETTER_UNITS
+        # delta > 0 always means WORSE, whichever way the unit points
+        delta = (nv - ov) / ov if lower_better else (ov - nv) / ov
+        row = {
+            "config": cfg, "unit": unit, "old": ov, "new": nv,
+            "delta": round(delta, 4), "threshold": threshold,
+            "regressed": delta > threshold,
+        }
+        comparisons.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    return regressions, comparisons
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _report(regressions: list[dict], comparisons: list[dict]) -> None:
+    for row in comparisons:
+        mark = "REGRESSION" if row["regressed"] else "ok"
+        print(
+            f"perfdiff: {row['config']}: {row['old']:g} -> "
+            f"{row['new']:g} {row['unit']} "
+            f"({row['delta'] * 100:+.1f}% worse, threshold "
+            f"{row['threshold'] * 100:.0f}%) {mark}",
+            file=sys.stderr if row["regressed"] else sys.stdout,
+        )
+    if not comparisons:
+        print("perfdiff: no comparable configs", file=sys.stderr)
+
+
+def selftest() -> int:
+    """Prove the gate's calibration on the committed fixture pair:
+    the seeded 20% regression must trip it, the seeded 3% noise must
+    not.  This is what ``make perf-gate`` runs — deterministic (no
+    live measurement), so it can gate ``make test``."""
+    baseline = _load(os.path.join(FIXTURE_DIR, "baseline.json"))
+    regressed = _load(os.path.join(FIXTURE_DIR, "regressed.json"))
+    noise = _load(os.path.join(FIXTURE_DIR, "noise.json"))
+    failures: list[str] = []
+    regs, comps = compare(baseline, regressed)
+    if not comps:
+        failures.append("fixture pair produced no comparisons")
+    missed = [c["config"] for c in comps if not c["regressed"]]
+    if missed:
+        failures.append(
+            f"seeded 20% regression NOT detected for: {missed}"
+        )
+    regs_noise, comps_noise = compare(baseline, noise)
+    if not comps_noise:
+        failures.append("noise fixture produced no comparisons")
+    if regs_noise:
+        failures.append(
+            "noise-level deltas tripped the gate: "
+            f"{[r['config'] for r in regs_noise]}"
+        )
+    if failures:
+        for f in failures:
+            print(f"perf-gate selftest FAILED: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"perf-gate: ok — seeded 20% regression detected on "
+        f"{len(comps)} config(s), {len(comps_noise)} noise-level "
+        "delta(s) passed"
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", nargs="?", help="baseline ledger/BENCH file")
+    ap.add_argument("new", nargs="?", help="candidate ledger/BENCH file")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative regression threshold (default 0.10)")
+    ap.add_argument("--config", action="append", dest="configs",
+                    help="limit to these config names (repeatable)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the gate against the seeded fixture "
+                    "pair (make perf-gate)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.old or not args.new:
+        ap.print_usage(sys.stderr)
+        return 2
+    try:
+        old_doc, new_doc = _load(args.old), _load(args.new)
+    except (OSError, ValueError) as exc:
+        print(f"perfdiff: {exc}", file=sys.stderr)
+        return 2
+    regressions, comparisons = compare(
+        old_doc, new_doc, threshold=args.threshold, configs=args.configs
+    )
+    _report(regressions, comparisons)
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""perfledger: ONE merged record of every perf measurement this repo
+has ever taken, with provenance.
+
+The perf trajectory (22k -> 36k -> 103k sigs/s) lived scattered across
+nine BENCH_*/MULTICHIP_* files plus docs/data/kernel_ab_*.json, each
+with its own shape — comparing two rounds meant re-reading five
+formats by hand, and nothing could gate a regression.  This tool
+normalizes all of them into ``docs/data/perf_ledger.json``::
+
+    {"schema": 1,
+     "entries": [{"config", "value", "unit", "source", "measured",
+                  "round"?, "dispatch_tier"?, "jit_compiles"?,
+                  "steady_retraces"?, "platform"?, ...}, ...]}
+
+Each entry is one measured point: what was measured (``config``), the
+number (``value``/``unit``), where it came from (``source`` file or
+tool), when, and the device-path provenance that makes the number
+interpretable — the dispatch tier that actually ran, per-seam jit
+compile counts, and steady-state retraces (a nonzero retrace means the
+"steady state" wasn't).
+
+Writers:
+- ``bench.py`` and ``bench_all.py`` append every measured row
+  automatically (source ``bench`` / ``bench_all``).
+- ``tools/device_campaign.py`` appends each campaign step (replacing
+  its ad-hoc MULTICHIP scraping as the merged store of record).
+- ``python tools/perfledger.py --harvest`` back-fills from the
+  historical BENCH_*/MULTICHIP_*/kernel_ab files.
+
+Readers: ``tools/perfdiff.py`` (the regression gate, ``make
+perf-gate``) and the ``/debug/perf`` route, which serves the ledger
+tail next to live tier health (cometbft_tpu/crypto/health.py).
+
+Dedup key: (source, config, round, measured) — re-running a harvest
+or a bench replaces its own point instead of duplicating it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCHEMA = 1
+
+#: provenance keys carried through from source rows verbatim when
+#: present — everything a reader needs to interpret the number
+PROVENANCE_KEYS = (
+    "dispatch_tier", "dispatch_tiers", "jit_compiles", "steady_retraces",
+    "warmup_compiles", "platform", "ndev", "per_chip_sigs_per_sec",
+    "sigs_per_sec_per_chip", "sigs_per_sec", "latency_ms",
+    "commits_per_sec", "nval", "batch", "note", "path", "vs_baseline",
+    "target_ms", "rc",
+)
+
+
+def default_path() -> str:
+    from cometbft_tpu.crypto.health import perf_ledger_path
+
+    return perf_ledger_path()
+
+
+def load(path: str | None = None) -> dict:
+    path = path or default_path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"schema": SCHEMA, "entries": []}
+    doc.setdefault("schema", SCHEMA)
+    doc.setdefault("entries", [])
+    return doc
+
+
+def entry_key(e: dict) -> tuple:
+    return (
+        e.get("source"), e.get("config"), e.get("round"), e.get("measured")
+    )
+
+
+def append(entries: list[dict], path: str | None = None) -> dict:
+    """Atomically merge ``entries`` into the ledger.  A same-key entry
+    REPLACES its predecessor and moves to the END of the list — append
+    order IS recency (perfdiff's latest-per-config and the
+    /debug/perf ledger tail both read positionally, so an in-place
+    replace would leave a stale harvest entry looking newest)."""
+    path = path or default_path()
+    doc = load(path)
+    merged: dict[tuple, dict] = {}  # insertion-ordered: last write last
+    for e in entries:
+        merged[entry_key(e)] = e
+    doc["entries"] = [
+        e for e in doc["entries"] if entry_key(e) not in merged
+    ] + list(merged.values())
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return doc
+
+
+def tail(n: int = 10, path: str | None = None) -> list[dict]:
+    return load(path)["entries"][-n:]
+
+
+def make_entry(
+    config: str, value, unit: str, source: str, row: dict | None = None,
+    **extra,
+) -> dict:
+    """Normalize one measured point; ``row`` contributes whatever
+    PROVENANCE_KEYS it carries."""
+    e: dict = {"config": config, "value": value, "unit": unit,
+               "source": source}
+    row = row or {}
+    e["measured"] = (
+        extra.pop("measured", None)
+        or row.get("measured")
+        or row.get("measured_at")
+    )
+    for k in PROVENANCE_KEYS:
+        if k in row and k not in e:
+            e[k] = row[k]
+    e.update(extra)
+    return e
+
+
+# -- bench-side helpers (called by bench.py / bench_all.py) ---------------
+
+def headline_entry(result: dict, source: str = "bench") -> dict:
+    """bench.py's headline JSON -> one ledger entry (provenance: tier
+    and compile counts when the device path ran)."""
+    e = make_entry(
+        result.get("metric", "ed25519_batch_verify_throughput"),
+        result.get("value"), result.get("unit", "sigs/sec"), source,
+        row=result,
+    )
+    for k in ("generic_sigs_per_sec", "keyed_sigs_per_sec",
+              "keyed_cols_impl", "partial", "error"):
+        if k in result:
+            e[k] = result[k]
+    return e
+
+
+def append_rows(
+    rows: list[dict], source: str, path: str | None = None,
+) -> None:
+    """BENCH_ALL-shaped rows (config/value/unit + extras) -> ledger.
+    Best-effort by design: the ledger must never fail a bench."""
+    try:
+        append(
+            [
+                make_entry(
+                    r.get("config", r.get("metric", "unknown")),
+                    r.get("value"), r.get("unit", ""), source, row=r,
+                )
+                for r in rows
+            ],
+            path,
+        )
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        print(f"perfledger append failed (ignored): {exc}",
+              file=sys.stderr)
+
+
+# -- the historical harvest ----------------------------------------------
+
+def _read(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def harvest(repo: str = REPO) -> list[dict]:
+    """Normalize every historical BENCH_*/MULTICHIP_*/kernel_ab file
+    into ledger entries (idempotent: stable keys, so re-harvesting
+    replaces rather than duplicates)."""
+    entries: list[dict] = []
+
+    # BENCH_rNN.json: driver transcripts with a parsed headline
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        doc = _read(path)
+        if not doc:
+            continue
+        rnd = doc.get("n")
+        parsed = doc.get("parsed") or {}
+        if "value" in parsed:
+            entries.append(
+                make_entry(
+                    parsed.get("metric", "ed25519_batch_verify_throughput"),
+                    parsed.get("value"), parsed.get("unit", "sigs/sec"),
+                    os.path.basename(path), row=parsed, round=rnd,
+                )
+            )
+    # MULTICHIP_rNN.json: dryrun provenance — device count per round
+    # (0 recorded honestly for the rounds the tunnel was down)
+    for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
+        doc = _read(path)
+        if not doc:
+            continue
+        m = re.search(r"MULTICHIP_r(\d+)", path)
+        rnd = int(m.group(1)) if m else None
+        entries.append(
+            make_entry(
+                "multichip_dryrun",
+                doc.get("n_devices", 0) if doc.get("ok") else 0,
+                "devices", os.path.basename(path),
+                round=rnd, rc=doc.get("rc"),
+            )
+        )
+    # BENCH_ALL.json / MULTICHIP_KEYED.json: config rows
+    for name in ("BENCH_ALL.json", "MULTICHIP_KEYED.json"):
+        doc = _read(os.path.join(repo, name))
+        if not doc:
+            continue
+        for row in doc.get("results", []):
+            entries.append(
+                make_entry(
+                    row.get("config", row.get("metric", "unknown")),
+                    row.get("value"), row.get("unit", ""), name, row=row,
+                )
+            )
+    # BENCH_MICRO.json: host micro-bench rows
+    doc = _read(os.path.join(repo, "BENCH_MICRO.json"))
+    if doc:
+        for row in doc.get("results", []):
+            entries.append(
+                make_entry(
+                    row.get("bench", "unknown"), row.get("ops_per_sec"),
+                    "ops/sec", "BENCH_MICRO.json",
+                    ns_per_op=row.get("ns_per_op"),
+                )
+            )
+    # docs/data/kernel_ab_*.json: campaign step results
+    for path in sorted(
+        glob.glob(os.path.join(repo, "docs", "data", "kernel_ab_*.json"))
+    ):
+        doc = _read(path)
+        if not doc:
+            continue
+        for step, row in (doc.get("results") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            value = row.get("sigs_per_sec_device") or row.get(
+                "sigs_per_sec_aggregate"
+            )
+            if value is None:
+                continue
+            entries.append(
+                make_entry(
+                    step, value, "sigs/sec", os.path.basename(path),
+                    row=row,
+                    measured=row.get("measured_at"),
+                )
+            )
+    return entries
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--path", help="ledger file (default: "
+                    "docs/data/perf_ledger.json / CMT_TPU_PERF_LEDGER)")
+    ap.add_argument("--harvest", action="store_true",
+                    help="merge the historical BENCH_*/MULTICHIP_* "
+                    "files into the ledger")
+    ap.add_argument("--tail", type=int, metavar="N",
+                    help="print the last N entries")
+    args = ap.parse_args(argv)
+    path = args.path or default_path()
+    if args.harvest:
+        doc = append(harvest(), path)
+        print(f"perfledger: {len(doc['entries'])} entries in {path}",
+              file=sys.stderr)
+    if args.tail:
+        print(json.dumps(tail(args.tail, path), indent=1))
+    if not args.harvest and not args.tail:
+        doc = load(path)
+        print(f"perfledger: {len(doc['entries'])} entries in {path} "
+              "(use --harvest / --tail N)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
