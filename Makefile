@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -134,6 +134,16 @@ mesh-smoke:
 health-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_health.py \
 		-k "HealthSmoke" -q
+
+# verify-queue smoke: queue round trip on the host tier, the
+# deterministic double-buffer overlap proof (buffer N+1's host prep
+# completes during buffer N's gated launch, overlap ratio > 0), and
+# the bench --pipelined round trip with ledger rows (tier-1 runs the
+# full tests/test_verify_queue.py suite too; `make test` gates on
+# this target alongside the three lints)
+pipeline-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_verify_queue.py \
+		-k "RoundTrip or Overlap or PipelinedBench" -q
 
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —

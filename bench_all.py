@@ -445,6 +445,54 @@ def main() -> None:
     n5 = 16 if on_cpu else 1000
     stream_config("blocksync_replay_1kval", vals1k, commit1k, n5, 1000)
 
+    # ---- configs 4b+5b: the same replay workloads through the verify
+    # queue (crypto/verify_queue.py) — commits submitted as batched
+    # requests, the collector's host prep (prehash + plan/pack)
+    # overlapping the launcher's in-flight batch.  The sync stream rows
+    # above are the baselines tools/perfdiff.py gates these against;
+    # the tier is metric-derived (the queue dispatches through the
+    # production verifier seam) and the overlap ratio rides along.
+    from cometbft_tpu.crypto import verify_queue as vqmod
+
+    def queue_config(name, vals, commit, n_commits):
+        nsig = commit.size()
+        pks = [vals.get_by_index(i).pub_key for i in range(nsig)]
+        msgs = [
+            commit.vote_sign_bytes(CHAIN_ID, i) for i in range(nsig)
+        ]
+        items = [
+            (pk, m, cs.signature)
+            for pk, m, cs in zip(pks, msgs, commit.signatures)
+        ]
+        # cache OFF: every submitted commit re-verifies honestly;
+        # max_batch = one commit per buffer so the measured shape IS
+        # the double-buffered pipeline
+        q = vqmod.VerifyQueue(use_cache=False, max_batch=nsig)
+        q.start()
+        try:
+            t0 = time.perf_counter()
+            futs = []
+            for _ in range(n_commits):
+                futs.extend(q.submit_many(items))
+            assert all(f.result(600) for f in futs), (
+                "queue bench sigs must verify"
+            )
+            dt = time.perf_counter() - t0
+            overlap = q.stats()["overlap_ratio"]
+        finally:
+            q.stop()
+        record(
+            name, nsig * n_commits / dt, "sigs/sec",
+            commits_per_sec=round(n_commits / dt, 1),
+            n_commits_run=n_commits,
+            overlap_ratio=overlap,
+        )
+
+    queue_config("light_sync_150val_pipelined", vals150, commit150, n4)
+    queue_config(
+        "blocksync_replay_1kval_pipelined", vals1k, commit1k, n5
+    )
+
     # ---- config 5: mixed ed25519 + bls12381 mega-commit --------------
     # One commit whose validators mix both key types; verify_commit's
     # per-key-type grouping sends ed25519 votes to the batch kernel and

@@ -219,6 +219,20 @@ class BlockPool:
             req = self._requesters.get(self.height)
             return req.ext_votes if req else None
 
+    def peek_blocks_from(self, start: int, count: int) -> list:
+        """Blocks already received for heights [start, start+count) —
+        ``None`` holes included.  Read-only prefetch peek for the
+        verify-ahead plane (blocksync/reactor.py submits the peeked
+        blocks' commit signatures to the verify queue while the
+        current block applies); the requesters stay owned by the
+        pool."""
+        with self._mtx:
+            out = []
+            for h in range(start, start + count):
+                req = self._requesters.get(h)
+                out.append(req.block if req else None)
+            return out
+
     def peek_two_blocks(self) -> tuple[Block | None, Block | None]:
         with self._mtx:
             first = self._requesters.get(self.height)

@@ -168,6 +168,23 @@ class BlocksyncReactor(Reactor):
         )
         self._caught_up_since: float | None = None
         self.metrics.syncing.set(1 if block_sync else 0)
+        # verify-ahead prefetch (crypto/verify_queue.py): while block H
+        # applies, the next N blocks' commit signatures go to the
+        # verify queue as one prefetch-priority batch, so their
+        # verify_commit_light is a speculative-cache hit and catch-up
+        # is bounded by store I/O, not crypto (ROADMAP item 2).  The
+        # depth env is validated fail-loudly at reactor construction
+        # (node assembly), same contract as the ring vars.
+        from cometbft_tpu.crypto.verify_queue import (
+            prefetch_depth_from_env,
+        )
+        from cometbft_tpu.metrics import crypto_metrics
+
+        self._prefetch_depth = prefetch_depth_from_env()
+        self._prefetched_height = 0
+        crypto_metrics().verify_queue_prefetch_depth.set(
+            self._prefetch_depth
+        )
 
     def is_syncing(self) -> bool:
         return self.block_sync.is_set()
@@ -370,6 +387,10 @@ class BlocksyncReactor(Reactor):
                 first, first_parts, second.last_commit,
                 extended_votes=ext,
             )
+        # verify-ahead: queue the NEXT blocks' commit signatures before
+        # the (store-I/O-heavy) apply below, so their crypto runs on
+        # the verify queue's launcher while this block applies
+        self._prefetch_commit_verifies()
         self.state = self.block_exec.apply_block(
             self.state, first_id, first,
             syncing_to_height=self.pool.max_peer_height(),
@@ -387,6 +408,67 @@ class BlocksyncReactor(Reactor):
             num_txs=len(first.data.txs),
         )
         return True
+
+    def _prefetch_commit_verifies(self) -> None:
+        """Submit the next ``CMT_TPU_VERIFY_PREFETCH`` received blocks'
+        commit signatures (block H's commit rides in block H+1's
+        LastCommit) to the verify queue at prefetch priority — one
+        coalesced device batch per sync step.  Pubkeys come from the
+        CURRENT validator set: if the set rotates inside the window,
+        the stale entries are wasted prefetch (cache misses at verify
+        time, strictly re-verified), never wrong verdicts — cached
+        facts are keyed by (pubkey, sign bytes, signature), not by
+        height.  Each height is submitted once (``_prefetched_height``
+        watermark); holes in the pool truncate the window."""
+        from cometbft_tpu.crypto import verify_queue as _vq
+
+        if self._prefetch_depth <= 0 or not _vq.speculation_active():
+            return
+        start = self.pool.height + 1
+        blocks = self.pool.peek_blocks_from(
+            start, self._prefetch_depth + 1
+        )
+        vals = self.state.validators
+        chain_id = self.state.chain_id
+        items = []
+        heights = []
+        for j in range(len(blocks) - 1):
+            blk, nxt = blocks[j], blocks[j + 1]
+            if blk is None or nxt is None:
+                break  # hole: later blocks would verify out of order
+            height = blk.header.height
+            if height <= self._prefetched_height:
+                continue
+            commit = nxt.last_commit
+            if commit is None or commit.size() != len(vals):
+                break  # validator set rotated: stop, never guess
+            mark = len(items)
+            rotated = False
+            for i, cs in enumerate(commit.signatures):
+                if not cs.is_commit():
+                    continue  # verify_commit_light checks commit votes
+                val = vals.get_by_index(i)
+                if val is None or val.address != cs.validator_address:
+                    rotated = True
+                    break
+                items.append((
+                    val.pub_key,
+                    commit.vote_sign_bytes(chain_id, i),
+                    cs.signature,
+                ))
+            if rotated:
+                del items[mark:]  # drop this height's partial batch
+                break
+            heights.append(height)
+        if items and _vq.submit_prefetch(items):
+            # watermark advances ONLY on a successful enqueue: a
+            # queue hiccup (draining/restart race) must retry these
+            # heights next step, not silently skip them forever
+            self._prefetched_height = heights[-1]
+            FLIGHT.record(
+                "blocksync_prefetch", first_height=heights[0],
+                blocks=len(heights), sigs=len(items),
+            )
 
     def _extended_votes_valid(self, block, block_id, votes) -> bool:
         """A blocksync peer's ferried extended votes are UNTRUSTED:

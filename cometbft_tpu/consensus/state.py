@@ -846,6 +846,7 @@ class ConsensusState(BaseService):
             ):
                 self.proposal_block = None
                 raise ConsensusError("proposal block hash mismatch")
+            self._speculate_last_commit(self.proposal_block)
             if self.event_bus is not None and not self._replay_mode:
                 self.event_bus.publish_complete_proposal(
                     EventDataCompleteProposal(
@@ -858,6 +859,40 @@ class ConsensusState(BaseService):
                     )
                 )
         return added
+
+    def _speculate_last_commit(self, block) -> None:  # holds _rs_mtx
+        """Prime the verify queue with the proposal's LastCommit
+        signatures the moment the block completes: ``apply_block``'s
+        ``verify_commit`` at finalize then hits the speculative-result
+        cache instead of paying a synchronous batch launch on the
+        commit critical path.  For a validator that voted at height-1
+        the cache is already warm (add_vote speculated each vote);
+        this covers catch-up and restarts, where the LastCommit
+        arrives cold inside the proposal.  Fire-and-forget at prefetch
+        priority — live vote verification always preempts it — and
+        bounded waste (one commit) when the proposal dies."""
+        from cometbft_tpu.crypto import verify_queue as _vq
+
+        if not _vq.speculation_active():
+            return
+        lc = block.last_commit
+        lvals = self.state.last_validators
+        if lc is None or lvals is None or lc.size() != len(lvals):
+            return
+        items = []
+        for i, cs in enumerate(lc.signatures):
+            if cs.is_absent():
+                continue  # verify_commit checks non-absent votes
+            val = lvals.get_by_index(i)
+            if val is None or val.address != cs.validator_address:
+                return  # malformed commit: let verify_commit raise
+            items.append((
+                val.pub_key,
+                lc.vote_sign_bytes(self.state.chain_id, i),
+                cs.signature,
+            ))
+        if items:
+            _vq.submit_prefetch(items)
 
     def _handle_complete_proposal(self, height: int) -> None:  # holds _rs_mtx
         """(state.go handleCompleteProposal)"""

@@ -1,0 +1,899 @@
+"""Pipelined verify-ahead: the async double-buffered verify queue.
+
+BENCH_r02 measured 171 ms sync latency per device launch while the
+pipelined bench mode showed 1.6x over sync, and the PR 7 utilization
+plane shows the device idle between commits — the gap to the BASELINE
+north star is launch overlap, not kernel speed (ROADMAP item 2).  This
+module closes it at the ``TpuBatchVerifier`` seam: a process-wide
+``VerifyQueue`` accepts verification requests from any caller
+(consensus ``VoteSet.add_vote``, blocksync replay prefetch, later the
+mempool CheckTx plane — ROADMAP item 4 reuses this seam), coalesces
+them into device-sized batches, and keeps **two buffers in flight**:
+
+- a *collector* thread drains pending requests, computes the SHA-512
+  cache-key prehash, and runs the verifier's host phase
+  (``TpuBatchVerifier.plan()`` — dispatch routing, key-table lookup,
+  input packing) for buffer N+1 **while** buffer N's device launch is
+  in flight on the
+- *launcher* thread, which executes prepared batches through the
+  existing dispatch ladder (keyed_mesh -> keyed -> generic -> host;
+  the verifier's ``execute()`` chooses the tier per batch) and
+  delivers completion futures back to callers.
+
+Mixed-priority scheduling: consensus-vote requests **preempt**
+blocksync/prefetch batches still in the queue — the collector always
+prepares pending consensus work first, and the launcher always picks a
+prepared consensus batch over a prepared prefetch batch.
+
+**Speculative-result cache.**  Every verification that PASSES lands
+in a bounded LRU keyed by SHA-512(pubkey || signature || message) —
+the message is the vote's sign bytes, so the key is the
+(vote-sign-bytes digest, pubkey) pair the speculative plane needs,
+deliberately bound to the *signature* as well: a cached verdict must
+never answer for a different signature over the same bytes.  Only
+POSITIVE verdicts are memoized (SpeculativeCache docstring): a
+transient device fault mis-verifying a valid signature must cost one
+rejection and heal on retry, never poison the cache.
+``VoteSet.add_vote`` submits signatures on receipt, so
+``verify_commit`` at finalize time is mostly a cache hit instead of a
+10k-sig synchronous launch (types/validation.py consults
+``cached_result``); blocksync submits the next
+``CMT_TPU_VERIFY_PREFETCH`` blocks' commit signatures while the
+current block applies.  Fall-back is STRICT: on a cache miss, queue
+unavailability, a failed future, or a wait timeout, callers run the
+exact synchronous verify they ran before this module existed — the
+queue is an accelerator, never a correctness dependency.  And a
+consensus-priority caller never WAITS behind in-flight work: when the
+queue is busy, ``verify_or_fallback`` verifies inline (pre-queue
+latency) and still feeds the cache.
+
+Env knobs (validated fail-loudly, same contract as the ring vars in
+utils/flight.py):
+
+- ``CMT_TPU_VERIFY_PREFETCH`` — blocksync prefetch depth in blocks
+  (default 8; 0 disables prefetch).
+- ``CMT_TPU_SPEC_CACHE`` — speculative-result cache capacity in
+  entries (default 65536, >= 1024; ~152 B/entry, so the default is
+  ~10 MB and covers a fully speculated 10k-validator commit 6x over).
+- ``CMT_TPU_VERIFY_QUEUE=0`` — node assembly skips the queue entirely
+  (every caller takes the synchronous path, exactly as before).
+
+Observability: ``crypto_verify_queue_*`` metrics (CryptoMetrics),
+``verify_queue/prepare`` + ``verify_queue/launch`` spans (the overlap
+is visible as prepare-of-N+1 nesting inside launch-of-N wall time —
+docs/observability.md "reading an overlap trace"), and the launcher
+feeds ``crypto_host_device_overlap_ratio`` with the share of each
+launch wall covered by concurrent host prep.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict, deque
+
+from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
+from cometbft_tpu.metrics import health_metrics as _health_metrics
+from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.flight import ring_size_from_env as _int_env
+from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils.service import BaseService
+from cometbft_tpu.utils.trace import TRACER as _tracer
+
+#: request priorities (metric label values); consensus preempts
+#: prefetch at both the collector and the launcher
+PRIORITY_CONSENSUS = "consensus"
+PRIORITY_PREFETCH = "prefetch"
+_PRIORITIES = (PRIORITY_CONSENSUS, PRIORITY_PREFETCH)
+
+DEFAULT_PREFETCH_DEPTH = 8
+DEFAULT_SPEC_CACHE_CAP = 65536
+#: largest coalesced batch — matches ops/ed25519_verify.MAX_LAUNCH's
+#: default so one queue batch is one device launch
+DEFAULT_MAX_BATCH = 8192
+#: how long a caller waits on a future before the strict sync
+#: fallback; generous because a pure-Python host tier can take seconds
+#: per large prefetch batch ahead of a consensus request
+DEFAULT_WAIT_S = 120.0
+
+
+def prefetch_depth_from_env() -> int:
+    """Blocksync verify-prefetch depth in blocks; 0 disables."""
+    return _int_env("CMT_TPU_VERIFY_PREFETCH", DEFAULT_PREFETCH_DEPTH, 0)
+
+
+def spec_cache_capacity_from_env() -> int:
+    """Speculative-result cache capacity in entries (>= 1024: smaller
+    caches evict a large commit mid-verify and the speculative plane
+    silently degrades to all-miss)."""
+    return _int_env("CMT_TPU_SPEC_CACHE", DEFAULT_SPEC_CACHE_CAP, 1024)
+
+
+class QueueUnavailable(RuntimeError):
+    """The queue is stopped/draining; callers must verify
+    synchronously."""
+
+
+class VerifyFuture:
+    """Completion handle for one submitted (pubkey, msg, sig) request.
+
+    ``result()`` returns the verification bit or raises: the waiter
+    treats ANY raise (failed launch, drain, timeout) as "queue
+    unavailable" and falls back to synchronous verification."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result: bool | None = None
+        self._error: BaseException | None = None
+
+    def _resolve(self, result: bool) -> None:
+        if not self._event.is_set():
+            self._result = result
+            self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        # first writer wins: a drain-timeout _fail must not clobber a
+        # verdict a slow launcher delivered concurrently (and vice
+        # versa — the waiter's strict sync fallback covers the rest)
+        if not self._event.is_set():
+            self._error = exc
+            self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = DEFAULT_WAIT_S) -> bool:
+        if not self._event.wait(timeout):
+            raise QueueUnavailable("verify future timed out")
+        if self._error is not None:
+            raise QueueUnavailable(
+                f"verify batch failed: {self._error!r}"
+            ) from self._error
+        return bool(self._result)
+
+
+def cache_key(pub: bytes, msg: bytes, sig: bytes) -> bytes:
+    """SHA-512 over pubkey || signature || sign-bytes — the host
+    prehash the collector runs for buffer N+1 while buffer N launches.
+    Binding the signature (not just the (digest, pubkey) pair) is
+    load-bearing: two distinct signatures over the same vote bytes
+    must never share a cached verdict."""
+    h = hashlib.sha512()
+    h.update(pub)
+    h.update(sig)
+    h.update(msg)
+    return h.digest()
+
+
+@cmtsync.guarded
+class SpeculativeCache:
+    """Bounded LRU of cache_key -> True: PROOFS OF VALIDITY only.
+    A positive verdict is a pure fact about the (pubkey, sign-bytes,
+    signature) triple — height- and validator-set-independent, never
+    stale — so capacity is the only eviction policy.  Negative
+    verdicts are deliberately NEVER stored: a transient device fault
+    mis-verifying one signature must cost one rejected attempt (the
+    pre-queue behavior — the retry re-verifies fresh), not a
+    permanently poisoned cache entry that rejects a valid commit
+    forever.  Invalid signatures therefore re-verify on every consult,
+    which is the attacker paying, not us."""
+
+    _GUARDED_BY = {"_map": "_mtx"}
+
+    def __init__(self, capacity: int | None = None) -> None:
+        self.capacity = (
+            capacity if capacity is not None
+            else spec_cache_capacity_from_env()
+        )
+        self._mtx = cmtsync.Mutex()
+        self._map: OrderedDict[bytes, bool] = OrderedDict()
+
+    def lookup(self, key: bytes) -> bool | None:
+        with self._mtx:
+            if key not in self._map:
+                return None
+            self._map.move_to_end(key)
+            return self._map[key]
+
+    def store(self, key: bytes, ok: bool) -> None:
+        if not ok:
+            return  # negative verdicts are never memoized (class doc)
+        with self._mtx:
+            self._map[key] = True
+            self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._map)
+
+
+class _Request:
+    __slots__ = ("pub_key", "msg", "sig", "future", "key")
+
+    def __init__(self, pub_key, msg: bytes, sig: bytes) -> None:
+        self.pub_key = pub_key
+        self.msg = msg
+        self.sig = sig
+        self.future = VerifyFuture()
+        self.key: bytes | None = None  # prehash, set by the collector
+
+
+class _Prepared:
+    """One prepared buffer: requests grouped per key type with their
+    host-phase artifacts, ready for the launcher."""
+
+    __slots__ = ("priority", "reqs", "groups", "prep_seconds")
+
+    def __init__(self, priority: str) -> None:
+        self.priority = priority
+        self.reqs: list[_Request] = []
+        #: list of (reqs, verifier | None, plan | None); verifier None
+        #: means per-signature host verification in the launcher
+        self.groups: list[tuple] = []
+        self.prep_seconds = 0.0
+
+
+@cmtsync.guarded
+class VerifyQueue(BaseService):
+    """The double-buffered verify queue (module docstring).
+
+    ``verifier_factory(pub_key)`` builds the per-batch verifier
+    (default: crypto/batch.create_batch_verifier — the production
+    dispatch ladder).  ``launch`` overrides the launch phase entirely
+    (tests gate it to prove the overlap deterministically): a callable
+    ``launch(items) -> list[bool]`` over ``(pub_key, msg, sig)``
+    tuples.  ``use_cache=False`` disables the speculative cache
+    (benches re-verify the same batch honestly)."""
+
+    _GUARDED_BY = {
+        "_pending": "_qmtx",
+        "_prepared": "_qmtx",
+        "_preparing": "_qmtx",
+        "_draining": "_qmtx",
+        "_launch_active": "_qmtx",
+        "_launch_t0": "_qmtx",
+        "_overlap_accum": "_qmtx",
+        "_prep_since": "_qmtx",
+        "_overlap_seconds": "_qmtx",
+        "_launch_wall_seconds": "_qmtx",
+        "_stats": "_qmtx",
+        "_last_overlap": "_qmtx",
+    }
+
+    def __init__(
+        self,
+        verifier_factory=None,
+        launch=None,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        spec_cache: SpeculativeCache | None = None,
+        use_cache: bool = True,
+        logger: Logger | None = None,
+    ) -> None:
+        super().__init__(
+            name="verify-queue",
+            logger=logger or default_logger().with_fields(
+                module="crypto.verify_queue"
+            ),
+        )
+        self._factory = verifier_factory
+        self._launch = launch
+        self._max_batch = max_batch
+        self.cache = (
+            (spec_cache or SpeculativeCache()) if use_cache else None
+        )
+        self._qmtx = cmtsync.Mutex()
+        self._collector_wake = threading.Event()
+        self._launcher_wake = threading.Event()
+        self._pending: dict[str, deque[_Request]] = {
+            p: deque() for p in _PRIORITIES
+        }
+        #: prepared buffers awaiting launch, at most ONE per priority:
+        #: with the one the launcher holds, that is the double buffer
+        self._prepared: dict[str, deque[_Prepared]] = {
+            p: deque() for p in _PRIORITIES
+        }
+        #: True from the moment _next_pending pops a batch until the
+        #: collector parks (or abandons) its prepared buffer — without
+        #: it busy() goes dark for the whole prep phase and a consensus
+        #: vote parks behind the prefetch batch being prepared
+        self._preparing = False
+        self._draining = False
+        self._launch_active = 0
+        self._launch_t0 = 0.0
+        self._overlap_accum = 0.0
+        #: start (or accounted-until watermark) of the prep currently
+        #: running on the collector, None when idle — lets a launch
+        #: that ends MID-prep credit the overlap accrued so far (a
+        #: prep outliving the launch it overlapped must not count 0)
+        self._prep_since: float | None = None
+        self._overlap_seconds = 0.0
+        self._launch_wall_seconds = 0.0
+        self._last_overlap: float | None = None
+        self._stats = {
+            "submitted": {p: 0 for p in _PRIORITIES},
+            "cache_resolved": 0,
+            "prepared_batches": 0,
+            "launched_batches": 0,
+            "launched_sigs": 0,
+            "failed_batches": 0,
+        }
+        self._collector_thread: threading.Thread | None = None
+        self._launcher_thread: threading.Thread | None = None
+
+    # -- submission ------------------------------------------------------
+
+    def accepting(self) -> bool:
+        with self._qmtx:
+            draining = self._draining
+        return self.is_running() and not draining
+
+    def busy(self) -> bool:
+        """True while any buffer is pending, prepared, or launching.
+        Latency-sensitive callers (a live consensus vote) use this to
+        verify INLINE instead of parking behind an in-flight prefetch
+        launch — priority preemption reorders queued buffers but can
+        never interrupt the launch already on the device."""
+        with self._qmtx:
+            return bool(
+                self._launch_active
+                or self._preparing
+                or any(self._pending.values())
+                or any(self._prepared.values())
+            )
+
+    def submit_many(
+        self, items, priority: str = PRIORITY_CONSENSUS
+    ) -> list[VerifyFuture]:
+        """Enqueue ``(pub_key, msg, sig)`` tuples; returns one future
+        per item.  Raises QueueUnavailable when stopped/draining."""
+        if priority not in _PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r}")
+        reqs = [_Request(pk, bytes(m), bytes(s)) for pk, m, s in items]
+        with self._qmtx:
+            if self._draining or not self.is_running():
+                raise QueueUnavailable("verify queue is not accepting")
+            self._pending[priority].extend(reqs)
+            self._stats["submitted"][priority] += len(reqs)
+            depth = len(self._pending[priority])
+        cm = _crypto_metrics()
+        cm.verify_queue_submitted.labels(priority=priority).inc(len(reqs))
+        cm.verify_queue_depth.labels(priority=priority).set(depth)
+        self._collector_wake.set()
+        return [r.future for r in reqs]
+
+    def submit(self, pub_key, msg, sig,
+               priority: str = PRIORITY_CONSENSUS) -> VerifyFuture:
+        return self.submit_many([(pub_key, msg, sig)], priority)[0]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._collector_thread = threading.Thread(
+            target=self._collector, name="verify-queue-collect",
+            daemon=True,
+        )
+        self._launcher_thread = threading.Thread(
+            target=self._launcher, name="verify-queue-launch",
+            daemon=True,
+        )
+        self._collector_thread.start()
+        self._launcher_thread.start()
+
+    def on_stop(self) -> None:
+        """Drain: stop accepting, let the collector prepare what is
+        already pending and the launcher finish every prepared buffer,
+        then fail any leftovers so no caller blocks forever."""
+        with self._qmtx:
+            self._draining = True
+        self._collector_wake.set()
+        self._launcher_wake.set()
+        for t in (self._collector_thread, self._launcher_thread):
+            if t is not None:
+                t.join(timeout=DEFAULT_WAIT_S)
+        leftovers: list[_Request] = []
+        with self._qmtx:
+            for p in _PRIORITIES:
+                leftovers.extend(self._pending[p])
+                self._pending[p].clear()
+                for prep in self._prepared[p]:
+                    leftovers.extend(prep.reqs)
+                self._prepared[p].clear()
+        for r in leftovers:
+            r.future._fail(QueueUnavailable("queue stopped"))
+        if _installed() is self:
+            install_queue(None)
+
+    # -- the collector (host phase: buffer N+1) --------------------------
+
+    def _next_pending(self) -> tuple[list[_Request] | None, str | None]:
+        """Pop the next batch worth of requests: consensus first
+        (preemption), and only for a priority lane whose prepared slot
+        is free (the double-buffer bound).  Sets ``_preparing`` under
+        the same lock as the pop so busy() never misses the batch
+        between dequeue and the prepared-slot append."""
+        with self._qmtx:
+            for p in _PRIORITIES:
+                if self._pending[p] and not self._prepared[p]:
+                    take = min(len(self._pending[p]), self._max_batch)
+                    reqs = [
+                        self._pending[p].popleft() for _ in range(take)
+                    ]
+                    self._preparing = True
+                    _crypto_metrics().verify_queue_depth.labels(
+                        priority=p
+                    ).set(len(self._pending[p]))
+                    return reqs, p
+        return None, None
+
+    def _idle_done(self) -> bool:
+        with self._qmtx:
+            if not self._draining:
+                return False
+            return not any(self._pending.values())
+
+    def _collector(self) -> None:
+        while True:
+            reqs, priority = self._next_pending()
+            if reqs is None:
+                if self._idle_done():
+                    return
+                self._collector_wake.wait(0.05)
+                self._collector_wake.clear()
+                continue
+            try:
+                try:
+                    prep = self._prepare(reqs, priority)
+                except Exception as exc:  # noqa: BLE001 — fall back
+                    self.logger.error(
+                        "verify-queue prepare failed", err=repr(exc)
+                    )
+                    for r in reqs:
+                        r.future._fail(exc)
+                    continue
+                if not prep.reqs:
+                    continue  # every request was a cache hit
+                with self._qmtx:
+                    self._prepared[priority].append(prep)
+                    self._stats["prepared_batches"] += 1
+                    inflight = self._launch_active + sum(
+                        len(d) for d in self._prepared.values()
+                    )
+                _crypto_metrics().verify_queue_inflight.set(inflight)
+                self._launcher_wake.set()
+            finally:
+                # clear AFTER the prepared-slot append (or abandon):
+                # between pop and here busy() sees _preparing, after
+                # the append it sees the prepared buffer — no window
+                with self._qmtx:
+                    self._preparing = False
+
+    def _prepare(self, reqs: list[_Request], priority: str) -> _Prepared:
+        """Host phase for one buffer: cache-key prehash, speculative
+        dedupe, then the verifier's plan() (dispatch routing + input
+        packing) — all of it overlapping whatever launch is in
+        flight."""
+        t0 = time.perf_counter()
+        with self._qmtx:
+            self._prep_since = t0
+        prep = _Prepared(priority)
+        cm = _crypto_metrics()
+        try:
+            with _tracer.span(
+                "verify_queue/prepare", cat="crypto", batch=len(reqs),
+                priority=priority,
+            ):
+                work: list[_Request] = []
+                for r in reqs:
+                    r.key = cache_key(r.pub_key.bytes(), r.msg, r.sig)
+                    cached = (
+                        self.cache.lookup(r.key)
+                        if self.cache is not None else None
+                    )
+                    if cached is not None:
+                        cm.verify_queue_spec_cache.labels(
+                            result="hit"
+                        ).inc()
+                        r.future._resolve(cached)
+                        continue
+                    if self.cache is not None:
+                        cm.verify_queue_spec_cache.labels(
+                            result="miss"
+                        ).inc()
+                    work.append(r)
+                if work:
+                    with self._qmtx:
+                        self._stats["cache_resolved"] += (
+                            len(reqs) - len(work)
+                        )
+                    prep.reqs = work
+                    cm.verify_queue_batch_size.observe(len(work))
+                    if self._launch is not None:
+                        prep.groups = [(work, None, None)]
+                    else:
+                        prep.groups = self._build_groups(work)
+                else:
+                    with self._qmtx:
+                        self._stats["cache_resolved"] += len(reqs)
+            prep.prep_seconds = time.perf_counter() - t0
+        finally:
+            # overlap accounting: host prep that ran while a launch was
+            # in flight is exactly the wall time the pipeline bought.
+            # The _prep_since watermark may have been advanced by a
+            # launch that ENDED mid-prep (it credited the overlap up to
+            # its end), so accrue only from the watermark forward.  In
+            # a finally so a raising prepare (malformed signature in
+            # plan/pack) can't leave a stale watermark that every later
+            # launch end mistakes for a live prep, pinning the
+            # cumulative overlap ratio near 1.0.
+            now = time.perf_counter()
+            with self._qmtx:
+                since = (
+                    self._prep_since if self._prep_since is not None
+                    else t0
+                )
+                if self._launch_active:
+                    self._overlap_accum += max(
+                        0.0, now - max(since, self._launch_t0)
+                    )
+                self._prep_since = None
+        return prep
+
+    def _build_groups(self, work: list[_Request]) -> list[tuple]:
+        from cometbft_tpu.crypto import batch as crypto_batch
+
+        by_type: dict[str, list[_Request]] = {}
+        for r in work:
+            by_type.setdefault(r.pub_key.type(), []).append(r)
+        factory = self._factory
+        groups: list[tuple] = []
+        for reqs in by_type.values():
+            pk0 = reqs[0].pub_key
+            verifier = None
+            if len(reqs) >= 2 and crypto_batch.supports_batch_verifier(
+                pk0
+            ):
+                try:
+                    verifier = (
+                        factory(pk0) if factory is not None
+                        else crypto_batch.create_batch_verifier(pk0)
+                    )
+                except Exception:  # noqa: BLE001 — per-sig fallback
+                    verifier = None
+            plan = None
+            if verifier is not None:
+                for r in reqs:
+                    verifier.add(r.pub_key, r.msg, r.sig)
+                plan_fn = getattr(verifier, "plan", None)
+                if plan_fn is not None:
+                    plan = plan_fn()
+            groups.append((reqs, verifier, plan))
+        return groups
+
+    # -- the launcher (device phase: buffer N) ---------------------------
+
+    def _next_prepared(self) -> _Prepared | None:
+        with self._qmtx:
+            for p in _PRIORITIES:
+                if self._prepared[p]:
+                    return self._prepared[p].popleft()
+        return None
+
+    def _launch_done(self) -> bool:
+        with self._qmtx:
+            if not self._draining:
+                return False
+            if any(self._prepared.values()) or any(
+                self._pending.values()
+            ):
+                return False
+        t = self._collector_thread
+        return t is None or not t.is_alive()
+
+    def _launcher(self) -> None:
+        while True:
+            prep = self._next_prepared()
+            if prep is None:
+                if self._launch_done():
+                    return
+                self._launcher_wake.wait(0.05)
+                self._launcher_wake.clear()
+                continue
+            self._collector_wake.set()  # slot freed: prep buffer N+1
+            self._execute(prep)
+
+    def _execute(self, prep: _Prepared) -> None:
+        t0 = time.perf_counter()
+        with self._qmtx:
+            self._launch_active += 1
+            if self._launch_active == 1:
+                self._launch_t0 = t0
+                self._overlap_accum = 0.0
+        try:
+            with _tracer.span(
+                "verify_queue/launch", cat="crypto",
+                batch=len(prep.reqs), priority=prep.priority,
+            ):
+                for reqs, verifier, plan in prep.groups:
+                    self._execute_group(reqs, verifier, plan)
+        finally:
+            now = time.perf_counter()
+            wall = max(now - t0, 0.0)
+            with self._qmtx:
+                self._launch_active -= 1
+                if self._prep_since is not None:
+                    # a prep is STILL running: credit its overlap with
+                    # this launch now and advance its watermark so its
+                    # own end-of-prep accrual can't double count
+                    self._overlap_accum += max(
+                        0.0, now - max(self._prep_since, t0)
+                    )
+                    self._prep_since = now
+                overlap = min(self._overlap_accum, wall)
+                self._overlap_accum = 0.0
+                self._overlap_seconds += overlap
+                self._launch_wall_seconds += wall
+                self._stats["launched_batches"] += 1
+                self._stats["launched_sigs"] += len(prep.reqs)
+                # CUMULATIVE ratio: overlapped host-prep seconds over
+                # total launch wall — a final buffer with nothing
+                # behind it dilutes rather than zeroes the signal
+                ratio = (
+                    min(
+                        self._overlap_seconds
+                        / self._launch_wall_seconds,
+                        1.0,
+                    )
+                    if self._launch_wall_seconds > 0 else 0.0
+                )
+                self._last_overlap = ratio
+                inflight = self._launch_active + sum(
+                    len(d) for d in self._prepared.values()
+                )
+            cm = _crypto_metrics()
+            cm.verify_queue_inflight.set(inflight)
+            _health_metrics().host_device_overlap_ratio.set(ratio)
+
+    def _execute_group(self, reqs, verifier, plan) -> None:
+        try:
+            if self._launch is not None:
+                results = self._launch(
+                    [(r.pub_key, r.msg, r.sig) for r in reqs]
+                )
+            elif verifier is not None:
+                if plan is not None:
+                    ok, results = verifier.execute(plan)
+                else:
+                    ok, results = verifier.verify()
+            else:
+                results = [
+                    r.pub_key.verify_signature(r.msg, r.sig)
+                    for r in reqs
+                ]
+            results = list(results)
+        except Exception as exc:  # noqa: BLE001 — strict sync fallback
+            self.logger.error(
+                "verify-queue launch failed", err=repr(exc),
+                batch=len(reqs),
+            )
+            with self._qmtx:
+                self._stats["failed_batches"] += 1
+            for r in reqs:
+                r.future._fail(exc)
+            return
+        if len(results) != len(reqs):
+            # a malformed verifier/launch result must fail the batch
+            # IMMEDIATELY (callers take the strict sync fallback), not
+            # leave zip-truncated futures dangling until the 120 s
+            # wait times out — on the consensus path, with locks held
+            exc = RuntimeError(
+                f"launch returned {len(results)} results for "
+                f"{len(reqs)} requests"
+            )
+            self.logger.error(
+                "verify-queue launch result mismatch", err=str(exc)
+            )
+            with self._qmtx:
+                self._stats["failed_batches"] += 1
+            for r in reqs:
+                r.future._fail(exc)
+            return
+        for r, bit in zip(reqs, results):
+            bit = bool(bit)
+            if self.cache is not None and r.key is not None:
+                self.cache.store(r.key, bit)
+            r.future._resolve(bit)
+
+    # -- introspection ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._qmtx:
+            out = {
+                "submitted": dict(self._stats["submitted"]),
+                "cache_resolved": self._stats["cache_resolved"],
+                "prepared_batches": self._stats["prepared_batches"],
+                "launched_batches": self._stats["launched_batches"],
+                "launched_sigs": self._stats["launched_sigs"],
+                "failed_batches": self._stats["failed_batches"],
+                "pending": {
+                    p: len(d) for p, d in self._pending.items()
+                },
+                "prepared": {
+                    p: len(d) for p, d in self._prepared.items()
+                },
+                "overlap_ratio": self._last_overlap,
+                "draining": self._draining,
+            }
+        out["cache_entries"] = len(self.cache) if self.cache else 0
+        return out
+
+
+# -- the process-wide queue + speculative helpers ------------------------
+
+_install_mtx = cmtsync.Mutex()
+_QUEUE: VerifyQueue | None = None
+
+
+def install_queue(queue: VerifyQueue | None) -> None:
+    """Install the process-wide queue (node assembly) or uninstall
+    with None (node stop does this via VerifyQueue.on_stop)."""
+    global _QUEUE
+    with _install_mtx:
+        _QUEUE = queue
+
+
+def _installed() -> VerifyQueue | None:
+    return _QUEUE
+
+
+def speculation_active() -> bool:
+    """True while a queue is installed and accepting — the gate every
+    speculative consult (types/validation.py) and submission
+    (vote_set, blocksync, consensus) checks first.  With no queue
+    installed, every caller behaves exactly as before this module
+    existed."""
+    q = _QUEUE
+    return q is not None and q.accepting()
+
+
+def cached_result(
+    pub: bytes, msg: bytes, sig: bytes, key: bytes | None = None
+) -> bool | None:
+    """Speculative-cache consult: True when this exact (pubkey,
+    sign-bytes, signature) triple already verified VALID, None
+    otherwise (caller verifies synchronously — negative verdicts are
+    never cached, see SpeculativeCache).  Pass ``key`` (a precomputed
+    ``cache_key``) to skip the SHA-512 prehash — a consult-then-record
+    caller (validation._verify_group over a cold 10k-sig commit)
+    hashes each triple once, not twice."""
+    q = _QUEUE
+    if q is None or q.cache is None:
+        return None
+    result = q.cache.lookup(
+        key if key is not None else cache_key(pub, msg, sig)
+    )
+    _crypto_metrics().verify_queue_spec_cache.labels(
+        result="hit" if result is not None else "miss"
+    ).inc()
+    return result
+
+
+def record_result(
+    pub: bytes, msg: bytes, sig: bytes, ok: bool,
+    key: bytes | None = None,
+) -> None:
+    """Feed a synchronously obtained verdict into the cache so repeat
+    verifications (evidence re-checks, light-client retries) skip the
+    launch.  ``key`` as in ``cached_result``."""
+    q = _QUEUE
+    if q is not None and q.cache is not None:
+        q.cache.store(
+            key if key is not None else cache_key(pub, msg, sig),
+            bool(ok),
+        )
+
+
+def _verify_inline(q: VerifyQueue | None, items) -> list[bool]:
+    """The pre-queue synchronous path, cache-aware: speculated triples
+    resolve from the cache, fresh verdicts feed it (True only) so
+    ``verify_commit`` still hits even for inline-verified votes."""
+    out: list[bool] = []
+    for pk, msg, sig in items:
+        key = None
+        if q is not None:
+            pkb = pk.bytes()
+            key = cache_key(pkb, msg, sig)
+            if cached_result(pkb, msg, sig, key=key) is True:
+                out.append(True)
+                continue
+        ok = pk.verify_signature(msg, sig)
+        if key is not None and ok:
+            record_result(pkb, msg, sig, ok, key=key)
+        out.append(ok)
+    return out
+
+
+def verify_or_fallback(
+    items, priority: str = PRIORITY_CONSENSUS,
+    timeout: float = DEFAULT_WAIT_S,
+) -> list[bool]:
+    """Verify ``(pub_key, msg, sig)`` tuples through the queue as ONE
+    batched submission, with the strict synchronous fallback: any
+    queue problem (not installed, draining, failed batch, timeout)
+    degrades that item to the exact ``pub_key.verify_signature`` call
+    the caller made before the queue existed.
+
+    Consensus-priority requests NEVER park behind in-flight work:
+    when the queue is busy (a prefetch launch on the device, buffers
+    queued), a live vote's couple of signatures verify inline — the
+    pre-queue latency — and the verdicts still land in the
+    speculative cache.  Preemption reorders queued buffers; it cannot
+    interrupt a launch, so waiting here could cost a full prefetch
+    launch wall on the consensus hot path."""
+    q = _QUEUE
+    if q is None:
+        return [
+            pk.verify_signature(msg, sig) for pk, msg, sig in items
+        ]
+    if priority == PRIORITY_CONSENSUS and q.busy():
+        return _verify_inline(q, items)
+    try:
+        futs = q.submit_many(items, priority)
+    except QueueUnavailable:
+        return _verify_inline(q, items)
+    out: list[bool] = []
+    # one SHARED deadline across the whole submission: the futures
+    # resolve together (one batch), so per-future timeouts would
+    # multiply a wedged launcher's stall by len(items) — with the
+    # VoteSet mutex held, in the worst caller
+    deadline = time.monotonic() + timeout
+    for (pk, msg, sig), fut in zip(items, futs):
+        try:
+            out.append(
+                fut.result(max(0.0, deadline - time.monotonic()))
+            )
+        except QueueUnavailable:
+            out.append(pk.verify_signature(msg, sig))
+    return out
+
+
+def submit_prefetch(items) -> int:
+    """Fire-and-forget prefetch submission (blocksync replay, the
+    consensus proposal's last_commit): results land in the speculative
+    cache for the verify_commit that follows.  Returns the number of
+    requests actually enqueued (0 when the queue is down — prefetch is
+    never worth an error)."""
+    q = _QUEUE
+    if q is None:
+        return 0
+    try:
+        q.submit_many(items, PRIORITY_PREFETCH)
+    except QueueUnavailable:
+        return 0
+    return len(items)
+
+
+__all__ = [
+    "DEFAULT_MAX_BATCH",
+    "DEFAULT_PREFETCH_DEPTH",
+    "DEFAULT_SPEC_CACHE_CAP",
+    "PRIORITY_CONSENSUS",
+    "PRIORITY_PREFETCH",
+    "QueueUnavailable",
+    "SpeculativeCache",
+    "VerifyFuture",
+    "VerifyQueue",
+    "cache_key",
+    "cached_result",
+    "install_queue",
+    "prefetch_depth_from_env",
+    "record_result",
+    "spec_cache_capacity_from_env",
+    "speculation_active",
+    "submit_prefetch",
+    "verify_or_fallback",
+]

@@ -554,6 +554,11 @@ class CryptoMetrics:
             self.key_pool_retraces = _NOP
             self.bytes_transferred = _NOP
             self.jit_cache_misses = self.guard_trips = _NOP
+            self.verify_queue_depth = self.verify_queue_inflight = _NOP
+            self.verify_queue_submitted = _NOP
+            self.verify_queue_batch_size = _NOP
+            self.verify_queue_spec_cache = _NOP
+            self.verify_queue_prefetch_depth = _NOP
             return
         s = "crypto"
         self.batch_verify_launches = reg.counter(
@@ -636,6 +641,44 @@ class CryptoMetrics:
             "disallowed implicit host-device transfer in the verify "
             "window (kind: retrace | transfer).",
             labels=("kind",),
+        )
+        # -- verify-ahead queue (crypto/verify_queue.py) -----------------
+        self.verify_queue_depth = reg.gauge(
+            s, "verify_queue_depth",
+            "Requests waiting in the verify queue, by priority lane "
+            "(consensus | prefetch) — consensus preempts prefetch.",
+            labels=("priority",),
+        )
+        self.verify_queue_inflight = reg.gauge(
+            s, "verify_queue_inflight",
+            "Buffers in flight in the verify queue (prepared + "
+            "launching); 2 means the double buffer is full — host "
+            "prep of buffer N+1 is overlapping buffer N's launch.",
+        )
+        self.verify_queue_submitted = reg.counter(
+            s, "verify_queue_submitted",
+            "Verification requests submitted to the verify queue, by "
+            "priority lane (consensus | prefetch).",
+            labels=("priority",),
+        )
+        self.verify_queue_batch_size = reg.histogram(
+            s, "verify_queue_batch_size",
+            "Signatures per coalesced verify-queue buffer (after "
+            "speculative-cache dedupe).",
+            buckets=(1, 2, 8, 32, 128, 512, 2048, 8192),
+        )
+        self.verify_queue_spec_cache = reg.counter(
+            s, "verify_queue_spec_cache",
+            "Speculative-result cache consults (hit | miss): a hit at "
+            "verify_commit time is a signature that skipped its "
+            "synchronous launch because the queue verified it on "
+            "vote receipt or blocksync prefetch.",
+            labels=("result",),
+        )
+        self.verify_queue_prefetch_depth = reg.gauge(
+            s, "verify_queue_prefetch_depth",
+            "Configured blocksync verify-prefetch depth in blocks "
+            "(CMT_TPU_VERIFY_PREFETCH; 0 = prefetch disabled).",
         )
 
 

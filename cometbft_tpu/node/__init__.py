@@ -167,6 +167,13 @@ class Node(BaseService):
         #: background tier prober (started with the metrics server;
         #: CMT_TPU_HEALTH_INTERVAL=0 disables)
         self.health_prober = None
+        #: pipelined verify-ahead queue (crypto/verify_queue.py;
+        #: CMT_TPU_VERIFY_QUEUE=0 disables): consensus votes and
+        #: blocksync prefetch coalesce into double-buffered batches
+        #: through the dispatch ladder, and verify_commit consults the
+        #: speculative-result cache.  Started in _start_services,
+        #: drained in on_stop.
+        self.verify_queue = None
 
         # 1. stores (node/node.go:320 initDBs)
         backend = config.base.db_backend
@@ -626,6 +633,27 @@ class Node(BaseService):
             raise
 
     def _start_services(self) -> None:
+        # verify-ahead queue FIRST: the reactors that feed it
+        # (consensus add_vote, blocksync prefetch) start below, and
+        # every caller degrades to the synchronous path if this fails
+        # — the queue is an accelerator, never a liveness dependency
+        if os.environ.get("CMT_TPU_VERIFY_QUEUE", "1") != "0":
+            from cometbft_tpu.crypto.verify_queue import (
+                VerifyQueue,
+                install_queue,
+            )
+
+            try:
+                self.verify_queue = VerifyQueue(
+                    logger=self.logger.with_fields(module="verify_queue")
+                )
+                self.verify_queue.start()
+                install_queue(self.verify_queue)
+            except Exception as exc:  # noqa: BLE001 — optional plane
+                self.verify_queue = None
+                self.logger.error(
+                    "verify queue failed to start", err=repr(exc)
+                )
         if self.metrics_server is not None:
             self.metrics_server.start()
             # device-health prober: periodic canary verifies per
@@ -804,6 +832,9 @@ class Node(BaseService):
             self.event_bus,
             self.proxy_app,
             self.privval_listener,
+            # after consensus/switch so no reactor submits into a
+            # draining queue; drain resolves every in-flight future
+            self.verify_queue,
             self.health_prober,
             self.metrics_server,
             getattr(self, "diagnostics_server", None),

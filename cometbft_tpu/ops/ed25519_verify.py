@@ -589,6 +589,36 @@ def runtime_device_min_batch() -> int:
     return threshold
 
 
+class _VerifyPlan:
+    """Host-phase output of :meth:`TpuBatchVerifier.plan`: the dispatch
+    routing decision plus everything :meth:`TpuBatchVerifier.execute`
+    needs to launch — packed pub/sig arrays, the key-set table entry
+    and per-lane key ids for the keyed tier.  The split exists for the
+    verify queue (crypto/verify_queue.py): its collector thread runs
+    ``plan()`` for buffer N+1 while buffer N's ``execute()`` launch is
+    in flight, so host packing overlaps device compute.  ``verify()``
+    remains ``execute(plan())`` — single-threaded callers see the
+    exact pre-split behavior."""
+
+    __slots__ = (
+        "n", "route", "reason", "entry", "key_ids", "pub", "sig",
+        "msgs", "pubs", "sigs", "t_plan",
+    )
+
+    def __init__(self) -> None:
+        self.n = 0
+        self.route = "empty"
+        self.reason = "batch_size"
+        self.entry = None
+        self.key_ids = None
+        self.pub = None
+        self.sig = None
+        self.msgs: list[bytes] = []
+        self.pubs: list[bytes] = []
+        self.sigs: list[bytes] = []
+        self.t_plan = 0.0
+
+
 class TpuBatchVerifier(BatchVerifier):
     """BatchVerifier provider backed by the device kernel
     (the reference's crypto/ed25519/ed25519.go:190 BatchVerifier slot).
@@ -622,11 +652,20 @@ class TpuBatchVerifier(BatchVerifier):
     def __len__(self) -> int:
         return len(self._pubs)
 
-    def verify(self) -> tuple[bool, list[bool]]:
-        n = len(self._pubs)
+    def plan(self) -> _VerifyPlan:
+        """Host phase: the dispatch routing decision (device vs host,
+        keyed-table lookup/warm-peek) plus input packing — everything
+        that happens BEFORE the device launch.  Safe to run on the
+        verify queue's collector thread while another batch's
+        :meth:`execute` launch is in flight."""
+        plan = _VerifyPlan()
+        plan.t_plan = time.perf_counter()
+        n = plan.n = len(self._pubs)
         if n == 0:
-            return False, []
-        t_enter = time.perf_counter()
+            return plan
+        plan.pubs, plan.msgs, plan.sigs = (
+            self._pubs, self._msgs, self._sigs
+        )
         cm = _crypto_metrics()
         device_usable = self._device_min_batch < 1 << 30
         msg_fits = max(len(m) for m in self._msgs) <= _BUCKETS[-1]
@@ -674,15 +713,38 @@ class TpuBatchVerifier(BatchVerifier):
             else:
                 reason = "batch_size"
             cm.dispatch_decisions.labels(route="host", reason=reason).inc()
-            cm.dispatch_tier.labels(tier="host").inc()
-            cpu = _ed.CpuBatchVerifier()
-            for p, m, s in zip(self._pubs, self._msgs, self._sigs):
-                cpu.add(_ed.Ed25519PubKey(p), m, s)
-            return cpu.verify()
+            plan.route = "host"
+            plan.reason = reason
+            return plan
         cm.dispatch_decisions.labels(route="device", reason=reason).inc()
         cm.batch_verify_batch_size.observe(n)
-        pub = np.frombuffer(b"".join(self._pubs), dtype=np.uint8).reshape(n, 32)
-        sig = np.frombuffer(b"".join(self._sigs), dtype=np.uint8).reshape(n, 64)
+        plan.route = "device"
+        plan.reason = reason
+        plan.entry = entry
+        if entry is not None:
+            plan.key_ids = entry.key_ids(self._pubs)
+        plan.pub = np.frombuffer(
+            b"".join(self._pubs), dtype=np.uint8
+        ).reshape(n, 32)
+        plan.sig = np.frombuffer(
+            b"".join(self._sigs), dtype=np.uint8
+        ).reshape(n, 64)
+        return plan
+
+    def execute(self, plan: _VerifyPlan) -> tuple[bool, list[bool]]:
+        """Device phase: launch + result fetch for a plan built by
+        :meth:`plan`.  ``verify()`` is ``execute(plan())``."""
+        if plan.route == "empty":
+            return False, []
+        cm = _crypto_metrics()
+        if plan.route == "host":
+            cm.dispatch_tier.labels(tier="host").inc()
+            cpu = _ed.CpuBatchVerifier()
+            for p, m, s in zip(plan.pubs, plan.msgs, plan.sigs):
+                cpu.add(_ed.Ed25519PubKey(p), m, s)
+            return cpu.verify()
+        n = plan.n
+        entry = plan.entry
         t0 = time.perf_counter()
         self._last_tier = None
         with _tracer.span(
@@ -694,23 +756,26 @@ class TpuBatchVerifier(BatchVerifier):
             # dispatch raises at the offending line instead of
             # silently paying the link RTT per batch
             with _jitguard.transfer_window():
-                # health seam: queue-wait (host prep before dispatch),
-                # the launch watchdog (a wedged launch becomes
+                # health seam: queue-wait (host prep + any time the
+                # plan sat in the verify queue before dispatch), the
+                # launch watchdog (a wedged launch becomes
                 # crypto_device_hangs_total + a flight event inside
                 # its budget, not a silent stall), and busy/idle +
                 # overlap accounting over the launch wall
                 intent = "keyed" if entry is not None else "generic"
                 t_launch = time.perf_counter()
-                _health.USAGE.note_queue_wait(t_launch - t_enter)
+                _health.USAGE.note_queue_wait(t_launch - plan.t_plan)
                 fetch0 = _health.USAGE.fetch_wait()
                 with _health.WATCHDOG.watch(tier=intent, batch=n):
                     if entry is not None:
                         out = self._run_keyed(
-                            entry, entry.key_ids(self._pubs), pub, sig,
-                            self._msgs,
+                            entry, plan.key_ids, plan.pub, plan.sig,
+                            plan.msgs,
                         )
                     else:
-                        out = self._run_generic(pub, sig, self._msgs)
+                        out = self._run_generic(
+                            plan.pub, plan.sig, plan.msgs
+                        )
                 _health.USAGE.launch_end(
                     t_launch, ndev=self._usage_ndev,
                     fetch_wait=_health.USAGE.fetch_wait() - fetch0,
@@ -723,6 +788,9 @@ class TpuBatchVerifier(BatchVerifier):
             sp.set(ok=all(results), tier=tier)
         cm.kernel_time_seconds.observe(time.perf_counter() - t0)
         return all(results), results
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        return self.execute(self.plan())
 
     # dispatch seam: the multi-chip verifier (parallel/mesh.py
     # ShardedTpuBatchVerifier) overrides these two with mesh-sharded

@@ -19,8 +19,11 @@ from dataclasses import dataclass
 from fractions import Fraction
 
 from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import verify_queue as _vq
 from cometbft_tpu.types.block import BlockID, Commit
 from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.trace import TRACER as _tracer
 
 
@@ -137,51 +140,136 @@ def _verify(
     # crypto pass — one batch launch per key type in the commit; with
     # multiple key types the groups run CONCURRENTLY (the TPU kernel
     # waits on device compute and the native BLS library releases the
-    # GIL, so a mixed mega-commit costs max(ed25519, bls) not the sum)
+    # GIL, so a mixed mega-commit costs max(ed25519, bls) not the sum).
+    # When the verify queue is live (crypto/verify_queue.py), each
+    # signature consults the speculative-result cache first: votes the
+    # queue already verified on receipt (VoteSet.add_vote) or via
+    # blocksync prefetch skip the launch entirely — a fully speculated
+    # commit performs ZERO new launches.  Fall-back is strict: cache
+    # misses run the exact batch/serial verify below.
+    spec_mtx = cmtsync.Mutex()
+    spec = {"hits": 0, "misses": 0, "tier": None}
+
     def _verify_group(group) -> None:
-        pk0 = vals.get_by_index(group[0].val_idx).pub_key
+        pks = [vals.get_by_index(e.val_idx).pub_key for e in group]
+        sbs = [commit.vote_sign_bytes(chain_id, e.idx) for e in group]
+        pending = list(range(len(group)))
+        keys: list[bytes] | None = None
+        if _vq.speculation_active():
+            # only POSITIVE verdicts are ever cached (verify_queue
+            # stores proofs of validity), so a hit is a signature that
+            # skips its launch and anything else re-verifies below —
+            # a transient mis-verify can never stick.  The SHA-512
+            # prehash is computed ONCE per signature and reused by the
+            # record_result below — on a cold 10k-sig commit the
+            # consult-then-record shape would otherwise hash twice.
+            keys = [
+                _vq.cache_key(
+                    pks[i].bytes(), sbs[i],
+                    commit.signatures[e.idx].signature,
+                )
+                for i, e in enumerate(group)
+            ]
+            pending = []
+            hits = 0
+            for i, e in enumerate(group):
+                if _vq.cached_result(
+                    pks[i].bytes(), sbs[i],
+                    commit.signatures[e.idx].signature,
+                    key=keys[i],
+                ) is True:
+                    hits += 1
+                else:
+                    pending.append(i)
+            with spec_mtx:
+                spec["hits"] += hits
+                spec["misses"] += len(pending)
+            if not pending:
+                return
+        pk0 = pks[pending[0]]
         verifier = None
-        if len(group) >= 2 and crypto_batch.supports_batch_verifier(pk0):
+        if len(pending) >= 2 and crypto_batch.supports_batch_verifier(
+            pk0
+        ):
             verifier = crypto_batch.create_batch_verifier(pk0)
         if verifier is not None:
-            for e in group:
+            for i in pending:
                 verifier.add(
-                    vals.get_by_index(e.val_idx).pub_key,
-                    commit.vote_sign_bytes(chain_id, e.idx),
-                    commit.signatures[e.idx].signature,
+                    pks[i], sbs[i],
+                    commit.signatures[group[i].idx].signature,
                 )
             ok, results = verifier.verify()
+            tier = getattr(verifier, "_last_tier", None)
+            with spec_mtx:
+                spec["tier"] = tier or spec["tier"] or "host"
+            if _vq.speculation_active():
+                # repeat verifications of this commit (evidence
+                # re-checks, light-client retries) become cache hits
+                for i, r in zip(pending, results):
+                    _vq.record_result(
+                        pks[i].bytes(), sbs[i],
+                        commit.signatures[group[i].idx].signature,
+                        bool(r),
+                        key=keys[i] if keys is not None else None,
+                    )
             if not ok:
-                bad = next(i for i, r in enumerate(results) if not r)
+                bad = next(j for j, r in enumerate(results) if not r)
                 raise InvalidCommitSignatures(
-                    f"wrong signature (#{group[bad].idx})"
+                    f"wrong signature (#{group[pending[bad]].idx})"
                 )
         else:
-            for e in group:
-                pk = vals.get_by_index(e.val_idx).pub_key
-                if not pk.verify_signature(
-                    commit.vote_sign_bytes(chain_id, e.idx),
-                    commit.signatures[e.idx].signature,
-                ):
+            with spec_mtx:
+                spec["tier"] = spec["tier"] or "host"
+            for i in pending:
+                sig = commit.signatures[group[i].idx].signature
+                ok1 = pks[i].verify_signature(sbs[i], sig)
+                if _vq.speculation_active():
+                    _vq.record_result(
+                        pks[i].bytes(), sbs[i], sig, ok1,
+                        key=keys[i] if keys is not None else None,
+                    )
+                if not ok1:
                     raise InvalidCommitSignatures(
-                        f"wrong signature (#{e.idx})"
+                        f"wrong signature (#{group[i].idx})"
                     )
 
     groups = _batch_groups(entries, vals)
     with _tracer.span(
         "verify_commit", cat="crypto",
         height=commit.height, sigs=len(entries), groups=len(groups),
-    ):
-        if len(groups) <= 1:
-            for group in groups:
-                _verify_group(group)
-        else:
-            import concurrent.futures as _futures
+    ) as sp:
+        speculating = _vq.speculation_active()
+        try:
+            if len(groups) <= 1:
+                for group in groups:
+                    _verify_group(group)
+            else:
+                import concurrent.futures as _futures
 
-            with _futures.ThreadPoolExecutor(len(groups)) as pool:
-                futs = [pool.submit(_verify_group, g) for g in groups]
-                for f in futs:
-                    f.result()  # re-raises InvalidCommitSignatures
+                with _futures.ThreadPoolExecutor(len(groups)) as pool:
+                    futs = [
+                        pool.submit(_verify_group, g) for g in groups
+                    ]
+                    for f in futs:
+                        f.result()  # re-raises InvalidCommitSignatures
+        finally:
+            if speculating:
+                # tier tells the flight tail whether a slow commit came
+                # from a cold queue (misses ran on a real tier) or a
+                # warm one (all hits -> "speculative", no launch)
+                tier = (
+                    "speculative" if spec["misses"] == 0
+                    else (spec["tier"] or "host")
+                )
+                sp.set(
+                    spec_hits=spec["hits"], spec_misses=spec["misses"],
+                    tier=tier,
+                )
+                FLIGHT.record(
+                    "consensus/speculative_verify",
+                    height=commit.height, sigs=len(entries),
+                    hits=spec["hits"], misses=spec["misses"], tier=tier,
+                )
 
     for e in entries:
         if e.counts:

@@ -159,15 +159,37 @@ class VoteSet:
         return True
 
     def _verify(self, vote: Vote, pub_key) -> None:
-        if not pub_key.verify_signature(
-            vote.sign_bytes(self.chain_id), vote.signature
-        ):
-            raise VoteSetError("invalid vote signature")
+        """Signature checks on vote receipt — the SPECULATIVE verify
+        plane: both the vote signature and (on extension-enabled
+        non-nil precommits) the extension signature go to the verify
+        queue as ONE batched submission, so concurrent gossip votes
+        coalesce into device-sized batches and the verdicts land in
+        the speculative-result cache — ``verify_commit`` at finalize
+        is then mostly a cache hit instead of a synchronous full-set
+        launch.  With no queue installed, ``verify_or_fallback``
+        degrades to the exact per-call ``verify_signature`` path this
+        method always had; error precedence is unchanged either way
+        (vote signature first, then extension shape, then extension
+        signature)."""
+        from cometbft_tpu.crypto import verify_queue as _vq
+
         ext_slot = (
             self.extensions_enabled
             and self.signed_msg_type == PRECOMMIT_TYPE
             and not vote.is_nil()
         )
+        items = [
+            (pub_key, vote.sign_bytes(self.chain_id), vote.signature)
+        ]
+        if ext_slot and vote.extension_signature:
+            items.append((
+                pub_key,
+                vote.extension_sign_bytes(self.chain_id),
+                vote.extension_signature,
+            ))
+        results = _vq.verify_or_fallback(items)
+        if not results[0]:
+            raise VoteSetError("invalid vote signature")
         if not ext_slot:
             # extensions ride ONLY non-nil precommits (vote.go
             # ValidateBasic): a nil/prevote extension is never
@@ -180,10 +202,7 @@ class VoteSet:
             return
         if not vote.extension_signature:
             raise VoteSetError("missing vote extension signature")
-        if not pub_key.verify_signature(
-            vote.extension_sign_bytes(self.chain_id),
-            vote.extension_signature,
-        ):
+        if not results[1]:
             raise VoteSetError("invalid vote extension signature")
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:

@@ -50,8 +50,22 @@ def hist_count(metric, **labels) -> int:
     return metric.labels(**labels)._count
 
 
-def flight_kinds(since: int) -> list[str]:
-    return [ev["kind"] for ev in FLIGHT.events()[since:]]
+def flight_events_since(since_total: int) -> list[dict]:
+    """Events recorded after a ``FLIGHT.recorded_total`` mark.
+    Wrap-proof: a positional ``len(FLIGHT.events())`` mark goes stale
+    the moment the bounded ring fills (``events()[mark:]`` is then
+    always empty), which a full tier-1 run's event volume reaches.
+    The ``new <= 0`` guard matters: ``events[-0:]`` is the WHOLE ring,
+    not the empty tail."""
+    events = FLIGHT.events()
+    new = FLIGHT.recorded_total - since_total
+    if new <= 0:
+        return []
+    return events[-min(new, len(events)):]
+
+
+def flight_kinds(since_total: int) -> list[str]:
+    return [ev["kind"] for ev in flight_events_since(since_total)]
 
 
 class TestEnvKnobs:
@@ -90,7 +104,7 @@ class TestLaunchWatchdog:
         raises the hang counter + flight event WITHIN the budget and
         never deadlocks the launching thread."""
         wd = H.LaunchWatchdog(budget_s=0.05)
-        mark = len(FLIGHT.events())
+        mark = FLIGHT.recorded_total
         try:
             tripped_at = None
             with wd.watch(tier="fake", batch=64):
@@ -109,7 +123,7 @@ class TestLaunchWatchdog:
             # the launch returned afterwards: recovery is recorded
             assert "crypto/device_hang_recovered" in kinds
             ev = [
-                e for e in FLIGHT.events()[mark:]
+                e for e in flight_events_since(mark)
                 if e["kind"] == "crypto/device_hang"
             ][0]
             assert ev["tier"] == "fake" and ev["batch"] == 64
@@ -261,7 +275,7 @@ class TestHealthProber:
             return True
 
         prober = H.HealthProber(interval_s=60, tiers={"keyed": flaky})
-        mark = len(FLIGHT.events())
+        mark = FLIGHT.recorded_total
         assert prober.probe_once() == {"keyed": False}
         assert counter_value(hm.tier_healthy, tier="keyed") == 0.0
         assert counter_value(
@@ -292,7 +306,7 @@ class TestHealthProber:
             watchdog=wd,
         )
         try:
-            mark = len(FLIGHT.events())
+            mark = FLIGHT.recorded_total
             prober.probe_once()
             deadline = time.monotonic() + 2
             while (
@@ -485,7 +499,7 @@ class TestVerifierHealthHooks:
         wd = H.LaunchWatchdog(budget_s=0.05)
         monkeypatch.setattr(H, "WATCHDOG", wd)
         try:
-            mark = len(FLIGHT.events())
+            mark = FLIGHT.recorded_total
 
             def hung_run(pub, sig, msgs):
                 time.sleep(0.2)  # past the 0.05s budget
