@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -144,6 +144,17 @@ health-smoke:
 pipeline-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_verify_queue.py \
 		-k "RoundTrip or Overlap or PipelinedBench" -q
+
+# chaos smoke: the dispatch-ladder liveness proof (docs/
+# dispatch_ladder.md) — a single-validator node under CMT_TPU_CHAOS=1
+# with a device-loss-then-recovery plan must commit >= 20 consecutive
+# heights while the ladder demotes tier by tier to the host floor and
+# re-promotes (a demotion + a promotion + liveness, asserted in one
+# drive); tier-1 runs the full tests/test_dispatch.py suite too, and
+# `make test` gates on this target alongside the other smokes
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_dispatch.py \
+		-k "ChaosLivenessNode" -q
 
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —

@@ -133,25 +133,28 @@ def device_status() -> dict:
 def _ed25519_factory() -> BatchVerifier:
     # Routing decisions that end at the host verifier are recorded
     # here, where they are made; a device-capable verifier defers its
-    # decision to batch time (TpuBatchVerifier.verify — it may still
-    # fall back on batch size / calibration).  A factory-routed host
-    # verifier can only ever run the host tier, so its
-    # crypto_dispatch_tier count is recorded here too; device-capable
-    # verifiers record the tier ACTUALLY used per batch in verify().
+    # decision to batch time (TpuBatchVerifier.plan — it may still
+    # fall back on batch size / calibration / ladder demotion).  Tier
+    # ACCOUNTING is uniform either way: every verifier this factory
+    # returns records crypto_dispatch_tier per BATCH at the ladder's
+    # decision point (dispatch.LADDER.note_batch — host-only routes
+    # via LadderHostVerifier.verify, device routes via
+    # TpuBatchVerifier.execute), so counts are comparable across
+    # tiers instead of mixing factory-time and batch-time samples.
+    from cometbft_tpu.crypto.dispatch import LadderHostVerifier
+
     if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
         _crypto_metrics().dispatch_decisions.labels(
             route="host", reason="disabled"
         ).inc()
-        _crypto_metrics().dispatch_tier.labels(tier="host").inc()
-        return _ed.CpuBatchVerifier()
+        return LadderHostVerifier()
     try:
         ndev = _device_ndev()
         if ndev == 0:
             _crypto_metrics().dispatch_decisions.labels(
                 route="host", reason="device_unavailable"
             ).inc()
-            _crypto_metrics().dispatch_tier.labels(tier="host").inc()
-            return _ed.CpuBatchVerifier()
+            return LadderHostVerifier()
         if ndev > 1 and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY"):
             # multi-chip: shard the batch over a 1-D mesh — every
             # caller of this seam scales across chips transparently
@@ -165,8 +168,7 @@ def _ed25519_factory() -> BatchVerifier:
         _crypto_metrics().dispatch_decisions.labels(
             route="host", reason="device_unavailable"
         ).inc()
-        _crypto_metrics().dispatch_tier.labels(tier="host").inc()
-        return _ed.CpuBatchVerifier()
+        return LadderHostVerifier()
 
 
 def _bls_factory() -> BatchVerifier:

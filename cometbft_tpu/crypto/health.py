@@ -20,8 +20,10 @@ and an operator can act on, three instruments in one plane:
   keyed / generic / host), every ``CMT_TPU_HEALTH_INTERVAL`` seconds
   (default 60; 0 disables).  Each probe feeds
   ``crypto_tier_probe_seconds{tier}`` and ``crypto_tier_healthy{tier}``
-  — the per-tier health signal automatic demotion/promotion will
-  consume.  Device tiers are probed only when a jax backend has
+  AND the dispatch ladder (``crypto/dispatch.py``): N consecutive
+  canary failures demote the tier, M consecutive healthy canaries
+  promote it back — the loop this plane measures is now closed.
+  Device tiers are probed only when a jax backend has
   ALREADY initialized in-process and is a real accelerator: the prober
   must never trigger the import-hang it exists to detect
   (crypto/batch.py's probe-subprocess rationale), and probing the
@@ -60,8 +62,10 @@ from cometbft_tpu.utils.service import BaseService
 DEFAULT_LAUNCH_BUDGET_S = 240.0
 DEFAULT_HEALTH_INTERVAL_S = 60.0
 
-#: the dispatch-ladder tiers in demotion order (docs/observability.md)
-TIERS = ("keyed_mesh", "keyed", "generic", "host")
+#: the probe-able dispatch-ladder tiers in demotion order — a strict
+#: subset of crypto/dispatch.TIER_ORDER (the python floor needs no
+#: canary: it is never demoted)
+TIERS = ("keyed_mesh", "keyed", "generic_mesh", "generic", "host")
 
 
 def _float_env(var: str, default: float, minimum: float) -> float:
@@ -181,11 +185,16 @@ class LaunchWatchdog:
     @contextmanager
     def watch(self, tier: str, batch: int = 0,
               budget_s: float | None = None):
+        """Yields a state box whose ``fired`` flag is filled at exit:
+        callers that demote on escalation can tell whether THIS
+        launch's overrun already demoted the tier (dispatch ladder
+        duplicate-offense pairing)."""
         token = self.arm(tier, batch=batch, budget_s=budget_s)
+        state = {"fired": False}
         try:
-            yield
+            yield state
         finally:
-            self.disarm(token)
+            state["fired"] = self.disarm(token)
 
     # -- the watchdog thread ---------------------------------------------
 
@@ -229,6 +238,23 @@ class LaunchWatchdog:
                     tier=entry["tier"], batch=entry["batch"],
                     elapsed_s=round(elapsed, 3),
                 )
+                # the overrun demotes the wedged tier NOW, before the
+                # stalled call returns (if it ever does) — the r04
+                # failure mode becomes a ladder transition, not just a
+                # counter.  Probe watchdogs carry a "probe:" prefix;
+                # the hang is the underlying tier's either way.
+                try:
+                    from cometbft_tpu.crypto import dispatch as _disp
+
+                    tier = entry["tier"]
+                    if tier.startswith("probe:"):
+                        tier = tier[len("probe:"):]
+                    _disp.LADDER.watchdog_fault(tier)
+                except Exception as exc:  # noqa: BLE001 — the
+                    # watchdog thread must survive a ladder hiccup
+                    self.logger.error(
+                        "watchdog demotion failed", err=repr(exc)
+                    )
 
     def stop(self) -> None:
         """Tests only: stop the shared thread (a fresh arm restarts
@@ -468,10 +494,16 @@ class HealthProber(BaseService):
         box: dict = {}
 
         def run() -> None:
+            from cometbft_tpu.crypto import dispatch as _disp
+
             t0 = time.perf_counter()
             try:
                 # probes are real device launches: the watchdog bounds
-                # them exactly like production batches
+                # them exactly like production batches — and the chaos
+                # plan faults canaries exactly like production batches
+                # (probe=True skips the launch_hang sleep: the prober's
+                # own timeout plays the watchdog's role on this seam)
+                _disp.CHAOS.inject(tier, probe=True)
                 with self._watchdog.watch(tier=f"probe:{tier}"):
                     box["ok"] = bool(probe())
             except Exception as exc:  # noqa: BLE001 — a dead tier is
@@ -502,12 +534,18 @@ class HealthProber(BaseService):
     def probe_once(self) -> dict[str, bool]:
         """One canary round over every available tier; returns
         tier -> healthy.  Exposed for tests and `make health-smoke`."""
+        from cometbft_tpu.crypto import dispatch as _disp
+
         hm = _health_metrics()
         results: dict[str, bool] = {}
         for tier, probe in self._tier_probes().items():
             ok, err, dt = self._run_probe(tier, probe)
             hm.tier_probe_seconds.labels(tier=tier).observe(dt)
             hm.tier_healthy.labels(tier=tier).set(1.0 if ok else 0.0)
+            # canary evidence drives the dispatch ladder: N consecutive
+            # failures demote the tier, M consecutive successes (past
+            # its cool-down) promote it back (crypto/dispatch.py)
+            _disp.LADDER.note_probe(tier, ok)
             with self._state_mtx:
                 prev = self._state.get(tier, {})
                 self._state[tier] = {
@@ -605,6 +643,7 @@ def default_tier_probes() -> dict:
     probes["keyed"] = _probe_keyed
     if len(devices) > 1:
         probes["keyed_mesh"] = _probe_keyed_mesh
+        probes["generic_mesh"] = _probe_generic_mesh
     return probes
 
 
@@ -664,13 +703,35 @@ def _probe_keyed() -> bool:
 
 
 def _probe_keyed_mesh() -> bool:
+    """Mesh-tier canary PINNED to the keyed_mesh runner: a canary must
+    exercise its own tier, not walk the dispatch ladder — a demoted
+    tier's canary routed one rung down would report the FALLBACK's
+    health as promotion evidence for the dead tier."""
+    from cometbft_tpu.ops import precompute as PR
     from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
 
     bv = ShardedTpuBatchVerifier(device_min_batch=0)
-    for pub, msg, sig in _canary_fixture() * 4:
-        bv.add(pub, msg, sig)
-    ok, bits = bv.verify()
-    return ok and all(bits)
+    if not bv._mesh_capable():
+        return _probe_keyed()
+    pub, sig, msgs = _probe_arrays()
+    pubs_b = [p.bytes() for p, _, _ in _canary_fixture()]
+    entry = PR.TABLE_CACHE.lookup_or_build(pubs_b)
+    if entry is None:  # out of table policy: not a device failure
+        return _probe_generic_mesh()
+    key_ids = entry.key_ids([bytes(p) for p in pub])
+    out = bv._run_keyed_mesh(entry, key_ids, pub, sig, msgs)
+    return bool(out.all())
+
+
+def _probe_generic_mesh() -> bool:
+    """Sharded-generic canary, pinned to its runner for the same
+    reason as the keyed_mesh probe."""
+    from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier
+
+    bv = ShardedTpuBatchVerifier(device_min_batch=0)
+    pub, sig, msgs = _probe_arrays()
+    out = bv._run_generic_mesh(pub, sig, msgs)
+    return bool(out.all())
 
 
 #: process-wide singletons — the verifier seam and probers all feed

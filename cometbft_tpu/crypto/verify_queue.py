@@ -16,8 +16,11 @@ them into device-sized batches, and keeps **two buffers in flight**:
   input packing) for buffer N+1 **while** buffer N's device launch is
   in flight on the
 - *launcher* thread, which executes prepared batches through the
-  existing dispatch ladder (keyed_mesh -> keyed -> generic -> host;
-  the verifier's ``execute()`` chooses the tier per batch) and
+  failover dispatch ladder (``crypto/dispatch.py``: keyed_mesh ->
+  keyed -> generic_mesh -> generic -> host -> python; the verifier's
+  ``execute()`` walks the plan's admissible tiers top-down, demoting
+  a faulting tier and continuing one rung down — a tier demoted
+  between plan time and launch time is skipped mid-walk) and
   delivers completion futures back to callers.
 
 Mixed-priority scheduling: consensus-vote requests **preempt**
@@ -554,9 +557,15 @@ class VerifyQueue(BaseService):
         for reqs in by_type.values():
             pk0 = reqs[0].pub_key
             verifier = None
-            if len(reqs) >= 2 and crypto_batch.supports_batch_verifier(
-                pk0
-            ):
+            # every group — single-signature ones included — routes
+            # through the verifier seam so the dispatch ladder
+            # (crypto/dispatch.py) is the ONE decision + accounting
+            # point: a 1-sig group still plans (host route at
+            # production thresholds, device when the ladder says so)
+            # and lands in crypto_dispatch_tier; the per-sig fallback
+            # below covers only unsupported key types and factory
+            # failures
+            if crypto_batch.supports_batch_verifier(pk0):
                 try:
                     verifier = (
                         factory(pk0) if factory is not None

@@ -50,6 +50,9 @@ _INSPECT_ROUTES = (
     # what post-mortem inspection of a device-lost node needs, and
     # the payload is store-free (crypto/health.py)
     "debug/perf",
+    # dispatch-ladder state: which tiers were demoted, why, and when
+    # — the first question after a device-lost run (crypto/dispatch.py)
+    "debug/dispatch",
 )
 
 

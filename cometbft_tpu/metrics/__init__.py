@@ -547,6 +547,9 @@ class CryptoMetrics:
             self.batch_verify_batch_size = _NOP
             self.dispatch_decisions = _NOP
             self.dispatch_tier = _NOP
+            self.dispatch_demotions_total = _NOP
+            self.dispatch_promotions_total = _NOP
+            self.dispatch_current_tier = _NOP
             self.kernel_time_seconds = _NOP
             self.host_verify_time_seconds = _NOP
             self.key_pool_keys = self.key_pool_capacity = _NOP
@@ -582,10 +585,37 @@ class CryptoMetrics:
         self.dispatch_tier = reg.counter(
             s, "dispatch_tier",
             "Dispatch-ladder tier ACTUALLY used per batch-verify call "
-            "(keyed_mesh | keyed | generic_mesh | generic | host) — "
-            "recorded at batch time, not factory time, so a warm "
-            "key-set table failing to promote the batch to the keyed "
-            "tier is visible as a generic/host count.",
+            "(keyed_mesh | keyed | generic_mesh | generic | host | "
+            "python) — recorded at batch time at the ladder's single "
+            "decision point (crypto/dispatch.LADDER.note_batch), for "
+            "host-only factory routes and device routes alike, so "
+            "counts are comparable across tiers.",
+            labels=("tier",),
+        )
+        self.dispatch_demotions_total = reg.counter(
+            s, "dispatch_demotions_total",
+            "Dispatch-ladder tier demotions (crypto/dispatch.py): "
+            "`from` is the demoted tier, `to` the next admissible "
+            "rung below it, `reason` the bounded failure class "
+            "(watchdog | probe_failures | chaos:<kind> | "
+            "launch:<ExcType> | table_lookup:<ExcType> | "
+            "rtt_probe:<ExcType>).",
+            labels=("from", "to", "reason"),
+        )
+        self.dispatch_promotions_total = reg.counter(
+            s, "dispatch_promotions_total",
+            "Dispatch-ladder tier re-admissions: a demoted tier "
+            "promoted back after CMT_TPU_PROMOTE_AFTER consecutive "
+            "healthy canaries, or one successful batch on a "
+            "half-open post-cool-down trial.",
+            labels=("tier",),
+        )
+        self.dispatch_current_tier = reg.gauge(
+            s, "dispatch_current_tier",
+            "One-hot gauge of the best currently-admissible dispatch "
+            "tier known to this process (1 on exactly one tier label; "
+            "alert when the high-value tiers sit at 0 — the ladder "
+            "has demoted the device).",
             labels=("tier",),
         )
         self.kernel_time_seconds = reg.histogram(

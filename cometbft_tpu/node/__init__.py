@@ -633,6 +633,18 @@ class Node(BaseService):
             raise
 
     def _start_services(self) -> None:
+        # chaos drill (CMT_TPU_CHAOS=1): pin the fault-plan epoch to
+        # service start and log the armed schedule — a node under
+        # chaos must SAY so, loudly, before the first injected fault
+        from cometbft_tpu.crypto import dispatch as _dispatch
+
+        if _dispatch.chaos_enabled():
+            _dispatch.CHAOS.start()
+            self.logger.error(
+                "CHAOS MODE ARMED — seeded faults will be injected "
+                "at the crypto dispatch seam (CMT_TPU_CHAOS_PLAN)",
+                plan=_dispatch.CHAOS.snapshot()["windows"],
+            )
         # verify-ahead queue FIRST: the reactors that feed it
         # (consensus add_vote, blocksync prefetch) start below, and
         # every caller degrades to the synchronous path if this fails

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from cometbft_tpu.crypto import BatchVerifier, PubKey
+from cometbft_tpu.crypto import dispatch as _failover
 from cometbft_tpu.crypto import ed25519 as _ed
 from cometbft_tpu.crypto import health as _health
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
@@ -578,7 +579,14 @@ def runtime_device_min_batch() -> int:
             _runtime_threshold = 1 << 30
             return _runtime_threshold
         rtt = _measure_link_rtt()
-    except Exception:  # no usable device: verify() falls back anyway
+    except Exception as exc:  # noqa: BLE001 — no usable device:
+        # verify() falls back anyway, but the swallow becomes a SIGNAL:
+        # the generic tier is demoted through the ladder (metric label
+        # + crypto/dispatch_transition flight event carry the reason)
+        # instead of vanishing into a silent host route
+        _failover.LADDER.tier_fault(
+            "generic", reason=f"rtt_probe:{type(exc).__name__}"
+        )
         _runtime_threshold = 1 << 30
         return _runtime_threshold
     n_star = rtt / max(t_cpu - t_dev, 1e-9)
@@ -602,7 +610,7 @@ class _VerifyPlan:
 
     __slots__ = (
         "n", "route", "reason", "entry", "key_ids", "pub", "sig",
-        "msgs", "pubs", "sigs", "t_plan",
+        "msgs", "pubs", "sigs", "t_plan", "tiers",
     )
 
     def __init__(self) -> None:
@@ -617,6 +625,10 @@ class _VerifyPlan:
         self.pubs: list[bytes] = []
         self.sigs: list[bytes] = []
         self.t_plan = 0.0
+        #: ladder-admissible tiers for this batch, best first, always
+        #: ending in the host/python floor (crypto/dispatch.py);
+        #: execute() walks this list top-down
+        self.tiers: list[str] = []
 
 
 class TpuBatchVerifier(BatchVerifier):
@@ -652,11 +664,21 @@ class TpuBatchVerifier(BatchVerifier):
     def __len__(self) -> int:
         return len(self._pubs)
 
+    # -- ladder eligibility (crypto/dispatch.py owns admissibility) ------
+
+    def _keyed_tiers(self) -> list[str]:
+        """Keyed tiers this verifier can run, best first (the mesh
+        verifier prepends keyed_mesh)."""
+        return ["keyed"]
+
+    def _generic_tiers(self) -> list[str]:
+        return ["generic"]
+
     def plan(self) -> _VerifyPlan:
-        """Host phase: the dispatch routing decision (device vs host,
-        keyed-table lookup/warm-peek) plus input packing — everything
-        that happens BEFORE the device launch.  Safe to run on the
-        verify queue's collector thread while another batch's
+        """Host phase: the dispatch routing decision (ladder tier
+        selection, keyed-table lookup/warm-peek) plus input packing —
+        everything that happens BEFORE the device launch.  Safe to run
+        on the verify queue's collector thread while another batch's
         :meth:`execute` launch is in flight."""
         plan = _VerifyPlan()
         plan.t_plan = time.perf_counter()
@@ -667,13 +689,20 @@ class TpuBatchVerifier(BatchVerifier):
             self._pubs, self._msgs, self._sigs
         )
         cm = _crypto_metrics()
+        ladder = _failover.LADDER
         device_usable = self._device_min_batch < 1 << 30
         msg_fits = max(len(m) for m in self._msgs) <= _BUCKETS[-1]
         entry = None
         reason = "batch_size"
-        if device_usable and msg_fits and not os.environ.get(
-            "CMT_TPU_DISABLE_PRECOMPUTE"
+        keyed_admissible = any(
+            ladder.active(t) for t in self._keyed_tiers()
+        )
+        if device_usable and msg_fits and keyed_admissible and (
+            not os.environ.get("CMT_TPU_DISABLE_PRECOMPUTE")
         ):
+            # when every keyed tier is demoted the lookup is skipped
+            # entirely: a dead device must not stall the plan phase
+            # behind a table build no admissible tier could use
             from cometbft_tpu.ops import precompute as _pr
 
             try:
@@ -696,15 +725,34 @@ class TpuBatchVerifier(BatchVerifier):
                     entry = _pr.TABLE_CACHE.peek(self._pubs)
                     if entry is not None:
                         reason = "keyed_warm"
-            except Exception:
-                entry = None  # any device hiccup -> generic/host path
-        if (n < self._device_min_batch and entry is None) or not msg_fits:
-            # Messages beyond the largest device bucket: honor the
-            # BatchVerifier contract via the host fallback instead of
-            # raising mid-verify.  The 1<<30 threshold sentinel means
-            # calibration ruled the device out entirely (cpu backend /
-            # unusable link), not that this batch was too small.
-            if n >= self._device_min_batch:
+            except Exception as exc:  # noqa: BLE001 — typed escalation:
+                # a table lookup/build failure is a KEYED-tier fault;
+                # the ladder demotes it (reason on the demotion metric
+                # + crypto/dispatch_transition flight event) and this
+                # batch walks on at the generic tier — the silent
+                # swallow this block used to be is now a signal
+                ladder.tier_fault(
+                    "keyed",
+                    reason=f"table_lookup:{type(exc).__name__}",
+                    batch=n,
+                )
+                entry = None
+        # eligible device tiers for THIS batch, ladder order
+        eligible: list[str] = []
+        if entry is not None:
+            eligible += self._keyed_tiers()
+        if device_usable and msg_fits and n >= self._device_min_batch:
+            eligible += self._generic_tiers()
+        admissible = ladder.admissible(eligible)
+        if not admissible:
+            # Host route: batch too small, message beyond the largest
+            # device bucket (honor the BatchVerifier contract via the
+            # host fallback instead of raising mid-verify), the 1<<30
+            # calibration sentinel (device ruled out entirely), or
+            # every eligible device tier currently demoted.
+            if eligible:
+                reason = "ladder_demoted"
+            elif n >= self._device_min_batch:
                 reason = "msg_too_large"
             elif not device_usable:
                 reason = "calibration"
@@ -715,12 +763,14 @@ class TpuBatchVerifier(BatchVerifier):
             cm.dispatch_decisions.labels(route="host", reason=reason).inc()
             plan.route = "host"
             plan.reason = reason
+            plan.tiers = ["host", _failover.FLOOR_TIER]
             return plan
         cm.dispatch_decisions.labels(route="device", reason=reason).inc()
         cm.batch_verify_batch_size.observe(n)
         plan.route = "device"
         plan.reason = reason
         plan.entry = entry
+        plan.tiers = admissible + ["host", _failover.FLOOR_TIER]
         if entry is not None:
             plan.key_ids = entry.key_ids(self._pubs)
         plan.pub = np.frombuffer(
@@ -732,75 +782,173 @@ class TpuBatchVerifier(BatchVerifier):
         return plan
 
     def execute(self, plan: _VerifyPlan) -> tuple[bool, list[bool]]:
-        """Device phase: launch + result fetch for a plan built by
-        :meth:`plan`.  ``verify()`` is ``execute(plan())``."""
+        """Device phase: walk the plan's ladder tiers top-down — chaos
+        injection, launch + result fetch per device tier, typed fault
+        escalation (a failing tier is demoted through
+        crypto/dispatch.LADDER and the batch continues one rung down),
+        with the host/python floor guaranteeing an answer.
+        ``verify()`` is ``execute(plan())``."""
         if plan.route == "empty":
             return False, []
         cm = _crypto_metrics()
-        if plan.route == "host":
-            cm.dispatch_tier.labels(tier="host").inc()
-            cpu = _ed.CpuBatchVerifier()
-            for p, m, s in zip(plan.pubs, plan.msgs, plan.sigs):
-                cpu.add(_ed.Ed25519PubKey(p), m, s)
-            return cpu.verify()
+        ladder = _failover.LADDER
         n = plan.n
-        entry = plan.entry
-        t0 = time.perf_counter()
         self._last_tier = None
-        with _tracer.span(
-            "batch_verify", cat="crypto",
-            kernel="keyed" if entry is not None else "generic", batch=n,
-        ) as sp:
-            # steady-state window: once jitguard is armed and sealed,
-            # an implicit host<->device transfer anywhere in the
-            # dispatch raises at the offending line instead of
-            # silently paying the link RTT per batch
-            with _jitguard.transfer_window():
-                # health seam: queue-wait (host prep + any time the
-                # plan sat in the verify queue before dispatch), the
-                # launch watchdog (a wedged launch becomes
-                # crypto_device_hangs_total + a flight event inside
-                # its budget, not a silent stall), and busy/idle +
-                # overlap accounting over the launch wall
-                intent = "keyed" if entry is not None else "generic"
-                t_launch = time.perf_counter()
-                _health.USAGE.note_queue_wait(t_launch - plan.t_plan)
-                fetch0 = _health.USAGE.fetch_wait()
-                with _health.WATCHDOG.watch(tier=intent, batch=n):
-                    if entry is not None:
-                        out = self._run_keyed(
-                            entry, plan.key_ids, plan.pub, plan.sig,
-                            plan.msgs,
-                        )
-                    else:
-                        out = self._run_generic(
-                            plan.pub, plan.sig, plan.msgs
-                        )
-                _health.USAGE.launch_end(
-                    t_launch, ndev=self._usage_ndev,
-                    fetch_wait=_health.USAGE.fetch_wait() - fetch0,
+        queue_wait_noted = False
+        last_exc: BaseException | None = None
+        tiers = plan.tiers or ["host", _failover.FLOOR_TIER]
+        for pos, tier in enumerate(tiers):
+            if tier not in ("host", _failover.FLOOR_TIER) and (
+                not ladder.active(tier)
+            ):
+                continue  # demoted since plan time (queue parked it)
+            try:
+                if tier == _failover.FLOOR_TIER:
+                    ok, results = self._run_python(plan)
+                elif tier == "host":
+                    ok, results = self._run_host(plan)
+                else:
+                    t0 = time.perf_counter()
+                    # flag BEFORE the launch: a faulting tier must not
+                    # make the fallback rung observe the queue wait
+                    # again, inflated by the failed launch's wall
+                    note_qw = not queue_wait_noted
+                    queue_wait_noted = True
+                    results = self._launch_tier(
+                        tier, plan, note_queue_wait=note_qw
+                    )
+                    ok = all(results)
+                    cm.kernel_time_seconds.observe(
+                        time.perf_counter() - t0
+                    )
+            except Exception as exc:  # noqa: BLE001 — the escalation
+                # seam: ANY tier failure (chaos fault, device loss,
+                # RetraceError under a sealed guard, native-lib crash)
+                # demotes the tier and walks one rung down; only the
+                # python floor re-raises — if pure per-signature
+                # verification raises, that is a programming error,
+                # not an availability problem
+                if tier == _failover.FLOOR_TIER:
+                    raise
+                last_exc = exc
+                ladder.tier_fault(
+                    tier, reason=_failover.fault_reason(exc), batch=n,
+                    duplicate=getattr(
+                        exc, "_ladder_watchdog_fired", False
+                    ),
                 )
-            results = [bool(v) for v in out]
-            tier = self._last_tier or (
-                "keyed" if entry is not None else "generic"
-            )
-            cm.dispatch_tier.labels(tier=tier).inc()
-            sp.set(ok=all(results), tier=tier)
-        cm.kernel_time_seconds.observe(time.perf_counter() - t0)
-        return all(results), results
+                continue
+            self._last_tier = tier
+            ladder.note_batch(tier)
+            return ok, results
+        # unreachable while the python floor is in the walk; keep the
+        # failure honest if a caller hands a floorless plan
+        raise last_exc if last_exc is not None else RuntimeError(
+            "dispatch ladder exhausted without a floor tier"
+        )
 
     def verify(self) -> tuple[bool, list[bool]]:
         return self.execute(self.plan())
 
+    # -- per-tier execution ----------------------------------------------
+
+    def _launch_tier(
+        self, tier: str, plan: _VerifyPlan, note_queue_wait: bool = True
+    ) -> list[bool]:
+        """One device-tier attempt: span + sealed-transfer window +
+        watchdog + busy/idle accounting around the tier's runner.
+        Returns the per-signature verdict list."""
+        n = plan.n
+        wd = None
+        try:
+            with _tracer.span(
+                "batch_verify", cat="crypto", kernel=tier, batch=n,
+            ) as sp:
+                # steady-state window: once jitguard is armed and
+                # sealed, an implicit host<->device transfer anywhere
+                # in the dispatch raises at the offending line instead
+                # of silently paying the link RTT per batch
+                with _jitguard.transfer_window():
+                    # health seam: queue-wait (host prep + any time the
+                    # plan sat in the verify queue before dispatch),
+                    # the launch watchdog (a wedged launch becomes
+                    # crypto_device_hangs_total + a flight event inside
+                    # its budget, not a silent stall), and busy/idle +
+                    # overlap accounting over the launch wall
+                    t_launch = time.perf_counter()
+                    if note_queue_wait:
+                        _health.USAGE.note_queue_wait(
+                            t_launch - plan.t_plan
+                        )
+                    fetch0 = _health.USAGE.fetch_wait()
+                    with _health.WATCHDOG.watch(
+                        tier=tier, batch=n
+                    ) as wd:
+                        # chaos injects INSIDE the armed watchdog
+                        # window: a launch_hang fault sleeps past the
+                        # budget while the watchdog is watching, so
+                        # the overrun fires (counter + flight event +
+                        # ladder demotion) before the stalled "launch"
+                        # returns — the r04 signature, reproduced end
+                        # to end (crypto/dispatch.py)
+                        _failover.CHAOS.inject(tier)
+                        out = self._run_tier(tier, plan)
+                    _health.USAGE.launch_end(
+                        t_launch, ndev=self._tier_ndev(tier),
+                        fetch_wait=_health.USAGE.fetch_wait() - fetch0,
+                    )
+                results = [bool(v) for v in out]
+                sp.set(ok=all(results), tier=tier)
+            return results
+        except Exception as exc:
+            # the watchdog already demoted this launch's tier at the
+            # overrun; mark the escalation so execute() records the
+            # second signal WITHOUT advancing the back-off again
+            if wd is not None and wd["fired"]:
+                exc._ladder_watchdog_fired = True
+            raise
+
+    def _run_tier(self, tier: str, plan: _VerifyPlan) -> np.ndarray:
+        """tier name -> runner (the mesh verifier extends this with
+        the *_mesh tiers)."""
+        if tier == "keyed":
+            return self._run_keyed(
+                plan.entry, plan.key_ids, plan.pub, plan.sig, plan.msgs
+            )
+        if tier == "generic":
+            return self._run_generic(plan.pub, plan.sig, plan.msgs)
+        raise _failover.TierUnavailable(tier, "no runner on this seam")
+
+    def _tier_ndev(self, tier: str) -> int:
+        """Chips one launch of ``tier`` occupies (busy/idle
+        accounting); mesh tiers override via _usage_ndev."""
+        return 1
+
+    def _run_host(self, plan: _VerifyPlan) -> tuple[bool, list[bool]]:
+        """The native host batch tier (Pippenger/RLC MSM with the
+        reference's per-signature re-verify for exact verdicts)."""
+        cpu = _ed.CpuBatchVerifier()
+        for p, m, s in zip(plan.pubs, plan.msgs, plan.sigs):
+            cpu.add(_ed.Ed25519PubKey(p), m, s)
+        return cpu.verify()
+
+    def _run_python(self, plan: _VerifyPlan) -> tuple[bool, list[bool]]:
+        """The pure per-signature floor — the tier consensus liveness
+        rests on when everything above it is demoted."""
+        results = [
+            _ed.Ed25519PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(plan.pubs, plan.msgs, plan.sigs)
+        ]
+        return all(results), results
+
     # dispatch seam: the multi-chip verifier (parallel/mesh.py
-    # ShardedTpuBatchVerifier) overrides these two with mesh-sharded
-    # launches; callers only ever see the BatchVerifier interface.
+    # ShardedTpuBatchVerifier) adds mesh-sharded runners on top of
+    # these single-device ones; callers only ever see the
+    # BatchVerifier interface.
     def _run_generic(self, pub, sig, msgs) -> np.ndarray:
-        self._last_tier = "generic"
         return _finish(verify_arrays_async(pub, sig, msgs))
 
     def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
-        self._last_tier = "keyed"
         return _finish(
             verify_arrays_keyed_async(entry, key_ids, pub, sig, msgs)
         )

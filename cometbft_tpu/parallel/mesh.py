@@ -253,6 +253,45 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         # per-chip busy/idle attribution (crypto/health.py DeviceUsage)
         self._usage_ndev = self._ndev
 
+    # -- ladder eligibility (crypto/dispatch.py owns admissibility) ------
+
+    def _mesh_capable(self) -> bool:
+        """Can the sharded keyed tier run at all here?  The ladder
+        consumes this as ELIGIBILITY (capability), as opposed to
+        ADMISSIBILITY (health) — what used to be an in-runner silent
+        fallback is now a tier the ladder simply never offers."""
+        return (
+            self._ndev > 1
+            and _shard_map is not None
+            and not os.environ.get("CMT_TPU_DISABLE_SHARDED_KEYED")
+        )
+
+    def _keyed_tiers(self) -> list[str]:
+        if self._mesh_capable():
+            return ["keyed_mesh", "keyed"]
+        return ["keyed"]
+
+    def _generic_tiers(self) -> list[str]:
+        if self._ndev > 1:
+            return ["generic_mesh", "generic"]
+        return ["generic"]
+
+    def _run_tier(self, tier, plan):
+        if tier == "keyed_mesh":
+            return self._run_keyed_mesh(
+                plan.entry, plan.key_ids, plan.pub, plan.sig, plan.msgs
+            )
+        if tier == "generic_mesh":
+            return self._run_generic_mesh(plan.pub, plan.sig, plan.msgs)
+        # the single-device keyed/generic rungs (tables and batch on
+        # the default device) come from the base seam
+        return super()._run_tier(tier, plan)
+
+    def _tier_ndev(self, tier: str) -> int:
+        from cometbft_tpu.crypto.dispatch import MESH_TIERS
+
+        return self._usage_ndev if tier in MESH_TIERS else 1
+
     def _pad_cols(
         self, packed: np.ndarray, chunk: int | None = None
     ) -> np.ndarray:
@@ -272,7 +311,7 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
     def _sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self._mesh, P(*spec))
 
-    def _run_generic(self, pub, sig, msgs) -> np.ndarray:
+    def _run_generic_mesh(self, pub, sig, msgs) -> np.ndarray:
         from cometbft_tpu.ops.ed25519_verify import (
             MAX_LAUNCH,
             _compiled,
@@ -291,26 +330,17 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         else:
             fn = _compiled(batch, bucket)
         out = fn(jax.device_put(packed, self._sharding(None, DATA_AXIS)))
-        self._last_tier = "generic_mesh"
         with _health.USAGE.timed_fetch():
             res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
         return res[: len(msgs)]
 
-    def _run_keyed(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
+    def _run_keyed_mesh(self, entry, key_ids, pub, sig, msgs) -> np.ndarray:
         from cometbft_tpu.ops.ed25519_verify import (
             MAX_LAUNCH,
             pack_inputs,
         )
 
         ndev = self._ndev
-        if (
-            ndev <= 1
-            or _shard_map is None
-            or os.environ.get("CMT_TPU_DISABLE_SHARDED_KEYED")
-        ):
-            # one rung down the ladder: the single-device keyed path
-            # (tables on the default device, no shard routing)
-            return super()._run_keyed(entry, key_ids, pub, sig, msgs)
         # per-chip shards of the table (and validity mask), resident
         # under a NamedSharding; built once per (entry, mesh)
         table, valid, per_cap = entry.sharded_tables(
@@ -373,7 +403,6 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         with _health.USAGE.timed_fetch():
             res = jax.device_get(out)  # host sync: single per-batch result gather off the mesh
         cm.bytes_transferred.labels(direction="d2h").inc(res.nbytes)
-        self._last_tier = "keyed_mesh"
         return res[dest]  # unscatter to original lane order
 
 

@@ -159,6 +159,8 @@ class Environment:
             "debug/flight": self.debug_flight,
             # GET /debug/perf: device-health + perf-ledger snapshot
             "debug/perf": self.debug_perf,
+            # GET /debug/dispatch: failover-ladder state + chaos plan
+            "debug/dispatch": self.debug_dispatch,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -326,6 +328,17 @@ class Environment:
         from cometbft_tpu.crypto.health import debug_perf_payload
 
         return debug_perf_payload()
+
+    def debug_dispatch(self) -> dict:
+        """Failover dispatch-ladder snapshot (crypto/dispatch.py):
+        ladder order, per-tier demotion/cool-down/streak state, the
+        recent transition trail, and the armed chaos plan.  Served on
+        a live node AND in inspect mode — post-mortem of a device-lost
+        node starts with the transition trail
+        (docs/dispatch_ladder.md)."""
+        from cometbft_tpu.crypto.dispatch import debug_dispatch_payload
+
+        return debug_dispatch_payload()
 
     def genesis_route(self) -> dict:
         import json as _json

@@ -316,7 +316,7 @@ class TestHealthProber:
                 time.sleep(0.01)
             assert counter_value(hm.device_hangs_total) == 1
             hang = [
-                e for e in FLIGHT.events()[mark:]
+                e for e in flight_events_since(mark)
                 if e["kind"] == "crypto/device_hang"
             ][0]
             assert hang["tier"] == "probe:hung"

@@ -21,6 +21,16 @@ The keyed_mesh step's JSON line (per-chip + aggregate sigs/s,
 dispatch_tier, per-seam compiles) is scraped into this campaign's
 MULTICHIP entry fields; bench.py itself also merges the full row into
 MULTICHIP_KEYED.json.
+
+``--auto-resume`` closes the r03/r04 loop: instead of exiting when the
+tunnel is down (rc=3 at start, rc=4 mid-campaign), the driver parks
+and polls ``crypto/batch.device_status()`` + the prober's tier health
+(cheap in-process reads that never trigger a probe) and the subprocess
+device probe every ``--poll-interval`` seconds, then restarts the
+campaign from its last completed step the moment a window opens —
+recording a ``campaign/resume`` flight event + span so the provenance
+trail shows exactly when the window opened and how long the wait cost.
+``--max-wait`` bounds the park (default 2 h; 0 = one probe, no park).
 """
 
 from __future__ import annotations
@@ -176,63 +186,207 @@ def _run_step_proc(name: str, tool: str, env: dict, timeout: float) -> dict:
         }
 
 
+def device_looks_up() -> bool | None:
+    """Cheap in-process window check before the subprocess probe:
+    the device-probe state machine (crypto/batch.device_status — a
+    read that never triggers a probe) and, when a prober is running
+    in-process, its per-tier health.  Returns True/False when those
+    surfaces are conclusive, None when only the subprocess probe can
+    tell (status "unknown"/"probing", no prober)."""
+    try:
+        from cometbft_tpu.crypto import batch as _batch
+        from cometbft_tpu.crypto import health as _health
+
+        status = _batch.device_status()["status"]
+        if status == "failed":
+            return False
+        prober = _health._ACTIVE_PROBER
+        if prober is not None:
+            tiers = prober.snapshot()["tiers"]
+            device = {
+                t: s for t, s in tiers.items() if t != "host"
+            }
+            if device:
+                return any(s.get("healthy") for s in device.values())
+        if status == "ready":
+            return True
+    except Exception:  # noqa: BLE001 — the subprocess probe decides
+        pass
+    return None
+
+
+def wait_for_window(
+    poll_interval: float, max_wait: float
+) -> float | None:
+    """Park until the tunnel answers; returns the seconds waited, or
+    None when ``max_wait`` elapsed first.  Polls the cheap in-process
+    surfaces before paying a subprocess probe each round."""
+    t0 = time.time()
+    while True:
+        up = device_looks_up()
+        if up is not False and probe():
+            return time.time() - t0
+        waited = time.time() - t0
+        if waited + poll_interval > max_wait:
+            return None
+        print(
+            f"tunnel still down after {waited:.0f}s; next poll in "
+            f"{poll_interval:.0f}s",
+            file=sys.stderr,
+        )
+        time.sleep(poll_interval)
+
+
+def _note_resume(waited_s: float, next_step: str) -> None:
+    """The resume is a flight event + span: the provenance trail shows
+    when the window opened and what the wait cost."""
+    try:
+        from cometbft_tpu.utils.flight import FLIGHT
+        from cometbft_tpu.utils.trace import TRACER
+
+        FLIGHT.record(
+            "campaign/resume", waited_s=round(waited_s, 1),
+            next_step=next_step,
+        )
+        with TRACER.span(
+            "campaign/resume", cat="bench",
+            waited_s=round(waited_s, 1), next_step=next_step,
+        ):
+            pass
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        print(f"resume flight event failed (ignored): {exc}",
+              file=sys.stderr)
+
+
+def pending_steps(data: dict, steps: list[str], redo: bool) -> list[str]:
+    """Steps without a result yet — the resume point is the first."""
+    out = []
+    for name in steps:
+        done = data["results"].get(name, {})
+        if not redo and "sigs_per_sec_device" in done:
+            continue
+        out.append(name)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", help="run just this step")
     ap.add_argument("--timeout", type=float, default=1500.0)
     ap.add_argument("--redo", action="store_true",
                     help="rerun steps that already have results")
+    ap.add_argument("--auto-resume", action="store_true",
+                    help="park and poll for a tunnel window instead "
+                         "of exiting when the device is down, then "
+                         "resume from the last completed step")
+    ap.add_argument("--poll-interval", type=float, default=60.0,
+                    help="seconds between window polls (--auto-resume)")
+    ap.add_argument("--max-wait", type=float, default=7200.0,
+                    help="give up after this many seconds parked "
+                         "(--auto-resume)")
     args = ap.parse_args()
 
-    if not probe():
-        print("device tunnel not answering; campaign deferred",
-              file=sys.stderr)
-        return 3
     data = load()
     steps = [args.only] if args.only else list(STEPS)
     for name in steps:
-        done = data["results"].get(name, {})
-        if not args.redo and "sigs_per_sec_device" in done:
+        rate = data["results"].get(name, {}).get("sigs_per_sec_device")
+        if not args.redo and rate:
             print(f"{name}: already measured "
-                  f"({done['sigs_per_sec_device']:,.0f} sigs/s), skipping",
+                  f"({rate:,.0f} sigs/s), skipping",
                   file=sys.stderr)
-            continue
-        print(f"{name}: running (timeout {args.timeout:.0f}s)...",
-              file=sys.stderr)
-        entry = run_step(name, args.timeout)
-        entry["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-        data["results"][name] = entry
-        save(data)
-        # the merged store of record is the perf ledger
-        # (tools/perfledger.py): each step's point lands there with
-        # its provenance the moment it is measured, so the trajectory
-        # never again has to be reassembled from per-round files
-        value = entry.get("sigs_per_sec_aggregate") or entry.get(
-            "sigs_per_sec_device"
-        )
-        if value:
-            from tools import perfledger
-
-            perfledger.append_rows(
-                [
-                    dict(
-                        entry, config=name, value=value,
-                        unit="sigs/sec",
-                        measured=entry["measured_at"],
-                    )
-                ],
-                source="device_campaign",
+    # steps attempted since the last park: a step that fails while the
+    # tunnel is UP is a real failure, not a window to wait for — it is
+    # not retried until a fresh window opens (else a broken step would
+    # spin in a tight re-run loop under --auto-resume)
+    attempted: set[str] = set()
+    # steps that got a rate THIS invocation: a resume never re-runs
+    # them, but --redo's claim on PRE-EXISTING results survives a park
+    # (redo steps the park preempted still run when a window opens)
+    measured_now: set[str] = set()
+    while True:
+        pending = [
+            n for n in pending_steps(data, steps, args.redo)
+            if n not in attempted and n not in measured_now
+        ]
+        if not pending:
+            break
+        if not probe():
+            if not args.auto_resume:
+                print("device tunnel not answering; campaign deferred",
+                      file=sys.stderr)
+                return 3
+            waited = wait_for_window(args.poll_interval, args.max_wait)
+            if waited is None:
+                print(f"no tunnel window within {args.max_wait:.0f}s; "
+                      "campaign deferred", file=sys.stderr)
+                dump_trace()
+                return 3
+            attempted.clear()  # a fresh window warrants fresh retries
+            pending = [
+                n for n in pending_steps(data, steps, args.redo)
+                if n not in measured_now
+            ]
+            _note_resume(waited, pending[0] if pending else "(none)")
+            print(f"tunnel window opened after {waited:.0f}s; resuming "
+                  f"at {pending[0] if pending else 'done'}",
+                  file=sys.stderr)
+        interrupted = False
+        for name in pending:
+            print(f"{name}: running (timeout {args.timeout:.0f}s)...",
+                  file=sys.stderr)
+            entry = run_step(name, args.timeout)
+            entry["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+            data["results"][name] = entry
+            save(data)
+            # the merged store of record is the perf ledger
+            # (tools/perfledger.py): each step's point lands there with
+            # its provenance the moment it is measured, so the
+            # trajectory never again has to be reassembled from
+            # per-round files
+            value = entry.get("sigs_per_sec_aggregate") or entry.get(
+                "sigs_per_sec_device"
             )
-        dump_trace()
-        rate = entry.get("sigs_per_sec_device")
-        print(f"{name}: " + (f"{rate:,.0f} sigs/s" if rate else
-                             f"no rate (rc={entry['rc']})"),
-              file=sys.stderr)
-        if not probe(45):
-            print("tunnel went away mid-campaign; stopping here",
-                  file=sys.stderr)
+            if value:
+                from tools import perfledger
+
+                perfledger.append_rows(
+                    [
+                        dict(
+                            entry, config=name, value=value,
+                            unit="sigs/sec",
+                            measured=entry["measured_at"],
+                        )
+                    ],
+                    source="device_campaign",
+                )
             dump_trace()
-            return 4
+            rate = entry.get("sigs_per_sec_device")
+            print(f"{name}: " + (f"{rate:,.0f} sigs/s" if rate else
+                                 f"no rate (rc={entry['rc']})"),
+                  file=sys.stderr)
+            attempted.add(name)
+            if rate:
+                measured_now.add(name)
+            if not probe(45):
+                if not args.auto_resume:
+                    print("tunnel went away mid-campaign; stopping here",
+                          file=sys.stderr)
+                    dump_trace()
+                    return 4
+                # this step's failure (if any) happened while the
+                # tunnel was dying — the next window retries it
+                attempted.discard(name)
+                print("tunnel went away mid-campaign; parking for the "
+                      "next window (--auto-resume)", file=sys.stderr)
+                interrupted = True
+                break
+        if not interrupted:
+            # every remaining step was attempted in this window: what
+            # is still missing a rate failed with the tunnel UP — real
+            # failures, not windows to wait for
+            break
+        # loop: park for the next window, then resume from the first
+        # step still missing a result
     dump_trace()
     print(json.dumps(load(), indent=1))
     return 0
