@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -155,6 +155,18 @@ pipeline-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_dispatch.py \
 		-k "ChaosLivenessNode" -q
+
+# ingest smoke: the device-batched CheckTx liveness proof (ISSUE 10)
+# — a single-validator node under closed-loop admission saturation
+# (signed txs through the VerifyQueue ingest lane, small mempool cap)
+# must commit strictly-increasing heights while admission SHEDS
+# (nonzero MempoolFullError/duplicate counters on /metrics): degrade
+# by load shed, never by consensus stall.  Tier-1 runs the full
+# tests/test_ingest.py suite too; `make test` gates on this target
+# alongside the other smokes
+ingest-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ingest.py \
+		-k "IngestSmoke" -q
 
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —

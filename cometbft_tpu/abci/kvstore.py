@@ -56,6 +56,7 @@ SNAPSHOT_CHUNK_SIZE = 65536
 
 _CODE_INVALID_FORMAT = 1
 _CODE_INVALID_POWER = 2
+_CODE_BAD_SIGNATURE = 3
 
 
 class KVStoreApp(Application):
@@ -132,7 +133,50 @@ class KVStoreApp(Application):
             return None
         return pub, power
 
+    @staticmethod
+    def _open_envelope(
+        tx: bytes,
+    ) -> tuple[bytes, CheckTxResponse | None]:
+        """``(payload, error)`` for the mempool's signed-admission
+        envelope (mempool/ingest.py).  The signature is VERIFIED here,
+        not just stripped: the mempool pre-checks it at admission, but
+        a byzantine proposer can put a forged envelope straight into a
+        block — the admission guarantee must survive block inclusion,
+        so process_proposal/execute re-check it at the app seam.  A
+        plain tx returns ``(tx, None)``; a malformed or forged
+        envelope returns the rejection the caller must surface."""
+        from cometbft_tpu.crypto.ed25519 import Ed25519PubKey
+        from cometbft_tpu.mempool import ingest as _ingest
+
+        try:
+            parsed = _ingest.parse_signed_tx(tx)
+        except _ingest.MalformedSignedTx as exc:
+            return tx, CheckTxResponse(
+                code=_CODE_BAD_SIGNATURE, log=str(exc)
+            )
+        if parsed is None:
+            return tx, None
+        pub, sig, payload = parsed
+        try:
+            pk = Ed25519PubKey(pub)
+        except ValueError as exc:
+            return payload, CheckTxResponse(
+                code=_CODE_BAD_SIGNATURE, log=str(exc)
+            )
+        if not pk.verify_signature(_ingest.sign_bytes(payload), sig):
+            return payload, CheckTxResponse(
+                code=_CODE_BAD_SIGNATURE,
+                log="invalid admission signature",
+            )
+        return payload, None
+
     def _check_tx(self, tx: bytes) -> CheckTxResponse:
+        tx, env_err = self._open_envelope(tx)
+        if env_err is not None:
+            return env_err
+        return self._check_payload(tx)
+
+    def _check_payload(self, tx: bytes) -> CheckTxResponse:
         try:
             text = tx.decode()
         except UnicodeDecodeError:
@@ -204,10 +248,13 @@ class KVStoreApp(Application):
         )
 
     def _exec_tx(self, tx: bytes) -> ExecTxResult:
-        check = self._check_tx(tx)
+        # open (and verify) the envelope ONCE; check + execute the
+        # payload it carried
+        payload, env_err = self._open_envelope(tx)
+        check = env_err or self._check_payload(payload)
         if check.code != 0:
             return ExecTxResult(code=check.code, log=check.log)
-        text = tx.decode()
+        text = payload.decode()
         if text.startswith(VALIDATOR_TX_PREFIX):
             pub, power = self._parse_validator_tx(text)
             key = base64.b64encode(pub).decode()

@@ -312,9 +312,23 @@ def cmd_light(args) -> int:
 
 
 def cmd_load(args) -> int:
-    """(test/loadtime/cmd/load) — generate timestamped tx load."""
-    from cometbft_tpu.loadtime import Loader
+    """(test/loadtime/cmd/load) — generate timestamped tx load, or
+    with ``--sustained`` the closed-loop ramp harness (ISSUE 10)."""
+    from cometbft_tpu.loadtime import Loader, SustainedLoader, parse_ramp
 
+    if args.sustained:
+        loader = SustainedLoader(
+            endpoints=[
+                e for e in args.endpoints.split(",") if e.strip()
+            ],
+            workers=args.workers,
+            tx_size=args.size,
+            signed=args.signed,
+            broadcast=args.broadcast_method,
+        )
+        report = loader.run(parse_ramp(args.sustained))
+        print(json.dumps(report))
+        return 0 if report["errors"] == 0 else 1
     loader = Loader(
         endpoints=[e for e in args.endpoints.split(",") if e.strip()],
         rate=args.rate,
@@ -793,6 +807,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--connections", type=int, default=1)
     p.add_argument("--duration", type=float, default=60.0, help="seconds")
     p.add_argument("--broadcast-method", default="broadcast_tx_sync")
+    p.add_argument(
+        "--sustained", default="",
+        help="closed-loop ramp schedule 'rate:seconds,...' (rate 0 = "
+        "saturate); measures admission latency percentiles and "
+        "shed/accept accounting instead of the fixed-rate loader",
+    )
+    p.add_argument("--workers", type=int, default=8,
+                   help="concurrent submitters (sustained mode)")
+    p.add_argument("--signed", action="store_true",
+                   help="wrap payloads in the signed admission "
+                   "envelope (mempool/ingest.py) — exercises the "
+                   "device-batched CheckTx plane")
     p.set_defaults(fn=cmd_load)
 
     p = sub.add_parser(

@@ -784,8 +784,37 @@ class LadderHostVerifier(_ed.CpuBatchVerifier):
 def debug_dispatch_payload() -> dict:
     """Everything ``/debug/dispatch`` serves: ladder order + per-tier
     state (demoted, cool-downs, streaks), the recent transition trail,
-    and the chaos plan (docs/dispatch_ladder.md)."""
-    return {"ladder": LADDER.snapshot(), "chaos": CHAOS.snapshot()}
+    the chaos plan (docs/dispatch_ladder.md), and the perf ledger's
+    latest MEASURED sigs/s per tier next to the configured order —
+    with an explicit contradiction list whenever a tier the ladder
+    prefers measures slower than one below it (the r05
+    host-Pippenger-beats-generic shape), so an operator can see at a
+    glance when configuration and evidence disagree."""
+    from cometbft_tpu.crypto.health import measured_tier_throughput
+
+    measured = measured_tier_throughput()
+    contradictions = []
+    for i, hi in enumerate(TIER_ORDER):
+        if hi not in measured:
+            continue
+        for lo in TIER_ORDER[i + 1:]:
+            if lo not in measured:
+                continue
+            hi_v = measured[hi]["sigs_per_sec"]
+            lo_v = measured[lo]["sigs_per_sec"]
+            if lo_v > hi_v:
+                contradictions.append({
+                    "preferred": hi,
+                    "preferred_sigs_per_sec": hi_v,
+                    "faster": lo,
+                    "faster_sigs_per_sec": lo_v,
+                })
+    return {
+        "ladder": LADDER.snapshot(),
+        "chaos": CHAOS.snapshot(),
+        "measured_tier_throughput": measured,
+        "order_contradictions": contradictions,
+    }
 
 
 __all__ = [

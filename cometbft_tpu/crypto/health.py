@@ -766,6 +766,31 @@ def perf_ledger_tail(n: int = 10) -> list[dict]:
         return []
 
 
+def measured_tier_throughput() -> dict[str, dict]:
+    """Latest MEASURED sigs/s per dispatch tier from the perf ledger —
+    the r05 lesson (host Pippenger outran the generic device path)
+    made concrete: the static ladder order is a configuration, these
+    numbers are evidence.  Ledger append order is recency (same-key
+    replaces move to the end), so a later row for a tier wins; zero
+    values are skipped (the ledger records device-down rounds as 0 —
+    availability, not performance)."""
+    out: dict[str, dict] = {}
+    for e in perf_ledger_tail(0):  # 0 = the whole ledger, in order
+        tier = e.get("dispatch_tier")
+        if not tier or e.get("unit") != "sigs/sec":
+            continue
+        val = e.get("value")
+        if not isinstance(val, (int, float)) or val <= 0:
+            continue
+        out[tier] = {
+            "sigs_per_sec": val,
+            "config": e.get("config"),
+            "source": e.get("source"),
+            "measured": e.get("measured"),
+        }
+    return out
+
+
 def debug_perf_payload(ledger_tail_n: int = 10) -> dict:
     """Everything ``/debug/perf`` serves: tier health + last probe
     latencies, watchdog state, utilization gauges, device-probe
@@ -803,5 +828,6 @@ __all__ = [
     "health_interval_from_env",
     "launch_budget_from_env",
     "perf_ledger_path",
+    "measured_tier_throughput",
     "perf_ledger_tail",
 ]

@@ -6,8 +6,9 @@ plane shows the device idle between commits — the gap to the BASELINE
 north star is launch overlap, not kernel speed (ROADMAP item 2).  This
 module closes it at the ``TpuBatchVerifier`` seam: a process-wide
 ``VerifyQueue`` accepts verification requests from any caller
-(consensus ``VoteSet.add_vote``, blocksync replay prefetch, later the
-mempool CheckTx plane — ROADMAP item 4 reuses this seam), coalesces
+(consensus ``VoteSet.add_vote``, blocksync replay prefetch, and the
+mempool CheckTx ingest lane — ROADMAP item 4's admission plane,
+``CListMempool._verify_tx_signature``), coalesces
 them into device-sized batches, and keeps **two buffers in flight**:
 
 - a *collector* thread drains pending requests, computes the SHA-512
@@ -60,6 +61,19 @@ utils/flight.py):
   ~10 MB and covers a fully speculated 10k-validator commit 6x over).
 - ``CMT_TPU_VERIFY_QUEUE=0`` — node assembly skips the queue entirely
   (every caller takes the synchronous path, exactly as before).
+- ``CMT_TPU_CHECKTX_BATCH`` — ingest-lane accumulation target in
+  signatures (default 256, >= 1): concurrent mempool CheckTx
+  submissions coalesce until this many are pending, then release as
+  ONE buffer (one DispatchLadder launch).
+- ``CMT_TPU_CHECKTX_WAIT_MS`` — ingest accumulation deadline in
+  milliseconds (default 5, >= 0): the oldest pending CheckTx
+  signature never waits longer than this for the batch to fill.
+
+The ``ingest`` lane (ROADMAP item 4, the mempool admission plane) is
+the lowest priority: consensus and prefetch buffers strictly preempt
+it at buffer granularity, and its requests additionally accumulate
+behind the micro-batcher gate above — mempool admission soaks up
+device idle time between commits without ever delaying a vote.
 
 Observability: ``crypto_verify_queue_*`` metrics (CryptoMetrics),
 ``verify_queue/prepare`` + ``verify_queue/launch`` spans (the overlap
@@ -85,13 +99,23 @@ from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.trace import TRACER as _tracer
 
 #: request priorities (metric label values); consensus preempts
-#: prefetch at both the collector and the launcher
+#: prefetch, and both strictly preempt the mempool ``ingest`` lane at
+#: both the collector and the launcher (buffer granularity — a
+#: prepared consensus buffer launches before a parked ingest buffer)
 PRIORITY_CONSENSUS = "consensus"
 PRIORITY_PREFETCH = "prefetch"
-_PRIORITIES = (PRIORITY_CONSENSUS, PRIORITY_PREFETCH)
+PRIORITY_INGEST = "ingest"
+_PRIORITIES = (PRIORITY_CONSENSUS, PRIORITY_PREFETCH, PRIORITY_INGEST)
 
 DEFAULT_PREFETCH_DEPTH = 8
 DEFAULT_SPEC_CACHE_CAP = 65536
+#: ingest micro-batcher: accumulate concurrent CheckTx submissions
+#: until this many signatures are pending (one DispatchLadder launch
+#: instead of one per RPC thread) ...
+DEFAULT_CHECKTX_BATCH = 256
+#: ... or until the OLDEST pending ingest request has waited this many
+#: milliseconds — the admission-latency bound a half-full batch pays
+DEFAULT_CHECKTX_WAIT_MS = 5
 #: largest coalesced batch — matches ops/ed25519_verify.MAX_LAUNCH's
 #: default so one queue batch is one device launch
 DEFAULT_MAX_BATCH = 8192
@@ -111,6 +135,19 @@ def spec_cache_capacity_from_env() -> int:
     caches evict a large commit mid-verify and the speculative plane
     silently degrades to all-miss)."""
     return _int_env("CMT_TPU_SPEC_CACHE", DEFAULT_SPEC_CACHE_CAP, 1024)
+
+
+def checktx_batch_from_env() -> int:
+    """Ingest-lane accumulation target in signatures (>= 1; 1 disables
+    coalescing — every CheckTx submission releases immediately)."""
+    return _int_env("CMT_TPU_CHECKTX_BATCH", DEFAULT_CHECKTX_BATCH, 1)
+
+
+def checktx_wait_ms_from_env() -> int:
+    """Ingest-lane accumulation deadline in milliseconds (>= 0; 0
+    releases every pending ingest batch immediately, whatever its
+    size)."""
+    return _int_env("CMT_TPU_CHECKTX_WAIT_MS", DEFAULT_CHECKTX_WAIT_MS, 0)
 
 
 class QueueUnavailable(RuntimeError):
@@ -216,7 +253,7 @@ class SpeculativeCache:
 
 
 class _Request:
-    __slots__ = ("pub_key", "msg", "sig", "future", "key")
+    __slots__ = ("pub_key", "msg", "sig", "future", "key", "t")
 
     def __init__(self, pub_key, msg: bytes, sig: bytes) -> None:
         self.pub_key = pub_key
@@ -224,6 +261,10 @@ class _Request:
         self.sig = sig
         self.future = VerifyFuture()
         self.key: bytes | None = None  # prehash, set by the collector
+        #: arrival time (monotonic) — the ingest micro-batcher's
+        #: accumulation deadline is measured from the OLDEST pending
+        #: request, so a half-full batch never waits past the bound
+        self.t = time.monotonic()
 
 
 class _Prepared:
@@ -256,7 +297,7 @@ class VerifyQueue(BaseService):
     _GUARDED_BY = {
         "_pending": "_qmtx",
         "_prepared": "_qmtx",
-        "_preparing": "_qmtx",
+        "_preparing_lane": "_qmtx",
         "_draining": "_qmtx",
         "_launch_active": "_qmtx",
         "_launch_t0": "_qmtx",
@@ -275,6 +316,8 @@ class VerifyQueue(BaseService):
         max_batch: int = DEFAULT_MAX_BATCH,
         spec_cache: SpeculativeCache | None = None,
         use_cache: bool = True,
+        checktx_batch: int | None = None,
+        checktx_wait_ms: int | None = None,
         logger: Logger | None = None,
     ) -> None:
         super().__init__(
@@ -286,6 +329,17 @@ class VerifyQueue(BaseService):
         self._factory = verifier_factory
         self._launch = launch
         self._max_batch = max_batch
+        #: ingest micro-batcher tunables (module docstring): pending
+        #: ingest requests accumulate until this many are queued or
+        #: the oldest has waited this long, then release as ONE buffer
+        self._checktx_batch = (
+            checktx_batch if checktx_batch is not None
+            else checktx_batch_from_env()
+        )
+        self._checktx_wait_s = (
+            checktx_wait_ms if checktx_wait_ms is not None
+            else checktx_wait_ms_from_env()
+        ) / 1000.0
         self.cache = (
             (spec_cache or SpeculativeCache()) if use_cache else None
         )
@@ -300,11 +354,14 @@ class VerifyQueue(BaseService):
         self._prepared: dict[str, deque[_Prepared]] = {
             p: deque() for p in _PRIORITIES
         }
-        #: True from the moment _next_pending pops a batch until the
-        #: collector parks (or abandons) its prepared buffer — without
+        #: the lane being prepared, from the moment _next_pending pops
+        #: a batch until the collector parks (or abandons) its
+        #: prepared buffer; None when idle.  Lane-aware (not a bool)
+        #: so busy() can ignore an INGEST buffer mid-prepare while
+        #: still covering the consensus/prefetch prep window — without
         #: it busy() goes dark for the whole prep phase and a consensus
         #: vote parks behind the prefetch batch being prepared
-        self._preparing = False
+        self._preparing_lane: str | None = None
         self._draining = False
         self._launch_active = 0
         self._launch_t0 = 0.0
@@ -336,17 +393,33 @@ class VerifyQueue(BaseService):
         return self.is_running() and not draining
 
     def busy(self) -> bool:
-        """True while any buffer is pending, prepared, or launching.
-        Latency-sensitive callers (a live consensus vote) use this to
-        verify INLINE instead of parking behind an in-flight prefetch
-        launch — priority preemption reorders queued buffers but can
-        never interrupt the launch already on the device."""
+        """True while work a consensus vote could get stuck behind is
+        pending, prepared, preparing, or launching.  Latency-sensitive
+        callers (a live consensus vote) use this to verify INLINE
+        instead of parking — priority preemption reorders queued
+        buffers but can never interrupt the launch already on the
+        device.
+
+        QUEUED ingest work (accumulating requests, a parked ingest
+        buffer, an ingest buffer mid-prepare) is deliberately
+        excluded: it is exactly what consensus preempts, so a mempool
+        under sustained admission load must not push every live vote
+        onto the inline path by itself.  An ingest launch ALREADY ON
+        THE DEVICE still counts — it cannot be interrupted, and
+        waiting a full launch wall behind it is what this check
+        exists to avoid; while admission keeps the device saturated,
+        live votes therefore verify inline at pre-queue latency (the
+        designed degradation — never a stall)."""
         with self._qmtx:
             return bool(
                 self._launch_active
-                or self._preparing
-                or any(self._pending.values())
-                or any(self._prepared.values())
+                or self._preparing_lane in (
+                    PRIORITY_CONSENSUS, PRIORITY_PREFETCH
+                )
+                or any(
+                    self._pending[p] or self._prepared[p]
+                    for p in (PRIORITY_CONSENSUS, PRIORITY_PREFETCH)
+                )
             )
 
     def submit_many(
@@ -413,20 +486,52 @@ class VerifyQueue(BaseService):
 
     # -- the collector (host phase: buffer N+1) --------------------------
 
+    def _ingest_ready(self, now: float | None = None) -> bool:  # holds _qmtx
+        """Ingest accumulation gate (holds _qmtx): a pending ingest
+        batch releases when it reaches the size target, when the
+        oldest request hits the wait deadline, or on drain — never
+        before, so concurrent CheckTx calls coalesce into one
+        DispatchLadder launch instead of one launch per RPC thread."""
+        lane = self._pending[PRIORITY_INGEST]
+        if not lane:
+            return False
+        if self._draining or len(lane) >= self._checktx_batch:
+            return True
+        now = time.monotonic() if now is None else now
+        return now - lane[0].t >= self._checktx_wait_s
+
+    def _ingest_deadline_wait(self) -> float:
+        """How long the collector may sleep before the oldest pending
+        ingest request's accumulation deadline expires (holds no
+        lock — called from the collector's idle loop only)."""
+        with self._qmtx:
+            lane = self._pending[PRIORITY_INGEST]
+            if not lane:
+                return 0.05
+            remaining = self._checktx_wait_s - (
+                time.monotonic() - lane[0].t
+            )
+        return max(0.001, min(0.05, remaining))
+
     def _next_pending(self) -> tuple[list[_Request] | None, str | None]:
-        """Pop the next batch worth of requests: consensus first
-        (preemption), and only for a priority lane whose prepared slot
-        is free (the double-buffer bound).  Sets ``_preparing`` under
-        the same lock as the pop so busy() never misses the batch
-        between dequeue and the prepared-slot append."""
+        """Pop the next batch worth of requests: consensus first, then
+        prefetch, then ingest (strict preemption), and only for a
+        priority lane whose prepared slot is free (the double-buffer
+        bound).  The ingest lane additionally holds until its
+        micro-batch accumulation gate opens (``_ingest_ready``).  Sets
+        ``_preparing_lane`` under the same lock as the pop so busy() never
+        misses the batch between dequeue and the prepared-slot
+        append."""
         with self._qmtx:
             for p in _PRIORITIES:
+                if p == PRIORITY_INGEST and not self._ingest_ready():
+                    continue
                 if self._pending[p] and not self._prepared[p]:
                     take = min(len(self._pending[p]), self._max_batch)
                     reqs = [
                         self._pending[p].popleft() for _ in range(take)
                     ]
-                    self._preparing = True
+                    self._preparing_lane = p
                     _crypto_metrics().verify_queue_depth.labels(
                         priority=p
                     ).set(len(self._pending[p]))
@@ -445,7 +550,10 @@ class VerifyQueue(BaseService):
             if reqs is None:
                 if self._idle_done():
                     return
-                self._collector_wake.wait(0.05)
+                # sleep no longer than the nearest ingest accumulation
+                # deadline — the default CheckTx wait bound (5 ms) is
+                # finer than the idle poll interval
+                self._collector_wake.wait(self._ingest_deadline_wait())
                 self._collector_wake.clear()
                 continue
             try:
@@ -470,10 +578,11 @@ class VerifyQueue(BaseService):
                 self._launcher_wake.set()
             finally:
                 # clear AFTER the prepared-slot append (or abandon):
-                # between pop and here busy() sees _preparing, after
-                # the append it sees the prepared buffer — no window
+                # between pop and here busy() sees _preparing_lane,
+                # after the append it sees the prepared buffer — no
+                # window
                 with self._qmtx:
-                    self._preparing = False
+                    self._preparing_lane = None
 
     def _prepare(self, reqs: list[_Request], priority: str) -> _Prepared:
         """Host phase for one buffer: cache-key prehash, speculative
@@ -870,6 +979,49 @@ def verify_or_fallback(
     return out
 
 
+def checktx_verify_or_fallback(
+    items, timeout: float = DEFAULT_WAIT_S,
+) -> tuple[list[bool], int]:
+    """Mempool admission: verify ``(pub_key, msg, sig)`` tuples through
+    the queue's low-priority ``ingest`` lane — the micro-batcher
+    coalesces concurrent CheckTx calls into single DispatchLadder
+    launches — with the same STRICT sync fallback the vote path has:
+    queue off, draining, a failed batch, or a wait timeout degrades to
+    the inline ``pub_key.verify_signature`` call, never a stall and
+    never a dropped tx.
+
+    Unlike consensus, ingest callers DO park behind in-flight work
+    (no ``busy()`` bypass): admission is latency-tolerant by design,
+    and waiting is what lets the accumulator fill.  Verdicts land in
+    the speculative cache, so a tx re-submitted across peers (or hit
+    again at recheck) resolves without a second launch.
+
+    Returns ``(results, n_inline)`` — how many of the items actually
+    degraded to the inline path, so the caller's batched/inline route
+    metrics report what verified each signature, not what was merely
+    attempted."""
+    q = _QUEUE
+    if q is None:
+        return _verify_inline(None, items), len(items)
+    try:
+        futs = q.submit_many(items, PRIORITY_INGEST)
+    except QueueUnavailable:
+        return _verify_inline(q, items), len(items)
+    out: list[bool] = []
+    n_inline = 0
+    # one shared deadline, same rationale as verify_or_fallback
+    deadline = time.monotonic() + timeout
+    for (pk, msg, sig), fut in zip(items, futs):
+        try:
+            out.append(
+                fut.result(max(0.0, deadline - time.monotonic()))
+            )
+        except QueueUnavailable:
+            out.append(pk.verify_signature(msg, sig))
+            n_inline += 1
+    return out, n_inline
+
+
 def submit_prefetch(items) -> int:
     """Fire-and-forget prefetch submission (blocksync replay, the
     consensus proposal's last_commit): results land in the speculative
@@ -887,12 +1039,18 @@ def submit_prefetch(items) -> int:
 
 
 __all__ = [
+    "DEFAULT_CHECKTX_BATCH",
+    "DEFAULT_CHECKTX_WAIT_MS",
     "DEFAULT_MAX_BATCH",
     "DEFAULT_PREFETCH_DEPTH",
     "DEFAULT_SPEC_CACHE_CAP",
     "PRIORITY_CONSENSUS",
+    "PRIORITY_INGEST",
     "PRIORITY_PREFETCH",
     "QueueUnavailable",
+    "checktx_batch_from_env",
+    "checktx_verify_or_fallback",
+    "checktx_wait_ms_from_env",
     "SpeculativeCache",
     "VerifyFuture",
     "VerifyQueue",

@@ -110,7 +110,13 @@ def make_tx(
 
 
 def parse_tx(tx: bytes) -> Payload | None:
-    """Inverse of make_tx; None for non-loadtime txs."""
+    """Inverse of make_tx; None for non-loadtime txs.  Signed-envelope
+    txs (SustainedLoader ``signed=True``) are unwrapped first so the
+    block-store report sees the loadtime payload inside."""
+    if tx.startswith(b"stx:"):
+        from cometbft_tpu.mempool.ingest import signed_tx_payload
+
+        tx = signed_tx_payload(tx)
     if not tx.startswith(_MAGIC):
         return None
     _, sep, value = tx.partition(b"=")
@@ -213,6 +219,231 @@ class Loader:
                 time.sleep(delay)
             else:
                 next_send = time.monotonic()  # fell behind: don't burst
+
+
+def parse_ramp(spec: str) -> list[tuple[int, float]]:
+    """``rate:seconds,rate:seconds,...`` → schedule steps.  Rate 0
+    means UNTHROTTLED (closed-loop saturation: every worker submits as
+    fast as admission answers).  Raises ValueError loudly on malformed
+    specs — a load experiment with a silently-wrong schedule produces
+    confidently-wrong numbers."""
+    steps: list[tuple[int, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rate_s, sep, dur_s = part.partition(":")
+        if not sep:
+            raise ValueError(
+                f"ramp step {part!r}: expected rate:seconds"
+            )
+        rate, dur = int(rate_s), float(dur_s)
+        if rate < 0 or dur <= 0:
+            raise ValueError(
+                f"ramp step {part!r}: rate >= 0 and seconds > 0"
+            )
+        steps.append((rate, dur))
+    if not steps:
+        raise ValueError(f"empty ramp spec {spec!r}")
+    return steps
+
+
+class SustainedLoader:
+    """Closed-loop sustained-load generator (ISSUE 10 — the harness
+    that proves the ingest plane degrades by SHEDDING, not stalling).
+
+    Where :class:`Loader` fires at a fixed rate and walks away, this
+    one runs a ramp *schedule* of (rate, duration) steps and measures
+    the admission path itself: per-tx round-trip latency percentiles,
+    accepted/shed/error accounting per step, and the achieved rate.
+    Rate 0 in a step means closed-loop saturation — each worker keeps
+    exactly one request in flight, so offered load tracks whatever the
+    node can absorb and the overflow shows up as SHED (MempoolFullError
+    / cache rejections), which is the liveness property the
+    ``ingest-smoke`` drive pins.
+
+    Two transports: ``submit`` (a callable ``submit(tx) -> None``,
+    raising on rejection — e.g. ``node.mempool.check_tx`` for an
+    in-process drive) or ``endpoints`` (RPC HTTP, ``broadcast_tx_sync``
+    like the reference loadtime tool).  ``signed=True`` wraps every
+    payload in the mempool/ingest.py envelope so the drive exercises
+    the device-batched signature-admission path."""
+
+    def __init__(
+        self,
+        submit=None,
+        endpoints: list[str] | None = None,
+        workers: int = 8,
+        tx_size: int = 256,
+        signed: bool = False,
+        signer_keys: int = 16,
+        broadcast: str = "broadcast_tx_sync",
+    ):
+        if submit is None and not endpoints:
+            raise ValueError("need a submit callable or endpoints")
+        self._submit = submit
+        self._clients = []
+        if submit is None:
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            self._clients = [
+                HTTPClient(e if "://" in e else f"http://{e}")
+                for e in endpoints
+            ]
+            self._broadcast = broadcast
+        self.workers = workers
+        self.tx_size = tx_size
+        self.experiment_id = uuid.uuid4().bytes
+        self._privs = None
+        if signed:
+            from cometbft_tpu.crypto import ed25519 as _ed
+
+            self._privs = [
+                _ed.priv_key_from_secret(b"sustained-%d" % i)
+                for i in range(max(1, signer_keys))
+            ]
+        self._seq = 0
+        self._mtx = cmtsync.Mutex()
+
+    def _next_seq(self) -> int:
+        with self._mtx:
+            self._seq += 1
+            return self._seq
+
+    def _make_tx(self, rate: int) -> bytes:
+        seq = self._next_seq()
+        tx = make_tx(
+            self.experiment_id, seq, rate, self.workers, self.tx_size
+        )
+        if self._privs is not None:
+            from cometbft_tpu.mempool import ingest as _ingest
+
+            tx = _ingest.make_signed_tx(
+                self._privs[seq % len(self._privs)], tx
+            )
+        return tx
+
+    def _send(self, worker: int, tx: bytes) -> str:
+        """One submission; returns 'accepted' | 'shed' | 'error'."""
+        if self._submit is not None:
+            from cometbft_tpu.mempool import (
+                MempoolFullError,
+                TxInCacheError,
+            )
+
+            try:
+                self._submit(tx)
+                return "accepted"
+            except (MempoolFullError, TxInCacheError):
+                return "shed"  # load shed, NOT a failure — the point
+            except Exception:  # noqa: BLE001 — node down/overloaded
+                return "error"
+        client = self._clients[worker % len(self._clients)]
+        try:
+            resp = getattr(client, self._broadcast)(tx=tx.hex())
+            code = int((resp or {}).get("code", 0))
+            # a nonzero code is the APP rejecting the tx — that is a
+            # failure of the offered load, not capacity shedding; a
+            # harness that counted it as shed would read systematic
+            # rejection as healthy degradation and exit 0
+            return "accepted" if code == 0 else "error"
+        except Exception as exc:  # noqa: BLE001
+            # broadcast_tx_sync surfaces mempool rejections as RPC
+            # errors — ONLY full/duplicate are load shed, the rest
+            # (app rejection, signature, node down) are real errors
+            text = str(exc)
+            if "full" in text or "cache" in text:
+                return "shed"
+            return "error"
+
+    def run(self, schedule: list[tuple[int, float]]) -> dict:
+        """Run the ramp schedule; returns the full report (per-step
+        rows + aggregate latency percentiles)."""
+        steps = []
+        for rate, duration in schedule:
+            steps.append(self._run_step(rate, duration))
+        lat = ExperimentReport(experiment_id=self.experiment_id.hex())
+        for st in steps:
+            for ns in st.pop("_latencies"):
+                lat.add(ns)
+        total = {
+            k: sum(st[k] for st in steps)
+            for k in ("accepted", "shed", "errors")
+        }
+        span = sum(st["duration_s"] for st in steps)
+        return {
+            "experiment_id": self.experiment_id.hex(),
+            "workers": self.workers,
+            "tx_size": self.tx_size,
+            "signed": self._privs is not None,
+            "steps": steps,
+            "accepted": total["accepted"],
+            "shed": total["shed"],
+            "errors": total["errors"],
+            "accepted_per_sec": round(total["accepted"] / span, 1)
+            if span > 0 else 0.0,
+            "latency_p50_s": lat.percentile_ns(0.50) / 1e9,
+            "latency_p95_s": lat.percentile_ns(0.95) / 1e9,
+            "latency_p99_s": lat.percentile_ns(0.99) / 1e9,
+            "latency_max_s": lat.max_ns / 1e9,
+        }
+
+    def _run_step(self, rate: int, duration: float) -> dict:
+        stop = time.monotonic() + duration
+        counts = {"accepted": 0, "shed": 0, "errors": 0}
+        latencies: list[int] = []
+        mtx = cmtsync.Mutex()
+
+        def worker(idx: int, per_worker_rate: float) -> None:
+            interval = (
+                1.0 / per_worker_rate if per_worker_rate > 0 else 0.0
+            )
+            next_send = time.monotonic()
+            while True:
+                now = time.monotonic()
+                if now >= stop:
+                    return
+                if interval:
+                    if now < next_send:
+                        time.sleep(min(next_send - now, stop - now))
+                        continue
+                    next_send += interval
+                tx = self._make_tx(rate)
+                t0 = time.perf_counter_ns()
+                outcome = self._send(idx, tx)
+                dt = time.perf_counter_ns() - t0
+                with mtx:
+                    counts[
+                        "errors" if outcome == "error" else outcome
+                    ] += 1
+                    latencies.append(dt)
+                if interval and next_send < time.monotonic():
+                    next_send = time.monotonic()  # fell behind
+
+        threads = []
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=worker,
+                args=(i, rate / self.workers if rate else 0.0),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        done = sum(counts.values())
+        return {
+            "rate": rate,
+            "duration_s": duration,
+            "accepted": counts["accepted"],
+            "shed": counts["shed"],
+            "errors": counts["errors"],
+            "offered_per_sec": round(done / duration, 1),
+            "accepted_per_sec": round(
+                counts["accepted"] / duration, 1
+            ),
+            "_latencies": latencies,
+        }
 
 
 @dataclass

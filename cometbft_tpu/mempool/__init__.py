@@ -10,6 +10,7 @@ txs are re-checked against the new app state (recheck).
 from __future__ import annotations
 
 import threading
+import time
 
 from cometbft_tpu.utils import sync as cmtsync
 from collections import OrderedDict
@@ -41,6 +42,12 @@ class MempoolFullError(MempoolError):
     pass
 
 
+class TxSignatureError(MempoolError):
+    """Signed-tx envelope (mempool/ingest.py) failed admission
+    signature verification — or claimed the envelope and didn't
+    parse."""
+
+
 @dataclass
 class _MempoolTx:
     tx: bytes
@@ -50,9 +57,27 @@ class _MempoolTx:
     senders: set = field(default_factory=set)  # peers we got it from
 
 
+DEFAULT_TXCACHE_SHARDS = 8
+
+
+def txcache_shards_from_env() -> int:
+    """TxCache shard count (>= 1; fail-loudly validated like the ring
+    vars — a malformed value must not silently collapse admission back
+    to one mutex)."""
+    from cometbft_tpu.utils.flight import ring_size_from_env
+
+    return ring_size_from_env(
+        "CMT_TPU_TXCACHE_SHARDS", DEFAULT_TXCACHE_SHARDS, 1
+    )
+
+
 @cmtsync.guarded
-class TxCache:
-    """Fixed-size LRU of recently seen tx hashes (mempool/cache.go)."""
+class _TxCacheShard:
+    """One hash-partitioned shard: its own LRU map under its own
+    mutex.  Keys land on a shard by their first hash byte, so the
+    partition is uniform and a key's shard is stable for its whole
+    cache lifetime (push/has/remove for one tx always contend on the
+    same single mutex — never two)."""
 
     _GUARDED_BY = {"_map": "_mtx"}
 
@@ -61,9 +86,7 @@ class TxCache:
         self._mtx = cmtsync.Mutex()
         self._map: OrderedDict[bytes, None] = OrderedDict()
 
-    def push(self, tx: bytes) -> bool:
-        """Returns False if already present (and refreshes recency)."""
-        key = tx_hash(tx)
+    def push_key(self, key: bytes) -> bool:
         with self._mtx:
             if key in self._map:
                 self._map.move_to_end(key)
@@ -73,27 +96,85 @@ class TxCache:
                 self._map.popitem(last=False)
             return True
 
-    def remove(self, tx: bytes) -> None:
+    def remove_key(self, key: bytes) -> None:
         with self._mtx:
-            self._map.pop(tx_hash(tx), None)
+            self._map.pop(key, None)
 
-    def has(self, tx: bytes) -> bool:
+    def has_key(self, key: bytes) -> bool:
         with self._mtx:
-            return tx_hash(tx) in self._map
+            return key in self._map
 
     def reset(self) -> None:
         with self._mtx:
             self._map.clear()
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._map)
+
+
+class TxCache:
+    """Fixed-size LRU of recently seen tx hashes (mempool/cache.go),
+    hash-partitioned across ``shards`` independent locks so admission
+    at device-batch throughput no longer serializes every CheckTx on
+    one mutex (BENCH_MICRO's cache_push row measured the single-lock
+    cache at ~1.1M ops/s on ONE thread; under concurrent RPC ingest
+    the lock convoy was the ceiling).  Semantics vs the unsharded
+    cache: push/remove/has/reset are identical per key; eviction is
+    LRU *per shard* with total capacity >= ``size`` (each shard holds
+    ceil(size/shards)), so the cache never remembers less than the
+    unsharded one promised.  The tx hash is computed OUTSIDE any lock
+    — the former version hashed under the mutex."""
+
+    def __init__(self, size: int, shards: int | None = None):
+        n = shards if shards is not None else txcache_shards_from_env()
+        # never more shards than capacity: a size-2 cache with 8
+        # shards would evict almost nothing it promised to remember
+        n = max(1, min(n, max(1, size)))
+        per_shard = -(-max(1, size) // n)  # ceil
+        self._shards = tuple(_TxCacheShard(per_shard) for _ in range(n))
+
+    def _shard(self, key: bytes) -> _TxCacheShard:
+        return self._shards[key[0] % len(self._shards)]
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        return self.push_hashed(tx_hash(tx))
+
+    def remove(self, tx: bytes) -> None:
+        self.remove_hashed(tx_hash(tx))
+
+    def has(self, tx: bytes) -> bool:
+        return self.has_hashed(tx_hash(tx))
+
+    # hashed variants: the admission hot path computes tx_hash ONCE in
+    # check_tx and threads the key through every cache touch
+
+    def push_hashed(self, key: bytes) -> bool:
+        return self._shard(key).push_key(key)
+
+    def remove_hashed(self, key: bytes) -> None:
+        self._shard(key).remove_key(key)
+
+    def has_hashed(self, key: bytes) -> bool:
+        return self._shard(key).has_key(key)
+
+    def reset(self) -> None:
+        for shard in self._shards:
+            shard.reset()
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
 
 
 class NopTxCache(TxCache):
     def __init__(self):
         super().__init__(1)
 
-    def push(self, tx: bytes) -> bool:
+    def push_hashed(self, key: bytes) -> bool:
         return True
 
-    def has(self, tx: bytes) -> bool:
+    def has_hashed(self, key: bytes) -> bool:
         return False
 
 
@@ -208,39 +289,113 @@ class CListMempool:
 
     def check_tx(self, tx: bytes, sender: str = "") -> CheckTxResponse:
         """Validate tx via the app and add it
-        (clist_mempool.go:269 CheckTx)."""
+        (clist_mempool.go:269 CheckTx).
+
+        Admission order: size → pre_check → is_full → cache dedupe →
+        envelope signature (mempool/ingest.py, batched through the
+        VerifyQueue's ingest lane) → app CheckTx.  The signature comes
+        AFTER the cache so a duplicate never pays a second verify, and
+        before the app so an invalid sender never costs an ABCI round
+        trip."""
+        m = self.metrics
         if len(tx) > self._max_tx_bytes:
+            m.checktx_total.labels(result="too_large").inc()
             raise TxTooLargeError(
                 f"tx size {len(tx)} exceeds max {self._max_tx_bytes}"
             )
         if self.pre_check is not None:  # unguarded: callable ref, swapped atomically under lock in update()
-            self.pre_check(tx)  # unguarded: same audited read as line above
+            try:
+                self.pre_check(tx)  # unguarded: same audited read as line above
+            except MempoolError:
+                m.checktx_total.labels(result="precheck").inc()
+                raise
         if self.is_full(len(tx)):
+            m.checktx_total.labels(result="full").inc()
             raise MempoolFullError(
                 f"mempool is full: {self.size()} txs"
             )
-        if not self.cache.push(tx):
+        # ONE hash per admission (lock-scope/efficiency audit, ISSUE
+        # 10): computed outside every lock, threaded through the cache
+        # and the map insert
+        key = tx_hash(tx)
+        if not self.cache.push_hashed(key):
             # record the sender even on the duplicate path so the
             # broadcast routine never echoes the tx back to them
             # (clist_mempool.go CheckTx ErrTxInCache branch)
             if sender:
                 with self._mtx:
-                    mt = self._txs.get(tx_hash(tx))
+                    mt = self._txs.get(key)
                     if mt is not None:
                         mt.senders.add(sender)
+            m.checktx_total.labels(result="duplicate").inc()
             raise TxInCacheError("tx already in cache")
+        try:
+            self._verify_tx_signature(tx)
+        except TxSignatureError:
+            m.checktx_total.labels(result="sig").inc()
+            m.failed_txs.inc()
+            if not self._keep_invalid:
+                self.cache.remove_hashed(key)
+            raise
         try:
             res = self._proxy.check_tx(
                 CheckTxRequest(tx=tx, type=CHECK_TX_TYPE_CHECK)
             )
         except BaseException:
-            self.cache.remove(tx)
+            # transport/app failure, not a tx verdict: re-admittable
+            m.checktx_total.labels(result="app").inc()
+            self.cache.remove_hashed(key)
             raise
-        self._handle_check_result(tx, res, sender)
+        self._handle_check_result(tx, res, sender, key)
         return res
 
+    def _verify_tx_signature(self, tx: bytes) -> None:
+        """Admission signature check for enveloped txs (plain txs pass
+        through untouched).  When the process-wide VerifyQueue is
+        accepting, the signature rides the low-priority ``ingest``
+        lane — the micro-batcher coalesces concurrent CheckTx calls
+        into one device launch; any queue problem (off, draining,
+        busy, failed batch) degrades to the same inline
+        ``verify_signature`` call, never a stall and never a dropped
+        tx."""
+        from cometbft_tpu.crypto import ed25519 as _ed
+        from cometbft_tpu.crypto import verify_queue as _vq
+        from cometbft_tpu.mempool import ingest as _ingest
+
+        try:
+            parsed = _ingest.parse_signed_tx(tx)
+        except _ingest.MalformedSignedTx as exc:
+            raise TxSignatureError(str(exc)) from None
+        if parsed is None:
+            return
+        pub, sig, payload = parsed
+        t0 = time.perf_counter()
+        try:
+            pk = _ed.Ed25519PubKey(pub)
+        except ValueError as exc:
+            raise TxSignatureError(str(exc)) from None
+        item = (pk, _ingest.sign_bytes(payload), sig)
+        if _vq.speculation_active():
+            results, n_inline = _vq.checktx_verify_or_fallback([item])
+            ok = results[0]
+            # honest route accounting: a queue that degraded THIS tx
+            # to the inline path mid-call counts as inline, so the
+            # batched/inline pair on /metrics reflects what actually
+            # verified each signature
+            (self.metrics.checktx_inline if n_inline
+             else self.metrics.checktx_batched).inc()
+        else:
+            ok = pk.verify_signature(item[1], sig)
+            self.metrics.checktx_inline.inc()
+        self.metrics.checktx_sig_seconds.observe(
+            time.perf_counter() - t0
+        )
+        if not ok:
+            raise TxSignatureError("invalid tx signature")
+
     def _handle_check_result(
-        self, tx: bytes, res: CheckTxResponse, sender: str
+        self, tx: bytes, res: CheckTxResponse, sender: str,
+        key: bytes | None = None,
     ) -> None:
         """(clist_mempool.go:328 handleCheckTxResponse)"""
         post_err = None
@@ -249,21 +404,32 @@ class CListMempool:
                 self.post_check(tx, res)  # unguarded: same audited read as line above
             except MempoolError as e:
                 post_err = e
+        # lock scope audit (ISSUE 10): ONE hash per admission (reused
+        # from check_tx when available), computed before any lock
+        if key is None:
+            key = tx_hash(tx)
         if res.code != 0 or post_err is not None:
             self.metrics.failed_txs.inc()
+            self.metrics.checktx_total.labels(result="app").inc()
             if not self._keep_invalid:
-                self.cache.remove(tx)
+                self.cache.remove_hashed(key)
             if post_err is not None:
                 raise post_err
             return
         with self._mtx:
             if self.is_full(len(tx)):
-                self.cache.remove(tx)
+                self.cache.remove_hashed(key)
+                self.metrics.checktx_total.labels(result="full").inc()
                 raise MempoolFullError("mempool is full")
-            key = tx_hash(tx)
             if key in self._txs:
                 if sender:
                     self._txs[key].senders.add(sender)
+                # already in the pool (cache evicted the hash while
+                # the tx still sat in _txs): a duplicate admission
+                # outcome — every path lands in exactly one bucket
+                self.metrics.checktx_total.labels(
+                    result="duplicate"
+                ).inc()
                 return
             self._seq += 1
             self._txs[key] = _MempoolTx(
@@ -274,11 +440,15 @@ class CListMempool:
                 senders={sender} if sender else set(),
             )
             self._txs_bytes += len(tx)
+            # the size gauges stay UNDER the lock: snapshot-then-set
+            # outside would let this (older) value overwrite the one a
+            # concurrent update() just published for an emptier pool
             self.metrics.size.set(len(self._txs))
             self.metrics.size_bytes.set(self._txs_bytes)
-            self.metrics.tx_size_bytes.observe(len(tx))
             self._notify_available()
             self._new_tx_cond.notify_all()
+        self.metrics.tx_size_bytes.observe(len(tx))
+        self.metrics.checktx_total.labels(result="accepted").inc()
 
     def _notify_available(self) -> None:  # holds _mtx
         if not self._notified_available and len(self._txs) > 0:

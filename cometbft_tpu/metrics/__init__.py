@@ -107,6 +107,8 @@ class MempoolMetrics:
         if reg is None:
             self.size = self.size_bytes = self.tx_size_bytes = _NOP
             self.failed_txs = self.evicted_txs = self.recheck_times = _NOP
+            self.checktx_total = self.checktx_sig_seconds = _NOP
+            self.checktx_batched = self.checktx_inline = _NOP
             return
         s = "mempool"
         self.size = reg.gauge(s, "size", "Number of uncommitted txs.")
@@ -125,6 +127,32 @@ class MempoolMetrics:
         )
         self.recheck_times = reg.counter(
             s, "recheck_times", "Number of recheck passes."
+        )
+        # -- ingest plane (ISSUE 10): every admission outcome lands in
+        # exactly one checktx_total bucket, so rate(accepted) vs
+        # rate(full+duplicate) IS the shed-not-stall liveness signal
+        # the sustained-load harness asserts
+        self.checktx_total = reg.counter(
+            s, "checktx_total",
+            "CheckTx admissions by outcome (accepted | duplicate | "
+            "full | sig | app | precheck | too_large).",
+            labels=("result",),
+        )
+        self.checktx_sig_seconds = reg.histogram(
+            s, "checktx_sig_seconds",
+            "Admission signature-verification wall per tx, queue wait "
+            "included (signed-envelope txs only).",
+            buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .5),
+        )
+        self.checktx_batched = reg.counter(
+            s, "checktx_batched",
+            "Signed-tx admissions verified through the VerifyQueue "
+            "ingest lane (device-batched).",
+        )
+        self.checktx_inline = reg.counter(
+            s, "checktx_inline",
+            "Signed-tx admissions verified inline on the host (queue "
+            "off/draining — the strict sync fallback).",
         )
 
 
@@ -246,8 +274,15 @@ class RPCMetrics:
             self.response_size_bytes = _NOP
             self.ws_connections = _NOP
             self.ws_subscriptions = _NOP
+            self.checktx_async_dropped = _NOP
             return
         s = "rpc"
+        self.checktx_async_dropped = reg.counter(
+            s, "checktx_async_dropped",
+            "broadcast_tx_async txs dropped at the bounded ingest "
+            "pool's full queue — load shed at the RPC edge (the "
+            "fire-and-forget path promises no admission verdict).",
+        )
         self.requests_total = reg.counter(
             s, "requests_total",
             "JSON-RPC requests dispatched, by route and outcome "

@@ -652,9 +652,18 @@ class Node(BaseService):
         if os.environ.get("CMT_TPU_VERIFY_QUEUE", "1") != "0":
             from cometbft_tpu.crypto.verify_queue import (
                 VerifyQueue,
+                checktx_batch_from_env,
+                checktx_wait_ms_from_env,
                 install_queue,
             )
 
+            # ingest micro-batcher knobs validate OUTSIDE the
+            # degrade-to-sync try below: a malformed
+            # CMT_TPU_CHECKTX_BATCH / CMT_TPU_CHECKTX_WAIT_MS fails
+            # the node LOUDLY (the documented fail-loudly env
+            # contract) instead of silently running un-batched
+            checktx_batch_from_env()
+            checktx_wait_ms_from_env()
             try:
                 self.verify_queue = VerifyQueue(
                     logger=self.logger.with_fields(module="verify_queue")

@@ -67,6 +67,50 @@ def _to_bytes(value, name: str) -> bytes:
             raise RPCError(-32602, f"invalid {name}: not hex/base64") from None
 
 
+class _AsyncTxPool:
+    """Bounded fire-and-forget CheckTx workers for broadcast_tx_async.
+
+    ``workers`` daemon threads drain a queue capped at ``depth`` txs;
+    ``offer`` never blocks — when the queue is full the tx is DROPPED
+    and counted in ``dropped`` (load shed at the RPC edge: async
+    broadcast promises no admission verdict, and clients that need one
+    use broadcast_tx_sync/commit).  Daemon threads mean node stop and
+    interpreter exit never wait behind a backlog."""
+
+    def __init__(self, submit, metrics=None, workers: int = 8,
+                 depth: int = 1024):
+        import queue as _q
+
+        self._submit = submit
+        self._metrics = metrics
+        self._q: "_q.Queue[bytes]" = _q.Queue(maxsize=depth)
+        self._drop_mtx = cmtsync.Mutex()
+        self.dropped = 0
+        for i in range(workers):
+            threading.Thread(
+                target=self._loop, name=f"rpc-checktx-{i}", daemon=True
+            ).start()
+
+    def _loop(self) -> None:
+        while True:
+            self._submit(self._q.get())
+
+    def offer(self, raw: bytes) -> bool:
+        import queue as _q
+
+        try:
+            self._q.put_nowait(raw)
+            return True
+        except _q.Full:
+            with self._drop_mtx:
+                self.dropped += 1
+            if self._metrics is not None:
+                # visible shed: without this the RPC edge drops txs
+                # the checktx_total counters never saw
+                self._metrics.checktx_async_dropped.inc()
+            return False
+
+
 class Environment:
     """(rpc/core/env.go:72 Environment)"""
 
@@ -114,6 +158,16 @@ class Environment:
         self._gen_chunks: list[str] | None = None  # lazy (env.go InitGenesisChunks)
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
         self._subs_mtx = cmtsync.Mutex()
+        #: bounded ingest pool for broadcast_tx_async (lazy): the old
+        #: thread-per-tx spawn was a thread bomb at sustained-load
+        #: rates — thousands of concurrent CheckTx threads convoying
+        #: on the admission path.  A few daemon workers drain a
+        #: BOUNDED queue instead; overflow is DROPPED (counted on the
+        #: pool) — async broadcast is fire-and-forget by contract, and
+        #: an unbounded backlog of tx bytes is a memory bomb plus a
+        #: drain-everything shutdown hang.
+        self._async_pool: _AsyncTxPool | None = None
+        self._async_pool_mtx = cmtsync.Mutex()
 
     # -- route tables (routes.go:15-63) ---------------------------------
 
@@ -667,11 +721,17 @@ class Environment:
 
     # -- broadcast (rpc/core/mempool.go) ----------------------------------
 
+    def _ingest_pool(self) -> "_AsyncTxPool":
+        with self._async_pool_mtx:
+            if self._async_pool is None:
+                self._async_pool = _AsyncTxPool(
+                    self._check_tx_quiet, metrics=self.metrics
+                )
+            return self._async_pool
+
     def broadcast_tx_async(self, tx=None) -> dict:
         raw = _to_bytes(tx, "tx")
-        threading.Thread(
-            target=self._check_tx_quiet, args=(raw,), daemon=True
-        ).start()
+        self._ingest_pool().offer(raw)
         return {"code": 0, "data": "", "log": "", "hash": hexb(tx_hash(raw))}
 
     def _check_tx_quiet(self, raw: bytes) -> None:

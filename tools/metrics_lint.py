@@ -86,6 +86,10 @@ DOC_CHECKED = (
     "CryptoMetrics",
     # an undocumented health series is an alert nobody can act on
     "HealthMetrics",
+    # the ingest plane (ISSUE 10): shed-vs-stall is read from the
+    # mempool admission counters, so every one of them must be
+    # interpretable from the docs
+    "MempoolMetrics",
 )
 
 DOC_FILES = (
