@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -168,6 +168,18 @@ ingest-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ingest.py \
 		-k "IngestSmoke" -q
 
+# light smoke: the serving-plane liveness proof (ISSUE 13) — a
+# single-validator node serving a sustained 10k-client light-sync
+# fleet (light/serve.py through the VerifyQueue light_client lane)
+# must commit strictly-increasing heights with zero loader errors and
+# a measurable header-cache hit rate: serving load stays preempted
+# below consensus, so header batches never park a live vote.  Tier-1
+# runs the full tests/test_light_serve.py suite too; `make test`
+# gates on this target alongside the other smokes
+light-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_light_serve.py \
+		-k "LightSmoke" -q
+
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —
 # deterministic, so it gates `make test`.  Compare two real ledger
@@ -182,8 +194,8 @@ perf-ledger:
 	$(PY) tools/perfledger.py --harvest
 
 native:
-	g++ -O2 -shared -fPIC -std=c++17 native/bls/bls12381.cpp \
-		-o native/build/libcmtbls.so
+	g++ -O3 -march=native -funroll-loops -shared -fPIC -std=c++17 \
+		native/bls/bls12381.cpp -o native/build/libcmtbls.so
 
 fuzz:
 	python tools/fuzz.py --time $${FUZZ_TIME:-60}

@@ -77,6 +77,53 @@ def make_commit_fixture(nvals: int):
     return vals, commit, bid
 
 
+def make_bls_aggregate_fixture(nvals: int):
+    """A commit carrying ONE BLS aggregate signature over its
+    BLOCK_ID_FLAG_COMMIT precommits (types/block.py Commit docstring):
+    every validator signs the shared canonical aggregate message, the
+    per-validator signature fields stay EMPTY, and verification is one
+    pairing-product check — the arXiv:2302.00418 committee shape the
+    ``bls_aggregate_150val`` row measures against ``verify_commit_150``."""
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT,
+        BlockID,
+        Commit,
+        CommitSig,
+        PartSetHeader,
+    )
+    from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+    keys = [
+        bls.priv_key_from_secret(b"agg%d" % i) for i in range(nvals)
+    ]
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    h = bytes(range(32))
+    bid = BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+    msg = Commit(height=1, round=0, block_id=bid).aggregate_sign_bytes(
+        CHAIN_ID
+    )
+    agg = bls.aggregate_signatures([k.sign(msg) for k in ordered])
+    sigs = tuple(
+        CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=k.pub_key().address(),
+            timestamp_ns=0,
+            signature=b"",
+        )
+        for k in ordered
+    )
+    commit = Commit(
+        height=1, round=0, block_id=bid, signatures=sigs,
+        agg_signature=agg,
+    )
+    return vals, commit, bid
+
+
 def make_mixed_commit_fixture(n_ed: int, n_bls: int):
     """A commit signed by n_ed ed25519 + n_bls bls12_381 validators
     (BASELINE config 5's mega-commit shape)."""
@@ -346,6 +393,30 @@ def main() -> None:
     record(
         "verify_commit_150_warm", dt * 1e3, "ms",
         sigs_per_sec=round(150 / dt, 1),
+    )
+
+    # ---- config 2b: BLS aggregate commit @ 150 validators ------------
+    # The side-by-side the ISSUE 13 acceptance pins: the SAME 150-vote
+    # commit shape, carried as one BLS aggregate signature instead of
+    # 150 ed25519 signatures — one pairing-product check
+    # (crypto/bls_dispatch.py, e(agg_pk, H(m)) == e(g1, agg_sig))
+    # against verify_commit_150's batch.  timed()'s warmup builds the
+    # native lib and warms the aggregate-pubkey LRU, so the measured
+    # steady state is the serving-plane shape: repeated commits from a
+    # stable validator set, each paying exactly one pairing.
+    t0 = time.time()
+    vals_agg, commit_agg, bid_agg = make_bls_aggregate_fixture(150)
+    log(f"150-val BLS aggregate fixture in {time.time() - t0:.1f}s")
+
+    def vc_agg():
+        validation.verify_commit(CHAIN_ID, vals_agg, bid_agg, 1, commit_agg)
+
+    dt = timed(vc_agg)
+    record(
+        "bls_aggregate_150val", dt * 1e3, "ms",
+        sigs_per_sec=round(150 / dt, 1),
+        pairing_checks=1,
+        baseline="verify_commit_150",
     )
 
     # ---- config 3: VerifyCommit @ 10k validators ---------------------
@@ -624,6 +695,124 @@ def main() -> None:
         shed=rep["shed"], errors=rep["errors"],
         latency_p50_ms=round(rep["latency_p50_s"] * 1e3, 2),
         latency_p95_ms=round(rep["latency_p95_s"] * 1e3, 2),
+    )
+
+    # ---- config 7: the light-client serving plane at 10k clients -----
+    # The ISSUE 13 heavy-traffic scenario end to end: a header chain
+    # served through light/serve.LightHeaderServer with the verify
+    # queue's light_client lane underneath (micro-batched cross-client
+    # coalescing) and the trust-period-aware header cache in front,
+    # driven by loadtime.LightSyncLoader simulating 10k client
+    # sessions.  The first pass verifies every header (launches); the
+    # sustained phase measures the serving shape — repeat syncs riding
+    # the header cache — with p50/p95 per request and headers/s.
+    from cometbft_tpu.light.provider import Provider as _Provider
+    from cometbft_tpu.light.serve import LightHeaderServer
+    from cometbft_tpu.loadtime import LightSyncLoader
+    from cometbft_tpu.metrics import LightMetrics, install_light_metrics
+    from cometbft_tpu.types.block import (
+        BLOCK_ID_FLAG_COMMIT as _FLAG_COMMIT,
+        BlockID as _BlockID,
+        Commit as _Commit,
+        CommitSig as _CommitSig,
+        Header as _Header,
+        PartSetHeader as _PSH,
+    )
+    from cometbft_tpu.types.light_block import (
+        LightBlock as _LightBlock,
+        SignedHeader as _SignedHeader,
+    )
+    from cometbft_tpu.types import canonical as _canonical
+    from cometbft_tpu.types.validator import (
+        Validator as _Validator,
+        ValidatorSet as _ValidatorSet,
+    )
+
+    lm = LightMetrics(Registry())
+    install_light_metrics(lm)
+    n_heights = 6 if on_cpu else 32
+    n_lvals = 20 if on_cpu else 150
+    t0 = time.time()
+    lkeys = [
+        ed.priv_key_from_secret(b"light%d" % i) for i in range(n_lvals)
+    ]
+    lvals = _ValidatorSet([_Validator(k.pub_key(), 10) for k in lkeys])
+    l_by_addr = {k.pub_key().address(): k for k in lkeys}
+    l_ordered = [l_by_addr[v.address] for v in lvals.validators]
+    lvh = lvals.hash()
+    now_ns_ = time.time_ns()
+    lblocks = {}
+    for hh in range(1, n_heights + 1):
+        hdr = _Header(
+            chain_id=CHAIN_ID, height=hh,
+            time_ns=now_ns_ - (n_heights - hh) * 1_000_000_000,
+            validators_hash=lvh, next_validators_hash=lvh,
+            proposer_address=l_ordered[0].pub_key().address(),
+        )
+        hhash = hdr.hash()
+        lbid = _BlockID(
+            hash=hhash, part_set_header=_PSH(total=1, hash=hhash[:32])
+        )
+        lsigs = []
+        for i, k in enumerate(l_ordered):
+            ts = now_ns_ + i
+            m = _canonical.vote_sign_bytes(
+                CHAIN_ID, _canonical.PRECOMMIT_TYPE, hh, 0, lbid, ts
+            )
+            lsigs.append(
+                _CommitSig(
+                    block_id_flag=_FLAG_COMMIT,
+                    validator_address=k.pub_key().address(),
+                    timestamp_ns=ts, signature=k.sign(m),
+                )
+            )
+        lblocks[hh] = _LightBlock(
+            signed_header=_SignedHeader(
+                header=hdr,
+                commit=_Commit(
+                    height=hh, round=0, block_id=lbid,
+                    signatures=tuple(lsigs),
+                ),
+            ),
+            validator_set=lvals,
+        )
+    log(
+        f"light chain fixture ({n_heights}h x {n_lvals}v) "
+        f"in {time.time() - t0:.1f}s"
+    )
+
+    class _FixtureProvider(_Provider):
+        def chain_id(self):
+            return CHAIN_ID
+
+        def light_block(self, height):
+            return lblocks[height]
+
+    q = vqmod.VerifyQueue(light_wait_ms=3)
+    q.start()
+    vqmod.install_queue(q)
+    try:
+        server = LightHeaderServer(CHAIN_ID, _FixtureProvider())
+        loader = LightSyncLoader(
+            sync=server.sync_range, clients=10_000, workers=16,
+            span=4, chain_from=1, chain_to=n_heights,
+        )
+        rep = loader.run(3.0 if on_cpu else 10.0)
+        qstats = q.stats()
+    finally:
+        q.stop()
+    assert rep["errors"] == 0, (
+        f"light_serve_sustained loader errors: {rep['errors']}"
+    )
+    record(
+        "light_serve_sustained", rep["headers_per_sec"], "headers/sec",
+        clients=rep["clients"], workers=rep["workers"],
+        requests=rep["requests"], errors=rep["errors"],
+        latency_p50_ms=round(rep["latency_p50_s"] * 1e3, 3),
+        latency_p95_ms=round(rep["latency_p95_s"] * 1e3, 3),
+        cache_hit_rate=rep["cache_hit_rate"],
+        light_lane_submitted=qstats["submitted"]["light_client"],
+        n_heights=n_heights, n_validators=n_lvals,
     )
 
     # ---- config 5: mixed ed25519 + bls12381 mega-commit --------------

@@ -447,6 +447,11 @@ class BlocksyncReactor(Reactor):
             for i, cs in enumerate(commit.signatures):
                 if not cs.is_commit():
                     continue  # verify_commit_light checks commit votes
+                if commit.is_aggregated(i):
+                    # proven by the commit-level BLS aggregate (one
+                    # pairing at verify time) — there is no per-sig
+                    # signature to prefetch
+                    continue
                 val = vals.get_by_index(i)
                 if val is None or val.address != cs.validator_address:
                     rotated = True

@@ -883,6 +883,11 @@ class ConsensusState(BaseService):
         for i, cs in enumerate(lc.signatures):
             if cs.is_absent():
                 continue  # verify_commit checks non-absent votes
+            if lc.is_aggregated(i):
+                # covered by the commit-level BLS aggregate: nothing
+                # per-signature to speculate (the aggregate verdict
+                # itself is cached at first verification)
+                continue
             val = lvals.get_by_index(i)
             if val is None or val.address != cs.validator_address:
                 return  # malformed commit: let verify_commit raise

@@ -172,9 +172,14 @@ def _ed25519_factory() -> BatchVerifier:
 
 
 def _bls_factory() -> BatchVerifier:
-    from cometbft_tpu.crypto import bls12381 as _bls
+    # ladder-routed since ISSUE 13: bls_native -> host RLC -> python
+    # floor with demotion/watchdog/chaos/accounting inherited — the
+    # bare BlsBatchVerifier this used to hand out verified the same
+    # math but was invisible to crypto_dispatch_tier and kept running
+    # a faulting native library forever
+    from cometbft_tpu.crypto.bls_dispatch import BlsLadderVerifier
 
-    return _bls.BlsBatchVerifier()
+    return BlsLadderVerifier()
 
 
 REGISTRY: dict[str, Callable[[], BatchVerifier]] = {

@@ -850,6 +850,14 @@ class Bls12381PubKey(PubKey):
             return bls_native.verify(
                 self._bytes, _digest_msg(msg), bytes(sig)
             )
+        return self.verify_signature_python(msg, sig)
+
+    def verify_signature_python(self, msg: bytes, sig: bytes) -> bool:
+        """The pure tower-field path, never the native backend — the
+        dispatch ladder's floor runner (crypto/bls_dispatch.py) when
+        ``bls_native`` is demoted or absent."""
+        if len(sig) != SIGNATURE_SIZE:
+            return False
         try:
             s = g2_from_bytes(sig)
             pk = self._point()
@@ -975,6 +983,18 @@ def aggregate_verify(
             [_digest_msg(m) for m in msgs],
             bytes(agg_sig),
         )
+    return aggregate_verify_python(pubs, msgs, agg_sig)
+
+
+def aggregate_verify_python(
+    pubs: list[Bls12381PubKey], msgs: list[bytes], agg_sig: bytes
+) -> bool:
+    """The pure tower-field distinct-message aggregate check — the
+    ladder's fallback runner, never the native backend."""
+    if len(pubs) != len(msgs) or not pubs:
+        return False
+    if len(agg_sig) != SIGNATURE_SIZE:
+        return False
     try:
         s = g2_from_bytes(agg_sig)
     except ValueError:
@@ -992,6 +1012,27 @@ def aggregate_verify(
     return pairing_product_is_one(pairs)
 
 
+def aggregate_pub_keys_bytes(pub_bytes: list[bytes]) -> bytes:
+    """Sum of G1 pubkeys over raw 96-byte encodings, native-accelerated
+    when the backend exports it (150 Jacobian adds: ~40 ms native with
+    full subgroup validation vs ~350 ms in the tower) — the primitive
+    the aggregate-pubkey cache (crypto/bls_dispatch.py) builds entries
+    with.  Raises ValueError on malformed/identity inputs or an
+    identity sum, matching ``aggregate_pub_keys``."""
+    if not pub_bytes:
+        raise ValueError("cannot aggregate zero pubkeys")
+    from cometbft_tpu.crypto import bls_native
+
+    if bls_native.has_aggregate_pubkeys():
+        out = bls_native.aggregate_pubkeys([bytes(p) for p in pub_bytes])
+        if out is None:
+            raise ValueError("invalid pubkey in aggregation")
+        return out
+    return aggregate_pub_keys(
+        [Bls12381PubKey(p) for p in pub_bytes]
+    ).bytes()
+
+
 def fast_aggregate_verify(
     pubs: list[Bls12381PubKey], msg: bytes, agg_sig: bytes
 ) -> bool:
@@ -1003,6 +1044,19 @@ def fast_aggregate_verify(
     except ValueError:
         return False
     return agg_pk.verify_signature(msg, agg_sig)
+
+
+def fast_aggregate_verify_python(
+    pubs: list[Bls12381PubKey], msg: bytes, agg_sig: bytes
+) -> bool:
+    """Same-message aggregate on the pure tower path end to end."""
+    if not pubs:
+        return False
+    try:
+        agg_pk = aggregate_pub_keys(pubs)
+    except ValueError:
+        return False
+    return agg_pk.verify_signature_python(msg, agg_sig)
 
 
 class BlsBatchVerifier:
@@ -1052,37 +1106,49 @@ class BlsBatchVerifier:
                 for pk, msg, sig in self._items
             ]
             return all(results), results
-        F2 = _Fq2Ops
-        try:
-            weights = [
-                int.from_bytes(os.urandom(16), "big") | 1 for _ in range(n)
-            ]
-            sig_acc = (F2.one, F2.one, F2.zero)
-            pairs = []
-            for (pk, msg, sig), z in zip(self._items, weights):
-                s = g2_from_bytes(sig)
-                if s is None:
-                    raise ValueError("identity signature")
-                sig_acc = _jac_add(
-                    F2, sig_acc, _jac_mul(F2, _jac_from_affine(F2, s), z)
-                )
-                pairs.append(
-                    (
-                        g1_mul(pk._point(), z),
-                        hash_to_g2(_digest_msg(msg)),
-                    )
-                )
-            pairs.append(
-                (g1_neg(G1_GEN), _jac_to_affine(F2, sig_acc))
-            )
-            if pairing_product_is_one(pairs):
-                return True, [True] * n
-        except ValueError:
-            pass
+        if batch_verify_rlc_python(self._items):
+            return True, [True] * n
         results = [
             pk.verify_signature(msg, sig) for pk, msg, sig in self._items
         ]
         return all(results), results
+
+
+def batch_verify_rlc_python(
+    items: list[tuple[Bls12381PubKey, bytes, bytes]],
+) -> bool:
+    """The pure tower-field random-linear-combination batch check
+    (BlsBatchVerifier docstring equation): one n+1-pair Miller loop +
+    one final exponentiation, fresh 128-bit weights per call.  False
+    means "some signature is invalid OR malformed" — callers wanting
+    the per-index vector re-verify serially."""
+    if not items:
+        return False
+    F2 = _Fq2Ops
+    try:
+        weights = [
+            int.from_bytes(os.urandom(16), "big") | 1
+            for _ in range(len(items))
+        ]
+        sig_acc = (F2.one, F2.one, F2.zero)
+        pairs = []
+        for (pk, msg, sig), z in zip(items, weights):
+            s = g2_from_bytes(sig)
+            if s is None:
+                raise ValueError("identity signature")
+            sig_acc = _jac_add(
+                F2, sig_acc, _jac_mul(F2, _jac_from_affine(F2, s), z)
+            )
+            pairs.append(
+                (
+                    g1_mul(pk._point(), z),
+                    hash_to_g2(_digest_msg(msg)),
+                )
+            )
+        pairs.append((g1_neg(G1_GEN), _jac_to_affine(F2, sig_acc)))
+        return pairing_product_is_one(pairs)
+    except ValueError:
+        return False
 
 
 __all__ = [
@@ -1095,9 +1161,13 @@ __all__ = [
     "SIGNATURE_SIZE",
     "MAX_MSG_LEN",
     "aggregate_pub_keys",
+    "aggregate_pub_keys_bytes",
     "aggregate_signatures",
     "aggregate_verify",
+    "aggregate_verify_python",
+    "batch_verify_rlc_python",
     "fast_aggregate_verify",
+    "fast_aggregate_verify_python",
     "gen_priv_key",
     "hash_to_g2",
     "pairing",

@@ -51,6 +51,19 @@ def _configure(lib) -> None:
         fn = getattr(lib, name)
         fn.argtypes = args
         fn.restype = ctypes.c_int
+    # newer exports: absent from a pre-built .so shipped before the
+    # source grew them (the loader rebuilds stale caches, but a
+    # read-only install can't) — probe instead of assuming
+    for name, args in (
+        (
+            "cmt_bls_aggregate_pubkeys",
+            [ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p],
+        ),
+    ):
+        fn = getattr(lib, name, None)
+        if fn is not None:
+            fn.argtypes = args
+            fn.restype = ctypes.c_int
     lib.cmt_bls_init()
 
 
@@ -67,6 +80,18 @@ def load():
 
 def available() -> bool:
     return load() is not None
+
+
+def loaded() -> bool:
+    """True only when the library is ALREADY loaded in this process —
+    never triggers the first-use g++ build (~10 s), so health probes
+    and capability checks on cold processes stay cheap."""
+    return _NATIVE._lib is not None
+
+
+def has_aggregate_pubkeys() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "cmt_bls_aggregate_pubkeys")
 
 
 # -- thin typed wrappers (bytes in/out) ---------------------------------
@@ -112,6 +137,19 @@ def batch_verify(
     )
 
 
+def aggregate_pubkeys(pks: list[bytes]) -> bytes | None:
+    """Sum of uncompressed G1 pubkeys (96 bytes), or None when the
+    export is missing, an input is malformed/identity, or the sum is
+    the identity — callers fall back to the Python tower path."""
+    lib = load()
+    if lib is None or not hasattr(lib, "cmt_bls_aggregate_pubkeys"):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.cmt_bls_aggregate_pubkeys(len(pks), b"".join(pks), out) != 1:
+        return None
+    return out.raw
+
+
 def sign(sk32: bytes, msg: bytes) -> bytes:
     lib = load()
     out = ctypes.create_string_buffer(96)
@@ -134,11 +172,14 @@ def hash_to_g2_compressed(msg: bytes) -> bytes:
 
 
 __all__ = [
+    "aggregate_pubkeys",
     "aggregate_verify",
     "available",
     "batch_verify",
+    "has_aggregate_pubkeys",
     "hash_to_g2_compressed",
     "load",
+    "loaded",
     "sign",
     "sk_to_pk",
     "verify",

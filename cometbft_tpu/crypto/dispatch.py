@@ -73,14 +73,28 @@ from cometbft_tpu.utils.flight import ring_size_from_env as _int_env
 from cometbft_tpu.utils.log import default_logger
 
 #: the full ladder, best tier first (docs/dispatch_ladder.md) — the
-#: canonical order every surface (health probes, docs, /debug) shares
+#: canonical order every surface (health probes, docs, /debug) shares.
+#: ``bls_native`` is the BLS12-381 family's top rung (the native C++
+#: pairing backend, crypto/bls_dispatch.py): an ed25519 batch never
+#: runs there and a BLS batch never runs on the device tiers, but both
+#: families share the ONE availability state machine, so a faulting
+#: native BLS library demotes exactly like a faulting device — with
+#: cool-down, half-open trials, and probe-driven promotion inherited.
 TIER_ORDER = (
-    "keyed_mesh", "keyed", "generic_mesh", "generic", "host", "python",
+    "keyed_mesh", "keyed", "generic_mesh", "generic", "bls_native",
+    "host", "python",
 )
-#: tiers that launch on the accelerator (chaos targets these only)
+#: tiers that launch on the accelerator
 DEVICE_TIERS = frozenset(
     ("keyed_mesh", "keyed", "generic_mesh", "generic")
 )
+#: tiers backed by the native BLS12-381 pairing library
+BLS_TIERS = frozenset(("bls_native",))
+#: tiers the chaos plan may fault: everything above the host/python
+#: floor — the accelerator tiers AND the native BLS backend (a
+#: crashing ctypes library is exactly the kind of loss the ladder
+#: exists to absorb); the floor itself is never chaos'd
+CHAOS_TIERS = DEVICE_TIERS | BLS_TIERS
 #: tiers that shard over the multi-chip mesh (shard-loss chaos scope)
 MESH_TIERS = frozenset(("keyed_mesh", "generic_mesh"))
 #: the floor: pure per-signature Python verification — never demoted,
@@ -259,7 +273,7 @@ class ChaosPlan:
         return windows
 
     def applies(self, kind: str, tier: str) -> bool:
-        if tier not in DEVICE_TIERS:
+        if tier not in CHAOS_TIERS:
             return False  # the host/python floor is never chaos'd
         if kind == "shard_loss":
             return tier in MESH_TIERS
@@ -333,7 +347,7 @@ class Chaos:
         watchdog demotes — the r04 signature) except on the probe
         seam, where the prober's own timeout plays that role."""
         plan = self.plan
-        if plan is None or tier not in DEVICE_TIERS:
+        if plan is None or tier not in CHAOS_TIERS:
             return
         with self._mtx:
             if self._epoch is None:
@@ -470,6 +484,13 @@ class DispatchLadder:
         except ValueError:
             return FLOOR_TIER
         for t in TIER_ORDER[idx + 1:]:
+            # cross-family rungs never serve each other's batches: a
+            # demoted DEVICE tier's work falls to host/python, never
+            # to the BLS pairing backend that happens to sit between
+            # them in the shared order — the demotion event's ``to``
+            # label must name where the batch actually goes
+            if tier in DEVICE_TIERS and t in BLS_TIERS:
+                continue
             if (t in self._known or t in ("host", FLOOR_TIER)) and (
                 self._active_locked(t)
             ):
@@ -818,8 +839,10 @@ def debug_dispatch_payload() -> dict:
 
 
 __all__ = [
+    "BLS_TIERS",
     "CHAOS",
     "CHAOS_KINDS",
+    "CHAOS_TIERS",
     "DEVICE_TIERS",
     "FLOOR_TIER",
     "LADDER",

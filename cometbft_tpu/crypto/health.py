@@ -65,7 +65,10 @@ DEFAULT_HEALTH_INTERVAL_S = 60.0
 #: the probe-able dispatch-ladder tiers in demotion order — a strict
 #: subset of crypto/dispatch.TIER_ORDER (the python floor needs no
 #: canary: it is never demoted)
-TIERS = ("keyed_mesh", "keyed", "generic_mesh", "generic", "host")
+TIERS = (
+    "keyed_mesh", "keyed", "generic_mesh", "generic", "bls_native",
+    "host",
+)
 
 
 def _float_env(var: str, default: float, minimum: float) -> float:
@@ -475,9 +478,18 @@ class HealthProber(BaseService):
     # -- probing ---------------------------------------------------------
 
     def _tier_probes(self) -> dict:
-        if self._tiers is None:
-            self._tiers = default_tier_probes()
-        return self._tiers
+        if self._tiers is not None:
+            return self._tiers  # caller-pinned set (tests)
+        # re-evaluated EVERY round, not cached: tier availability
+        # grows during the process lifetime (a jax backend initializes
+        # on the first device batch, the native BLS library loads on
+        # the first aggregate commit), and a probe set frozen at the
+        # first round would leave late-arriving tiers canary-less —
+        # demoted once, they could then only recover through
+        # half-open production batches paying the retry the prober
+        # exists to absorb.  The capability checks inside
+        # default_tier_probes are cheap reads (no imports, no builds).
+        return default_tier_probes()
 
     def _run_probe(self, tier: str, probe) -> tuple[bool, str | None,
                                                     float]:
@@ -629,6 +641,14 @@ def default_tier_probes() -> dict:
     from cometbft_tpu.crypto import batch as _batch
 
     probes: dict = {"host": _probe_host}
+    # the native BLS tier is probed only when the library ALREADY
+    # loaded in this process: the prober must never trigger the
+    # first-use g++ build (~10 s) for a tier no verify has asked for
+    # — the same already-initialized gate the device tiers use
+    from cometbft_tpu.crypto import bls_native as _bls_native
+
+    if _bls_native.loaded():
+        probes["bls_native"] = _probe_bls_native
     if not _batch._jax_backends_initialized():
         return probes
     try:
@@ -655,6 +675,30 @@ def _probe_host() -> bool:
         bv.add(pub, msg, sig)
     ok, bits = bv.verify()
     return ok and all(bits)
+
+
+_BLS_CANARY = None
+
+
+def _probe_bls_native() -> bool:
+    """Native-BLS canary, PINNED to the native backend (the PR 9
+    lesson: a canary that re-enters the ladder reports the FALLBACK's
+    health as promotion evidence for the dead tier) — one fixed
+    signature verified via bls_native.verify directly."""
+    global _BLS_CANARY
+    from cometbft_tpu.crypto import bls12381 as _bls
+    from cometbft_tpu.crypto import bls_native as _bls_native
+
+    if _BLS_CANARY is None:
+        priv = _bls.priv_key_from_secret(b"cometbft-tpu-bls-canary")
+        msg = b"bls-tier-canary"
+        _BLS_CANARY = (
+            priv.pub_key().bytes(), msg, _bls_native.sign(
+                priv.bytes(), msg
+            ),
+        )
+    pk, msg, sig = _BLS_CANARY
+    return bool(_bls_native.verify(pk, msg, sig))
 
 
 def _probe_arrays():

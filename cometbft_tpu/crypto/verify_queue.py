@@ -68,12 +68,21 @@ utils/flight.py):
 - ``CMT_TPU_CHECKTX_WAIT_MS`` — ingest accumulation deadline in
   milliseconds (default 5, >= 0): the oldest pending CheckTx
   signature never waits longer than this for the batch to fill.
+- ``CMT_TPU_LIGHT_BATCH`` / ``CMT_TPU_LIGHT_WAIT_MS`` — the same two
+  bounds for the ``light_client`` serving lane (defaults 1024 / 10):
+  concurrent light-client header syncs coalesce into single ladder
+  launches through the SAME ``_LaneBatcher`` machinery the ingest
+  lane uses.
 
 The ``ingest`` lane (ROADMAP item 4, the mempool admission plane) is
-the lowest priority: consensus and prefetch buffers strictly preempt
-it at buffer granularity, and its requests additionally accumulate
-behind the micro-batcher gate above — mempool admission soaks up
-device idle time between commits without ever delaying a vote.
+the lowest priority: every other lane strictly preempts it at buffer
+granularity, and its requests additionally accumulate behind the
+micro-batcher gate above — mempool admission soaks up device idle
+time between commits without ever delaying a vote.  The
+``light_client`` lane (ISSUE 13, the header serving plane) sits
+between prefetch and ingest with its own micro-batcher: external
+clients syncing header ranges must never delay live votes or the
+node's own replay, but they outrank admission.
 
 Observability: ``crypto_verify_queue_*`` metrics (CryptoMetrics),
 ``verify_queue/prepare`` + ``verify_queue/launch`` spans (the overlap
@@ -99,13 +108,22 @@ from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.trace import TRACER as _tracer
 
 #: request priorities (metric label values); consensus preempts
-#: prefetch, and both strictly preempt the mempool ``ingest`` lane at
-#: both the collector and the launcher (buffer granularity — a
-#: prepared consensus buffer launches before a parked ingest buffer)
+#: prefetch, both preempt the ``light_client`` serving lane, and all
+#: three strictly preempt the mempool ``ingest`` lane at both the
+#: collector and the launcher (buffer granularity — a prepared
+#: consensus buffer launches before a parked light/ingest buffer).
+#: light_client sits between prefetch and ingest: header serving for
+#: external clients must never delay live votes or the node's own
+#: block replay, but it IS revenue traffic — admission soaks up
+#: whatever idle remains below it.
 PRIORITY_CONSENSUS = "consensus"
 PRIORITY_PREFETCH = "prefetch"
+PRIORITY_LIGHT = "light_client"
 PRIORITY_INGEST = "ingest"
-_PRIORITIES = (PRIORITY_CONSENSUS, PRIORITY_PREFETCH, PRIORITY_INGEST)
+_PRIORITIES = (
+    PRIORITY_CONSENSUS, PRIORITY_PREFETCH, PRIORITY_LIGHT,
+    PRIORITY_INGEST,
+)
 
 DEFAULT_PREFETCH_DEPTH = 8
 DEFAULT_SPEC_CACHE_CAP = 65536
@@ -116,6 +134,16 @@ DEFAULT_CHECKTX_BATCH = 256
 #: ... or until the OLDEST pending ingest request has waited this many
 #: milliseconds — the admission-latency bound a half-full batch pays
 DEFAULT_CHECKTX_WAIT_MS = 5
+#: light_client lane micro-batcher (same accumulate/deadline/release
+#: machinery as ingest, via the shared _LaneBatcher): concurrent
+#: header-verification requests coalesce until this many signatures
+#: are pending ...
+DEFAULT_LIGHT_BATCH = 1024
+#: ... or the OLDEST pending light request has waited this long — a
+#: looser bound than CheckTx (10 ms vs 5): header sync is bulk
+#: traffic, and a wider window is what turns 10k concurrent clients'
+#: 150-sig commits into full-device launches
+DEFAULT_LIGHT_WAIT_MS = 10
 #: largest coalesced batch — matches ops/ed25519_verify.MAX_LAUNCH's
 #: default so one queue batch is one device launch
 DEFAULT_MAX_BATCH = 8192
@@ -148,6 +176,18 @@ def checktx_wait_ms_from_env() -> int:
     releases every pending ingest batch immediately, whatever its
     size)."""
     return _int_env("CMT_TPU_CHECKTX_WAIT_MS", DEFAULT_CHECKTX_WAIT_MS, 0)
+
+
+def light_batch_from_env() -> int:
+    """Light-client lane accumulation target in signatures (>= 1; 1
+    disables coalescing)."""
+    return _int_env("CMT_TPU_LIGHT_BATCH", DEFAULT_LIGHT_BATCH, 1)
+
+
+def light_wait_ms_from_env() -> int:
+    """Light-client lane accumulation deadline in milliseconds (>= 0;
+    0 releases every pending light batch immediately)."""
+    return _int_env("CMT_TPU_LIGHT_WAIT_MS", DEFAULT_LIGHT_WAIT_MS, 0)
 
 
 class QueueUnavailable(RuntimeError):
@@ -267,6 +307,47 @@ class _Request:
         self.t = time.monotonic()
 
 
+class _LaneBatcher:
+    """The accumulate/deadline/release gate an accumulating lane puts
+    in front of the collector (PR 10's CheckTx micro-batcher,
+    EXTRACTED so the ingest and light_client lanes share one
+    implementation instead of two drifting copies): a pending lane
+    releases when it reaches the ``batch_target`` size, when the
+    OLDEST pending request has waited ``wait_s``, or on drain — never
+    before, so concurrent submissions coalesce into one DispatchLadder
+    launch instead of one launch per caller thread.  Stateless apart
+    from its two bounds; all timing reads the requests' arrival
+    stamps, so unit tests drive it with explicit clocks."""
+
+    __slots__ = ("batch_target", "wait_s")
+
+    def __init__(self, batch_target: int, wait_ms: int) -> None:
+        self.batch_target = batch_target
+        self.wait_s = wait_ms / 1000.0
+
+    def ready(
+        self, lane: deque, draining: bool, now: float | None = None
+    ) -> bool:
+        if not lane:
+            return False
+        if draining or len(lane) >= self.batch_target:
+            return True
+        now = time.monotonic() if now is None else now
+        return now - lane[0].t >= self.wait_s
+
+    def deadline_wait(
+        self, lane: deque, now: float | None = None
+    ) -> float | None:
+        """Seconds until the oldest pending request's accumulation
+        deadline (None when the lane is empty) — the collector sleeps
+        no longer than the NEAREST deadline across all batched lanes,
+        so the wait bounds stay real."""
+        if not lane:
+            return None
+        now = time.monotonic() if now is None else now
+        return max(0.001, self.wait_s - (now - lane[0].t))
+
+
 class _Prepared:
     """One prepared buffer: requests grouped per key type with their
     host-phase artifacts, ready for the launcher."""
@@ -318,6 +399,8 @@ class VerifyQueue(BaseService):
         use_cache: bool = True,
         checktx_batch: int | None = None,
         checktx_wait_ms: int | None = None,
+        light_batch: int | None = None,
+        light_wait_ms: int | None = None,
         logger: Logger | None = None,
     ) -> None:
         super().__init__(
@@ -329,17 +412,25 @@ class VerifyQueue(BaseService):
         self._factory = verifier_factory
         self._launch = launch
         self._max_batch = max_batch
-        #: ingest micro-batcher tunables (module docstring): pending
-        #: ingest requests accumulate until this many are queued or
-        #: the oldest has waited this long, then release as ONE buffer
-        self._checktx_batch = (
-            checktx_batch if checktx_batch is not None
-            else checktx_batch_from_env()
-        )
-        self._checktx_wait_s = (
-            checktx_wait_ms if checktx_wait_ms is not None
-            else checktx_wait_ms_from_env()
-        ) / 1000.0
+        #: per-lane micro-batcher gates (module docstring): pending
+        #: ingest/light requests accumulate until the lane's size
+        #: target is reached or its oldest request hits the wait
+        #: deadline, then release as ONE buffer.  Lanes absent here
+        #: (consensus, prefetch) release immediately.
+        self._batchers: dict[str, _LaneBatcher] = {
+            PRIORITY_INGEST: _LaneBatcher(
+                checktx_batch if checktx_batch is not None
+                else checktx_batch_from_env(),
+                checktx_wait_ms if checktx_wait_ms is not None
+                else checktx_wait_ms_from_env(),
+            ),
+            PRIORITY_LIGHT: _LaneBatcher(
+                light_batch if light_batch is not None
+                else light_batch_from_env(),
+                light_wait_ms if light_wait_ms is not None
+                else light_wait_ms_from_env(),
+            ),
+        }
         self.cache = (
             (spec_cache or SpeculativeCache()) if use_cache else None
         )
@@ -400,11 +491,12 @@ class VerifyQueue(BaseService):
         buffers but can never interrupt the launch already on the
         device.
 
-        QUEUED ingest work (accumulating requests, a parked ingest
-        buffer, an ingest buffer mid-prepare) is deliberately
+        QUEUED ingest and light_client work (accumulating requests, a
+        parked buffer, a buffer mid-prepare) is deliberately
         excluded: it is exactly what consensus preempts, so a mempool
-        under sustained admission load must not push every live vote
-        onto the inline path by itself.  An ingest launch ALREADY ON
+        under sustained admission load — or a serving plane under 10k
+        syncing light clients — must not push every live vote
+        onto the inline path by itself.  Such a launch ALREADY ON
         THE DEVICE still counts — it cannot be interrupted, and
         waiting a full launch wall behind it is what this check
         exists to avoid; while admission keeps the device saturated,
@@ -486,45 +578,35 @@ class VerifyQueue(BaseService):
 
     # -- the collector (host phase: buffer N+1) --------------------------
 
-    def _ingest_ready(self, now: float | None = None) -> bool:  # holds _qmtx
-        """Ingest accumulation gate (holds _qmtx): a pending ingest
-        batch releases when it reaches the size target, when the
-        oldest request hits the wait deadline, or on drain — never
-        before, so concurrent CheckTx calls coalesce into one
-        DispatchLadder launch instead of one launch per RPC thread."""
-        lane = self._pending[PRIORITY_INGEST]
-        if not lane:
-            return False
-        if self._draining or len(lane) >= self._checktx_batch:
-            return True
-        now = time.monotonic() if now is None else now
-        return now - lane[0].t >= self._checktx_wait_s
-
-    def _ingest_deadline_wait(self) -> float:
-        """How long the collector may sleep before the oldest pending
-        ingest request's accumulation deadline expires (holds no
-        lock — called from the collector's idle loop only)."""
+    def _batcher_deadline_wait(self) -> float:
+        """How long the collector may sleep before the NEAREST pending
+        accumulation deadline across the batched lanes expires (holds
+        no lock — called from the collector's idle loop only)."""
+        wait = 0.05
+        now = time.monotonic()
         with self._qmtx:
-            lane = self._pending[PRIORITY_INGEST]
-            if not lane:
-                return 0.05
-            remaining = self._checktx_wait_s - (
-                time.monotonic() - lane[0].t
-            )
-        return max(0.001, min(0.05, remaining))
+            for p, gate in self._batchers.items():
+                remaining = gate.deadline_wait(self._pending[p], now)
+                if remaining is not None:
+                    wait = min(wait, remaining)
+        return max(0.001, wait)
 
     def _next_pending(self) -> tuple[list[_Request] | None, str | None]:
         """Pop the next batch worth of requests: consensus first, then
-        prefetch, then ingest (strict preemption), and only for a
-        priority lane whose prepared slot is free (the double-buffer
-        bound).  The ingest lane additionally holds until its
-        micro-batch accumulation gate opens (``_ingest_ready``).  Sets
+        prefetch, then light_client, then ingest (strict preemption),
+        and only for a priority lane whose prepared slot is free (the
+        double-buffer bound).  The batched lanes (ingest,
+        light_client) additionally hold until their micro-batch
+        accumulation gate opens (``_LaneBatcher.ready``).  Sets
         ``_preparing_lane`` under the same lock as the pop so busy() never
         misses the batch between dequeue and the prepared-slot
         append."""
         with self._qmtx:
             for p in _PRIORITIES:
-                if p == PRIORITY_INGEST and not self._ingest_ready():
+                gate = self._batchers.get(p)
+                if gate is not None and not gate.ready(
+                    self._pending[p], self._draining
+                ):
                     continue
                 if self._pending[p] and not self._prepared[p]:
                     take = min(len(self._pending[p]), self._max_batch)
@@ -550,10 +632,11 @@ class VerifyQueue(BaseService):
             if reqs is None:
                 if self._idle_done():
                     return
-                # sleep no longer than the nearest ingest accumulation
-                # deadline — the default CheckTx wait bound (5 ms) is
-                # finer than the idle poll interval
-                self._collector_wake.wait(self._ingest_deadline_wait())
+                # sleep no longer than the nearest accumulation
+                # deadline across the batched lanes — the default
+                # CheckTx wait bound (5 ms) is finer than the idle
+                # poll interval
+                self._collector_wake.wait(self._batcher_deadline_wait())
                 self._collector_wake.clear()
                 continue
             try:
@@ -788,6 +871,15 @@ class VerifyQueue(BaseService):
                 else:
                     ok, results = verifier.verify()
             else:
+                # per-signature host fallback (unsupported key types,
+                # factory failures): one ladder accounting sample at
+                # the decision point — crypto_dispatch_tier covers
+                # every verify, not just batch-seam launches
+                from cometbft_tpu.crypto.dispatch import (
+                    LADDER as _ladder,
+                )
+
+                _ladder.note_batch("host")
                 results = [
                     r.pub_key.verify_signature(r.msg, r.sig)
                     for r in reqs
@@ -1022,6 +1114,81 @@ def checktx_verify_or_fallback(
     return out, n_inline
 
 
+def light_verify_or_fallback(
+    items, timeout: float = DEFAULT_WAIT_S,
+) -> tuple[list[bool], int]:
+    """Light-client header serving: verify ``(pub_key, msg, sig)``
+    tuples through the ``light_client`` lane — the shared micro-batcher
+    coalesces CONCURRENT header syncs into single DispatchLadder
+    launches — with the same STRICT sync fallback and
+    ``(results, n_inline)`` contract as ``checktx_verify_or_fallback``.
+    Light callers, like ingest, DO park behind in-flight work: serving
+    latency is bulk-tolerant, and waiting is what fills the batch."""
+    q = _QUEUE
+    if q is None:
+        return _verify_inline(None, items), len(items)
+    try:
+        futs = q.submit_many(items, PRIORITY_LIGHT)
+    except QueueUnavailable:
+        return _verify_inline(q, items), len(items)
+    out: list[bool] = []
+    n_inline = 0
+    deadline = time.monotonic() + timeout
+    for (pk, msg, sig), fut in zip(items, futs):
+        try:
+            out.append(
+                fut.result(max(0.0, deadline - time.monotonic()))
+            )
+        except QueueUnavailable:
+            out.append(pk.verify_signature(msg, sig))
+            n_inline += 1
+    return out, n_inline
+
+
+# -- the submission-lane context (types/validation routing) --------------
+
+_LANE_TLS = threading.local()
+
+
+class submission_lane:
+    """While active on this thread, ``types/validation._verify`` routes
+    its batch signature verification through the queue at the given
+    priority instead of building a synchronous batch verifier — the
+    seam the light serving plane (light/serve.py) uses so that a full
+    ``verify_commit_light`` keeps its tally/address semantics while
+    its crypto rides the ``light_client`` micro-batcher.  ``_verify``
+    captures the lane ONCE at entry (its key-type groups may run on
+    executor threads where this thread-local is invisible).  Nests
+    safely; no-op when no queue is installed."""
+
+    def __init__(self, priority: str) -> None:
+        if priority not in _PRIORITIES:
+            raise ValueError(f"unknown priority {priority!r}")
+        self._priority = priority
+        self._prev: str | None = None
+
+    def __enter__(self) -> "submission_lane":
+        self._prev = getattr(_LANE_TLS, "lane", None)
+        _LANE_TLS.lane = self._priority
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _LANE_TLS.lane = self._prev
+
+
+def active_submission_lane() -> str | None:
+    """The lane a ``submission_lane`` context has pinned on this
+    thread, or None — None also when no queue is accepting, so the
+    validation path degrades to its exact pre-lane behavior."""
+    lane = getattr(_LANE_TLS, "lane", None)
+    if lane is None:
+        return None
+    q = _QUEUE
+    if q is None or not q.accepting():
+        return None
+    return lane
+
+
 def submit_prefetch(items) -> int:
     """Fire-and-forget prefetch submission (blocksync replay, the
     consensus proposal's last_commit): results land in the speculative
@@ -1041,16 +1208,23 @@ def submit_prefetch(items) -> int:
 __all__ = [
     "DEFAULT_CHECKTX_BATCH",
     "DEFAULT_CHECKTX_WAIT_MS",
+    "DEFAULT_LIGHT_BATCH",
+    "DEFAULT_LIGHT_WAIT_MS",
     "DEFAULT_MAX_BATCH",
     "DEFAULT_PREFETCH_DEPTH",
     "DEFAULT_SPEC_CACHE_CAP",
     "PRIORITY_CONSENSUS",
     "PRIORITY_INGEST",
+    "PRIORITY_LIGHT",
     "PRIORITY_PREFETCH",
     "QueueUnavailable",
+    "active_submission_lane",
     "checktx_batch_from_env",
     "checktx_verify_or_fallback",
     "checktx_wait_ms_from_env",
+    "light_batch_from_env",
+    "light_verify_or_fallback",
+    "light_wait_ms_from_env",
     "SpeculativeCache",
     "VerifyFuture",
     "VerifyQueue",
@@ -1061,6 +1235,7 @@ __all__ = [
     "record_result",
     "spec_cache_capacity_from_env",
     "speculation_active",
+    "submission_lane",
     "submit_prefetch",
     "verify_or_fallback",
 ]

@@ -53,6 +53,9 @@ _INSPECT_ROUTES = (
     # dispatch-ladder state: which tiers were demoted, why, and when
     # — the first question after a device-lost run (crypto/dispatch.py)
     "debug/dispatch",
+    # verified header ranges from the stopped node's stores — a light
+    # client can keep syncing off an inspector (light/serve.py)
+    "light_sync",
 )
 
 

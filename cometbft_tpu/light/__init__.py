@@ -15,6 +15,11 @@ from cometbft_tpu.light.provider import (
     Provider,
     ProviderError,
 )
+from cometbft_tpu.light.serve import (
+    HeaderRangeCache,
+    LightHeaderServer,
+    LightServeError,
+)
 from cometbft_tpu.light.store import LightStore
 from cometbft_tpu.light.verifier import (
     DEFAULT_TRUST_LEVEL,
@@ -28,8 +33,11 @@ __all__ = [
     "Client",
     "DEFAULT_TRUST_LEVEL",
     "ErrLightClientAttack",
+    "HeaderRangeCache",
     "LightBlockNotFoundError",
     "LightClientError",
+    "LightHeaderServer",
+    "LightServeError",
     "LightStore",
     "NodeProvider",
     "Provider",

@@ -163,21 +163,46 @@ def _commit_from_json(d: dict):
             ),
         ),
         signatures=tuple(sigs),
+        agg_signature=(
+            base64.b64decode(d["agg_signature"])
+            if d.get("agg_signature") else b""
+        ),
     )
 
 
-def _validator_set_from_json(vals: list):
+def _pub_key_from_json(d: dict):
+    """Inverse of rpc/serialize.validator_json's pub_key tagging —
+    BLS validator sets must survive the HTTP round trip (the light
+    serving plane serves aggregate commits whose signers are BLS)."""
     import base64
 
+    raw = base64.b64decode(d["value"])
+    # absent type = legacy ed25519-only emitters; an UNKNOWN explicit
+    # tag fails loudly — guessing ed25519 would surface later as a
+    # misleading wrong-signature error instead of a key-type error
+    tag = d.get("type", "tendermint/PubKeyEd25519")
+    if tag == "tendermint/PubKeyBls12381":
+        from cometbft_tpu.crypto.bls12381 import Bls12381PubKey
+
+        return Bls12381PubKey(raw)
+    if tag == "tendermint/PubKeySecp256k1":
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
+
+        return Secp256k1PubKey(raw)
+    if tag != "tendermint/PubKeyEd25519":
+        raise ValueError(f"unknown pub key JSON type {tag!r}")
     from cometbft_tpu.crypto.ed25519 import Ed25519PubKey
+
+    return Ed25519PubKey(raw)
+
+
+def _validator_set_from_json(vals: list):
     from cometbft_tpu.types.validator import Validator, ValidatorSet
 
     return ValidatorSet(
         [
             Validator(
-                pub_key=Ed25519PubKey(
-                    base64.b64decode(v["pub_key"]["value"])
-                ),
+                pub_key=_pub_key_from_json(v["pub_key"]),
                 voting_power=int(v["voting_power"]),
                 proposer_priority=int(v.get("proposer_priority", 0)),
             )

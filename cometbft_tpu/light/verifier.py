@@ -122,13 +122,16 @@ def verify_non_adjacent(
     _verify_new_header_and_vals(
         untrusted, trusted, chain_id, now, max_clock_drift_ns
     )
-    # ≥ trust_level of the OLD (trusted) set must have signed the new commit
+    # ≥ trust_level of the OLD (trusted) set must have signed the new
+    # commit; the untrusted block's own set resolves aggregate signers
+    # that rotated in past the trusted set (types/validation._verify)
     try:
         verify_commit_light_trusting(
             chain_id,
             trusted.validator_set,
             untrusted.signed_header.commit,
             trust_level,
+            signer_vals=untrusted.validator_set,
         )
     except Exception as exc:
         raise ErrNewValSetCantBeTrusted(str(exc)) from exc

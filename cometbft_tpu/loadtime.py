@@ -446,6 +446,150 @@ class SustainedLoader:
         }
 
 
+class LightSyncLoader:
+    """Sustained light-client fleet for the serving plane (ISSUE 13:
+    the ``light_serve_sustained`` bench row and ``make light-smoke``).
+
+    Simulates ``clients`` light-client SESSIONS — each session owns a
+    header range over the served chain window and repeatedly re-syncs
+    it — multiplexed over ``workers`` OS threads (a GIL box cannot run
+    10k Python threads, and it wouldn't measure anything different if
+    it could: what exercises the ``light_client`` lane's micro-batcher
+    is REQUEST-level concurrency, which the worker pool provides, and
+    what exercises the header cache is the session structure — many
+    clients re-walking the same ranges — which the session table
+    provides at any client count).  Sessions are drawn round-robin,
+    so at every instant the in-flight requests belong to different
+    simulated clients.
+
+    Accounting mirrors :class:`SustainedLoader`: per-request latency
+    percentiles, headers/s, error split (errors are FAILURES — the
+    acceptance drive requires zero), plus the serving plane's own
+    cache hit rate computed from the responses' ``cached`` flags.
+
+    Transports: ``sync`` (a callable ``sync(from_h, to_h) -> dict``,
+    e.g. ``LightHeaderServer.sync_range`` for an in-process drive) or
+    ``endpoints`` (the ``/light_sync`` RPC route)."""
+
+    def __init__(
+        self,
+        sync=None,
+        endpoints: list[str] | None = None,
+        clients: int = 10_000,
+        workers: int = 32,
+        span: int = 8,
+        chain_from: int = 1,
+        chain_to: int = 8,
+    ):
+        if sync is None and not endpoints:
+            raise ValueError("need a sync callable or endpoints")
+        if clients < 1 or workers < 1 or span < 1:
+            raise ValueError("clients, workers, span must be >= 1")
+        if chain_to < chain_from:
+            raise ValueError("empty chain window")
+        self._sync = sync
+        self._clients_rpc = []
+        if sync is None:
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            self._clients_rpc = [
+                HTTPClient(e if "://" in e else f"http://{e}")
+                for e in endpoints
+            ]
+        self.clients = clients
+        self.workers = workers
+        self.span = span
+        self.chain_from = chain_from
+        self.chain_to = chain_to
+        self._next_session = 0
+        self._mtx = cmtsync.Mutex()
+
+    def _session_range(self, session: int) -> tuple[int, int]:
+        """Session -> its header range: sessions tile the chain window
+        so concurrent sessions overlap on hot heights (the cache's
+        case) while still touching every height (the coverage case)."""
+        width = self.chain_to - self.chain_from + 1
+        start = self.chain_from + (session * max(1, self.span // 2)) % width
+        end = min(start + self.span - 1, self.chain_to)
+        return start, end
+
+    def _take_session(self) -> int:
+        with self._mtx:
+            s = self._next_session
+            self._next_session = (self._next_session + 1) % self.clients
+            return s
+
+    def run(self, duration_s: float) -> dict:
+        stop = time.monotonic() + duration_s
+        counts = {"requests": 0, "errors": 0, "headers": 0, "cached": 0}
+        latencies: list[int] = []
+        mtx = cmtsync.Mutex()
+
+        def worker(idx: int) -> None:
+            while time.monotonic() < stop:
+                session = self._take_session()
+                frm, to = self._session_range(session)
+                t0 = time.perf_counter_ns()
+                try:
+                    if self._sync is not None:
+                        resp = self._sync(frm, to)
+                    else:
+                        client = self._clients_rpc[
+                            idx % len(self._clients_rpc)
+                        ]
+                        resp = client.light_sync(
+                            from_height=frm, to_height=to
+                        )
+                    headers = resp.get("headers", [])
+                    n_cached = sum(
+                        1 for h in headers if h.get("cached")
+                    )
+                    err = 0
+                except Exception:  # noqa: BLE001 — serving failure
+                    headers, n_cached, err = [], 0, 1
+                dt = time.perf_counter_ns() - t0
+                with mtx:
+                    counts["requests"] += 1
+                    counts["errors"] += err
+                    counts["headers"] += len(headers)
+                    counts["cached"] += n_cached
+                    latencies.append(dt)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(self.workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rep = ExperimentReport(experiment_id="light-sync")
+        for ns in latencies:
+            rep.add(ns)
+        return {
+            "clients": self.clients,
+            "workers": self.workers,
+            "span": self.span,
+            "duration_s": duration_s,
+            "requests": counts["requests"],
+            "errors": counts["errors"],
+            "headers": counts["headers"],
+            "headers_per_sec": round(
+                counts["headers"] / duration_s, 1
+            ) if duration_s > 0 else 0.0,
+            "requests_per_sec": round(
+                counts["requests"] / duration_s, 1
+            ) if duration_s > 0 else 0.0,
+            "cache_hit_rate": round(
+                counts["cached"] / counts["headers"], 4
+            ) if counts["headers"] else 0.0,
+            "latency_p50_s": rep.percentile_ns(0.50) / 1e9,
+            "latency_p95_s": rep.percentile_ns(0.95) / 1e9,
+            "latency_p99_s": rep.percentile_ns(0.99) / 1e9,
+            "latency_max_s": rep.max_ns / 1e9,
+        }
+
+
 @dataclass
 class ExperimentReport:
     """(report/report.go Report)"""

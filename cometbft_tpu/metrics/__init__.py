@@ -711,7 +711,8 @@ class CryptoMetrics:
         self.verify_queue_depth = reg.gauge(
             s, "verify_queue_depth",
             "Requests waiting in the verify queue, by priority lane "
-            "(consensus | prefetch) — consensus preempts prefetch.",
+            "(consensus | prefetch | light_client | ingest) — strict "
+            "preemption in that order.",
             labels=("priority",),
         )
         self.verify_queue_inflight = reg.gauge(
@@ -723,7 +724,8 @@ class CryptoMetrics:
         self.verify_queue_submitted = reg.counter(
             s, "verify_queue_submitted",
             "Verification requests submitted to the verify queue, by "
-            "priority lane (consensus | prefetch).",
+            "priority lane (consensus | prefetch | light_client | "
+            "ingest).",
             labels=("priority",),
         )
         self.verify_queue_batch_size = reg.histogram(
@@ -834,6 +836,65 @@ class HealthMetrics:
         )
 
 
+class LightMetrics:
+    """Light-client serving plane (light/serve.py) — the
+    millions-of-users workload's own family.  No metricsgen analog:
+    the reference's light package has no serving plane to observe.
+    The verify-queue ``light_client`` lane itself reports through the
+    CryptoMetrics ``crypto_verify_queue_*`` series (priority label);
+    this family covers what sits ABOVE the lane: the verified
+    header-range cache and the request surface."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.header_cache = _NOP
+            self.header_cache_entries = _NOP
+            self.header_cache_evictions = _NOP
+            self.serve_requests = _NOP
+            self.serve_headers = _NOP
+            self.serve_seconds = _NOP
+            return
+        s = "light"
+        self.header_cache = reg.counter(
+            s, "header_cache",
+            "Verified-header-range cache consults (hit | miss): a hit "
+            "is a header served with ZERO new verification launches — "
+            "repeat syncs of a hot range cost hash lookups, not "
+            "pairings or batches.",
+            labels=("result",),
+        )
+        self.header_cache_entries = reg.gauge(
+            s, "header_cache_entries",
+            "Verified headers resident in the bounded range cache "
+            "(CMT_TPU_LIGHT_CACHE capacity).",
+        )
+        self.header_cache_evictions = reg.counter(
+            s, "header_cache_evictions",
+            "Header-cache evictions, by reason: lru (capacity "
+            "pressure) | expired (the header's trusting period "
+            "elapsed — serving it would let a client trust a header "
+            "its own rules reject).",
+            labels=("reason",),
+        )
+        self.serve_requests = reg.counter(
+            s, "serve_requests",
+            "Header-range sync requests served, by result (ok | "
+            "error).",
+            labels=("result",),
+        )
+        self.serve_headers = reg.counter(
+            s, "serve_headers",
+            "Total verified headers returned to light clients "
+            "(cached and freshly verified alike).",
+        )
+        self.serve_seconds = reg.histogram(
+            s, "serve_seconds",
+            "Wall seconds per header-range sync request (the "
+            "light_serve_sustained bench row's p50/p95 source).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+
+
 #: Process-wide sink for the crypto/device hot paths.  The batch
 #: verifier and table cache are module-level singletons with no node
 #: handle, so unlike the per-node structs above they update whatever is
@@ -874,6 +935,25 @@ def install_health_metrics(metrics: HealthMetrics | None) -> None:
     _HEALTH = metrics if metrics is not None else HealthMetrics(None)
 
 
+#: Process-wide sink for the light serving plane — the header-range
+#: cache is consulted from RPC handler threads and bench harnesses
+#: with no node handle.  Same contract as the crypto sink: no-op by
+#: default, node assembly installs the real struct, last wins.
+_LIGHT = LightMetrics(None)
+
+
+def light_metrics() -> LightMetrics:
+    """The currently installed light-serving sink (never None)."""
+    return _LIGHT
+
+
+def install_light_metrics(metrics: LightMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide light sink (None
+    resets to the no-op)."""
+    global _LIGHT
+    _LIGHT = metrics if metrics is not None else LightMetrics(None)
+
+
 #: Process-wide sink for wire-plane code with no node handle —
 #: SecretConnection seals/opens frames deep under the transport, where
 #: threading a per-node struct through would contort the handshake
@@ -905,6 +985,7 @@ class NodeMetrics:
         self.state = StateMetrics(reg)
         self.crypto = CryptoMetrics(reg)
         self.health = HealthMetrics(reg)
+        self.light = LightMetrics(reg)
         self.rpc = RPCMetrics(reg)
         self.event_bus = EventBusMetrics(reg)
         self.blocksync = BlockSyncMetrics(reg)
@@ -922,6 +1003,7 @@ __all__ = [
     "EventBusMetrics",
     "EvidenceMetrics",
     "HealthMetrics",
+    "LightMetrics",
     "MempoolMetrics",
     "NodeMetrics",
     "P2PMetrics",
@@ -935,6 +1017,8 @@ __all__ = [
     "health_metrics",
     "install_crypto_metrics",
     "install_health_metrics",
+    "install_light_metrics",
     "install_p2p_metrics",
+    "light_metrics",
     "p2p_metrics",
 ]

@@ -138,6 +138,7 @@ class Node(BaseService):
             NodeMetrics,
             install_crypto_metrics,
             install_health_metrics,
+            install_light_metrics,
             install_p2p_metrics,
         )
         from cometbft_tpu.utils.metrics import MetricsServer, Registry
@@ -161,6 +162,9 @@ class Node(BaseService):
             # the device-health plane (watchdog, prober, utilization —
             # crypto/health.py) shares the singleton-sink pattern
             install_health_metrics(self.metrics.health)
+            # the light serving plane (header cache + request surface,
+            # light/serve.py) — consulted from RPC handler threads
+            install_light_metrics(self.metrics.light)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
@@ -655,15 +659,25 @@ class Node(BaseService):
                 checktx_batch_from_env,
                 checktx_wait_ms_from_env,
                 install_queue,
+                light_batch_from_env,
+                light_wait_ms_from_env,
+            )
+            from cometbft_tpu.light.serve import (
+                header_cache_capacity_from_env,
             )
 
-            # ingest micro-batcher knobs validate OUTSIDE the
+            # micro-batcher + header-cache knobs validate OUTSIDE the
             # degrade-to-sync try below: a malformed
-            # CMT_TPU_CHECKTX_BATCH / CMT_TPU_CHECKTX_WAIT_MS fails
-            # the node LOUDLY (the documented fail-loudly env
-            # contract) instead of silently running un-batched
+            # CMT_TPU_CHECKTX_BATCH / CMT_TPU_CHECKTX_WAIT_MS /
+            # CMT_TPU_LIGHT_BATCH / CMT_TPU_LIGHT_WAIT_MS /
+            # CMT_TPU_LIGHT_CACHE fails the node LOUDLY (the
+            # documented fail-loudly env contract) instead of
+            # silently running un-batched or un-cached
             checktx_batch_from_env()
             checktx_wait_ms_from_env()
+            light_batch_from_env()
+            light_wait_ms_from_env()
+            header_cache_capacity_from_env()
             try:
                 self.verify_queue = VerifyQueue(
                     logger=self.logger.with_fields(module="verify_queue")

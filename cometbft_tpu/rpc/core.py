@@ -168,6 +168,11 @@ class Environment:
         #: drain-everything shutdown hang.
         self._async_pool: _AsyncTxPool | None = None
         self._async_pool_mtx = cmtsync.Mutex()
+        #: lazy light-client serving plane (light/serve.py): built on
+        #: the first /light_sync request from the node's own stores —
+        #: a node that never serves light clients pays nothing
+        self._light_server = None
+        self._light_server_mtx = cmtsync.Mutex()
 
     # -- route tables (routes.go:15-63) ---------------------------------
 
@@ -208,6 +213,9 @@ class Environment:
             "genesis_chunked": self.genesis_chunked,
             "check_tx": self.check_tx,
             "wire": self.wire,
+            # the light-client serving plane (light/serve.py): verified
+            # header ranges, cross-client coalesced + header-cached
+            "light_sync": self.light_sync,
             # GET /debug/flight (the path strips to this route name):
             # the always-on flight recorder's recent replication events
             "debug/flight": self.debug_flight,
@@ -298,6 +306,50 @@ class Environment:
                 "voting_power": self._own_voting_power(),
             },
         }
+
+    def light_sync(self, from_height=None, to_height=None) -> dict:
+        """Serve a VERIFIED header range to a light client (no
+        reference analog; light/serve.py): every header's +2/3 commit
+        is re-verified server-side — through the verify queue's
+        ``light_client`` lane, so concurrent clients' signatures
+        coalesce into single launches — unless the trust-period-aware
+        header cache already vouches for it."""
+        server = self._light_server
+        if server is None:
+            with self._light_server_mtx:
+                server = self._light_server
+                if server is None:
+                    if self.block_store is None or self.state_store is None:
+                        raise ValueError(
+                            "light_sync requires block and state stores"
+                        )
+                    from cometbft_tpu.light.provider import NodeProvider
+                    from cometbft_tpu.light.serve import LightHeaderServer
+
+                    chain_id = (
+                        self.genesis.chain_id
+                        if self.genesis is not None
+                        else (
+                            self.node_info.network
+                            if self.node_info is not None else ""
+                        )
+                    )
+                    server = LightHeaderServer(
+                        chain_id,
+                        NodeProvider(
+                            chain_id, self.block_store, self.state_store,
+                            self.evidence_pool,
+                        ),
+                    )
+                    self._light_server = server
+        frm = _to_int(from_height, "from_height")
+        to = (
+            _to_int(to_height, "to_height")
+            if to_height is not None else frm
+        )
+        out = server.sync_range(frm, to)
+        out["cache"] = server.cache.stats()
+        return out
 
     def _own_voting_power(self) -> str:
         if self.pub_key is None or self.state_store is None:

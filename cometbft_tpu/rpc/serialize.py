@@ -65,12 +65,17 @@ def commit_sig_json(cs) -> dict:
 
 
 def commit_json(c) -> dict:
-    return {
+    out = {
         "height": str(c.height),
         "round": c.round,
         "block_id": block_id_json(c.block_id),
         "signatures": [commit_sig_json(cs) for cs in c.signatures],
     }
+    if c.agg_signature:
+        # the commit-level BLS aggregate (types/block.py); omitted
+        # for per-signature commits so their JSON is unchanged
+        out["agg_signature"] = b64(c.agg_signature)
+    return out
 
 
 def block_json(b) -> dict:
@@ -98,11 +103,34 @@ def block_meta_json(meta) -> dict:
     }
 
 
+#: key type -> amino-style JSON type tag (the reference's
+#: crypto/encoding); BLS validator sets must survive the RPC round
+#: trip for the light serving plane, so the tag is derived from the
+#: key, never hardcoded
+PUB_KEY_JSON_TYPES = {
+    "ed25519": "tendermint/PubKeyEd25519",
+    "secp256k1": "tendermint/PubKeySecp256k1",
+    "bls12_381": "tendermint/PubKeyBls12381",
+}
+
+
 def validator_json(v) -> dict:
+    key_type = v.pub_key.type()
+    try:
+        tag = PUB_KEY_JSON_TYPES[key_type]
+    except KeyError:
+        # fail LOUDLY at the boundary: silently tagging an unknown
+        # family as ed25519 would make the far side reconstruct the
+        # wrong key class and fail later with a misleading
+        # wrong-signature error
+        raise ValueError(
+            f"no JSON type tag for pub key type {key_type!r} — "
+            "add it to rpc/serialize.PUB_KEY_JSON_TYPES"
+        ) from None
     return {
         "address": hexb(v.address),
         "pub_key": {
-            "type": "tendermint/PubKeyEd25519",
+            "type": tag,
             "value": b64(v.pub_key.bytes()),
         },
         "voting_power": str(v.voting_power),

@@ -166,7 +166,13 @@ class CommitSig:
         w.bytes_(4, self.signature)
         return w.finish()
 
-    def validate_basic(self) -> None:
+    def validate_basic(self, aggregated: bool = False) -> None:
+        """``aggregated=True`` (set by Commit.validate_basic when the
+        commit carries an aggregate signature) permits a COMMIT-flag
+        entry with an EMPTY signature: its proof is the commit-level
+        BLS aggregate, not a per-validator field.  Nil votes are never
+        aggregated (they sign a different block id), so they keep
+        their own signatures even in aggregate commits."""
         if self.block_id_flag not in (
             BLOCK_ID_FLAG_ABSENT,
             BLOCK_ID_FLAG_COMMIT,
@@ -179,18 +185,32 @@ class CommitSig:
         else:
             if len(self.validator_address) != 20:
                 raise ValueError("invalid validator address size")
-            if not self.signature or len(self.signature) > 96:
+            if not self.signature:
+                if not (aggregated and self.is_commit()):
+                    raise ValueError("invalid signature size")
+            elif len(self.signature) > 96:
                 raise ValueError("invalid signature size")
 
 
 @dataclass(frozen=True)
 class Commit:
-    """+2/3 precommits for a block (types/block.go:715)."""
+    """+2/3 precommits for a block (types/block.go:715).
+
+    ``agg_signature`` (no reference analog; arXiv:2302.00418's BLS
+    committee design) carries ONE BLS12-381 aggregate over the
+    BLOCK_ID_FLAG_COMMIT precommits: the covered CommitSig entries
+    have EMPTY per-validator signatures, every covered validator
+    signed the same canonical message (:meth:`aggregate_sign_bytes`),
+    and verification is one pairing-product check instead of an
+    N-signature batch (types/validation picks the path by what the
+    commit actually carries).  Empty = the classic per-signature
+    commit, byte-identical to before the field existed."""
 
     height: int = 0
     round: int = 0
     block_id: BlockID = field(default_factory=BlockID)
     signatures: tuple[CommitSig, ...] = ()
+    agg_signature: bytes = b""
 
     def size(self) -> int:
         return len(self.signatures)
@@ -209,21 +229,53 @@ class Commit:
             cs.timestamp_ns,
         )
 
-    def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
-            [cs.encode() for cs in self.signatures]
+    def aggregate_sign_bytes(self, chain_id: str) -> bytes:
+        """The ONE canonical message every aggregate-covered precommit
+        signed: the commit's own height/round/block id with the ZERO
+        timestamp.  Aggregation requires a shared message, and the
+        per-validator timestamp is the only field that varies across
+        honest precommits for one block — BLS validators producing
+        aggregate commits therefore sign the timestamp-free canonical
+        vote (the block id, height, round, and chain id still bind
+        the vote to exactly one decision)."""
+        return canonical.vote_sign_bytes(
+            chain_id,
+            canonical.PRECOMMIT_TYPE,
+            self.height,
+            self.round,
+            self.block_id,
+            0,
         )
+
+    def is_aggregated(self, idx: int) -> bool:
+        """Is signature ``idx`` covered by the commit-level aggregate
+        (COMMIT flag, empty per-validator signature)?"""
+        cs = self.signatures[idx]
+        return bool(self.agg_signature) and cs.is_commit() and (
+            not cs.signature
+        )
+
+    def hash(self) -> bytes:
+        leaves = [cs.encode() for cs in self.signatures]
+        if self.agg_signature:
+            # the aggregate is consensus-critical content: it must be
+            # bound by last_commit_hash like every per-vote signature
+            leaves.append(self.agg_signature)
+        return merkle.hash_from_byte_slices(leaves)
 
     def validate_basic(self) -> None:
         if self.height < 0 or self.round < 0:
             raise ValueError("negative height/round in commit")
+        if self.agg_signature and len(self.agg_signature) != 96:
+            raise ValueError("invalid aggregate signature size")
         if self.height >= 1:
             if self.block_id.is_nil():
                 raise ValueError("commit cannot be for nil block")
             if not self.signatures:
                 raise ValueError("no signatures in commit")
+            aggregated = bool(self.agg_signature)
             for cs in self.signatures:
-                cs.validate_basic()
+                cs.validate_basic(aggregated=aggregated)
 
 
 @dataclass(frozen=True)

@@ -139,6 +139,11 @@ def encode_commit(c: Commit) -> bytes:
     w.message(3, c.block_id.encode())
     for cs in c.signatures:
         w.message(4, cs.encode())
+    if c.agg_signature:
+        # field 5: the commit-level BLS aggregate (types/block.py
+        # Commit docstring); omitted entirely for per-signature
+        # commits so their wire bytes are unchanged
+        w.bytes_(5, c.agg_signature)
     return w.finish()
 
 
@@ -160,6 +165,7 @@ def decode_commit(data: bytes) -> Commit:
         round=_iv(f.get(2, [0])[0]),
         block_id=decode_block_id(_bz(f[3][0])) if 3 in f else BlockID(),
         signatures=tuple(sigs),
+        agg_signature=_bz(f.get(5, [b""])[0]),
     )
 
 

@@ -49,6 +49,10 @@ class _Entry:
     val_idx: int
     power: int
     counts: bool  # counts toward the tallied (for-block) power
+    #: covered by the commit-level BLS aggregate (Commit.agg_signature)
+    #: — tallies power like any entry but is excluded from the per-
+    #: signature crypto groups: its proof is the ONE pairing-product
+    aggregated: bool = False
 
 
 def _check_dims(vals: ValidatorSet, commit: Commit, height: int, block_id: BlockID):
@@ -75,6 +79,8 @@ def _batch_groups(entries: list[_Entry], vals) -> list[list[_Entry]]:
     BASELINE mega-commit shape."""
     groups: dict[str, list[_Entry]] = {}
     for e in entries:
+        if e.aggregated:
+            continue  # proven by the commit-level aggregate check
         groups.setdefault(
             vals.get_by_index(e.val_idx).pub_key.type(), []
         ).append(e)
@@ -89,6 +95,7 @@ def _verify(
     count_sig,
     count_all: bool,
     lookup_by_address: bool,
+    signer_vals: ValidatorSet | None = None,
 ) -> None:
     """Shared engine for the three verification modes
     (validation.go:160 verifyBasicValsAndCommit + verifyCommitBatch).
@@ -97,22 +104,51 @@ def _verify(
     tallied power only ever counts BlockIDFlagCommit votes. count_all
     keeps verifying past the threshold (VerifyCommit) or stops early
     (the Light variants).
+
+    When the commit carries ``agg_signature`` (types/block.py), the
+    covered COMMIT-flag votes are proven by ONE BLS pairing-product
+    check over their signers instead of per-signature batches — the
+    verify path is picked by what the commit actually carries.  In
+    by-address (trusting) mode the aggregate equation needs signers
+    OUTSIDE the tally set too: ``signer_vals`` (the untrusted block's
+    own validator set, passed by light/verifier.py) resolves their
+    pubkeys; signature validity comes from the aggregate, tallied
+    power still counts only validators matched in ``vals``.
     """
     if not lookup_by_address and len(vals) != commit.size():
         raise InvalidCommitSignatures(
             f"validator set size {len(vals)} != commit size {commit.size()}"
         )
 
+    has_agg = bool(commit.agg_signature)
     entries: list[_Entry] = []
+    agg_pubs: list = []  # every signer in the aggregate equation
     tallied = 0
     counted_power = 0
     seen_addrs: set[bytes] = set()
     for idx, cs in enumerate(commit.signatures):
         if not count_sig(cs):
             continue
+        aggregated = has_agg and cs.is_commit() and not cs.signature
         if lookup_by_address:
             val_idx, val = vals.get_by_address(cs.validator_address)
             if val_idx < 0:
+                if aggregated:
+                    # not in the tally set, but the pairing equation
+                    # still needs this signer's pubkey — an aggregate
+                    # over S only verifies against exactly S
+                    s_idx, s_val = (-1, None)
+                    if signer_vals is not None:
+                        s_idx, s_val = signer_vals.get_by_address(
+                            cs.validator_address
+                        )
+                    if s_idx < 0 or s_val is None:
+                        raise InvalidCommitSignatures(
+                            f"cannot resolve aggregate signer "
+                            f"{cs.validator_address.hex()[:12]} "
+                            "(no signer set for trusting verification)"
+                        )
+                    agg_pubs.append(s_val.pub_key)
                 continue
             if cs.validator_address in seen_addrs:
                 raise InvalidCommitSignatures(
@@ -127,14 +163,26 @@ def _verify(
                 raise InvalidCommitSignatures(
                     f"signature {idx} address mismatch"
                 )
+        if aggregated:
+            agg_pubs.append(val.pub_key)
         entries.append(
-            _Entry(idx, val_idx, val.voting_power, cs.is_commit())
+            _Entry(
+                idx, val_idx, val.voting_power, cs.is_commit(),
+                aggregated=aggregated,
+            )
         )
         if cs.is_commit():
             counted_power += val.voting_power
         # early-break path: stop collecting once the counted power
-        # passes the threshold (validation.go:290)
-        if not count_all and counted_power > voting_power_needed:
+        # passes the threshold (validation.go:290).  Disabled for
+        # aggregate commits: the pairing equation needs EVERY covered
+        # signer collected, so breaking early would verify the
+        # aggregate against a truncated signer list and reject a
+        # valid commit.
+        if (
+            not count_all and not has_agg
+            and counted_power > voting_power_needed
+        ):
             break
 
     # crypto pass — one batch launch per key type in the commit; with
@@ -149,6 +197,56 @@ def _verify(
     # misses run the exact batch/serial verify below.
     spec_mtx = cmtsync.Mutex()
     spec = {"hits": 0, "misses": 0, "tier": None}
+    # serving-plane lane (crypto/verify_queue.submission_lane):
+    # captured ONCE here because groups may run on executor threads
+    # where the caller's thread-local is invisible
+    lane = _vq.active_submission_lane()
+
+    def _verify_aggregate() -> None:
+        """The commit-level BLS aggregate: one pairing-product over
+        the covered signers' pubkey sum and the shared canonical
+        message — verdicts land in the speculative cache under the
+        same SHA-512 triple keying as per-signature facts (pubkeys ||
+        aggregate signature || sign bytes), so a repeat verification
+        of this commit (light-client re-sync, evidence re-check) is
+        launch- and pairing-free."""
+        msg = commit.aggregate_sign_bytes(chain_id)
+        pk_bytes = b"".join(pk.bytes() for pk in agg_pubs)
+        key: bytes | None = None
+        if _vq.speculation_active():
+            key = _vq.cache_key(pk_bytes, msg, commit.agg_signature)
+            if _vq.cached_result(
+                pk_bytes, msg, commit.agg_signature, key=key
+            ) is True:
+                with spec_mtx:
+                    spec["hits"] += len(agg_pubs)
+                return
+            with spec_mtx:
+                spec["misses"] += len(agg_pubs)
+        from cometbft_tpu.crypto import bls_dispatch as _bls_dispatch
+
+        verifier = _bls_dispatch.BlsLadderVerifier()
+        try:
+            verifier.set_aggregate(
+                agg_pubs, msg, commit.agg_signature
+            )
+        except (TypeError, ValueError) as exc:
+            # a non-BLS signer or malformed sizes: the commit is
+            # malformed, not the tier — never a ladder fault
+            raise InvalidCommitSignatures(
+                f"malformed aggregate commit: {exc}"
+            ) from exc
+        ok, _results = verifier.verify()
+        with spec_mtx:
+            spec["tier"] = verifier._last_tier or spec["tier"] or "host"
+        if key is not None:
+            _vq.record_result(
+                pk_bytes, msg, commit.agg_signature, ok, key=key
+            )
+        if not ok:
+            raise InvalidCommitSignatures(
+                "invalid BLS aggregate commit signature"
+            )
 
     def _verify_group(group) -> None:
         pks = [vals.get_by_index(e.val_idx).pub_key for e in group]
@@ -186,6 +284,31 @@ def _verify(
                 spec["misses"] += len(pending)
             if not pending:
                 return
+        if lane is not None and _vq.speculation_active():
+            # serving-plane route: the pending signatures ride the
+            # verify queue's lane (the light_client micro-batcher
+            # coalesces CONCURRENT header syncs into single ladder
+            # launches); verify_or_fallback keeps the strict sync
+            # fallback and the launcher feeds the speculative cache,
+            # so this branch never weakens the verdict
+            items = [
+                (
+                    pks[i], sbs[i],
+                    commit.signatures[group[i].idx].signature,
+                )
+                for i in pending
+            ]
+            results = _vq.verify_or_fallback(items, priority=lane)
+            with spec_mtx:
+                spec["tier"] = spec["tier"] or f"lane:{lane}"
+            bad = next(
+                (j for j, r in enumerate(results) if not r), None
+            )
+            if bad is not None:
+                raise InvalidCommitSignatures(
+                    f"wrong signature (#{group[pending[bad]].idx})"
+                )
+            return
         pk0 = pks[pending[0]]
         verifier = None
         if len(pending) >= 2 and crypto_batch.supports_batch_verifier(
@@ -218,6 +341,15 @@ def _verify(
                     f"wrong signature (#{group[pending[bad]].idx})"
                 )
         else:
+            # per-signature host fallback (secp256k1 and other key
+            # types without a batch verifier, 1-sig groups): still ONE
+            # ladder accounting sample at the decision point, so
+            # crypto_dispatch_tier covers every verify in the process
+            # — a raising (invalid) signature is a verdict the host
+            # tier produced correctly, not a tier failure
+            from cometbft_tpu.crypto.dispatch import LADDER as _ladder
+
+            _ladder.note_batch("host")
             with spec_mtx:
                 spec["tier"] = spec["tier"] or "host"
             for i in pending:
@@ -234,22 +366,36 @@ def _verify(
                     )
 
     groups = _batch_groups(entries, vals)
+    # one task per key-type group + (when the commit carries it) the
+    # aggregate check — with several, they run CONCURRENTLY: the TPU
+    # kernel waits on device compute and the native BLS library
+    # releases the GIL, so a mixed aggregate+ed25519 commit costs
+    # max(aggregate, ed25519), not the sum
+    tasks = [lambda g=g: _verify_group(g) for g in groups]
+    if agg_pubs:
+        tasks.append(_verify_aggregate)
+    elif has_agg:
+        raise InvalidCommitSignatures(
+            "aggregate signature with no aggregated signatures"
+        )
     with _tracer.span(
         "verify_commit", cat="crypto",
-        height=commit.height, sigs=len(entries), groups=len(groups),
+        height=commit.height,
+        sigs=len(entries) + max(0, len(agg_pubs) - sum(
+            1 for e in entries if e.aggregated
+        )),
+        groups=len(tasks),
     ) as sp:
         speculating = _vq.speculation_active()
         try:
-            if len(groups) <= 1:
-                for group in groups:
-                    _verify_group(group)
+            if len(tasks) <= 1:
+                for task in tasks:
+                    task()
             else:
                 import concurrent.futures as _futures
 
-                with _futures.ThreadPoolExecutor(len(groups)) as pool:
-                    futs = [
-                        pool.submit(_verify_group, g) for g in groups
-                    ]
+                with _futures.ThreadPoolExecutor(len(tasks)) as pool:
+                    futs = [pool.submit(t) for t in tasks]
                     for f in futs:
                         f.result()  # re-raises InvalidCommitSignatures
         finally:
@@ -335,12 +481,18 @@ def verify_commit_light_trusting(
     commit: Commit,
     trust_level: Fraction = Fraction(1, 3),
     count_all: bool = False,
+    signer_vals: ValidatorSet | None = None,
 ) -> None:
     """Light-client trusting verification: signatures matched by address
     against the *trusted* set; needs > trust_level of its power
     (validation.go:129).  ``count_all=True`` checks every signature with
     no early break (VerifyCommitLightTrustingAllSignatures), required
-    when the commit is used as evidence."""
+    when the commit is used as evidence.  ``signer_vals`` (the new
+    block's own validator set) resolves aggregate signers outside the
+    trusted set when the commit carries a BLS aggregate — see
+    ``_verify``; without it an aggregate commit whose signer set has
+    rotated past the trusted one fails loudly rather than verifying a
+    truncated pairing equation."""
     if trust_level.denominator == 0:
         raise ValueError("trust level has zero denominator")
     if not (0 < trust_level <= 1):
@@ -356,4 +508,5 @@ def verify_commit_light_trusting(
         count_sig=lambda cs: cs.is_commit(),
         count_all=count_all,
         lookup_by_address=True,
+        signer_vals=signer_vals,
     )

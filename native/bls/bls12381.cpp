@@ -177,11 +177,70 @@ static void fp_pow_raw(fp &out, const fp &base, const u64 e[6]) {
     out = acc;  // acc is FP_ONE when the exponent was zero
 }
 
+static inline void raw_add6(u64 o[6], const u64 a[6], const u64 b[6]) {
+    // callers keep a+b < 2^384 (operands < 2p, p is 381 bits), so the
+    // final carry is always zero
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 s = (u128)a[i] + b[i] + c;
+        o[i] = (u64)s;
+        c = s >> 64;
+    }
+}
+
+static inline void raw_shr1(u64 a[6]) {
+    for (int i = 0; i < 6; i++)
+        a[i] = (a[i] >> 1) | (i < 5 ? (a[i + 1] << 63) : 0);
+}
+
+// Binary extended Euclid (HAC 14.61) on the raw limbs: ~2*384
+// shift/subtract steps instead of the 381-bit exponentiation
+// (~570 Montgomery muls) this used to be.  The Miller loop batch-
+// inverts its slope denominators once per step, so the inversion was
+// >40% of a whole pairing; verification operates on public data, so
+// the variable-time gcd is fine.  Montgomery bookkeeping: the stored
+// value is aR; its plain inverse is a^-1 R^-1, and two multiplies by
+// R^2 land back on a^-1 R.
 static void fp_inv(fp &out, const fp &a) {
-    u64 e[6];
-    memcpy(e, P_LIMBS, sizeof e);
-    e[0] -= 2; // p - 2 (p is odd, no borrow)
-    fp_pow_raw(out, a, e);
+    if (fp_is_zero(a)) {  // 0^(p-2) == 0: keep the old contract
+        memset(out.l, 0, sizeof out.l);
+        return;
+    }
+    u64 u[6], v[6], x1[6] = {1, 0, 0, 0, 0, 0}, x2[6] = {0};
+    static const u64 ONE_RAW[6] = {1, 0, 0, 0, 0, 0};
+    memcpy(u, a.l, sizeof u);
+    memcpy(v, P_LIMBS, sizeof v);
+    while (fp_cmp_raw(u, ONE_RAW) != 0 && fp_cmp_raw(v, ONE_RAW) != 0) {
+        while (!(u[0] & 1)) {
+            raw_shr1(u);
+            if (x1[0] & 1) raw_add6(x1, x1, P_LIMBS);
+            raw_shr1(x1);
+        }
+        while (!(v[0] & 1)) {
+            raw_shr1(v);
+            if (x2[0] & 1) raw_add6(x2, x2, P_LIMBS);
+            raw_shr1(x2);
+        }
+        if (fp_cmp_raw(u, v) >= 0) {
+            fp_sub_raw(u, u, v);
+            if (fp_cmp_raw(x1, x2) >= 0) fp_sub_raw(x1, x1, x2);
+            else {
+                raw_add6(x1, x1, P_LIMBS);
+                fp_sub_raw(x1, x1, x2);
+            }
+        } else {
+            fp_sub_raw(v, v, u);
+            if (fp_cmp_raw(x2, x1) >= 0) fp_sub_raw(x2, x2, x1);
+            else {
+                raw_add6(x2, x2, P_LIMBS);
+                fp_sub_raw(x2, x2, x1);
+            }
+        }
+    }
+    fp t;
+    memcpy(t.l, fp_cmp_raw(u, ONE_RAW) == 0 ? x1 : x2, sizeof t.l);
+    fp_mul(t, t, FP_R2);    // (aR)^-1 * R^2 * R^-1 = a^-1
+    fp_mul(out, t, FP_R2);  // a^-1 * R^2 * R^-1 = a^-1 R
 }
 
 static bool fp_sqrt(fp &out, const fp &a) {
@@ -766,16 +825,56 @@ static bool g2_in_subgroup(const g2a &p) {
 
 struct pair_pq { g1a p; g2a q; };
 
+// f *= (s0 + s4 v w + s5 v^2 w): a TRUE sparse multiplication — 14
+// fp2 muls against the 18 of padding the line to a full fp12 and
+// calling fp12_mul (what this used to do), and none of the dead adds.
+// The line is evaluated 2n times per Miller iteration, so this is the
+// pairing's hottest multiply.
 static void fp12_mul_sparse(fp12 &f, const fp2 &s0, const fp2 &s4,
                             const fp2 &s5) {
-    fp12 l;
-    l.c0.c0 = s0;
-    l.c0.c1 = FP2_ZERO;
-    l.c0.c2 = FP2_ZERO;
-    l.c1.c0 = FP2_ZERO;
-    l.c1.c1 = s4;
-    l.c1.c2 = s5;
-    fp12_mul(f, f, l);
+    const fp6 &a0 = f.c0, &a1 = f.c1;
+    // t0 = a0 * (s0, 0, 0): a coefficient-wise fp2 scale (3 muls)
+    fp6 t0;
+    fp2_mul(t0.c0, a0.c0, s0);
+    fp2_mul(t0.c1, a0.c1, s0);
+    fp2_mul(t0.c2, a0.c2, s0);
+    // t1 = a1 * (0, s4, s5) mod (v^3 - xi)  (5 muls, Karatsuba on
+    // the two live coefficients):
+    //   z0 = xi*(x1*s5 + x2*s4),  z1 = x0*s4 + xi*(x2*s5),
+    //   z2 = x0*s5 + x1*s4
+    fp6 t1;
+    {
+        const fp2 &x0 = a1.c0, &x1 = a1.c1, &x2 = a1.c2;
+        fp2 x1s4, x2s5, cross, sx, sy;
+        fp2_mul(x1s4, x1, s4);
+        fp2_mul(x2s5, x2, s5);
+        fp2_add(sx, x1, x2);
+        fp2_add(sy, s4, s5);
+        fp2_mul(cross, sx, sy);          // x1s4+x1s5+x2s4+x2s5
+        fp2_sub(cross, cross, x1s4);
+        fp2_sub(cross, cross, x2s5);     // x1*s5 + x2*s4
+        fp2_mul_xi(t1.c0, cross);
+        fp2 x0s4, x0s5, xt;
+        fp2_mul(x0s4, x0, s4);
+        fp2_mul(x0s5, x0, s5);
+        fp2_mul_xi(xt, x2s5);
+        fp2_add(t1.c1, x0s4, xt);
+        fp2_add(t1.c2, x0s5, x1s4);
+    }
+    // r1 = (a0 + a1) * (s0, s4, s5) - t0 - t1  (6 muls, full fp6)
+    fp6 s, bsum, r1;
+    fp6_add(s, a0, a1);
+    bsum.c0 = s0;
+    bsum.c1 = s4;
+    bsum.c2 = s5;
+    fp6_mul(r1, s, bsum);
+    fp6_sub(r1, r1, t0);
+    fp6_sub(r1, r1, t1);
+    // r0 = t0 + v * t1
+    fp6 vt1;
+    fp6_mul_v(vt1, t1);
+    fp6_add(f.c0, t0, vt1);
+    f.c1 = r1;
 }
 
 static void batch_inv_fp2(std::vector<fp2> &vals) {
@@ -1685,5 +1784,35 @@ extern "C" int cmt_bls_hash_to_g2_compressed(const u8 *msg, size_t len,
     g2a h;
     hash_to_g2(h, msg, len);
     g2_to_compressed(out, h);
+    return 1;
+}
+
+// Sum of G1 pubkeys (blst P1Aggregate shape): the same-message
+// fast-aggregate support — 150 Jacobian adds here cost microseconds
+// where the Python tower pays ~350 ms, which is what makes a COLD
+// aggregate-commit verification one pairing-product instead of one
+// pairing-product plus a third of a second of host EC math.
+// Returns 1 with the 96-byte uncompressed sum in out_pk; 0 when any
+// input is malformed/identity or the sum itself is the identity
+// (an identity aggregate pubkey verifies nothing).
+extern "C" int cmt_bls_aggregate_pubkeys(size_t n, const u8 *pks,
+                                         u8 out_pk[96]) {
+    cmt_bls_init();
+    if (!n) return 0;
+    g1j acc;
+    acc.x = FP_ONE;
+    acc.y = FP_ONE;
+    memset(acc.z.l, 0, sizeof acc.z.l);
+    for (size_t i = 0; i < n; i++) {
+        g1a p;
+        if (!g1_from_uncompressed(p, pks + 96 * i) || p.inf) return 0;
+        g1j jp;
+        g1j_from_affine(jp, p);
+        g1j_add(acc, acc, jp);
+    }
+    g1a ra;
+    g1j_to_affine(ra, acc);
+    if (ra.inf) return 0;
+    g1_to_uncompressed(out_pk, ra);
     return 1;
 }

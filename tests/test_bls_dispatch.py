@@ -1,0 +1,546 @@
+"""BLS aggregate-commit verification through the dispatch ladder
+(ISSUE 13).
+
+Covers: the aggregate-carrying Commit (types/block.py — codec round
+trip, hash binding, validate_basic relaxation), verify_commit picking
+aggregate-vs-batch by what the commit carries (valid / tampered /
+wrong-signer-set / mixed ed25519+aggregate), trusting-mode aggregate
+resolution via ``signer_vals`` across a validator-set rotation, the
+BlsLadderVerifier's ladder walk (bls_native demotion -> pure-python
+floor equivalence, chaos injection, per-index batch verdicts), the
+aggregate-pubkey LRU, speculative-cache aggregate keying (a repeat
+verification is pairing-free), ladder accounting coverage
+(crypto_dispatch_tier samples for BLS aggregates AND the per-signature
+secp256k1 fallback), the bls_native health canary, and the fail-loudly
+env validation for the new knobs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as bls
+from cometbft_tpu.crypto import bls_dispatch
+from cometbft_tpu.crypto import bls_native
+from cometbft_tpu.crypto import dispatch
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import secp256k1
+from cometbft_tpu.crypto import verify_queue as vq
+from cometbft_tpu.metrics import (
+    CryptoMetrics,
+    install_crypto_metrics,
+)
+from cometbft_tpu.types import codec, validation
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    CommitSig,
+    PartSetHeader,
+)
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.utils.metrics import Registry
+
+CHAIN = "bls-agg-chain"
+NVAL = 8
+
+
+@pytest.fixture(autouse=True)
+def clean_ladder():
+    dispatch.reset_for_tests()
+    bls_dispatch.reset_for_tests()
+    yield
+    dispatch.reset_for_tests()
+    bls_dispatch.reset_for_tests()
+
+
+@pytest.fixture
+def live_metrics():
+    cm = CryptoMetrics(Registry())
+    install_crypto_metrics(cm)
+    yield cm
+    install_crypto_metrics(None)
+
+
+@pytest.fixture
+def queue_guard():
+    yield
+    q = vq._installed()
+    if q is not None and q.is_running():
+        q.stop()
+    vq.install_queue(None)
+
+
+def counter_value(metric, **labels) -> float:
+    return metric.labels(**labels).get()
+
+
+def _bid() -> BlockID:
+    h = bytes(range(32))
+    return BlockID(
+        hash=h, part_set_header=PartSetHeader(total=1, hash=h[::-1])
+    )
+
+
+_KEYS = [bls.priv_key_from_secret(b"bd-%d" % i) for i in range(NVAL)]
+
+
+def make_aggregate_fixture(keys=None, height: int = 1):
+    """Validator set + commit carrying ONE BLS aggregate over all its
+    COMMIT-flag precommits (every per-validator signature EMPTY)."""
+    keys = _KEYS if keys is None else keys
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    bid = _bid()
+    msg = Commit(
+        height=height, round=0, block_id=bid
+    ).aggregate_sign_bytes(CHAIN)
+    agg = bls.aggregate_signatures([k.sign(msg) for k in ordered])
+    sigs = tuple(
+        CommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=k.pub_key().address(),
+            timestamp_ns=0,
+            signature=b"",
+        )
+        for k in ordered
+    )
+    commit = Commit(
+        height=height, round=0, block_id=bid, signatures=sigs,
+        agg_signature=agg,
+    )
+    return vals, commit, bid
+
+
+class TestAggregateCommitType:
+    def test_validate_basic_allows_empty_sigs_only_with_aggregate(self):
+        vals, commit, bid = make_aggregate_fixture()
+        commit.validate_basic()  # empty per-sig fields OK
+        # without the aggregate the same signatures are malformed
+        bare = Commit(
+            height=1, round=0, block_id=bid,
+            signatures=commit.signatures,
+        )
+        with pytest.raises(ValueError, match="signature"):
+            bare.validate_basic()
+
+    def test_validate_basic_rejects_bad_aggregate_size(self):
+        vals, commit, bid = make_aggregate_fixture()
+        from dataclasses import replace
+
+        with pytest.raises(ValueError, match="aggregate"):
+            replace(commit, agg_signature=b"\x01" * 64).validate_basic()
+
+    def test_codec_round_trip_and_hash_binding(self):
+        vals, commit, bid = make_aggregate_fixture()
+        decoded = codec.decode_commit(codec.encode_commit(commit))
+        assert decoded == commit
+        # the aggregate is consensus-critical: commits differing only
+        # in it must hash differently (last_commit_hash binding)
+        from dataclasses import replace
+
+        other = replace(
+            commit,
+            agg_signature=bls.aggregate_signatures(
+                [_KEYS[0].sign(b"other")]
+            ),
+        )
+        assert other.hash() != commit.hash()
+
+    def test_aggregate_sign_bytes_is_timestamp_free_and_shared(self):
+        vals, commit, bid = make_aggregate_fixture()
+        msg = commit.aggregate_sign_bytes(CHAIN)
+        # identical for every signer (no per-validator variance), and
+        # bound to the commit's block id
+        from dataclasses import replace
+
+        moved = replace(commit, block_id=BlockID(
+            hash=bytes(reversed(range(32))),
+            part_set_header=commit.block_id.part_set_header,
+        ))
+        assert moved.aggregate_sign_bytes(CHAIN) != msg
+
+
+class TestVerifyCommitAggregate:
+    def test_valid_aggregate_commit_verifies(self):
+        vals, commit, bid = make_aggregate_fixture()
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        validation.verify_commit_light(CHAIN, vals, bid, 1, commit)
+
+    def test_tampered_aggregate_rejected(self):
+        vals, commit, bid = make_aggregate_fixture()
+        from dataclasses import replace
+
+        bad = replace(
+            commit,
+            agg_signature=bls.aggregate_signatures(
+                [_KEYS[0].sign(b"not the commit message")]
+            ),
+        )
+        with pytest.raises(validation.InvalidCommitSignatures):
+            validation.verify_commit(CHAIN, vals, bid, 1, bad)
+
+    def test_missing_signer_breaks_the_pairing_equation(self):
+        """An aggregate over N-1 signers presented as covering N must
+        fail: the equation verifies against exactly the signer list
+        the commit claims."""
+        vals, commit, bid = make_aggregate_fixture()
+        msg = commit.aggregate_sign_bytes(CHAIN)
+        partial = bls.aggregate_signatures(
+            [k.sign(msg) for k in _KEYS[:-1]]
+        )
+        from dataclasses import replace
+
+        with pytest.raises(validation.InvalidCommitSignatures):
+            validation.verify_commit(
+                CHAIN, vals, bid, 1,
+                replace(commit, agg_signature=partial),
+            )
+
+    def test_aggregate_with_no_covered_sigs_rejected(self):
+        """agg_signature present but every CommitSig carries its own
+        signature: nothing is covered — malformed, fail loudly."""
+        vals, commit, bid = make_aggregate_fixture()
+        by_addr = {k.pub_key().address(): k for k in _KEYS}
+        signed = tuple(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=cs.validator_address,
+                timestamp_ns=0,
+                signature=by_addr[cs.validator_address].sign(
+                    commit.aggregate_sign_bytes(CHAIN)
+                ),
+            )
+            for cs in commit.signatures
+        )
+        from dataclasses import replace
+
+        with pytest.raises(
+            validation.InvalidCommitSignatures, match="no aggregated"
+        ):
+            validation.verify_commit(
+                CHAIN, vals, bid, 1, replace(commit, signatures=signed)
+            )
+
+    def test_mixed_individual_and_aggregate_commit(self):
+        """ed25519 validators sign individually (timestamps and all),
+        BLS validators ride the aggregate — one commit, both paths,
+        picked per signature by what it carries."""
+        from cometbft_tpu.types import canonical
+
+        ed_keys = [
+            ed.priv_key_from_secret(b"bd-ed-%d" % i) for i in range(4)
+        ]
+        keys = ed_keys + _KEYS[:4]
+        vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+        by_addr = {k.pub_key().address(): k for k in keys}
+        ordered = [by_addr[v.address] for v in vals.validators]
+        bid = _bid()
+        agg_msg = Commit(
+            height=1, round=0, block_id=bid
+        ).aggregate_sign_bytes(CHAIN)
+        sigs = []
+        agg_parts = []
+        for i, k in enumerate(ordered):
+            if k.pub_key().type() == bls.KEY_TYPE:
+                agg_parts.append(k.sign(agg_msg))
+                sigs.append(
+                    CommitSig(
+                        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                        validator_address=k.pub_key().address(),
+                        timestamp_ns=0, signature=b"",
+                    )
+                )
+            else:
+                ts = 1_700_000_000_000_000_000 + i
+                m = canonical.vote_sign_bytes(
+                    CHAIN, canonical.PRECOMMIT_TYPE, 1, 0, bid, ts
+                )
+                sigs.append(
+                    CommitSig(
+                        block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                        validator_address=k.pub_key().address(),
+                        timestamp_ns=ts, signature=k.sign(m),
+                    )
+                )
+        commit = Commit(
+            height=1, round=0, block_id=bid, signatures=tuple(sigs),
+            agg_signature=bls.aggregate_signatures(agg_parts),
+        )
+        commit.validate_basic()
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        # tamper one ed25519 signature: the aggregate stays valid but
+        # the commit must still be rejected
+        from dataclasses import replace
+
+        broken = list(sigs)
+        for i, cs in enumerate(broken):
+            if cs.signature:
+                broken[i] = replace(
+                    cs, signature=bytes(64)
+                )
+                break
+        with pytest.raises(validation.InvalidCommitSignatures):
+            validation.verify_commit(
+                CHAIN, vals, bid, 1,
+                replace(commit, signatures=tuple(broken)),
+            )
+
+
+class TestTrustingModeAggregate:
+    def test_rotated_signers_resolve_via_signer_vals(self):
+        """Trusted set = 6 of the 8 signers; the other 2 rotated in.
+        The aggregate covers all 8 — signer_vals (the new block's own
+        set) resolves the 2 the trusted set can't."""
+        vals, commit, bid = make_aggregate_fixture()
+        trusted = ValidatorSet(
+            [Validator(k.pub_key(), 10) for k in _KEYS[:6]]
+        )
+        validation.verify_commit_light_trusting(
+            CHAIN, trusted, commit, signer_vals=vals
+        )
+
+    def test_rotated_signers_without_signer_vals_fail_loudly(self):
+        vals, commit, bid = make_aggregate_fixture()
+        trusted = ValidatorSet(
+            [Validator(k.pub_key(), 10) for k in _KEYS[:6]]
+        )
+        with pytest.raises(
+            validation.InvalidCommitSignatures, match="resolve"
+        ):
+            validation.verify_commit_light_trusting(
+                CHAIN, trusted, commit
+            )
+
+
+class TestBlsLadderVerifier:
+    def test_batch_mode_per_index_verdicts(self):
+        msgs = [b"m%d" % i for i in range(6)]
+        sigs = [k.sign(m) for k, m in zip(_KEYS, msgs)]
+        sigs[2] = sigs[3]  # cross-wire one signature
+        v = bls_dispatch.BlsLadderVerifier()
+        for k, m, s in zip(_KEYS, msgs, sigs):
+            v.add(k.pub_key(), m, s)
+        ok, results = v.verify()
+        assert not ok
+        assert results[2] is False
+        assert all(
+            r for i, r in enumerate(results) if i != 2
+        )
+
+    def test_demoted_native_falls_to_floor_with_same_verdicts(self):
+        vals, commit, bid = make_aggregate_fixture()
+        dispatch.LADDER.tier_fault("bls_native", reason="test")
+        # aggregate still verifies on the pure-python floor
+        msg = commit.aggregate_sign_bytes(CHAIN)
+        v = bls_dispatch.BlsLadderVerifier()
+        v.set_aggregate(
+            [k.pub_key() for k in _KEYS], msg, commit.agg_signature
+        )
+        ok, _ = v.verify()
+        assert ok
+        assert v._last_tier == dispatch.FLOOR_TIER
+        # and a tampered one still fails there
+        v = bls_dispatch.BlsLadderVerifier()
+        v.set_aggregate(
+            [k.pub_key() for k in _KEYS[:-1]], msg,
+            commit.agg_signature,
+        )
+        ok, _ = v.verify()
+        assert not ok
+
+    def test_chaos_faults_bls_native_and_ladder_absorbs(
+        self, live_metrics
+    ):
+        os.environ["CMT_TPU_CHAOS"] = "1"
+        os.environ["CMT_TPU_CHAOS_PLAN"] = "device_loss@0-60"
+        try:
+            dispatch.reset_for_tests()
+            dispatch.CHAOS.start()
+            vals, commit, bid = make_aggregate_fixture()
+            # the chaos fault demotes bls_native; the batch continues
+            # on the floor and the verdict is still correct
+            validation.verify_commit(CHAIN, vals, bid, 1, commit)
+            snap = dispatch.LADDER.snapshot()
+            assert snap["tiers"]["bls_native"]["demoted"] is True
+            assert snap["tiers"]["bls_native"]["last_reason"] == (
+                "chaos:device_loss"
+            )
+            assert counter_value(
+                live_metrics.dispatch_tier, tier="python"
+            ) >= 1
+        finally:
+            os.environ.pop("CMT_TPU_CHAOS", None)
+            os.environ.pop("CMT_TPU_CHAOS_PLAN", None)
+            dispatch.reset_for_tests()
+
+    def test_note_batch_accounting_for_aggregate(self, live_metrics):
+        if not bls_native.available():
+            pytest.skip("native BLS backend unavailable")
+        vals, commit, bid = make_aggregate_fixture()
+        before = counter_value(
+            live_metrics.dispatch_tier, tier="bls_native"
+        )
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        assert counter_value(
+            live_metrics.dispatch_tier, tier="bls_native"
+        ) == before + 1
+
+    def test_mode_mixing_rejected(self):
+        v = bls_dispatch.BlsLadderVerifier()
+        v.add(_KEYS[0].pub_key(), b"m", _KEYS[0].sign(b"m"))
+        with pytest.raises(ValueError):
+            v.set_aggregate(
+                [_KEYS[0].pub_key()], b"m", _KEYS[0].sign(b"m")
+            )
+
+
+class TestCrossFamilyLadder:
+    def test_device_demotion_never_targets_bls_tier(self):
+        """On a mixed-key chain bls_native sits between generic and
+        host in the shared order, but an ed25519 batch can never run
+        on the pairing backend — the demotion event's ``to`` label
+        must say where the batch actually goes (host), not the
+        cross-family rung that happens to be known and active."""
+        dispatch.LADDER.note_batch("bls_native")  # mixed chain: known
+        from cometbft_tpu.utils.flight import FLIGHT
+
+        mark = FLIGHT.recorded_total
+        dispatch.LADDER.tier_fault("generic", reason="test")
+        events = FLIGHT.events()
+        new = [
+            e for e in events[-(FLIGHT.recorded_total - mark):]
+            if e["kind"] == "crypto/dispatch_transition"
+        ]
+        assert new and new[-1]["to"] == "host", new
+
+
+class TestAggPubKeyCache:
+    def test_hit_skips_recompute_and_lru_bounds(self, monkeypatch):
+        cache = bls_dispatch.AggPubKeyCache(capacity=16)
+        calls = {"n": 0}
+        real = bls.aggregate_pub_keys_bytes
+
+        def counting(pub_bytes):
+            calls["n"] += 1
+            return real(pub_bytes)
+
+        monkeypatch.setattr(
+            bls, "aggregate_pub_keys_bytes", counting
+        )
+        pubs = [k.pub_key().bytes() for k in _KEYS]
+        a1 = cache.aggregate(pubs)
+        a2 = cache.aggregate(pubs)
+        assert a1 == a2 and calls["n"] == 1
+        # distinct signer subsets are distinct entries
+        cache.aggregate(pubs[:-1])
+        assert calls["n"] == 2
+        # capacity bound
+        small = bls_dispatch.AggPubKeyCache(capacity=2)
+        for i in range(4):
+            small.aggregate(pubs[i:i + 2])
+        assert len(small) == 2
+
+    def test_env_validation_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_BLS_AGG_PK_CACHE", "banana")
+        with pytest.raises(ValueError, match="CMT_TPU_BLS_AGG_PK_CACHE"):
+            bls_dispatch.agg_pk_cache_capacity_from_env()
+        monkeypatch.setenv("CMT_TPU_BLS_AGG_PK_CACHE", "4")
+        with pytest.raises(ValueError, match=">= 16"):
+            bls_dispatch.agg_pk_cache_capacity_from_env()
+
+
+class TestSpeculativeAggregate:
+    def test_repeat_verification_is_pairing_free(
+        self, live_metrics, queue_guard
+    ):
+        q = vq.VerifyQueue()
+        q.start()
+        vq.install_queue(q)
+        vals, commit, bid = make_aggregate_fixture()
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        # the verdict landed in the speculative cache under the
+        # SHA-512 triple keying; the repeat consults it and performs
+        # ZERO new ladder batches
+        tiers_before = {
+            t: counter_value(live_metrics.dispatch_tier, tier=t)
+            for t in dispatch.TIER_ORDER
+        }
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        tiers_after = {
+            t: counter_value(live_metrics.dispatch_tier, tier=t)
+            for t in dispatch.TIER_ORDER
+        }
+        assert tiers_after == tiers_before
+
+    def test_negative_aggregate_verdict_not_cached(self, queue_guard):
+        q = vq.VerifyQueue()
+        q.start()
+        vq.install_queue(q)
+        vals, commit, bid = make_aggregate_fixture()
+        from dataclasses import replace
+
+        bad = replace(
+            commit,
+            agg_signature=bls.aggregate_signatures(
+                [_KEYS[0].sign(b"x")]
+            ),
+        )
+        for _ in range(2):  # the rejection repeats — never poisoned
+            with pytest.raises(validation.InvalidCommitSignatures):
+                validation.verify_commit(CHAIN, vals, bid, 1, bad)
+        # and the VALID commit still verifies (distinct cache key)
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+
+
+class TestPerSigAccounting:
+    def test_secp256k1_commit_counts_host_batches(self, live_metrics):
+        """The per-signature fallback (no batch verifier for
+        secp256k1) must land in crypto_dispatch_tier — every verify
+        in the process is accounted."""
+        from cometbft_tpu.types import canonical
+
+        keys = [
+            secp256k1.gen_priv_key() for _ in range(3)
+        ]
+        vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+        by_addr = {k.pub_key().address(): k for k in keys}
+        ordered = [by_addr[v.address] for v in vals.validators]
+        bid = _bid()
+        sigs = []
+        for i, k in enumerate(ordered):
+            ts = 1_700_000_000_000_000_000 + i
+            m = canonical.vote_sign_bytes(
+                CHAIN, canonical.PRECOMMIT_TYPE, 1, 0, bid, ts
+            )
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=k.pub_key().address(),
+                    timestamp_ns=ts, signature=k.sign(m),
+                )
+            )
+        commit = Commit(
+            height=1, round=0, block_id=bid, signatures=tuple(sigs)
+        )
+        before = counter_value(live_metrics.dispatch_tier, tier="host")
+        validation.verify_commit(CHAIN, vals, bid, 1, commit)
+        assert counter_value(
+            live_metrics.dispatch_tier, tier="host"
+        ) == before + 1
+
+
+class TestBlsHealthProbe:
+    def test_probe_registered_only_when_loaded(self):
+        from cometbft_tpu.crypto import health
+
+        if not bls_native.available():
+            pytest.skip("native BLS backend unavailable")
+        # available() above loaded the library, so the probe registers
+        probes = health.default_tier_probes()
+        assert "bls_native" in probes
+        assert probes["bls_native"]() is True
+        assert "bls_native" in health.TIERS

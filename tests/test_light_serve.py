@@ -1,0 +1,636 @@
+"""Light-client serving plane tests (ISSUE 13).
+
+Covers: the trust-period-aware HeaderRangeCache (hit/miss semantics,
+expiry eviction, bounded LRU under a multi-thread hammer — race-mode
+armed under CMT_TPU_RACE=1), cached-vs-uncached sync equivalence, the
+ZERO-launch assertion for a fully cached repeat sync, the verify
+queue's ``light_client`` lane (micro-batch accumulation + deadline
+release through the shared _LaneBatcher, strict preemption below
+consensus, busy() exclusion), the fail-loudly env validation for the
+new knobs, the /light_sync RPC route, the LightSyncLoader report, and
+the ``light-smoke`` node drive: a single-validator node keeps
+committing strictly-increasing heights while 10k simulated light
+clients hammer the serving plane — serving load never parks a live
+vote.  ``make light-smoke`` runs the LightSmoke subset standalone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import verify_queue as vq
+from cometbft_tpu.light.provider import Provider
+from cometbft_tpu.light.serve import (
+    HeaderRangeCache,
+    LightHeaderServer,
+    LightServeError,
+    header_cache_capacity_from_env,
+)
+from cometbft_tpu.loadtime import LightSyncLoader
+from cometbft_tpu.metrics import (
+    CryptoMetrics,
+    LightMetrics,
+    install_crypto_metrics,
+    install_light_metrics,
+)
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+)
+from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.utils.metrics import Registry
+
+CHAIN = "light-serve-chain"
+NVAL = 6
+NHEIGHTS = 5
+
+_KEYS = [ed.priv_key_from_secret(b"ls-%d" % i) for i in range(NVAL)]
+
+
+@pytest.fixture
+def live_metrics():
+    cm = CryptoMetrics(Registry())
+    lm = LightMetrics(Registry())
+    install_crypto_metrics(cm)
+    install_light_metrics(lm)
+    yield cm, lm
+    install_crypto_metrics(None)
+    install_light_metrics(None)
+
+
+@pytest.fixture
+def queue_guard():
+    yield
+    q = vq._installed()
+    if q is not None and q.is_running():
+        q.stop()
+    vq.install_queue(None)
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def counter_value(metric, **labels) -> float:
+    return metric.labels(**labels).get()
+
+
+def make_chain(n_heights: int = NHEIGHTS, base_time_ns: int | None = None):
+    """A verifiable header chain: every height's commit is signed by
+    the full validator set over the exact canonical precommit bytes."""
+    vals = ValidatorSet([Validator(k.pub_key(), 10) for k in _KEYS])
+    by_addr = {k.pub_key().address(): k for k in _KEYS}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    vh = vals.hash()
+    now = time.time_ns() if base_time_ns is None else base_time_ns
+    blocks: dict[int, LightBlock] = {}
+    for h in range(1, n_heights + 1):
+        hdr = Header(
+            chain_id=CHAIN, height=h,
+            time_ns=now - (n_heights - h) * 1_000_000_000,
+            validators_hash=vh, next_validators_hash=vh,
+            proposer_address=ordered[0].pub_key().address(),
+        )
+        hh = hdr.hash()
+        bid = BlockID(
+            hash=hh, part_set_header=PartSetHeader(total=1, hash=hh[:32])
+        )
+        sigs = []
+        for i, k in enumerate(ordered):
+            ts = now + i
+            m = canonical.vote_sign_bytes(
+                CHAIN, canonical.PRECOMMIT_TYPE, h, 0, bid, ts
+            )
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                    validator_address=k.pub_key().address(),
+                    timestamp_ns=ts, signature=k.sign(m),
+                )
+            )
+        blocks[h] = LightBlock(
+            signed_header=SignedHeader(
+                header=hdr,
+                commit=Commit(
+                    height=h, round=0, block_id=bid,
+                    signatures=tuple(sigs),
+                ),
+            ),
+            validator_set=vals,
+        )
+    return vals, blocks
+
+
+class FixtureProvider(Provider):
+    def __init__(self, blocks):
+        self.blocks = blocks
+        self.calls = 0
+
+    def chain_id(self):
+        return CHAIN
+
+    def light_block(self, height):
+        self.calls += 1
+        return self.blocks[height]
+
+
+class TestHeaderRangeCache:
+    def test_hit_miss_and_metrics(self, live_metrics):
+        _, lm = live_metrics
+        cache = HeaderRangeCache(capacity=8)
+        assert cache.get(1) is None
+        cache.put(1, b"\xaa" * 32, time.time_ns())
+        assert cache.get(1) == b"\xaa" * 32
+        assert counter_value(lm.header_cache, result="hit") == 1
+        assert counter_value(lm.header_cache, result="miss") == 1
+        assert lm.header_cache_entries.labels().get() == 1
+
+    def test_trust_period_expiry_evicts(self, live_metrics):
+        _, lm = live_metrics
+        clock = {"now": 1_000_000_000_000}
+        cache = HeaderRangeCache(
+            capacity=8, trust_period_ns=1_000,
+            clock=lambda: clock["now"],
+        )
+        cache.put(5, b"\xbb" * 32, clock["now"])
+        assert cache.get(5) is not None
+        clock["now"] += 2_000  # past the trusting period
+        assert cache.get(5) is None
+        assert counter_value(
+            lm.header_cache_evictions, reason="expired"
+        ) == 1
+        assert len(cache) == 0
+
+    def test_bounded_lru(self, live_metrics):
+        _, lm = live_metrics
+        cache = HeaderRangeCache(capacity=4)
+        now = time.time_ns()
+        for h in range(1, 9):
+            cache.put(h, bytes([h]) * 32, now)
+        assert len(cache) == 4
+        assert cache.get(1) is None  # oldest evicted
+        assert cache.get(8) is not None
+        assert counter_value(
+            lm.header_cache_evictions, reason="lru"
+        ) == 4
+
+    def test_multi_thread_hammer(self, live_metrics):
+        """Bounded-LRU invariant under concurrent put/get from many
+        threads — run under CMT_TPU_RACE=1 (make test-race arms it)
+        the guarded-field checks fire on any unguarded access."""
+        cache = HeaderRangeCache(capacity=32)
+        now = time.time_ns()
+        errors: list[Exception] = []
+
+        def hammer(seed: int) -> None:
+            try:
+                for i in range(400):
+                    h = (seed * 131 + i) % 128 + 1
+                    cache.put(h, bytes([h % 256]) * 32, now)
+                    got = cache.get((i * 7) % 128 + 1)
+                    if got is not None:
+                        assert len(got) == 32
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(cache) <= 32
+
+    def test_env_validation_fails_loudly(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_LIGHT_CACHE", "not-a-number")
+        with pytest.raises(ValueError, match="CMT_TPU_LIGHT_CACHE"):
+            header_cache_capacity_from_env()
+        monkeypatch.setenv("CMT_TPU_LIGHT_CACHE", "2")
+        with pytest.raises(ValueError, match=">= 16"):
+            header_cache_capacity_from_env()
+        monkeypatch.setenv("CMT_TPU_LIGHT_BATCH", "0")
+        with pytest.raises(ValueError, match="CMT_TPU_LIGHT_BATCH"):
+            vq.light_batch_from_env()
+        monkeypatch.setenv("CMT_TPU_LIGHT_WAIT_MS", "-3")
+        with pytest.raises(ValueError, match="CMT_TPU_LIGHT_WAIT_MS"):
+            vq.light_wait_ms_from_env()
+
+
+class TestServeRange:
+    def test_cached_equals_uncached(self, live_metrics):
+        """The cache must change COST, never CONTENT: a cached sync
+        returns byte-identical header hashes to a cold one."""
+        _, blocks = make_chain()
+        cold = LightHeaderServer(
+            CHAIN, FixtureProvider(blocks),
+            cache=HeaderRangeCache(capacity=64),
+        )
+        first = cold.sync_range(1, NHEIGHTS)
+        warm = cold.sync_range(1, NHEIGHTS)
+        assert [h["hash"] for h in first["headers"]] == [
+            h["hash"] for h in warm["headers"]
+        ]
+        assert first["cache_hits"] == 0
+        assert warm["cache_hits"] == NHEIGHTS
+        # and equal to a fully independent uncached server's answer
+        fresh = LightHeaderServer(
+            CHAIN, FixtureProvider(blocks),
+            cache=HeaderRangeCache(capacity=64),
+        )
+        again = fresh.sync_range(1, NHEIGHTS)
+        assert [h["hash"] for h in again["headers"]] == [
+            h["hash"] for h in first["headers"]
+        ]
+
+    def test_fully_cached_repeat_sync_is_launch_free(
+        self, live_metrics, queue_guard
+    ):
+        """ISSUE 13 satellite: a repeat sync of a hot range performs
+        ZERO verification work — no provider fetch, no ladder batch,
+        no queue submission."""
+        cm, _ = live_metrics
+        q = vq.VerifyQueue(light_wait_ms=2)
+        q.start()
+        vq.install_queue(q)
+        _, blocks = make_chain()
+        provider = FixtureProvider(blocks)
+        server = LightHeaderServer(CHAIN, provider)
+        server.sync_range(1, NHEIGHTS)
+        calls_before = provider.calls
+        stats_before = q.stats()
+        from cometbft_tpu.crypto import dispatch
+
+        tiers_before = {
+            t: counter_value(cm.dispatch_tier, tier=t)
+            for t in dispatch.TIER_ORDER
+        }
+        out = server.sync_range(1, NHEIGHTS)
+        assert out["cache_hits"] == NHEIGHTS
+        assert provider.calls == calls_before
+        stats_after = q.stats()
+        assert stats_after["launched_batches"] == (
+            stats_before["launched_batches"]
+        )
+        assert stats_after["submitted"] == stats_before["submitted"]
+        tiers_after = {
+            t: counter_value(cm.dispatch_tier, tier=t)
+            for t in dispatch.TIER_ORDER
+        }
+        assert tiers_after == tiers_before
+
+    def test_cold_range_coalesces_into_one_lane_submission(
+        self, live_metrics, queue_guard
+    ):
+        """A LONE client cold-syncing a range must fill the light
+        lane's batch from its own headers (phase-1 priming) — one
+        coalesced submission and launch, not one accumulation-deadline
+        wait per header."""
+        q = vq.VerifyQueue(light_wait_ms=5)
+        q.start()
+        vq.install_queue(q)
+        _, blocks = make_chain()
+        server = LightHeaderServer(CHAIN, FixtureProvider(blocks))
+        before = q.stats()
+        server.sync_range(1, NHEIGHTS)
+        after = q.stats()
+        primed = (
+            after["submitted"]["light_client"]
+            - before["submitted"]["light_client"]
+        )
+        assert primed > 0
+        launches = (
+            after["launched_batches"] - before["launched_batches"]
+        )
+        # ONE buffer for the whole range (one key type), not one per
+        # header; <=2 tolerates a collector wake mid-submission
+        assert launches <= 2, (
+            f"range did not coalesce: {launches} launches for "
+            f"{NHEIGHTS} headers"
+        )
+
+    def test_expired_cache_reverifies(self, live_metrics):
+        """A header past the trusting period is re-fetched and
+        re-verified, never served stale."""
+        _, blocks = make_chain()
+        provider = FixtureProvider(blocks)
+        clock = {"now": time.time_ns()}
+        server = LightHeaderServer(
+            CHAIN, provider,
+            cache=HeaderRangeCache(
+                capacity=64, trust_period_ns=10**18,
+                clock=lambda: clock["now"],
+            ),
+        )
+        server.sync_range(1, 2, now=clock["now"])
+        calls = provider.calls
+        clock["now"] += 2 * 10**18
+        out = server.sync_range(1, 2, now=clock["now"])
+        assert out["cache_hits"] == 0
+        assert provider.calls == calls + 2
+
+    def test_bad_ranges_fail_loudly(self, live_metrics):
+        _, blocks = make_chain()
+        server = LightHeaderServer(CHAIN, FixtureProvider(blocks))
+        with pytest.raises(LightServeError):
+            server.sync_range(0, 1)
+        with pytest.raises(LightServeError):
+            server.sync_range(3, 2)
+        with pytest.raises(LightServeError):
+            server.sync_range(1, 2000)
+
+    def test_tampered_header_rejected_not_cached(self, live_metrics):
+        from dataclasses import replace
+
+        _, blocks = make_chain()
+        lb = blocks[2]
+        sigs = list(lb.commit.signatures)
+        sigs[0] = replace(sigs[0], signature=bytes(64))
+        blocks[2] = LightBlock(
+            signed_header=SignedHeader(
+                header=lb.header,
+                commit=replace(
+                    lb.commit, signatures=tuple(sigs)
+                ),
+            ),
+            validator_set=lb.validator_set,
+        )
+        server = LightHeaderServer(CHAIN, FixtureProvider(blocks))
+        with pytest.raises(Exception):
+            server.sync_range(1, 3)
+        # height 2 must NOT be in the cache after the failure
+        assert server.cache.get(2) is None
+
+
+class TestLightLane:
+    def _items(self, tag: bytes, n: int):
+        priv = _KEYS[0]
+        out = []
+        for i in range(n):
+            m = b"%s-%d" % (tag, i)
+            out.append((priv.pub_key(), m, priv.sign(m)))
+        return out
+
+    def test_accumulates_to_batch_size(self, queue_guard):
+        q = vq.VerifyQueue(light_batch=4, light_wait_ms=60_000)
+        q.start()
+        vq.install_queue(q)
+        futs = q.submit_many(
+            self._items(b"acc", 2), vq.PRIORITY_LIGHT
+        )
+        time.sleep(0.1)
+        # below the size target, far from the deadline: still parked
+        assert q.stats()["pending"]["light_client"] == 2
+        futs += q.submit_many(
+            self._items(b"acc2", 2), vq.PRIORITY_LIGHT
+        )
+        assert all(f.result(30) for f in futs)
+        q.stop()
+
+    def test_deadline_releases_partial_batch(self, queue_guard):
+        q = vq.VerifyQueue(light_batch=10_000, light_wait_ms=30)
+        q.start()
+        vq.install_queue(q)
+        t0 = time.monotonic()
+        futs = q.submit_many(
+            self._items(b"dl", 3), vq.PRIORITY_LIGHT
+        )
+        assert all(f.result(30) for f in futs)
+        assert time.monotonic() - t0 < 10
+        q.stop()
+
+    def test_consensus_preempts_parked_light_buffer(self, queue_guard):
+        """A prepared consensus buffer launches before a parked
+        light buffer, whatever the arrival order — serving 10k
+        clients can never delay a live vote."""
+        order: list[bytes] = []
+        release = threading.Event()
+        started = threading.Event()
+
+        def gated_launch(items):
+            order.append(items[0][1])
+            started.set()
+            assert release.wait(30)
+            return [pk.verify_signature(m, s) for pk, m, s in items]
+
+        q = vq.VerifyQueue(
+            launch=gated_launch, light_batch=2, light_wait_ms=0
+        )
+        q.start()
+        la = self._items(b"lightA", 2)
+        futs = list(q.submit_many(la, vq.PRIORITY_LIGHT))
+        assert started.wait(10)  # light A launch gated in flight
+        lb = self._items(b"lightB", 2)
+        futs += q.submit_many(lb, vq.PRIORITY_LIGHT)
+        _wait(
+            lambda: q.stats()["prepared"]["light_client"] == 1,
+            msg="light buffer parked",
+        )
+        cons = self._items(b"cons", 2)
+        futs += q.submit_many(cons, vq.PRIORITY_CONSENSUS)
+        _wait(
+            lambda: q.stats()["prepared"]["consensus"] == 1,
+            msg="consensus buffer parked",
+        )
+        release.set()
+        assert all(f.result(30) for f in futs)
+        assert order == [la[0][1], cons[0][1], lb[0][1]]
+        q.stop()
+
+    def test_busy_excludes_accumulating_light_work(self, queue_guard):
+        q = vq.VerifyQueue(light_batch=10_000, light_wait_ms=60_000)
+        q.start()
+        vq.install_queue(q)
+        q.submit_many(self._items(b"park", 4), vq.PRIORITY_LIGHT)
+        time.sleep(0.05)
+        assert not q.busy()  # consensus must NOT go inline for this
+        q.stop()
+
+    def test_light_verify_or_fallback_sync_when_queue_down(
+        self, queue_guard
+    ):
+        items = self._items(b"fb", 3)
+        results, n_inline = vq.light_verify_or_fallback(items)
+        assert all(results) and n_inline == 3
+
+
+class TestLightSyncLoader:
+    def test_report_shape_and_cache_hits(self, live_metrics, queue_guard):
+        _, blocks = make_chain()
+        server = LightHeaderServer(CHAIN, FixtureProvider(blocks))
+        loader = LightSyncLoader(
+            sync=server.sync_range, clients=100, workers=4,
+            span=3, chain_from=1, chain_to=NHEIGHTS,
+        )
+        rep = loader.run(0.5)
+        assert rep["errors"] == 0
+        assert rep["requests"] > 0
+        assert rep["headers"] > 0
+        assert rep["clients"] == 100
+        assert rep["latency_p95_s"] >= rep["latency_p50_s"] >= 0
+        # repeat syncs rode the cache
+        assert rep["cache_hit_rate"] > 0
+
+
+class TestLightSmoke:
+    def test_node_serves_light_clients_without_stalling(
+        self, tmp_path, live_metrics, queue_guard
+    ):
+        """ISSUE 13 acceptance (the light-smoke drive, mirroring the
+        ingest-smoke shape): a single-validator node serving a
+        sustained light-client fleet commits strictly-increasing
+        heights — the light_client lane stays preempted BELOW
+        consensus, so header batches never park a live vote — with
+        zero loader errors and a measurable header-cache hit rate on
+        the repeat syncs."""
+        import urllib.request
+
+        from cometbft_tpu.abci.kvstore import KVStoreApp
+        from cometbft_tpu.config import test_config
+        from cometbft_tpu.light.provider import NodeProvider
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+
+        pv = FilePV(ed.priv_key_from_secret(b"light-smoke-val"))
+        gen = GenesisDoc(
+            chain_id="light-smoke",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=(GenesisValidator(pv.pub_key, 10),),
+        )
+        cfg = test_config(str(tmp_path))
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        cfg.ensure_dirs()
+        node = Node(cfg, app=KVStoreApp(), genesis=gen,
+                    priv_validator=pv)
+        node.start()
+        try:
+            # let the chain grow a servable window first
+            deadline = time.time() + 60
+            while node.height() < 3 and time.time() < deadline:
+                time.sleep(0.05)
+            h0 = node.height()
+            assert h0 >= 3, f"chain did not start (height {h0})"
+            server = LightHeaderServer(
+                "light-smoke",
+                NodeProvider(
+                    "light-smoke", node.block_store, node.state_store
+                ),
+            )
+            # the node verified these very signatures at consensus
+            # time, so the speculative cache would answer EVERY light
+            # verify without touching the lane (cross-plane
+            # speculation — correct, but not what this smoke pins).
+            # A production serving node's bounded cache cannot hold
+            # the whole chain; empty it so the drive exercises the
+            # light_client lane the way a deep-history sync would.
+            node.verify_queue.cache._map.clear()
+            loader = LightSyncLoader(
+                sync=server.sync_range, clients=10_000, workers=8,
+                span=2, chain_from=1, chain_to=h0,
+            )
+            result: dict = {}
+
+            def drive():
+                result.update(loader.run(4.0))
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            heights = [h0]
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                h = node.height()
+                if h > heights[-1]:
+                    heights.append(h)
+                if not t.is_alive() and h >= h0 + 3:
+                    break
+                time.sleep(0.05)
+            t.join(timeout=60)
+            assert result, "loader did not finish"
+            # liveness: consensus kept committing under serving load
+            assert heights[-1] >= h0 + 3, (
+                f"heights stalled at {heights[-1]} under light load "
+                f"(loader: {result})"
+            )
+            assert all(b > a for a, b in zip(heights, heights[1:]))
+            # the fleet really served, with zero failures and the
+            # repeat syncs riding the header cache
+            assert result["requests"] > 0
+            assert result["errors"] == 0, result
+            assert result["cache_hit_rate"] > 0, result
+            # the serving plane is visible on /metrics: the light
+            # family AND the queue's light_client lane
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{node.metrics_server.port}/metrics",
+                timeout=5,
+            ).read().decode()
+            hits = served = lane = 0.0
+            for line in body.splitlines():
+                if line.startswith("cometbft_light_header_cache{"):
+                    if 'result="hit"' in line:
+                        hits = float(line.rsplit(" ", 1)[1])
+                elif line.startswith("cometbft_light_serve_headers"):
+                    served = float(line.rsplit(" ", 1)[1])
+                elif line.startswith(
+                    "cometbft_crypto_verify_queue_submitted{"
+                ) and 'priority="light_client"' in line:
+                    lane = float(line.rsplit(" ", 1)[1])
+            assert hits > 0, "no header-cache hits on /metrics"
+            assert served > 0, "no served headers on /metrics"
+            assert lane > 0, (
+                "no light_client lane submissions on /metrics — "
+                "serving bypassed the micro-batcher"
+            )
+        finally:
+            node.stop()
+
+
+class TestLightSyncRoute:
+    def test_rpc_route_serves_verified_range(self, live_metrics):
+        """/light_sync over the Environment route table, backed by
+        real block/state stores."""
+        from cometbft_tpu.rpc.core import Environment
+
+        vals, blocks = make_chain()
+
+        class _BS:
+            def height(self):
+                return NHEIGHTS
+
+            def base(self):
+                return 1
+
+        class _SS:
+            pass
+
+        env = Environment(block_store=_BS(), state_store=_SS())
+        # swap in the fixture-backed server (the lazy builder needs
+        # full stores; the route contract is what we pin here)
+        from cometbft_tpu.light.serve import LightHeaderServer as _S
+
+        env._light_server = _S(CHAIN, FixtureProvider(blocks))
+        out = env.light_sync(from_height=1, to_height=3)
+        assert [h["height"] for h in out["headers"]] == [1, 2, 3]
+        assert out["cache"]["entries"] == 3
+        assert "light_sync" in env.routes()
