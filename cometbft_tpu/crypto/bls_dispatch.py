@@ -233,12 +233,27 @@ class BlsLadderVerifier(BatchVerifier):
         _crypto_metrics().dispatch_decisions.labels(
             route="bls", reason=plan.mode
         ).inc()
+        # cost-ordered walk (ISSUE 14): the BLS tiers self-place
+        # through the SAME shape-bucket cost model the device tiers
+        # use — zero BLS-specific routing code.  Aggregates offer no
+        # host rung (host == python for a pairing-product), so only
+        # the admissible native tier is ordered; batch mode orders
+        # native against the pure-RLC host rung by measured
+        # throughput for this batch's shape.
         if plan.mode == "aggregate":
-            # host == python for aggregates (both are the pure
-            # pairing-product); one rung, honestly labeled the floor
-            plan.tiers = admissible + [_failover.FLOOR_TIER]
+            walk = ladder.route(
+                admissible, plan.n, add_host=False,
+                family=_failover.ROUTE_FAMILY_BLS_AGG,
+            )
+            if not walk:
+                # floor-only plan: still one dispatch_route sample
+                ladder.note_route(_failover.FLOOR_TIER, plan.n)
+            plan.tiers = walk + [_failover.FLOOR_TIER]
         else:
-            plan.tiers = admissible + ["host", _failover.FLOOR_TIER]
+            plan.tiers = ladder.route(
+                admissible, plan.n,
+                family=_failover.ROUTE_FAMILY_BLS,
+            ) + [_failover.FLOOR_TIER]
         return plan
 
     def execute(self, plan: _BlsPlan) -> tuple[bool, list[bool]]:
@@ -258,6 +273,7 @@ class BlsLadderVerifier(BatchVerifier):
                 not ladder.active(tier)
             ):
                 continue  # demoted since plan time (queue parked it)
+            t_tier = time.perf_counter()
             try:
                 if tier == BLS_NATIVE_TIER:
                     ok, results = self._run_native(plan)
@@ -281,7 +297,21 @@ class BlsLadderVerifier(BatchVerifier):
                 )
                 continue
             self._last_tier = tier
-            ladder.note_batch(tier)
+            # shape + wall feed the cost model (ed25519 execute
+            # parity), in the BLS family matching the plan's mode —
+            # the host rung here is pure-RLC BLS, and its timings must
+            # never drag the ed25519 host estimate (nor may an
+            # aggregate's one-pairing-covers-N rate masquerade as
+            # per-signature batch throughput)
+            ladder.note_batch(
+                tier, batch=plan.n,
+                seconds=time.perf_counter() - t_tier,
+                family=(
+                    _failover.ROUTE_FAMILY_BLS_AGG
+                    if plan.mode == "aggregate"
+                    else _failover.ROUTE_FAMILY_BLS
+                ),
+            )
             return ok, results
         raise last_exc if last_exc is not None else RuntimeError(
             "BLS dispatch ladder exhausted without a floor tier"

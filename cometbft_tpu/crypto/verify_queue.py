@@ -756,7 +756,12 @@ class VerifyQueue(BaseService):
             # production thresholds, device when the ladder says so)
             # and lands in crypto_dispatch_tier; the per-sig fallback
             # below covers only unsupported key types and factory
-            # failures
+            # failures.  The submission's COALESCED shape carries
+            # through plan() untouched — the cost router (ISSUE 14)
+            # sees the micro-batched size the launch will actually
+            # have, not the per-caller fragment sizes, so an ingest
+            # lane full of 1-sig CheckTx requests routes by the
+            # 256-sig buffer it coalesced into
             if crypto_batch.supports_batch_verifier(pk0):
                 try:
                     verifier = (
@@ -874,7 +879,10 @@ class VerifyQueue(BaseService):
                 # per-signature host fallback (unsupported key types,
                 # factory failures): one ladder accounting sample at
                 # the decision point — crypto_dispatch_tier covers
-                # every verify, not just batch-seam launches
+                # every verify, not just batch-seam launches.
+                # Deliberately shape-blind (no batch/seconds): these
+                # are whatever key types fell through, and timing
+                # them would pollute the host tier's cost estimates
                 from cometbft_tpu.crypto.dispatch import (
                     LADDER as _ladder,
                 )

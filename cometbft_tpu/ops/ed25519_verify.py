@@ -764,13 +764,27 @@ class TpuBatchVerifier(BatchVerifier):
             plan.route = "host"
             plan.reason = reason
             plan.tiers = ["host", _failover.FLOOR_TIER]
+            # route accounting for the host-only branch too: every
+            # plan lands in crypto_dispatch_route exactly once, so the
+            # 2-sig bucket's host routing is as visible as the
+            # 2048-sig bucket's device routing
+            ladder.note_route("host", n)
             return plan
         cm.dispatch_decisions.labels(route="device", reason=reason).inc()
         cm.batch_verify_batch_size.observe(n)
         plan.route = "device"
         plan.reason = reason
         plan.entry = entry
-        plan.tiers = admissible + ["host", _failover.FLOOR_TIER]
+        # cost-ordered walk (ISSUE 14): the admissible device tiers
+        # PLUS the host rung, ordered by predicted wall time for this
+        # batch's shape bucket (crypto/dispatch.TierCostModel) — the
+        # r05 contradiction (host Pippenger beating the generic device
+        # path) reroutes here instead of standing in /debug/dispatch;
+        # with routing off (CMT_TPU_ROUTE=0) or no participating
+        # estimates this is exactly the static admissible + host walk
+        plan.tiers = ladder.route(admissible, n) + [
+            _failover.FLOOR_TIER
+        ]
         if entry is not None:
             plan.key_ids = entry.key_ids(self._pubs)
         plan.pub = np.frombuffer(
@@ -802,6 +816,7 @@ class TpuBatchVerifier(BatchVerifier):
                 not ladder.active(tier)
             ):
                 continue  # demoted since plan time (queue parked it)
+            t_tier = time.perf_counter()
             try:
                 if tier == _failover.FLOOR_TIER:
                     ok, results = self._run_python(plan)
@@ -839,7 +854,12 @@ class TpuBatchVerifier(BatchVerifier):
                 )
                 continue
             self._last_tier = tier
-            ladder.note_batch(tier)
+            # shape + wall feed the cost model's per-(tier, bucket)
+            # EWMA at the one per-batch accounting point — the wall is
+            # this tier's run only, never a failed rung above it
+            ladder.note_batch(
+                tier, batch=n, seconds=time.perf_counter() - t_tier
+            )
             return ok, results
         # unreachable while the python floor is in the walk; keep the
         # failure honest if a caller hands a floorless plan

@@ -314,8 +314,26 @@ class Switch(BaseService):
             return
         self.metrics.peers.set(self.peers.size())
         try:
-            if peer.is_running():
-                peer.stop()
+            if not peer.is_running():
+                # the add->start window: _add_peer_conn publishes the
+                # peer to the set BEFORE peer.start() runs, so a
+                # concurrent switch stop can observe a not-yet-running
+                # peer here — leaving the TCP socket OPEN and the
+                # remote side's disconnect detection waiting on an EOF
+                # that never comes (the test_peer_disconnect_detected
+                # flake under concurrent pytest load).  Close the raw
+                # connection directly: remote disconnect detection
+                # must not depend on this thread winning that race.
+                peer.mconn.conn.close()
+            # stop() unconditionally, not just when running: start()
+            # may complete between the check above and here (the same
+            # race, one window narrower), and an error-path stop via
+            # the recv loop would early-return on the already-removed
+            # peer — leaving a started service never stopped.  A
+            # never-started peer raises NotStartedError into the
+            # best-effort catch; the conn close above already covered
+            # the remote side for that case.
+            peer.stop()
         except Exception:  # noqa: BLE001 — teardown is best-effort
             pass
         self._drop_peer_gauges(peer)

@@ -349,6 +349,10 @@ def _verify(
             # tier produced correctly, not a tier failure
             from cometbft_tpu.crypto.dispatch import LADDER as _ladder
 
+            # deliberately NO batch/seconds here: this rung verifies
+            # whatever key type fell through (secp256k1, 1-sig
+            # groups) — timing it would pollute the host tier's
+            # ed25519 cost estimates with unrelated crypto
             _ladder.note_batch("host")
             with spec_mtx:
                 spec["tier"] = spec["tier"] or "host"

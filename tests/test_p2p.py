@@ -304,6 +304,21 @@ def test_peer_disconnect_detected():
     sw2.start()
     try:
         connect_switches(sw1, sw2)
+        # connect_switches returns when both peer SETS see each other,
+        # which is before peer.start() necessarily ran (peers.add
+        # publishes first) — under concurrent pytest load that window
+        # stretches.  Bound the race with an explicit poll for the
+        # peer services actually RUNNING before stopping sw2, instead
+        # of assuming the start thread won; the switch-side fix
+        # (closing a not-yet-running peer's raw connection) covers the
+        # production shape of the same race.
+        assert _wait_for(
+            lambda: all(
+                p.is_running()
+                for p in list(sw1.peers.copy()) + list(sw2.peers.copy())
+            ) and sw1.peers.size() == 1 and sw2.peers.size() == 1,
+            timeout=10,
+        )
         sw2.stop()
         assert _wait_for(lambda: sw1.peers.size() == 0, timeout=10)
     finally:

@@ -1,0 +1,798 @@
+"""Shape-aware cost-based routing tests (crypto/dispatch.TierCostModel,
+ISSUE 14).
+
+Covers the acceptance set: the pow2 shape-bucket key, cost-model
+estimate lifecycle (seeded participates immediately, warming needs
+CMT_TPU_ROUTE_MIN_SAMPLES online samples, winsorized EWMA), the
+seeded-contradiction reroute (a perf-ledger pair where host measured
+faster than the preferred device tier reorders the plan() walk from
+the FIRST batch), verdict equivalence between the static and
+cost-ordered walks on valid AND tampered batches, hysteresis (one wild
+outlier sample cannot flip an established order; the per-bucket
+reorder cool-down holds an adopted order), the `resolved_by_router`
+flag closing the /debug/dispatch `order_contradictions` loop,
+fail-loudly validation of the CMT_TPU_ROUTE_* knobs, the sealed
+CMT_TPU_JITGUARD proof that shape-aware routing introduces zero new
+compile keys (it only PERMUTES the walk), the coalesced-shape flow
+through the VerifyQueue, and the mixed-shape routing smoke `make
+route-smoke` runs standalone: interleaved 2-sig and 2048-sig batches
+must land their `crypto_dispatch_route` buckets on different tiers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import dispatch
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.metrics import (
+    CryptoMetrics,
+    HealthMetrics,
+    install_crypto_metrics,
+    install_health_metrics,
+)
+from cometbft_tpu.utils.metrics import Registry
+
+
+@pytest.fixture
+def cm():
+    """Fresh registry-backed crypto + health sinks, uninstalled after."""
+    crypto = CryptoMetrics(Registry())
+    health = HealthMetrics(Registry())
+    install_crypto_metrics(crypto)
+    install_health_metrics(health)
+    try:
+        yield crypto
+    finally:
+        install_crypto_metrics(None)
+        install_health_metrics(None)
+
+
+@pytest.fixture
+def route_env():
+    """Setter for the routing/ladder env knobs (test_dispatch's
+    dispatch_env pattern): whatever a test sets, the originals are
+    restored and the process-wide LADDER re-reads the CLEAN env after
+    — including the conftest's suite-wide CMT_TPU_ROUTE=0 pin, which
+    the routing tests override per test."""
+    knobs = (
+        "CMT_TPU_ROUTE", "CMT_TPU_ROUTE_MIN_SAMPLES",
+        "CMT_TPU_ROUTE_MARGIN", "CMT_TPU_ROUTE_COOLDOWN_S",
+        "CMT_TPU_PERF_LEDGER", "CMT_TPU_COOLDOWN_S",
+        "CMT_TPU_COOLDOWN_MAX_S",
+    )
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def set_env(**kv: str) -> None:
+        for key, val in kv.items():
+            assert key in knobs, key
+            os.environ[key] = val
+        dispatch.reset_for_tests()
+
+    try:
+        yield set_env
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        dispatch.reset_for_tests()
+
+
+def write_ledger(path, rows) -> str:
+    """A perf-ledger fixture file of sigs/sec rows with batch
+    provenance and the explicit single-batch ``route_seed`` marker —
+    what CMT_TPU_PERF_LEDGER points the seed at."""
+    entries = [
+        {
+            "config": cfg, "value": val, "unit": "sigs/sec",
+            "dispatch_tier": tier, "batch": batch,
+            "route_seed": True,
+            "source": "test-fixture", "measured": "fixture",
+        }
+        for cfg, tier, val, batch in rows
+    ]
+    path.write_text(json.dumps({"schema": 1, "entries": entries}))
+    return str(path)
+
+
+def counter_value(metric, **labels) -> float:
+    return metric.labels(**labels).get()
+
+
+def _fill(bv, n: int, tag: bytes = b"rt", tamper: set[int] = frozenset()):
+    """n entries from ONE key/message pair (signed once — the wide
+    shapes stay cheap under pure-Python signing); tampered lanes get a
+    flipped signature byte."""
+    priv = ed.priv_key_from_secret(tag)
+    msg = tag + b"-msg"
+    sig = priv.sign(msg)
+    bad = sig[:-1] + bytes([sig[-1] ^ 1])
+    pub = priv.pub_key()
+    for i in range(n):
+        bv.add(pub, msg, bad if i in tamper else sig)
+    return bv
+
+
+@pytest.fixture
+def verifier_cls(monkeypatch):
+    monkeypatch.setenv("CMT_TPU_DISABLE_PRECOMPUTE", "1")
+    from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier
+
+    return TpuBatchVerifier
+
+
+@pytest.fixture
+def routed_cls(verifier_cls):
+    """TpuBatchVerifier whose generic runner is a fake (no XLA): the
+    routing seam under test is plan()'s walk order, and the wide smoke
+    shapes must not pay real device-kernel compiles."""
+
+    class RoutedVerifier(verifier_cls):
+        ran_tiers: list[str] = []
+
+        def _run_generic(self, pub, sig, msgs):
+            type(self).ran_tiers.append("generic")
+            return np.ones(len(msgs), dtype=bool)
+
+    RoutedVerifier.ran_tiers = []
+    return RoutedVerifier
+
+
+# -- shape buckets -------------------------------------------------------
+
+
+class TestShapeBucket:
+    def test_pow2_ceiling(self):
+        assert dispatch.shape_bucket(0) == 1
+        assert dispatch.shape_bucket(1) == 1
+        assert dispatch.shape_bucket(2) == 2
+        assert dispatch.shape_bucket(3) == 4
+        assert dispatch.shape_bucket(64) == 64
+        assert dispatch.shape_bucket(150) == 256
+        assert dispatch.shape_bucket(10_000) == 16384
+
+    def test_capped(self):
+        assert dispatch.shape_bucket(1 << 30) == dispatch.MAX_SHAPE_BUCKET
+
+
+# -- cost-model unit behavior --------------------------------------------
+
+
+def model(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("min_samples", 3)
+    kw.setdefault("margin", 0.2)
+    kw.setdefault("cooldown_s", 0.0)
+    return dispatch.TierCostModel(**kw)
+
+
+SEED = {
+    "host": {"buckets": {64: {"sigs_per_sec": 50_000.0,
+                              "config": "fix_host"}}},
+    "generic": {"buckets": {64: {"sigs_per_sec": 1_000.0,
+                                 "config": "fix_gen"}}},
+}
+
+
+class TestCostModelUnit:
+    def test_seeded_estimates_participate_immediately(self):
+        m = model()
+        m.seed_locked(SEED)
+        order, reordered, source = m.order_locked(
+            ["generic", "host"], 64, 0.0
+        )
+        assert order == ("host", "generic")
+        assert reordered and source == "seeded"
+
+    def test_no_cross_bucket_extrapolation(self):
+        """Estimates are strictly per-shape: a bucket with no data
+        keeps the static order (shape-dependence is the premise —
+        extrapolating across shapes is the bug the router removes)."""
+        m = model()
+        m.seed_locked(SEED)
+        order, reordered, source = m.order_locked(
+            ["generic", "host"], 2048, 0.0
+        )
+        assert order == ("generic", "host")
+        assert not reordered and source == "static"
+
+    def test_warming_needs_min_samples(self):
+        m = model(min_samples=3)
+        for _ in range(2):
+            m.observe_locked("host", 64, 64 / 50_000)
+            m.observe_locked("generic", 64, 64 / 1_000)
+        order, _, source = m.order_locked(["generic", "host"], 64, 0.0)
+        assert order == ("generic", "host") and source == "static"
+        m.observe_locked("host", 64, 64 / 50_000)
+        m.observe_locked("generic", 64, 64 / 1_000)
+        order, reordered, source = m.order_locked(
+            ["generic", "host"], 64, 1.0
+        )
+        assert order == ("host", "generic")
+        assert reordered and source == "learned"
+
+    def test_sub_margin_gain_does_not_reorder(self):
+        m = model(min_samples=1, margin=0.2)
+        m.observe_locked("generic", 64, 64 / 10_000)
+        m.observe_locked("host", 64, 64 / 11_000)  # +10% < 20% margin
+        order, reordered, _ = m.order_locked(
+            ["generic", "host"], 64, 0.0
+        )
+        assert order == ("generic", "host") and not reordered
+
+    def test_single_outlier_cannot_flip_established_pair(self):
+        """The hysteresis acceptance: winsorized EWMA bounds one
+        sample's influence to x2 clamped through alpha=0.2, so a lone
+        wild measurement (a paused process, a cold compile) moves an
+        established estimate at most 20% — under the reorder margin."""
+        m = model(min_samples=1)
+        for _ in range(5):
+            m.observe_locked("generic", 64, 64 / 12_000)
+            m.observe_locked("host", 64, 64 / 10_000)
+        order, _, _ = m.order_locked(["generic", "host"], 64, 0.0)
+        assert order == ("generic", "host")
+        m.observe_locked("host", 64, 64 / 1_000_000)  # the outlier
+        order, reordered, _ = m.order_locked(
+            ["generic", "host"], 64, 1.0
+        )
+        assert order == ("generic", "host") and not reordered
+        # consistent repeats ARE evidence, not noise — they still win
+        for _ in range(4):
+            m.observe_locked("host", 64, 64 / 50_000)
+        order, reordered, source = m.order_locked(
+            ["generic", "host"], 64, 2.0
+        )
+        assert order == ("host", "generic")
+        assert reordered and source == "learned"
+
+    def test_reorder_cooldown_holds_adopted_order(self):
+        m = model(min_samples=1, cooldown_s=100.0)
+        m.seed_locked(SEED)
+        order, reordered, _ = m.order_locked(
+            ["generic", "host"], 64, t := 0.0
+        )
+        assert order == ("host", "generic") and reordered
+        # estimates swing back hard — the winsorized EWMA needs ~25
+        # consistent samples to climb 1000 -> 60k (x1.2 per step),
+        # proof in itself that no few samples can whiplash an estimate
+        # — but even once they HAVE, the adopted order holds for the
+        # cool-down window
+        for _ in range(30):
+            m.observe_locked("generic", 64, 64 / 500_000)
+        order, reordered, _ = m.order_locked(
+            ["generic", "host"], 64, t + 50.0
+        )
+        assert order == ("host", "generic") and not reordered
+        order, reordered, _ = m.order_locked(
+            ["generic", "host"], 64, t + 101.0
+        )
+        assert order == ("generic", "host") and reordered
+
+    def test_missing_estimate_keeps_static_position(self):
+        """A tier without a participating estimate never moves —
+        evidence permutes the walk, absence of evidence never does."""
+        m = model(min_samples=1)
+        m.seed_locked(SEED)  # keyed_mesh has no estimate
+        order, _, _ = m.order_locked(
+            ["keyed_mesh", "generic", "host"], 64, 0.0
+        )
+        assert order == ("keyed_mesh", "host", "generic")
+
+    def test_unestimated_tier_between_estimated_pair_does_not_block(
+        self,
+    ):
+        """Regression (caught by the first bench run): the estimated
+        pair is compared across an estimate-less tier sitting BETWEEN
+        them in the static order — keyed(slow)/generic(unmeasured)/
+        host(fast) must still rank host first, with generic keeping
+        its slot."""
+        m = model(min_samples=1)
+        m.seed_locked({
+            "keyed": {"buckets": {64: {"sigs_per_sec": 700.0,
+                                       "config": "k"}}},
+            "host": {"buckets": {64: {"sigs_per_sec": 24_000.0,
+                                      "config": "h"}}},
+        })
+        order, reordered, source = m.order_locked(
+            ["keyed", "generic", "host"], 64, 0.0
+        )
+        assert order == ("host", "generic", "keyed")
+        assert reordered and source == "seeded"
+
+    def test_online_evidence_outranks_a_seed(self):
+        m = model(min_samples=1)
+        m.observe_locked("host", 64, 64 / 7_000)
+        m.seed_locked(SEED)  # must not clobber the online estimate
+        fam = dispatch.ROUTE_FAMILY_ED25519
+        assert m._est[(fam, "host", 64)]["sigs_per_sec"] == (
+            pytest.approx(7_000)
+        )
+        assert m._est[(fam, "generic", 64)]["source"] == "seeded"
+
+    def test_disabled_model_is_static(self):
+        m = model(enabled=False)
+        m.seed_locked(SEED)
+        order, reordered, source = m.order_locked(
+            ["generic", "host"], 64, 0.0
+        )
+        assert order == ("generic", "host")
+        assert not reordered and source == "static"
+
+    def test_families_never_share_estimates(self):
+        """The cross-family pollution guard (review finding): the
+        "host" rung means ed25519 CPU-batch in an ed25519 walk but
+        pure-RLC BLS in a BLS batch walk — a slow BLS host sample
+        must not drag the ed25519 host estimate (and vice versa), and
+        an aggregate's one-pairing-covers-N rate never masquerades as
+        per-signature batch throughput."""
+        m = model(min_samples=1)
+        for _ in range(3):
+            m.observe_locked("host", 256, 256 / 20_000)  # ed25519
+            m.observe_locked(
+                "host", 256, 256 / 50, family=dispatch.ROUTE_FAMILY_BLS
+            )  # pure-RLC BLS: 400x slower, same rung name
+            m.observe_locked(
+                "bls_native", 256, 256 / 40_000,
+                family=dispatch.ROUTE_FAMILY_BLS_AGG,
+            )
+        ed = m._est[(dispatch.ROUTE_FAMILY_ED25519, "host", 256)]
+        bls = m._est[(dispatch.ROUTE_FAMILY_BLS, "host", 256)]
+        assert ed["sigs_per_sec"] == pytest.approx(20_000, rel=0.01)
+        assert bls["sigs_per_sec"] == pytest.approx(50, rel=0.01)
+        # the BLS batch walk consults ITS host estimate: native wins
+        m.observe_locked(
+            "bls_native", 256, 256 / 30_000,
+            family=dispatch.ROUTE_FAMILY_BLS,
+        )
+        order, _, _ = m.order_locked(
+            ["bls_native", "host"], 256, 0.0,
+            family=dispatch.ROUTE_FAMILY_BLS,
+        )
+        assert order == ("bls_native", "host")
+        # while the ed25519 walk is untouched by the BLS samples
+        m.observe_locked("generic", 256, 256 / 1_000)
+        order, _, _ = m.order_locked(["generic", "host"], 256, 0.0)
+        assert order == ("host", "generic")
+
+
+# -- the seeded-contradiction reroute at the plan() seam -----------------
+
+
+class TestSeededContradictionReroute:
+    def test_ledger_contradiction_reroutes_first_batch(
+        self, cm, route_env, verifier_cls, tmp_path
+    ):
+        """The acceptance flip: the perf ledger says host measured
+        faster than the generic device path at this shape (the r05
+        contradiction) — plan() must walk host FIRST from the first
+        batch, with seeded provenance on the route metric."""
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_8", "host", 40_000.0, 8),
+            ("fix_generic_8", "generic", 300.0, 8),
+        ])
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+        bv = _fill(verifier_cls(device_min_batch=1), 8)
+        plan = bv.plan()
+        assert plan.tiers == ["host", "generic", "python"]
+        assert counter_value(
+            cm.dispatch_route, tier="host", bucket="8", source="seeded"
+        ) == 1
+        assert counter_value(cm.route_reorders_total, bucket="8") == 1
+        ok, results = bv.execute(plan)
+        assert ok and all(results)
+        assert bv._last_tier == "host"
+
+    def test_verdict_equivalence_static_vs_cost_ordered(
+        self, cm, route_env, verifier_cls, routed_cls, tmp_path
+    ):
+        """Routing permutes the walk, never the verdicts: the same
+        valid+tampered batch verified under the static order and the
+        cost order must return identical verdict vectors."""
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_8", "host", 40_000.0, 8),
+            ("fix_generic_8", "generic", 300.0, 8),
+        ])
+        verdicts = {}
+        for mode in ("0", "1"):
+            route_env(CMT_TPU_ROUTE=mode, CMT_TPU_PERF_LEDGER=ledger)
+            bv = _fill(routed_cls(device_min_batch=1), 8, tamper={3, 5})
+            plan = bv.plan()
+            expect_first = "generic" if mode == "0" else "host"
+            assert plan.tiers[0] == expect_first
+            verdicts[mode] = bv.execute(plan)
+        # NB: the fake generic runner verifies nothing (all-ones), so
+        # equivalence is asserted on the HOST-ordered walk against the
+        # pure-python oracle, and the static walk's shape separately
+        ok, results = verdicts["1"]
+        assert ok is False
+        assert [i for i, r in enumerate(results) if not r] == [3, 5]
+
+    def test_real_kernel_equivalence_both_orders(
+        self, cm, route_env, verifier_cls, tmp_path
+    ):
+        """Full equivalence on REAL runners: the batch-8 generic
+        XLA-on-CPU kernel (shape shared with the dispatch/jitguard
+        suites, so a warm cache pays no compile) and the host batch
+        verifier must return the same valid/tampered verdicts whatever
+        order the router picks."""
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_8", "host", 40_000.0, 8),
+            ("fix_generic_8", "generic", 300.0, 8),
+        ])
+        out = {}
+        for mode in ("0", "1"):
+            route_env(CMT_TPU_ROUTE=mode, CMT_TPU_PERF_LEDGER=ledger)
+            bv = _fill(
+                verifier_cls(device_min_batch=1), 8, tamper={2}
+            )
+            plan = bv.plan()
+            out[mode] = (plan.tiers[0], bv.execute(plan))
+        (t0, v0), (t1, v1) = out["0"], out["1"]
+        assert t0 == "generic" and t1 == "host"
+        assert v0 == v1
+        assert v0[0] is False and v0[1] == [
+            True, True, False, True, True, True, True, True,
+        ]
+
+    def test_learned_contradiction_reroutes_within_n_batches(
+        self, cm, route_env, verifier_cls
+    ):
+        """Online learning alone (no ledger): after
+        CMT_TPU_ROUTE_MIN_SAMPLES batches' timings show host faster,
+        the next plan() reorders."""
+        route_env(
+            CMT_TPU_ROUTE="1", CMT_TPU_ROUTE_MIN_SAMPLES="2",
+            CMT_TPU_ROUTE_COOLDOWN_S="0",
+            CMT_TPU_PERF_LEDGER="/nonexistent/ledger.json",
+        )
+        bv = _fill(verifier_cls(device_min_batch=1), 8)
+        assert bv.plan().tiers[0] == "generic"  # no evidence yet
+        for _ in range(2):  # the one per-batch accounting point
+            dispatch.LADDER.note_batch("generic", batch=8, seconds=8 / 300)
+            dispatch.LADDER.note_batch("host", batch=8, seconds=8 / 40_000)
+        plan = _fill(verifier_cls(device_min_batch=1), 8).plan()
+        assert plan.tiers[0] == "host"
+        assert counter_value(
+            cm.dispatch_route, tier="host", bucket="8", source="learned"
+        ) == 1
+
+    def test_small_batch_host_branch_still_lands_in_route_metric(
+        self, cm, route_env, verifier_cls
+    ):
+        """A 2-sig evidence check below every device threshold takes
+        the host branch without consulting the cost model — and still
+        records its route (tier=host, bucket=2, source=static)."""
+        route_env(
+            CMT_TPU_ROUTE="1",
+            CMT_TPU_PERF_LEDGER="/nonexistent/ledger.json",
+        )
+        bv = _fill(verifier_cls(), 2)  # cpu: device ruled out
+        plan = bv.plan()
+        assert plan.route == "host" and plan.tiers == ["host", "python"]
+        assert counter_value(
+            cm.dispatch_route, tier="host", bucket="2", source="static"
+        ) == 1
+
+
+# -- /debug/dispatch: the contradiction loop closed ----------------------
+
+
+class TestResolvedByRouter:
+    LEDGER_ROWS = [
+        ("fix_keyed_64", "keyed", 700.0, 64),
+        ("fix_host_64", "host", 24_000.0, 64),
+    ]
+
+    def test_contradiction_resolved_when_router_reorders(
+        self, cm, route_env, tmp_path
+    ):
+        ledger = write_ledger(tmp_path / "l.json", self.LEDGER_ROWS)
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+        payload = dispatch.debug_dispatch_payload()
+        contr = payload["order_contradictions"]
+        entry = next(
+            c for c in contr
+            if c["preferred"] == "keyed" and c["faster"] == "host"
+        )
+        assert entry["bucket"] == 64
+        assert entry["resolved_by_router"] is True
+        # the live cost table is served alongside
+        table = payload["cost_model"]["table"]
+        assert {
+            (r["tier"], r["bucket"], r["source"]) for r in table
+        } >= {("keyed", 64, "seeded"), ("host", 64, "seeded")}
+
+    def test_contradiction_unresolved_with_routing_off(
+        self, cm, route_env, tmp_path
+    ):
+        ledger = write_ledger(tmp_path / "l.json", self.LEDGER_ROWS)
+        route_env(CMT_TPU_ROUTE="0", CMT_TPU_PERF_LEDGER=ledger)
+        payload = dispatch.debug_dispatch_payload()
+        entry = next(
+            c for c in payload["order_contradictions"]
+            if c["preferred"] == "keyed" and c["faster"] == "host"
+        )
+        assert entry["resolved_by_router"] is False
+        assert payload["cost_model"]["enabled"] is False
+
+    def test_full_walk_resolution_is_not_pairwise(
+        self, cm, route_env, tmp_path
+    ):
+        """Review regression: the margin-gated ordering is
+        non-transitive — with keyed=100, generic=115, host=130 at 20%
+        margin no ADJACENT estimated pair clears the bar, so a real
+        walk keeps keyed first even though host beats keyed pairwise
+        by 30%.  The resolved flag must report what a full walk does,
+        never the bare pair."""
+        ledger = write_ledger(tmp_path / "l.json", [
+            ("fix_keyed", "keyed", 100.0, 64),
+            ("fix_generic", "generic", 115.0, 64),
+            ("fix_host", "host", 130.0, 64),
+        ])
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+        assert dispatch.LADDER.router_prefers("host", "keyed", 64) is (
+            False
+        )
+        entry = next(
+            c for c in dispatch.debug_dispatch_payload()[
+                "order_contradictions"
+            ]
+            if c["preferred"] == "keyed" and c["faster"] == "host"
+        )
+        assert entry["resolved_by_router"] is False
+
+    def test_pipeline_rows_do_not_seed_buckets(
+        self, cm, route_env, tmp_path
+    ):
+        """Review regression: pipelined / sustained / mixed-workload
+        ledger rows measure a pipeline, not one launch — they must
+        stay OUT of the per-bucket seed view (tier-level display
+        only), while a latency row carrying an explicit sigs_per_sec
+        field (the verify_commit_*_device shape) qualifies."""
+        from cometbft_tpu.crypto.health import measured_tier_throughput
+
+        path = tmp_path / "l.json"
+        path.write_text(json.dumps({"schema": 1, "entries": [
+            {"config": "verify_queue_pipelined", "value": 19_444.0,
+             "unit": "sigs/sec", "dispatch_tier": "host",
+             "batch": 2048},
+            {"config": "verify_queue_sync", "value": 10_306.0,
+             "unit": "sigs/sec", "dispatch_tier": "host",
+             "batch": 2048},
+            {"config": "verify_commit_150_device", "value": 328.0,
+             "unit": "ms", "dispatch_tier": "keyed",
+             "sigs_per_sec": 457.3},
+        ]}))
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=str(path))
+        m = measured_tier_throughput()
+        # sync (single-batch, allowlisted) seeds; pipelined does not —
+        # even though the pipelined row is more recent per tier-level
+        assert m["host"]["buckets"][2048]["config"] == (
+            "verify_queue_sync"
+        )
+        assert m["host"]["sigs_per_sec"] == 10_306.0
+        # the ms-united device row still reaches the bucket view,
+        # without fabricating a tier-level throughput entry
+        assert m["keyed"]["buckets"][256]["sigs_per_sec"] == 457.3
+        assert m["keyed"].get("sigs_per_sec") is None
+
+    def test_floor_tier_contradiction_never_crashes_the_surface(
+        self, cm, route_env, tmp_path
+    ):
+        """Review regression: a degraded box can ledger a python-tier
+        row that out-measures a barely-alive device tier; the floor is
+        excluded from the router's candidate walk, and the resulting
+        contradiction must answer resolved=False — never crash
+        /debug/dispatch with a ValueError."""
+        ledger = write_ledger(tmp_path / "l.json", [
+            ("fix_generic_64", "generic", 5.0, 64),
+            ("fix_python_64", "python", 900.0, 64),
+        ])
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+        assert dispatch.LADDER.router_prefers(
+            "python", "generic", 64
+        ) is False
+        payload = dispatch.debug_dispatch_payload()  # must not raise
+        entry = next(
+            c for c in payload["order_contradictions"]
+            if c["preferred"] == "generic" and c["faster"] == "python"
+        )
+        assert entry["resolved_by_router"] is False
+
+    def test_shapeless_contradiction_is_not_claimed_resolved(
+        self, cm, route_env, tmp_path
+    ):
+        """Rows without batch provenance stay tier-level facts: the
+        shape-aware router must not claim to resolve a contradiction
+        it cannot place in a bucket."""
+        path = tmp_path / "l.json"
+        path.write_text(json.dumps({"schema": 1, "entries": [
+            {"config": "anon_keyed", "value": 700.0,
+             "unit": "sigs/sec", "dispatch_tier": "keyed"},
+            {"config": "anon_host", "value": 24_000.0,
+             "unit": "sigs/sec", "dispatch_tier": "host"},
+        ]}))
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=str(path))
+        entry = next(
+            c for c in dispatch.debug_dispatch_payload()[
+                "order_contradictions"
+            ]
+            if c["preferred"] == "keyed" and c["faster"] == "host"
+        )
+        assert entry["bucket"] is None
+        assert entry["resolved_by_router"] is False
+
+
+# -- env validation (the PR 10/13 fail-loudly convention) ----------------
+
+
+class TestRouteEnvValidation:
+    @pytest.mark.parametrize("var,reader,bad", [
+        ("CMT_TPU_ROUTE", dispatch.route_enabled_from_env, "2"),
+        ("CMT_TPU_ROUTE", dispatch.route_enabled_from_env, "yes"),
+        ("CMT_TPU_ROUTE_MIN_SAMPLES",
+         dispatch.route_min_samples_from_env, "0"),
+        ("CMT_TPU_ROUTE_MIN_SAMPLES",
+         dispatch.route_min_samples_from_env, "x"),
+        ("CMT_TPU_ROUTE_MARGIN", dispatch.route_margin_from_env, "-1"),
+        ("CMT_TPU_ROUTE_MARGIN", dispatch.route_margin_from_env, "x"),
+        ("CMT_TPU_ROUTE_COOLDOWN_S",
+         dispatch.route_cooldown_from_env, "-5"),
+        ("CMT_TPU_ROUTE_COOLDOWN_S",
+         dispatch.route_cooldown_from_env, "x"),
+    ])
+    def test_knobs_fail_loudly(self, var, reader, bad, monkeypatch):
+        monkeypatch.setenv(var, bad)
+        with pytest.raises(ValueError, match=var):
+            reader()
+
+    @pytest.mark.parametrize("var,reader,good,expect", [
+        ("CMT_TPU_ROUTE", dispatch.route_enabled_from_env, "0", False),
+        ("CMT_TPU_ROUTE", dispatch.route_enabled_from_env, "1", True),
+        ("CMT_TPU_ROUTE_MIN_SAMPLES",
+         dispatch.route_min_samples_from_env, "5", 5),
+        ("CMT_TPU_ROUTE_MARGIN",
+         dispatch.route_margin_from_env, "0.5", 0.5),
+        ("CMT_TPU_ROUTE_COOLDOWN_S",
+         dispatch.route_cooldown_from_env, "0", 0.0),
+    ])
+    def test_knobs_parse(self, var, reader, good, expect, monkeypatch):
+        monkeypatch.setenv(var, good)
+        assert reader() == expect
+
+
+# -- sealed jitguard: routing introduces zero new compile keys -----------
+
+
+class TestJitguardRouting:
+    def test_zero_new_compile_keys_under_shape_aware_routing(
+        self, cm, route_env, verifier_cls, tmp_path, monkeypatch
+    ):
+        """Acceptance: cost ordering only PERMUTES which already-
+        compiled rung a batch runs on.  Warm the generic kernel at the
+        suite's shared batch-8 shape, seal the guard, then drive the
+        same shape through BOTH orders (host-first via the seeded
+        contradiction, generic-first with routing off) — zero new
+        compile keys either way."""
+        from cometbft_tpu.ops import jitguard
+
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_8", "host", 40_000.0, 8),
+            ("fix_generic_8", "generic", 300.0, 8),
+        ])
+        monkeypatch.setattr(jitguard, "_ENABLED", True)
+        jitguard.reset()
+        try:
+            route_env(
+                CMT_TPU_ROUTE="0", CMT_TPU_PERF_LEDGER=ledger
+            )
+            warm = _fill(verifier_cls(device_min_batch=1), 8, b"warm")
+            ok, _ = warm.verify()
+            assert ok and warm._last_tier == "generic"
+            before = dict(jitguard.compile_counts())
+            jitguard.seal()
+            # cost-ordered: the seeded contradiction routes host first
+            route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+            routed = _fill(verifier_cls(device_min_batch=1), 8, b"rt1")
+            ok, _ = routed.verify()
+            assert ok and routed._last_tier == "host"
+            # static again: the generic kernel re-runs at the SAME
+            # shape — a cache hit, not a compile
+            route_env(CMT_TPU_ROUTE="0", CMT_TPU_PERF_LEDGER=ledger)
+            static = _fill(verifier_cls(device_min_batch=1), 8, b"rt2")
+            ok, _ = static.verify()
+            assert ok and static._last_tier == "generic"
+            assert jitguard.compile_counts() == before
+        finally:
+            jitguard.reset()
+
+
+# -- coalesced shape through the VerifyQueue -----------------------------
+
+
+class TestQueueShapeFlow:
+    def test_coalesced_submission_routes_by_buffer_shape(
+        self, cm, route_env, routed_cls, tmp_path
+    ):
+        """The queue's collector hands plan() the COALESCED buffer, so
+        the router sees the shape the launch will actually have — one
+        8-sig submission lands in bucket 8, not eight bucket-1
+        fragments."""
+        from cometbft_tpu.crypto import verify_queue as vq
+
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_8", "host", 40_000.0, 8),
+            ("fix_generic_8", "generic", 300.0, 8),
+        ])
+        route_env(CMT_TPU_ROUTE="1", CMT_TPU_PERF_LEDGER=ledger)
+        priv = ed.priv_key_from_secret(b"qshape")
+        msg = b"qshape-msg"
+        sig = priv.sign(msg)
+        q = vq.VerifyQueue(
+            verifier_factory=lambda pk: routed_cls(device_min_batch=1),
+            use_cache=False,
+        )
+        q.start()
+        try:
+            futs = q.submit_many(
+                [(priv.pub_key(), msg, sig)] * 8
+            )
+            assert all(f.result(30) for f in futs)
+        finally:
+            q.stop()
+        assert counter_value(
+            cm.dispatch_route, tier="host", bucket="8", source="seeded"
+        ) == 1
+
+
+# -- the mixed-shape routing smoke (make route-smoke) --------------------
+
+
+class TestRouteSmoke:
+    def test_mixed_shapes_route_to_different_tiers(
+        self, cm, route_env, routed_cls, tmp_path
+    ):
+        """The route-smoke gate: interleaved 2-sig and 2048-sig
+        batches through production plan()/execute() with a seeded cost
+        table must land their `crypto_dispatch_route` buckets on
+        DIFFERENT tiers — the 2-sig checks on host (the seeded
+        contradiction), the 2048-sig commits on the device tier the
+        static order already prefers — while every verdict stays
+        exact."""
+        ledger = write_ledger(tmp_path / "ledger.json", [
+            ("fix_host_2", "host", 30_000.0, 2),
+            ("fix_generic_2", "generic", 200.0, 2),
+            ("fix_generic_2048", "generic", 99_000.0, 2048),
+            ("fix_host_2048", "host", 20_000.0, 2048),
+        ])
+        route_env(
+            CMT_TPU_ROUTE="1", CMT_TPU_ROUTE_COOLDOWN_S="0",
+            CMT_TPU_PERF_LEDGER=ledger,
+        )
+        first_tiers = {}
+        for shape in (2, 2048, 2, 2048):
+            bv = _fill(routed_cls(device_min_batch=1), shape)
+            plan = bv.plan()
+            first_tiers.setdefault(shape, plan.tiers[0])
+            assert plan.tiers[0] == first_tiers[shape]
+            ok, results = bv.execute(plan)
+            assert ok and len(results) == shape
+        assert first_tiers == {2: "host", 2048: "generic"}
+        # both buckets visible on the route metric, on different tiers
+        assert counter_value(
+            cm.dispatch_route, tier="host", bucket="2", source="seeded"
+        ) == 2
+        assert counter_value(
+            cm.dispatch_route, tier="generic", bucket="2048",
+            source="static",
+        ) == 2
+        # and the per-batch accounting followed the routed tiers
+        assert counter_value(cm.dispatch_tier, tier="host") == 2
+        assert counter_value(cm.dispatch_tier, tier="generic") == 2
+        assert routed_cls.ran_tiers == ["generic", "generic"]

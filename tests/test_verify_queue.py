@@ -561,8 +561,14 @@ class TestSpeculativeCommitEquivalence:
         self, live_metrics, queue_guard, monkeypatch
     ):
         from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.crypto import dispatch as _dispatch
         from cometbft_tpu.ops.ed25519_verify import TpuBatchVerifier
 
+        # order-robustness: a suite that demoted the generic tier
+        # within its cool-down (test_health's watchdog drives) would
+        # otherwise rob the "control pays a device launch" assertion
+        # of its device route
+        _dispatch.reset_for_tests()
         cm, _ = live_metrics
         vals, keys, bid, commit = self._fixture()
         tampered = self._tampered(commit)
