@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -192,6 +192,22 @@ light-smoke:
 route-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_route.py \
 		-k "RouteSmoke" -q
+
+# fleet smoke: the cross-node SLO proof (ISSUE 15) — a 4-node
+# SUBPROCESS localnet (one node mixed-version: CMT_TPU_TRACE_CTX=0)
+# under sustained load must commit >= +3 strictly-increasing heights,
+# produce ONE stitched cross-node Chrome trace containing a complete
+# proposal -> gossip-hop -> quorum -> commit height tree with hops
+# from >= 2 distinct origin nodes, serve /debug/fleet, and append the
+# perfdiff-gated height_latency_p95_4node + localnet_sustained_4node
+# rows to docs/data/perf_ledger.json (CMT_TPU_FLEET_LEDGER=1 targets
+# the real ledger; the bare tier-1 run writes a scratch copy so test
+# runs never dirty the tree).  Tier-1 runs the full
+# tests/test_fleet.py suite too; `make test` gates on this target
+# alongside the other smokes
+fleet-smoke:
+	JAX_PLATFORMS=cpu CMT_TPU_FLEET_LEDGER=1 $(PY) -m pytest \
+		tests/test_fleet.py -k "FleetSmoke" -q
 
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —

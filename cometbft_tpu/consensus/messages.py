@@ -5,10 +5,23 @@ One tagged union covering the state-machine inputs (Proposal,
 BlockPart, Vote) and the gossip control messages (NewRoundStep,
 NewValidBlock, ProposalPOL, HasVote, VoteSetMaj23, VoteSetBits).  The
 same encoding serves the WAL and the p2p channels.
+
+Fleet plane (docs/observability.md "Fleet plane"): consensus-critical
+envelopes (proposal, block-part, vote) may carry an optional TRAILING
+trace-context field — origin node id, height/round, and the origin's
+wall-clock send timestamp — so receivers can record per-hop gossip
+latency and the fleet aggregator can stitch one cross-node height
+timeline.  The field is strictly additive: an untagged message encodes
+byte-identically to the pre-fleet codec, and ``decode_message``
+tolerates (and strips) the context, so tagged and untagged nodes
+interoperate in one localnet (CMT_TPU_TRACE_CTX=0 restores untagged
+sends for meshes that still contain strict pre-fleet decoders).
 """
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass, field
 
 from cometbft_tpu.types import codec
@@ -27,6 +40,64 @@ _MAX_BIT_ARRAY_BITS = 1 << 20
 
 class MessageError(ValueError):
     pass
+
+
+# -- cross-node causal trace context ------------------------------------
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Per-hop origin stamp for consensus-critical gossip.
+
+    ``origin`` is the node id of THIS hop's sender (a forwarding node
+    re-stamps, so hop latency is always sender→receiver, never a
+    multi-hop accumulation); ``send_wall`` is the sender's
+    ``time.time()`` at encode time — receivers correct it with the
+    peer clock-offset estimate (MConnection pong piggyback) before
+    histogramming, and clamp at zero.
+    """
+
+    origin: str
+    height: int
+    round: int
+    send_wall: float
+
+    @property
+    def send_wall_ns(self) -> int:
+        return int(self.send_wall * 1e9)
+
+
+def stamping_enabled() -> bool:
+    """Whether this node tags outbound consensus gossip
+    (CMT_TPU_TRACE_CTX, default on).  Off = behave like a pre-fleet
+    node: send untagged, record no hops — receiving tagged messages
+    still works, which is the mixed-version interop contract."""
+    return os.environ.get("CMT_TPU_TRACE_CTX", "1") != "0"
+
+
+def make_trace_ctx(origin: str, height: int, round_: int) -> TraceContext:
+    return TraceContext(
+        origin=origin, height=height, round=round_, send_wall=time.time()
+    )
+
+
+def _enc_trace_ctx(ctx: TraceContext) -> bytes:
+    w = ProtoWriter()
+    w.string(1, ctx.origin)
+    w.varint(2, ctx.height)
+    w.svarint(3, ctx.round)
+    w.varint(4, ctx.send_wall_ns)
+    return w.finish()
+
+
+def _dec_trace_ctx(data: bytes) -> TraceContext:
+    f = ProtoReader(data).to_dict()
+    return TraceContext(
+        origin=_bz(f.get(1, [b""])[0]).decode("utf-8", "replace"),
+        height=_iv(f.get(2, [0])[0]),
+        round=_unzigzag(_iv(f.get(3, [0])[0])),
+        send_wall=_iv(f.get(4, [0])[0]) / 1e9,
+    )
 
 
 @dataclass(frozen=True)
@@ -111,6 +182,10 @@ _TAG_VOTE = 6
 _TAG_HAS_VOTE = 7
 _TAG_VOTE_SET_MAJ23 = 8
 _TAG_VOTE_SET_BITS = 9
+#: optional trailing trace-context field (fleet plane).  15 is the
+#: last one-byte-key field number — far from the body tags so future
+#: message kinds (10..14) never collide with it.
+_TAG_TRACE_CTX = 15
 
 
 def _enc_bit_array(ba: BitArray) -> bytes:
@@ -135,7 +210,11 @@ def _dec_bit_array(data: bytes) -> BitArray:
     return BitArray.from_bytes(bits, data)
 
 
-def encode_message(msg) -> bytes:
+def encode_message(msg, ctx: TraceContext | None = None) -> bytes:
+    """Encode one consensus message; ``ctx`` (fleet plane) appends the
+    optional trailing trace-context field.  Without ``ctx`` the output
+    is byte-identical to the pre-fleet codec — the WAL and untagged
+    sends never change."""
     w = ProtoWriter()
     if isinstance(msg, NewRoundStepMessage):
         m = ProtoWriter()
@@ -193,15 +272,45 @@ def encode_message(msg) -> bytes:
         w.message(_TAG_VOTE_SET_BITS, m.finish())
     else:
         raise MessageError(f"cannot encode {type(msg).__name__}")
+    if ctx is not None:
+        w.message(_TAG_TRACE_CTX, _enc_trace_ctx(ctx))
     return w.finish()
 
 
 def decode_message(data: bytes):
+    """Decode one consensus message, dropping any trace context —
+    every pre-fleet call site keeps its exact contract."""
+    return decode_message_traced(data)[0]
+
+
+def decode_message_traced(data: bytes):
+    """Decode -> (message, TraceContext | None).
+
+    The trailing context field is stripped BEFORE the one-body check,
+    so tagged and untagged messages both parse; a malformed context on
+    a well-formed body yields ``ctx=None`` rather than rejecting the
+    message (observability must never cost consensus a vote).  Any
+    OTHER extra field still fails the strict one-body check — the
+    fuzz surface does not widen beyond the one tag."""
     f = ProtoReader(data).to_dict()
+    ctx = None
+    raw_ctx = f.pop(_TAG_TRACE_CTX, None)
+    if raw_ctx:
+        if len(raw_ctx) != 1:
+            raise MessageError("repeated trace context")
+        try:
+            ctx = _dec_trace_ctx(_bz(raw_ctx[0]))
+        except Exception:  # noqa: BLE001 — bad ctx is ignored, not fatal
+            ctx = None
     if len(f) != 1:
         raise MessageError("consensus message must have exactly one body")
     tag = next(iter(f))
-    body = _bz(f[tag][0])
+    if len(f[tag]) != 1:
+        raise MessageError("consensus message must have exactly one body")
+    return _decode_body(tag, _bz(f[tag][0])), ctx
+
+
+def _decode_body(tag: int, body: bytes):
     m = ProtoReader(body).to_dict() if tag != _TAG_PROPOSAL else None
     if tag == _TAG_NEW_ROUND_STEP:
         return NewRoundStepMessage(

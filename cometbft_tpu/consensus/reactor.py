@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass, field
 
@@ -35,8 +36,10 @@ from cometbft_tpu.consensus.messages import (
     VoteMessage,
     VoteSetBitsMessage,
     VoteSetMaj23Message,
-    decode_message,
+    decode_message_traced,
     encode_message,
+    make_trace_ctx,
+    stamping_enabled,
 )
 from cometbft_tpu.consensus.state import ConsensusState
 from cometbft_tpu.consensus.ticker import (
@@ -62,9 +65,31 @@ from cometbft_tpu.types.event_bus import (
     query_for_event,
 )
 from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.metrics import p2p_metrics
 from cometbft_tpu.utils.bit_array import BitArray
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.time import now_ns
+from cometbft_tpu.utils.trace import TRACER
+
+#: envelope types that carry (and receivers hop-record) a trace
+#: context — the consensus-critical gossip the fleet plane stitches
+_HOP_MSG_TYPES = {
+    ProposalMessage: "proposal",
+    BlockPartMessage: "block_part",
+    VoteMessage: "vote",
+}
+
+
+def gossip_hop_seconds(
+    recv_wall: float, send_wall: float, offset: float | None
+) -> float:
+    """Offset-corrected hop latency, clamped at zero.  ``offset`` is
+    the peer clock-offset estimate (remote_wall - local_wall, None
+    when no stamped pong has arrived yet): the sender's stamp is
+    converted onto OUR clock before differencing, so skewed-but-
+    estimated clocks still give ms-accurate hops, and the clamp
+    guarantees the histogram never sees a negative sample."""
+    return max(0.0, recv_wall - send_wall + (offset or 0.0))
 
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
@@ -463,6 +488,66 @@ class ConsensusReactor(Reactor):
             getattr(cfg, "peer_query_maj23_sleep_duration_ns", 0) / 1e9
             or PEER_QUERY_MAJ23_SLEEP
         )
+        #: fleet plane: stamp outbound proposal/part/vote envelopes
+        #: (CMT_TPU_TRACE_CTX=0 reverts to pre-fleet untagged sends
+        #: AND disables receive-side hop recording — the whole node
+        #: behaves like an old peer)
+        self._trace_ctx_on = stamping_enabled()
+        self._origin_id: str | None = None
+        #: hop-histogram children, resolved ONCE on first stamped
+        #: receive (the sink is installed at node assembly, which can
+        #: be after reactor construction) — the receive path must not
+        #: pay a labels() dict lookup per message (the MConnection
+        #: _m_rtt convention)
+        self._hop_hist: dict[str, object] | None = None
+
+    def _origin(self) -> str:
+        """Our node id for trace-context stamps (lazy: the switch is
+        attached after construction)."""
+        if self._origin_id is None and self.switch is not None:
+            try:
+                self._origin_id = self.switch.node_info().node_id
+            except Exception:  # noqa: BLE001 — tests without transports
+                self._origin_id = ""
+        return self._origin_id or ""
+
+    def _enc(self, msg, height: int, round_: int) -> bytes:
+        """Encode a consensus-critical message, trace-context-stamped
+        when the fleet plane is on.  The stamp is minted per SEND (a
+        relayed vote gets THIS hop's origin + wall time), which is
+        what makes p2p_gossip_hop_seconds a true per-hop latency."""
+        if not self._trace_ctx_on:
+            return encode_message(msg)
+        return encode_message(
+            msg, make_trace_ctx(self._origin(), height, round_)
+        )
+
+    def _record_hop(self, peer, msg_type: str, ctx) -> None:
+        recv_wall = time.time()
+        offset = getattr(getattr(peer, "mconn", None), "clock_offset", None)
+        hop = gossip_hop_seconds(recv_wall, ctx.send_wall, offset)
+        if self._hop_hist is None:
+            hist = p2p_metrics().gossip_hop_seconds
+            self._hop_hist = {
+                t: hist.labels(message_type=t)
+                for t in _HOP_MSG_TYPES.values()
+            }
+        self._hop_hist[msg_type].observe(hop)
+        # paint the hop interval ending at receive; keyed by
+        # (height, round, origin) these spans are the stitchable
+        # fragments the fleet aggregator joins across rings
+        TRACER.add_complete(
+            "p2p/recv_hop", time.perf_counter() - hop, hop, cat="p2p",
+            args={
+                "msg_type": msg_type,
+                "origin": ctx.origin[:16],
+                "height": ctx.height,
+                "round": ctx.round,
+                "from_peer": peer.id[:16],
+                "send_wall": ctx.send_wall,
+                "offset_corrected": offset is not None,
+            },
+        )
 
     def wait_sync(self) -> bool:
         return self._wait_sync.is_set()
@@ -610,13 +695,17 @@ class ConsensusReactor(Reactor):
 
     def receive(self, env: Envelope) -> None:
         try:
-            msg = decode_message(env.message)
+            msg, ctx = decode_message_traced(env.message)
         except Exception as exc:  # noqa: BLE001
             self.logger.error("malformed consensus msg", err=repr(exc),
                               peer=env.src.id[:10])
             if self.switch is not None:
                 self.switch.stop_peer_for_error(env.src, exc)
             return
+        if ctx is not None and self._trace_ctx_on:
+            hop_type = _HOP_MSG_TYPES.get(type(msg))
+            if hop_type is not None:
+                self._record_hop(env.src, hop_type, ctx)
         ps: PeerState = env.src.get(PEER_STATE_KEY)
         if ps is None:
             return
@@ -636,7 +725,16 @@ class ConsensusReactor(Reactor):
                 return
             if isinstance(msg, ProposalMessage):
                 ps.set_has_proposal(msg.proposal)
-                cs.send_peer_msg(msg, env.src.id)
+                # the proposal's origin stamp rides into the state
+                # machine so the height tree can record the true
+                # network-inclusive start (height/proposal_origin_wall)
+                # — unless this node opted out entirely: the escape
+                # hatch must reproduce PRE-fleet rings, not just
+                # pre-fleet sends
+                cs.send_peer_msg(
+                    msg, env.src.id,
+                    ctx=ctx if self._trace_ctx_on else None,
+                )
             elif isinstance(msg, ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)
             elif isinstance(msg, BlockPartMessage):
@@ -738,7 +836,10 @@ class ConsensusReactor(Reactor):
                     msg = BlockPartMessage(
                         height=rs["height"], round=rs["round"], part=part
                     )
-                    if peer.send(DATA_CHANNEL, encode_message(msg)):
+                    if peer.send(
+                        DATA_CHANNEL,
+                        self._enc(msg, rs["height"], rs["round"]),
+                    ):
                         ps.set_has_proposal_block_part(
                             prs.height, prs.round, index
                         )
@@ -763,7 +864,9 @@ class ConsensusReactor(Reactor):
             and not prs.proposal
         ):
             msg = ProposalMessage(proposal=rs["proposal"])
-            if peer.send(DATA_CHANNEL, encode_message(msg)):
+            if peer.send(
+                DATA_CHANNEL, self._enc(msg, rs["height"], rs["round"])
+            ):
                 ps.set_has_proposal(rs["proposal"])
             pol_round = rs["proposal"].pol_round
             if pol_round >= 0:
@@ -805,7 +908,7 @@ class ConsensusReactor(Reactor):
         if part is None:
             return False
         msg = BlockPartMessage(height=prs.height, round=prs.round, part=part)
-        if peer.send(DATA_CHANNEL, encode_message(msg)):
+        if peer.send(DATA_CHANNEL, self._enc(msg, prs.height, prs.round)):
             ps.set_has_proposal_block_part(prs.height, prs.round, index)
         return True
 
@@ -883,7 +986,10 @@ class ConsensusReactor(Reactor):
                         vote = vote_from_commit(commit, index)
                     if vote is not None:
                         msg = VoteMessage(vote=vote)
-                        if peer.send(VOTE_CHANNEL, encode_message(msg)):
+                        if peer.send(
+                            VOTE_CHANNEL,
+                            self._enc(msg, vote.height, vote.round),
+                        ):
                             with ps._mtx:
                                 if ps.prs.catchup_commit is not None:
                                     ps.prs.catchup_commit.set_index(
@@ -935,7 +1041,7 @@ class ConsensusReactor(Reactor):
         if vote is None:
             return False
         msg = VoteMessage(vote=vote)
-        if peer.send(VOTE_CHANNEL, encode_message(msg)):
+        if peer.send(VOTE_CHANNEL, self._enc(msg, vote.height, vote.round)):
             ps.set_has_vote(vote)
             return True
         return False
@@ -993,6 +1099,7 @@ __all__ = [
     "ConsensusReactor",
     "PeerState",
     "PeerRoundState",
+    "gossip_hop_seconds",
     "vote_from_commit",
     "STATE_CHANNEL",
     "DATA_CHANNEL",

@@ -78,6 +78,10 @@ class MsgInfo:
 
     msg: object
     peer_id: str = ""  # "" = internal (our own proposal/parts/votes)
+    #: fleet plane: the envelope's trace context (TraceContext | None).
+    #: Deliberately NOT WAL-encoded — replay skips span recording
+    #: anyway, so the stamp is live-path-only observability.
+    ctx: object = None
 
 
 def encode_msg_info(mi: MsgInfo) -> bytes:
@@ -228,9 +232,10 @@ class ConsensusState(BaseService):
 
     # -- public input API (reactor entry points) -------------------------
 
-    def send_peer_msg(self, msg, peer_id: str) -> None:
-        """Queue a peer message (reactor.go Receive → peerMsgQueue)."""
-        self._queue.put(("msg", MsgInfo(msg, peer_id)))
+    def send_peer_msg(self, msg, peer_id: str, ctx=None) -> None:
+        """Queue a peer message (reactor.go Receive → peerMsgQueue).
+        ``ctx`` carries the envelope's trace context when present."""
+        self._queue.put(("msg", MsgInfo(msg, peer_id, ctx)))
 
     def _send_internal(self, msg) -> None:
         """(state.go sendInternalMessage) — must never block the receive
@@ -421,7 +426,7 @@ class ConsensusState(BaseService):
         msg, peer_id = mi.msg, mi.peer_id
         with self._rs_mtx:
             if isinstance(msg, ProposalMessage):
-                self._set_proposal(msg.proposal)
+                self._set_proposal(msg.proposal, ctx=mi.ctx)
                 # stashed early parts may have completed the proposal
                 if (
                     self.proposal_block_parts is not None
@@ -754,8 +759,9 @@ class ConsensusState(BaseService):
 
     # -- proposal handling ------------------------------------------------
 
-    def _set_proposal(self, proposal: Proposal) -> None:  # holds _rs_mtx
-        """(state.go:2048 defaultSetProposal)"""
+    def _set_proposal(self, proposal: Proposal, ctx=None) -> None:  # holds _rs_mtx
+        """(state.go:2048 defaultSetProposal); ``ctx`` is the gossip
+        envelope's trace context when the sender stamped it."""
         if self.proposal is not None:
             return
         if proposal.height != self.height or proposal.round != self.round:
@@ -795,15 +801,34 @@ class ConsensusState(BaseService):
         if not self._replay_mode:
             # zero-duration mark: where in the height's timeline the
             # proposal landed (docs/observability.md height pipeline)
+            recv_args = {
+                "height": proposal.height,
+                "round": proposal.round,
+                "parent": "height/pipeline",
+            }
+            if ctx is not None:
+                recv_args["origin"] = ctx.origin[:16]
+                recv_args["origin_send_wall"] = ctx.send_wall
             _tracer.add_complete(
                 "height/proposal_received", time.perf_counter(), 0.0,
-                cat="height",
-                args={
-                    "height": proposal.height,
-                    "round": proposal.round,
-                    "parent": "height/pipeline",
-                },
+                cat="height", args=recv_args,
             )
+            if ctx is not None:
+                # the remote proposer's SEND wall time: with this mark
+                # in the tree, a stitched height shows true
+                # network-inclusive latency — local _height_t0 only
+                # sees the proposal ARRIVE (fleet plane satellite)
+                _tracer.add_complete(
+                    "height/proposal_origin_wall", time.perf_counter(),
+                    0.0, cat="height",
+                    args={
+                        "height": proposal.height,
+                        "round": proposal.round,
+                        "origin": ctx.origin[:16],
+                        "send_wall": ctx.send_wall,
+                        "parent": "height/pipeline",
+                    },
+                )
             FLIGHT.record(
                 "proposal", height=proposal.height, round=proposal.round,
                 hash=proposal.block_id.hash.hex()[:12],

@@ -53,6 +53,10 @@ _INSPECT_ROUTES = (
     # dispatch-ladder state: which tiers were demoted, why, and when
     # — the first question after a device-lost run (crypto/dispatch.py)
     "debug/dispatch",
+    # fleet rollup: an inspector pointed at live peers via
+    # CMT_TPU_FLEET_PEERS still aggregates the rest of the localnet
+    # (its own row is trace/flight-only — no live registry)
+    "debug/fleet",
     # verified header ranges from the stopped node's stores — a light
     # client can keep syncing off an inspector (light/serve.py)
     "light_sync",

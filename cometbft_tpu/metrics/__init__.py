@@ -174,6 +174,8 @@ class P2PMetrics:
             self.peer_pending_send_bytes = _NOP
             self.num_txs = _NOP
             self.ping_rtt_seconds = _NOP
+            self.gossip_hop_seconds = _NOP
+            self.peer_clock_offset_seconds = _NOP
             self.send_queue_size = self.send_queue_bytes = _NOP
             self.send_timeouts = self.try_send_failures = _NOP
             self.send_rate_bytes = self.recv_rate_bytes = _NOP
@@ -211,6 +213,22 @@ class P2PMetrics:
             "observed on the matching pong).",
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                      1.0, 2.5),
+            labels=("peer_id",),
+        )
+        self.gossip_hop_seconds = reg.histogram(
+            s, "gossip_hop_seconds",
+            "Per-hop gossip latency of trace-context-stamped consensus "
+            "messages (origin send wall to local receive, peer "
+            "clock-offset corrected, clamped at zero).",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5),
+            labels=("message_type",),
+        )
+        self.peer_clock_offset_seconds = reg.gauge(
+            s, "peer_clock_offset_seconds",
+            "Estimated remote-minus-local wall-clock offset per peer "
+            "(pong piggyback, RTT halved; the correction applied to "
+            "gossip hop latency).",
             labels=("peer_id",),
         )
         self.send_queue_size = reg.gauge(
@@ -1000,6 +1018,70 @@ def install_p2p_metrics(metrics: P2PMetrics | None) -> None:
     _P2P = metrics if metrics is not None else P2PMetrics(None)
 
 
+class FleetMetrics:
+    """Fleet observability plane (utils/fleetobs.py) — what the
+    aggregating node learns about the localnet it scrapes.  No
+    metricsgen analog: the reference observes one process per
+    exporter; this family exists precisely because nothing else can
+    see N nodes as one system (docs/observability.md "Fleet
+    plane")."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.scrapes = _NOP
+            self.scrape_seconds = _NOP
+            self.nodes = _NOP
+            self.height_skew = _NOP
+            self.height_lag = _NOP
+            return
+        s = "fleet"
+        self.scrapes = reg.counter(
+            s, "scrapes",
+            "Per-peer fleet scrapes (/metrics + /trace + "
+            "/debug/flight), by result (ok | error).",
+            labels=("node", "result"),
+        )
+        self.scrape_seconds = reg.histogram(
+            s, "scrape_seconds",
+            "Wall time of one full peer scrape (all three surfaces).",
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.nodes = reg.gauge(
+            s, "nodes",
+            "Nodes (self included) covered by the last fleet rollup.",
+        )
+        self.height_skew = reg.gauge(
+            s, "height_skew",
+            "Max minus min committed height across the fleet at the "
+            "last rollup — the first number an operator reads.",
+        )
+        self.height_lag = reg.gauge(
+            s, "height_lag",
+            "Heights a node sits behind the fleet maximum at the last "
+            "rollup.",
+            labels=("node",),
+        )
+
+
+#: Process-wide sink for the fleet plane — the /debug/fleet handler
+#: and tools/fleet_scrape.py run with no node handle.  Same contract
+#: as the crypto sink: no-op by default, node assembly installs the
+#: real struct, last installed wins.
+_FLEET = FleetMetrics(None)
+
+
+def fleet_metrics() -> FleetMetrics:
+    """The currently installed fleet-plane sink (never None)."""
+    return _FLEET
+
+
+def install_fleet_metrics(metrics: FleetMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide fleet sink (None
+    resets to the no-op)."""
+    global _FLEET
+    _FLEET = metrics if metrics is not None else FleetMetrics(None)
+
+
 class NodeMetrics:
     """Bundle wired at node assembly (node/node.go:334)."""
 
@@ -1012,6 +1094,7 @@ class NodeMetrics:
         self.crypto = CryptoMetrics(reg)
         self.health = HealthMetrics(reg)
         self.light = LightMetrics(reg)
+        self.fleet = FleetMetrics(reg)
         self.rpc = RPCMetrics(reg)
         self.event_bus = EventBusMetrics(reg)
         self.blocksync = BlockSyncMetrics(reg)
@@ -1028,6 +1111,7 @@ __all__ = [
     "CryptoMetrics",
     "EventBusMetrics",
     "EvidenceMetrics",
+    "FleetMetrics",
     "HealthMetrics",
     "LightMetrics",
     "MempoolMetrics",
@@ -1040,8 +1124,10 @@ __all__ = [
     "StoreMetrics",
     "WALMetrics",
     "crypto_metrics",
+    "fleet_metrics",
     "health_metrics",
     "install_crypto_metrics",
+    "install_fleet_metrics",
     "install_health_metrics",
     "install_light_metrics",
     "install_p2p_metrics",

@@ -137,6 +137,7 @@ class Node(BaseService):
         from cometbft_tpu.metrics import (
             NodeMetrics,
             install_crypto_metrics,
+            install_fleet_metrics,
             install_health_metrics,
             install_light_metrics,
             install_p2p_metrics,
@@ -165,6 +166,9 @@ class Node(BaseService):
             # the light serving plane (header cache + request surface,
             # light/serve.py) — consulted from RPC handler threads
             install_light_metrics(self.metrics.light)
+            # the fleet plane (/debug/fleet + tools/fleet_scrape.py)
+            # scrapes with no node handle — same sink pattern
+            install_fleet_metrics(self.metrics.fleet)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
@@ -530,6 +534,7 @@ class Node(BaseService):
             statesync_reactor=self.statesync_reactor,
             unsafe=config.rpc.unsafe,
             metrics=self.metrics.rpc,
+            metrics_registry=self.metrics.registry,
         )
         self.rpc_server: JSONRPCServer | None = None
         if config.rpc.laddr:

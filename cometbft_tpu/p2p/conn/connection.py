@@ -80,9 +80,19 @@ def encode_packet_ping() -> bytes:
     return w.finish()
 
 
-def encode_packet_pong() -> bytes:
+def encode_packet_pong(wall: float | None = None) -> bytes:
+    """Pong keepalive.  ``wall`` (fleet plane) piggybacks the
+    responder's ``time.time()`` as a varint-ns field INSIDE the pong
+    body — pre-fleet decoders ignore the body entirely (they only
+    test for the pong key), so stamped and empty pongs interoperate
+    both directions."""
     w = ProtoWriter()
-    w.message(_F_PONG, b"")
+    if wall is None:
+        w.message(_F_PONG, b"")
+    else:
+        b = ProtoWriter()
+        b.varint(1, int(wall * 1e9))
+        w.message(_F_PONG, b.finish())
     return w.finish()
 
 
@@ -97,12 +107,25 @@ def encode_packet_msg(channel_id: int, eof: bool, data: bytes) -> bytes:
 
 
 def decode_packet(data: bytes):
-    """Returns ('ping',), ('pong',) or ('msg', channel_id, eof, payload)."""
+    """Returns ('ping',), ('pong', wall_ns | None) or
+    ('msg', channel_id, eof, payload).  ``wall_ns`` is the responder's
+    piggybacked wall clock (None from pre-fleet peers' empty pongs)."""
     f = ProtoReader(data).to_dict()
     if _F_PING in f:
         return ("ping",)
     if _F_PONG in f:
-        return ("pong",)
+        from cometbft_tpu.types.codec import as_bytes as _ab, as_int as _ai
+
+        wall_ns = None
+        try:
+            body = _ab(f[_F_PONG][0])
+            if body:
+                pf = ProtoReader(body).to_dict()
+                if 1 in pf:
+                    wall_ns = _ai(pf[1][0]) or None
+        except Exception:  # noqa: BLE001 — a garbled stamp is no stamp
+            wall_ns = None
+        return ("pong", wall_ns)
     if _F_MSG in f:
         from cometbft_tpu.types.codec import as_bytes, as_int
 
@@ -245,6 +268,16 @@ class MConnection(BaseService):
         # on exactly the degraded links the metric exists to expose)
         self._ping_sent_q: deque[float] = deque()
         self.last_rtt: float | None = None
+        #: fleet plane: estimated ``remote_wall - local_wall`` from the
+        #: pong piggyback (NTP-style midpoint: the responder's stamp
+        #: lands half an RTT before the pong arrives).  None until the
+        #: first stamped pong (pre-fleet peers never produce one).
+        self.clock_offset: float | None = None
+        self._offset_rtt: float | None = None  # RTT quality of the estimate
+        self._offset_at: float = 0.0           # monotonic acceptance time
+        self._m_clock_offset = self.metrics.peer_clock_offset_seconds.labels(
+            peer_id=peer_id
+        )
         self.last_error: str | None = None
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
@@ -413,8 +446,30 @@ class MConnection(BaseService):
         self.conn.write(encode_uvarint(len(pkt)) + pkt)
 
     def _send_pong(self) -> None:
-        pkt = encode_packet_pong()
+        # stamp as close to the write as possible: the responder-side
+        # delay between stamp and wire is part of the RTT the receiver
+        # halves, so a late stamp biases the offset estimate
+        pkt = encode_packet_pong(time.time())
         self.conn.write(encode_uvarint(len(pkt)) + pkt)
+
+    def _note_clock_offset(self, remote_wall: float, rtt: float) -> None:
+        """Fold one pong's piggybacked wall clock into the per-peer
+        offset estimate.  Prefer low-RTT samples (their midpoint
+        assumption is tightest) but never let the estimate go stale:
+        a sample is accepted if it is comparable quality to the one
+        we hold, or the held one is older than ~2 minutes."""
+        sample = remote_wall - (time.time() - rtt / 2.0)
+        now = time.monotonic()
+        held = self._offset_rtt
+        if (
+            held is None
+            or rtt <= held * 1.25 + 0.002
+            or now - self._offset_at > 120.0
+        ):
+            self.clock_offset = sample
+            self._offset_rtt = rtt
+            self._offset_at = now
+            self._m_clock_offset.set(sample)
 
     def _ping_routine(self) -> None:
         cfg = self.config
@@ -464,6 +519,11 @@ class MConnection(BaseService):
                             self._last_pong - self._ping_sent_q.popleft()
                         )
                         self._m_rtt.observe(self.last_rtt)
+                        wall_ns = pkt[1] if len(pkt) > 1 else None
+                        if wall_ns:
+                            self._note_clock_offset(
+                                wall_ns / 1e9, self.last_rtt
+                            )
                 else:
                     _, ch_id, eof, payload = pkt
                     ch = self.channels.get(ch_id)
@@ -492,6 +552,7 @@ class MConnection(BaseService):
             "send": self._send_monitor.status(),
             "recv": self._recv_monitor.status(),
             "ping_rtt": self.last_rtt,
+            "clock_offset": self.clock_offset,
             "pending_send_bytes": self.pending_send_bytes(),
             "last_error": self.last_error,
             "channels": [

@@ -359,6 +359,7 @@ class Switch(BaseService):
         m.recv_rate_bytes.remove(peer_id=peer.id)
         m.num_txs.remove(peer_id=peer.id)
         m.ping_rtt_seconds.remove(peer_id=peer.id)
+        m.peer_clock_offset_seconds.remove(peer_id=peer.id)
         for ch_id, name in self.channel_names.items():
             cid = f"{ch_id:#x}"
             m.send_queue_size.remove(peer_id=peer.id, chID=cid)

@@ -133,6 +133,7 @@ class Environment:
         statesync_reactor=None,
         unsafe=False,
         metrics=None,
+        metrics_registry=None,
     ):
         from cometbft_tpu.metrics import RPCMetrics
 
@@ -155,6 +156,9 @@ class Environment:
         self.statesync_reactor = statesync_reactor
         self.unsafe = unsafe
         self.metrics = metrics if metrics is not None else RPCMetrics()
+        #: the node's metric Registry (fleet plane: /debug/fleet reads
+        #: SELF's families in-process rather than over the wire)
+        self.metrics_registry = metrics_registry
         self._gen_chunks: list[str] | None = None  # lazy (env.go InitGenesisChunks)
         self._subs: dict[str, dict[str, object]] = {}  # client -> query -> sub
         self._subs_mtx = cmtsync.Mutex()
@@ -223,6 +227,8 @@ class Environment:
             "debug/perf": self.debug_perf,
             # GET /debug/dispatch: failover-ladder state + chaos plan
             "debug/dispatch": self.debug_dispatch,
+            # GET /debug/fleet: cross-node rollup + stitched heights
+            "debug/fleet": self.debug_fleet,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -445,6 +451,25 @@ class Environment:
         from cometbft_tpu.crypto.dispatch import debug_dispatch_payload
 
         return debug_dispatch_payload()
+
+    def debug_fleet(self) -> dict:
+        """Fleet-plane rollup (utils/fleetobs.py): scrape the metrics
+        servers named in CMT_TPU_FLEET_PEERS, merge SELF in-process,
+        and return the per-node height/lag/tier/queue table plus the
+        stitched cross-node height summary.  Served on a live node
+        AND in inspect mode (docs/observability.md "Fleet plane")."""
+        import os as _os
+
+        from cometbft_tpu.utils import fleetobs
+
+        scrapes = fleetobs.scrape_fleet(
+            fleetobs.fleet_peer_targets(
+                _os.environ.get("CMT_TPU_FLEET_PEERS")
+            ),
+            include_self=True,
+            self_registry=self.metrics_registry,
+        )
+        return fleetobs.fleet_payload(scrapes)
 
     def genesis_route(self) -> dict:
         import json as _json

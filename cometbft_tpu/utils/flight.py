@@ -80,7 +80,13 @@ class FlightRecorder:
     def record(self, kind: str, **fields) -> None:
         """Append one event.  ``fields`` must be JSON-able primitives
         (call sites hex() bytes); the hot path builds one dict and
-        appends — no lock, no I/O."""
+        appends — no lock, no I/O.
+
+        ``t`` is WALL clock (``time.time()``), by contract: the fleet
+        aggregator merges flight rings from N nodes onto one timeline
+        keyed on it, so a monotonic stamp here would force per-ring
+        offset archaeology.  One clock read per event — nothing else
+        on this path may add a syscall."""
         self._ring.append(
             {
                 "t": time.time(),
@@ -107,6 +113,9 @@ class FlightRecorder:
             "depth": self.depth,
             "recorded_total": self.recorded_total,
             "dropped": max(0, self.recorded_total - len(events)),
+            # event "t" stamps are wall clock — the fleet aggregator
+            # merges rings across nodes on this promise
+            "clock": "wall",
             "events": events,
         }
 

@@ -143,6 +143,11 @@ class SpanTracer:
         #: perf_counter origin; event ts values are microseconds since
         #: this instant (Chrome traces need any consistent monotonic us)
         self.epoch = time.perf_counter()
+        #: wall clock captured at the same instant as ``epoch`` — the
+        #: anchor that lets the fleet aggregator place this ring's
+        #: (monotonic-derived) span timestamps on a cross-node wall
+        #: timeline: wall_of(ts_us) = epoch_wall + ts_us/1e6
+        self.epoch_wall = time.time()
         self._dropped = 0
         # getpid() is a real syscall on sandboxed kernels (~10us) —
         # cache it; _PID_TRACERS refreshes after fork
@@ -245,7 +250,12 @@ class SpanTracer:
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_spans": self._dropped},
+            "otherData": {
+                "dropped_spans": self._dropped,
+                # fleet plane: the wall anchor for cross-node stitching
+                "wall_epoch": self.epoch_wall,
+                "pid": pid,
+            },
         }
 
     def export_json(self) -> str:

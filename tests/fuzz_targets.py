@@ -140,9 +140,23 @@ def _ws_target(data: bytes) -> None:
 
 
 def _seed_reactor_msgs() -> list[bytes]:
+    from cometbft_tpu.consensus.messages import (
+        HasVoteMessage,
+        TraceContext,
+        encode_message,
+    )
     from cometbft_tpu.mempool.reactor import encode_txs
 
     seeds = [encode_txs([b"tx1", b"tx2"])]
+    # a trace-context-TAGGED consensus message: the fuzzer mutates the
+    # trailing field through decode_message_traced's lenient path (a
+    # garbled context must never reject a well-formed body)
+    hv = HasVoteMessage(height=3, round=0, type=1, index=2)
+    ctx = TraceContext(
+        origin="ab" * 20, height=3, round=0, send_wall=1700000000.5
+    )
+    seeds.append(encode_message(hv))
+    seeds.append(encode_message(hv, ctx))
     try:
         from cometbft_tpu.p2p.pex.reactor import encode_pex_request
 

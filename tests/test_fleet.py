@@ -1,0 +1,934 @@
+"""Fleet observability plane (ISSUE 15).
+
+Unit tiers: the trace-context trailing-field codec (round trip +
+backward compat both directions + a deterministic trailing-field fuzz
+pass), the pong-piggyback clock-offset estimator, hop-latency math
+(the never-negative clamp), and the fleetobs aggregator
+(parse/merge/stitch/latency/rollup) on synthetic scrapes.
+
+The smoke tier (``make fleet-smoke``, gated into ``make test``) spins
+a REAL 4-node subprocess localnet — one node mixed-version
+(CMT_TPU_TRACE_CTX=0, i.e. a pre-fleet peer) — drives it with
+``loadtime.SustainedLoader`` over the RPC wire, and asserts the
+ISSUE's acceptance shape: >= +3 strictly-increasing committed
+heights, ONE stitched cross-node Chrome trace containing a complete
+proposal → gossip-hop → quorum → commit height tree with hops from
+>= 2 distinct origin nodes, a live ``/debug/fleet`` rollup, and the
+perfdiff-gated ``height_latency_p95_4node`` +
+``localnet_sustained_4node`` ledger rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from cometbft_tpu.consensus.messages import (  # noqa: E402
+    BlockPartMessage,
+    HasVoteMessage,
+    MessageError,
+    ProposalMessage,
+    TraceContext,
+    VoteMessage,
+    decode_message,
+    decode_message_traced,
+    encode_message,
+    make_trace_ctx,
+)
+from cometbft_tpu.consensus.reactor import gossip_hop_seconds  # noqa: E402
+from cometbft_tpu.utils import fleetobs  # noqa: E402
+
+# deadlock-lane scaling, same contract as test_e2e_perturb
+DEADLINE_SCALE = 5.0 if os.environ.get("CMT_TPU_DEADLOCK") else 1.0
+
+BASE_PORT = 27470       # p2p/rpc pairs (testnet --starting-port layout)
+METRICS_PORT = 27490    # + node index
+N_NODES = 4
+UNTAGGED = 3            # the mixed-version (pre-fleet) node
+
+
+def _mk_vote():
+    from cometbft_tpu.types.block import BlockID
+    from cometbft_tpu.types.vote import Vote
+
+    return Vote(
+        type=1, height=7, round=0, block_id=BlockID(),
+        timestamp_ns=1, validator_address=b"\x01" * 20,
+        validator_index=0, signature=b"\x02" * 64,
+    )
+
+
+class TestTraceCtxCodec:
+    """Satellite: round trip + backward compat both directions."""
+
+    def test_round_trip_all_stamped_types(self):
+        from cometbft_tpu.types.part_set import PartSet
+
+        ps = PartSet.from_bytes(b"block-bytes" * 40, part_size=64)
+        msgs = [
+            HasVoteMessage(height=7, round=0, type=1, index=2),
+            BlockPartMessage(height=7, round=0, part=ps.get_part(0)),
+            VoteMessage(vote=_mk_vote()),
+        ]
+        for msg in msgs:
+            ctx = make_trace_ctx("origin-node-id", 7, 0)
+            got, got_ctx = decode_message_traced(encode_message(msg, ctx))
+            assert got == msg
+            assert got_ctx is not None
+            assert got_ctx.origin == "origin-node-id"
+            assert got_ctx.height == 7 and got_ctx.round == 0
+            assert abs(got_ctx.send_wall - ctx.send_wall) < 1e-6
+
+    def test_untagged_encoding_is_byte_identical_prefix(self):
+        """old→new: a pre-fleet sender's bytes are exactly what we
+        produce without ctx — and the tagged encoding only APPENDS."""
+        msg = HasVoteMessage(height=3, round=1, type=2, index=0)
+        plain = encode_message(msg)
+        tagged = encode_message(msg, make_trace_ctx("n", 3, 1))
+        assert tagged.startswith(plain)
+        assert len(tagged) > len(plain)
+        got, ctx = decode_message_traced(plain)
+        assert got == msg and ctx is None
+
+    def test_tagged_parses_for_ctx_blind_consumer(self):
+        """new→old inside this tree: decode_message (every pre-fleet
+        call site, including the WAL replay path) strips the context
+        silently."""
+        msg = HasVoteMessage(height=3, round=1, type=2, index=0)
+        tagged = encode_message(msg, make_trace_ctx("n", 3, 1))
+        assert decode_message(tagged) == msg
+
+    def test_strictness_preserved(self):
+        """The one-body check still rejects everything EXCEPT the one
+        context tag — the codec's attack surface does not widen."""
+        from cometbft_tpu.utils.protoio import ProtoWriter
+
+        msg = HasVoteMessage(height=1, round=0, type=1, index=0)
+        plain = encode_message(msg)
+        # a second body
+        with pytest.raises(MessageError):
+            decode_message(plain + plain)
+        # an unknown extra field (tag 14, not the ctx tag)
+        w = ProtoWriter()
+        w.bytes_(14, b"junk")
+        with pytest.raises(MessageError):
+            decode_message(plain + w.finish())
+        # no body at all
+        with pytest.raises(MessageError):
+            decode_message(b"")
+
+    def test_malformed_ctx_never_rejects_body(self):
+        """Observability must not cost consensus a message: a garbled
+        trailing field decodes as ctx=None."""
+        from cometbft_tpu.utils.protoio import ProtoWriter
+
+        msg = HasVoteMessage(height=1, round=0, type=1, index=0)
+        plain = encode_message(msg)
+        w = ProtoWriter()
+        w.bytes_(15, b"\xff\xfe\xfd")  # ctx tag, garbage payload
+        got, ctx = decode_message_traced(plain + w.finish())
+        assert got == msg and ctx is None
+
+    def test_trailing_field_fuzz_deterministic(self):
+        """Satellite: fuzz the trailing field — random mutations of
+        the context bytes must either parse (any ctx) or fall back to
+        ctx=None, and the body ALWAYS survives."""
+        rng = random.Random(0xF1EE7)
+        msg = HasVoteMessage(height=9, round=2, type=1, index=5)
+        plain = encode_message(msg)
+        tagged = encode_message(msg, make_trace_ctx("ab" * 20, 9, 2))
+        tail = bytearray(tagged[len(plain):])
+        for _ in range(1500):
+            mutated = bytearray(tail)
+            for _ in range(rng.randint(1, 4)):
+                op = rng.randrange(3)
+                if op == 0 and mutated:
+                    mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+                elif op == 1 and len(mutated) > 1:
+                    del mutated[rng.randrange(len(mutated))]
+                else:
+                    mutated.insert(
+                        rng.randrange(len(mutated) + 1), rng.randrange(256)
+                    )
+            try:
+                got, _ctx = decode_message_traced(plain + bytes(mutated))
+            except ValueError:
+                # the mutation broke protobuf framing or escaped the
+                # ctx tag into a strict reject (MessageError subclasses
+                # ValueError) — fail-closed is fine, crash is not
+                continue
+            assert got == msg
+
+    def test_reactor_msgs_corpus_replays_clean(self):
+        """The guided-fuzz corpus (now seeded with a tagged message)
+        replays through the new decode path with zero crashes."""
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        from fuzz_targets import make_fuzzers
+
+        (fz,) = make_fuzzers(["reactor_msgs"])
+        rep = fz.replay()
+        assert not rep.crashes, rep.crashes
+
+
+class TestClockOffsetAndHop:
+    def test_pong_codec_round_trip(self):
+        from cometbft_tpu.p2p.conn.connection import (
+            decode_packet,
+            encode_packet_pong,
+        )
+
+        kind, wall_ns = decode_packet(encode_packet_pong(1700000000.25))
+        assert kind == "pong"
+        assert wall_ns == int(1700000000.25 * 1e9)
+        # pre-fleet empty pong: no stamp
+        kind, wall_ns = decode_packet(encode_packet_pong())
+        assert kind == "pong" and wall_ns is None
+
+    def _mconn(self):
+        from cometbft_tpu.p2p.conn.connection import (
+            ChannelDescriptor,
+            MConnection,
+        )
+
+        class _NullConn:
+            def write(self, b):
+                pass
+
+            def read_exact(self, n):
+                raise EOFError
+
+            def close(self):
+                pass
+
+        return MConnection(
+            _NullConn(), [ChannelDescriptor(id=0x01)],
+            on_receive=lambda *a: None, peer_id="peertest",
+        )
+
+    def test_offset_estimate_prefers_low_rtt(self):
+        mc = self._mconn()
+        now = time.time()
+        # first sample always accepted
+        mc._note_clock_offset(now + 0.5, rtt=0.010)
+        first = mc.clock_offset
+        assert first == pytest.approx(0.5, abs=0.02)
+        # a much worse-RTT sample is rejected (estimate unchanged)
+        mc._note_clock_offset(time.time() + 5.0, rtt=0.500)
+        assert mc.clock_offset == first
+        # a comparable/better sample replaces
+        mc._note_clock_offset(time.time() + 1.0, rtt=0.004)
+        assert mc.clock_offset == pytest.approx(1.0, abs=0.02)
+
+    def test_offset_estimate_refreshes_when_stale(self):
+        mc = self._mconn()
+        mc._note_clock_offset(time.time(), rtt=0.001)
+        mc._offset_at -= 200.0  # age the estimate past the 120s bound
+        mc._note_clock_offset(time.time() + 2.0, rtt=0.800)
+        assert mc.clock_offset == pytest.approx(2.0, abs=0.5)
+
+    def test_status_carries_offset(self):
+        mc = self._mconn()
+        assert mc.status()["clock_offset"] is None
+        mc._note_clock_offset(time.time() + 0.25, rtt=0.002)
+        assert mc.status()["clock_offset"] == pytest.approx(0.25, abs=0.02)
+
+    def test_hop_never_negative(self):
+        """Acceptance: p2p_gossip_hop_seconds never goes negative —
+        the offset correction clamps."""
+        now = time.time()
+        # sender's clock runs AHEAD: raw difference would be negative
+        assert gossip_hop_seconds(now, now + 5.0, None) == 0.0
+        # correction recovers the true hop when the offset is known
+        assert gossip_hop_seconds(
+            now, now + 5.0 - 0.010, 5.0
+        ) == pytest.approx(0.010, abs=1e-6)
+        # over-corrected (estimate noise) still clamps
+        assert gossip_hop_seconds(now, now + 0.001, -0.010) == 0.0
+
+    def test_hop_records_metric_and_span(self):
+        from cometbft_tpu.metrics import (
+            P2PMetrics,
+            install_p2p_metrics,
+            p2p_metrics,
+        )
+        from cometbft_tpu.utils.metrics import Registry
+        from cometbft_tpu.utils.trace import TRACER
+
+        class _FakeMConn:
+            clock_offset = 0.0
+
+        class _FakePeer:
+            id = "peer-a" * 7
+            mconn = _FakeMConn()
+
+        from cometbft_tpu.consensus.reactor import ConsensusReactor
+
+        reg = Registry("t")
+        install_p2p_metrics(P2PMetrics(reg))
+        try:
+            r = ConsensusReactor.__new__(ConsensusReactor)
+            r._trace_ctx_on = True
+            r._hop_hist = None
+            ctx = TraceContext(
+                origin="origin-x", height=11, round=0,
+                send_wall=time.time() - 0.003,
+            )
+            r._record_hop(_FakePeer(), "vote", ctx)
+            child = p2p_metrics().gossip_hop_seconds.labels(
+                message_type="vote"
+            )
+            assert child._count == 1
+            assert 0.0 <= child._sum < 5.0
+            hops = [
+                e for e in TRACER.events()
+                if e["name"] == "p2p/recv_hop"
+                and e["args"].get("height") == 11
+            ]
+            assert hops and hops[-1]["args"]["origin"] == "origin-x"
+        finally:
+            install_p2p_metrics(None)
+
+
+class TestFleetObs:
+    def test_parse_prom_text(self):
+        text = "\n".join(
+            [
+                "# HELP x_y help",
+                "# TYPE x_y gauge",
+                'cometbft_consensus_latest_block_height 42',
+                'cometbft_crypto_dispatch_current_tier{tier="host"} 1',
+                'cometbft_crypto_dispatch_current_tier{tier="keyed"} 0',
+                'p2p_gossip_hop_seconds_count{message_type="vote"} 9',
+                'p2p_gossip_hop_seconds_sum{message_type="vote"} 0.018',
+                'weird{label="a\\"b"} 1.5',
+                "malformed line without value",
+            ]
+        )
+        parsed = fleetobs.parse_prom_text(text)
+        s = fleetobs.NodeScrape(name="n", metrics=parsed)
+        assert fleetobs.series_value(
+            s, "consensus_latest_block_height"
+        ) == 42.0
+        tiers = fleetobs.series(s, "crypto_dispatch_current_tier")
+        assert {lbl["tier"]: v for lbl, v in tiers} == {
+            "host": 1.0, "keyed": 0.0,
+        }
+        assert fleetobs.series_value(
+            s, "gossip_hop_seconds_count", {"message_type": "vote"}
+        ) == 9.0
+        weird = [lbl for (lbl, _) in fleetobs.series(s, "weird")]
+        assert weird[0]["label"] == 'a"b'
+
+    def _synthetic_scrapes(self):
+        """Two nodes, shifted wall epochs: node-a proposes (its send
+        stamps start the height), both commit, hops from two
+        origins."""
+        t0 = 1_700_000_000.0
+
+        def span(name, ts_us, dur_us, **args):
+            return {
+                "name": name, "cat": "x", "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": 1, "tid": 1, "args": args,
+            }
+
+        a = fleetobs.NodeScrape(
+            name="node-a",
+            trace={
+                "traceEvents": [
+                    span("height/pipeline", 100.0, 50_000.0, height=5,
+                         round=0),
+                    span("p2p/recv_hop", 5_000.0, 800.0, height=5,
+                         round=0, origin="node-b",
+                         send_wall=t0 + 0.0042, msg_type="vote"),
+                    span("height/quorum_prevote", 30_000.0, 0.0,
+                         height=5, round=0),
+                ],
+                "otherData": {"wall_epoch": t0},
+            },
+            flight=[{"t": t0 + 0.02, "kind": "commit", "height": 5}],
+            metrics=fleetobs.parse_prom_text(
+                "cometbft_consensus_latest_block_height 5\n"
+                'cometbft_crypto_dispatch_current_tier{tier="host"} 1\n'
+            ),
+        )
+        b = fleetobs.NodeScrape(
+            name="node-b",
+            trace={
+                "traceEvents": [
+                    span("height/pipeline", 200.0, 61_000.0, height=5,
+                         round=0),
+                    span("height/proposal_origin_wall", 900.0, 0.0,
+                         height=5, round=0, origin="node-a",
+                         send_wall=t0 + 0.001),
+                    span("height/proposal_received", 950.0, 0.0,
+                         height=5, round=0),
+                    span("p2p/recv_hop", 1_000.0, 500.0, height=5,
+                         round=0, origin="node-a",
+                         send_wall=t0 + 0.001, msg_type="proposal"),
+                ],
+                # node-b's ring epoch sits 10ms later on the wall
+                "otherData": {"wall_epoch": t0 + 0.010},
+            },
+            metrics=fleetobs.parse_prom_text(
+                "cometbft_consensus_latest_block_height 4\n"
+            ),
+        )
+        return t0, a, b
+
+    def test_stitch_and_latency(self):
+        t0, a, b = self._synthetic_scrapes()
+        stitched = fleetobs.stitch_heights([a, b])
+        assert set(stitched) == {5}
+        ent = stitched[5]
+        assert ent["proposal"] and ent["quorum"] and ent["commit"]
+        assert ent["origins"] == {"node-a", "node-b"}
+        assert ent["hops"] == 2
+        assert ent["committed_on"] == {"node-a", "node-b"}
+        # earliest send stamp: the proposer's t0+0.001
+        assert ent["first_send_wall"] == pytest.approx(t0 + 0.001)
+        # latest commit end: node-b's pipeline end on the wall =
+        # (t0+0.010) + (200+61000)/1e6
+        assert ent["commit_end_wall"] == pytest.approx(
+            t0 + 0.010 + 0.0612, abs=1e-6
+        )
+        assert fleetobs.complete_heights(stitched, min_origins=2) == [5]
+        lat = fleetobs.height_latencies_ms(stitched)
+        assert lat[5] == pytest.approx(
+            (0.010 + 0.0612 - 0.001) * 1e3, abs=0.01
+        )
+
+    def test_merge_traces_wall_alignment(self):
+        t0, a, b = self._synthetic_scrapes()
+        merged = fleetobs.merge_traces([a, b])
+        events = merged["traceEvents"]
+        by_node = {}
+        names = {}
+        for e in events:
+            if e.get("ph") == "M" and e["name"] == "process_name":
+                names[e["pid"]] = e["args"]["name"]
+            if e.get("ph") == "X" and e["name"] == "height/pipeline":
+                by_node[e["pid"]] = e
+        assert sorted(names.values()) == ["node-a", "node-b"]
+        # node-b's events shift by its 10ms epoch offset
+        a_pid = next(p for p, n in names.items() if n == "node-a")
+        b_pid = next(p for p, n in names.items() if n == "node-b")
+        assert by_node[a_pid]["ts"] == pytest.approx(100.0)
+        assert by_node[b_pid]["ts"] == pytest.approx(200.0 + 10_000.0)
+        # flight events ride along as instants
+        assert any(
+            e.get("cat") == "flight" and e["name"] == "commit"
+            for e in events
+        )
+        assert merged["otherData"]["nodes"] == ["node-a", "node-b"]
+
+    def test_rollup_and_fleet_gauges(self):
+        from cometbft_tpu.metrics import (
+            FleetMetrics,
+            install_fleet_metrics,
+        )
+        from cometbft_tpu.utils.metrics import Registry
+
+        _t0, a, b = self._synthetic_scrapes()
+        reg = Registry("t")
+        install_fleet_metrics(FleetMetrics(reg))
+        try:
+            rollup = fleetobs.fleet_rollup([a, b])
+            assert rollup["max_height"] == 5
+            assert rollup["height_skew"] == 1
+            rows = {n["node"]: n for n in rollup["nodes"]}
+            assert rows["node-a"]["height_lag"] == 0
+            assert rows["node-b"]["height_lag"] == 1
+            assert rows["node-a"]["dispatch_tier"] == "host"
+            text = reg.expose()
+            assert "t_fleet_height_skew 1" in text
+            assert 't_fleet_height_lag{node="node-b"} 1' in text
+            assert "t_fleet_nodes 2" in text
+        finally:
+            install_fleet_metrics(None)
+
+    def test_node_identities_from_offset_gauges(self):
+        id_a, id_b = "aa" * 20, "bb" * 20
+        a = fleetobs.NodeScrape(
+            name="a",
+            metrics=fleetobs.parse_prom_text(
+                f'cometbft_p2p_peer_clock_offset_seconds{{peer_id="{id_b}"}} 0.25\n'
+            ),
+        )
+        b = fleetobs.NodeScrape(
+            name="b",
+            metrics=fleetobs.parse_prom_text(
+                f'cometbft_p2p_peer_clock_offset_seconds{{peer_id="{id_a}"}} -0.25\n'
+            ),
+        )
+        assert fleetobs.node_identities([a, b]) == {id_a: "a", id_b: "b"}
+        # a node with no samples yet stays unmapped, corrects by 0
+        c = fleetobs.NodeScrape(name="c")
+        ids = fleetobs.node_identities([a, b, c])
+        assert ids == {id_a: "a", id_b: "b"}
+        corr = fleetobs.clock_corrections([a, b, c])
+        assert corr == {"a": 0.0, "b": 0.25, "c": 0.0}
+
+    def test_skewed_clock_is_corrected_in_stitch_and_merge(self):
+        """A node whose wall clock runs 250ms AHEAD must not inflate
+        the stitched height latency: the reference node's offset
+        gauge realigns its commit end and its origin send stamps."""
+        id_a, id_b = "aa" * 20, "bb" * 20
+        t0 = 1_700_000_000.0
+        skew = 0.250
+
+        def span(name, ts_us, dur_us, **args):
+            return {
+                "name": name, "cat": "x", "ph": "X", "ts": ts_us,
+                "dur": dur_us, "pid": 1, "tid": 1, "args": args,
+            }
+
+        a = fleetobs.NodeScrape(
+            name="a",
+            trace={
+                "traceEvents": [
+                    # a received b's proposal: send stamp is on B'S
+                    # skewed clock
+                    span("p2p/recv_hop", 2_000.0, 500.0, height=3,
+                         round=0, origin=id_b[:16],
+                         send_wall=t0 + 0.001 + skew,
+                         msg_type="proposal"),
+                    span("height/quorum_prevote", 30_000.0, 0.0,
+                         height=3, round=0),
+                    span("height/proposal_received", 2_500.0, 0.0,
+                         height=3, round=0),
+                    span("height/pipeline", 100.0, 40_000.0, height=3,
+                         round=0),
+                ],
+                "otherData": {"wall_epoch": t0},
+            },
+            metrics=fleetobs.parse_prom_text(
+                f'p2p_peer_clock_offset_seconds{{peer_id="{id_b}"}} {skew}\n'
+            ),
+        )
+        b = fleetobs.NodeScrape(
+            name="b",
+            trace={
+                "traceEvents": [
+                    span("height/pipeline", 0.0, 50_000.0, height=3,
+                         round=0),
+                    span("p2p/recv_hop", 10_000.0, 400.0, height=3,
+                         round=0, origin=id_a[:16],
+                         send_wall=t0 + 0.004, msg_type="vote"),
+                ],
+                # b's ring anchor carries the skew: true wall t0+0.002
+                "otherData": {"wall_epoch": t0 + 0.002 + skew},
+            },
+            metrics=fleetobs.parse_prom_text(
+                f'p2p_peer_clock_offset_seconds{{peer_id="{id_a}"}} {-skew}\n'
+            ),
+        )
+        stitched = fleetobs.stitch_heights([a, b])
+        ent = stitched[3]
+        # b's proposal send stamp realigned onto a's clock
+        assert ent["first_send_wall"] == pytest.approx(
+            t0 + 0.001, abs=1e-6
+        )
+        # latest commit end: b's pipeline end = (t0+0.002) + 0.050
+        assert ent["commit_end_wall"] == pytest.approx(
+            t0 + 0.052, abs=1e-6
+        )
+        lat = fleetobs.height_latencies_ms(stitched)
+        assert lat[3] == pytest.approx(51.0, abs=0.01)
+        # merged timeline shifts b's events back by the skew
+        merged = fleetobs.merge_traces([a, b])
+        assert merged["otherData"]["clock_corrections"]["b"] == skew
+        names = {
+            e["pid"]: e["args"]["name"]
+            for e in merged["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "process_name"
+        }
+        b_pid = next(p for p, n in names.items() if n == "b")
+        b_pipe = next(
+            e for e in merged["traceEvents"]
+            if e.get("pid") == b_pid and e["name"] == "height/pipeline"
+        )
+        assert b_pipe["ts"] == pytest.approx(2_000.0, abs=0.2)
+
+    def test_stale_height_lag_children_retired(self):
+        from cometbft_tpu.metrics import (
+            FleetMetrics,
+            install_fleet_metrics,
+        )
+        from cometbft_tpu.utils.metrics import Registry
+
+        reg = Registry("t")
+        install_fleet_metrics(FleetMetrics(reg))
+        try:
+            mk = lambda name, h: fleetobs.NodeScrape(  # noqa: E731
+                name=name,
+                metrics=fleetobs.parse_prom_text(
+                    f"cometbft_consensus_latest_block_height {h}\n"
+                ),
+            )
+            fleetobs.fleet_rollup([mk("n1", 10), mk("n2", 9)])
+            assert 't_fleet_height_lag{node="n2"} 1' in reg.expose()
+            # n2 departs the peer set: its child must retire
+            fleetobs.fleet_rollup([mk("n1", 11), mk("n3", 11)])
+            text = reg.expose()
+            assert 'node="n2"' not in text
+            assert 't_fleet_height_lag{node="n3"} 0' in text
+        finally:
+            install_fleet_metrics(None)
+
+    def test_percentile(self):
+        assert fleetobs.percentile([], 95) == 0.0
+        vals = [float(i) for i in range(1, 101)]
+        assert fleetobs.percentile(vals, 50) == 50.0
+        assert fleetobs.percentile(vals, 95) == 95.0
+        assert fleetobs.percentile([7.0], 95) == 7.0
+
+    def test_scrape_error_is_data(self):
+        s = fleetobs.scrape_node("127.0.0.1:1", name="dead", timeout=0.2)
+        assert s.error is not None
+        rollup = fleetobs.fleet_rollup([s])
+        assert rollup["scrape_errors"] == 1
+
+    def test_fleet_peer_targets(self):
+        assert fleetobs.fleet_peer_targets(None) == []
+        assert fleetobs.fleet_peer_targets(" a:1, b:2 ,") == ["a:1", "b:2"]
+
+
+class TestWallClockContracts:
+    """Satellite: cross-node merges must not need per-ring offset
+    archaeology — flight events stamp wall clock, the span ring
+    exports its wall anchor."""
+
+    def test_flight_events_stamp_wall_clock(self):
+        from cometbft_tpu.utils.flight import FlightRecorder
+
+        fr = FlightRecorder(depth=16)
+        before = time.time()
+        fr.record("probe", x=1)
+        after = time.time()
+        (ev,) = fr.events()
+        assert before <= ev["t"] <= after  # wall, not monotonic
+        assert fr.export()["clock"] == "wall"
+
+    def test_tracer_exports_wall_epoch(self):
+        from cometbft_tpu.utils.trace import SpanTracer
+
+        before = time.time()
+        tr = SpanTracer(capacity=16, enabled=True)
+        after = time.time()
+        assert before <= tr.epoch_wall <= after
+        with tr.span("x"):
+            pass
+        other = tr.export()["otherData"]
+        assert other["wall_epoch"] == tr.epoch_wall
+        # the anchor converts ring ts (us since epoch) to wall time
+        ev = tr.events()[-1]
+        wall = other["wall_epoch"] + ev["ts"] / 1e6
+        assert abs(wall - time.time()) < 5.0
+
+
+class TestDebugSurfaces:
+    """Satellite: the /debug index + inspect mode list the new route."""
+
+    def test_debug_endpoints_lists_fleet(self):
+        from cometbft_tpu.utils.metrics import DEBUG_ENDPOINTS
+
+        paths = {p for p, _, _ in DEBUG_ENDPOINTS}
+        assert "/debug/fleet" in paths
+        assert "debug/fleet" in paths
+        helps = {p: h for p, _, h in DEBUG_ENDPOINTS}
+        assert "CMT_TPU_FLEET_PEERS" in helps["/debug/fleet"]
+
+    def test_rpc_route_registered(self):
+        from cometbft_tpu.rpc.core import Environment
+
+        env = Environment()
+        assert "debug/fleet" in env.routes()
+
+    def test_inspect_mode_includes_fleet(self):
+        from cometbft_tpu.inspect import _INSPECT_ROUTES
+
+        assert "debug/fleet" in _INSPECT_ROUTES
+
+
+# -- the 4-node SLO smoke -------------------------------------------------
+
+
+def _rpc(port: int, method: str, timeout: float = 3.0, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read())
+    if body.get("error"):
+        raise RuntimeError(body["error"])
+    return body["result"]
+
+
+def _rpc_port(i: int) -> int:
+    return BASE_PORT + 2 * i + 1
+
+
+def _metrics_addr(i: int) -> str:
+    return f"127.0.0.1:{METRICS_PORT + i}"
+
+
+def _height(port: int) -> int:
+    return int(_rpc(port, "status")["sync_info"]["latest_block_height"])
+
+
+def _wait_heights(ports, target: int, timeout: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout * DEADLINE_SCALE
+    pending = set(ports)
+    while pending:
+        for p in list(pending):
+            try:
+                if _height(p) >= target:
+                    pending.discard(p)
+            except Exception:
+                pass
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"nodes on ports {sorted(pending)} never reached "
+                f"height {target}"
+            )
+        time.sleep(0.3)
+
+
+class _FleetNet:
+    """4-node subprocess localnet with per-node metrics servers; node
+    UNTAGGED runs pre-fleet (CMT_TPU_TRACE_CTX=0) and node 0 is the
+    aggregator (CMT_TPU_FLEET_PEERS points at its three peers)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.procs: dict[int, subprocess.Popen] = {}
+        self.env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+
+    def init(self) -> None:
+        subprocess.run(
+            [
+                sys.executable, "-m", "cometbft_tpu", "testnet",
+                "--v", str(N_NODES), "--o", self.root,
+                "--chain-id", "fleet-chain",
+                "--starting-port", str(BASE_PORT),
+            ],
+            env=self.env, check=True, capture_output=True, cwd=REPO,
+        )
+        from cometbft_tpu.config import Config
+
+        for i in range(N_NODES):
+            cfg = Config.load(os.path.join(self.root, f"node{i}"))
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = _metrics_addr(i)
+            cfg.save()
+
+    def start(self, i: int) -> None:
+        env = dict(self.env)
+        if i == UNTAGGED:
+            env["CMT_TPU_TRACE_CTX"] = "0"
+        if i == 0:
+            env["CMT_TPU_FLEET_PEERS"] = ",".join(
+                _metrics_addr(j) for j in range(N_NODES) if j != 0
+            )
+        with open(
+            os.path.join(self.root, f"node{i}.log"), "ab", buffering=0
+        ) as log:
+            self.procs[i] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "cometbft_tpu",
+                    "--home", os.path.join(self.root, f"node{i}"),
+                    "start",
+                ],
+                env=env, stdout=subprocess.DEVNULL, stderr=log, cwd=REPO,
+            )
+
+    def stop_all(self) -> None:
+        import signal as _signal
+
+        for p in self.procs.values():
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet_net(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fleetnet"))
+    n = _FleetNet(root)
+    n.init()
+    for i in range(N_NODES):
+        n.start(i)
+    try:
+        _wait_heights([_rpc_port(i) for i in range(N_NODES)], 2)
+        yield n
+    finally:
+        n.stop_all()
+
+
+class TestFleetSmoke:
+    def test_fleet_smoke(self, fleet_net, tmp_path):
+        from cometbft_tpu.loadtime import SustainedLoader
+
+        ports = [_rpc_port(i) for i in range(N_NODES)]
+        targets = [_metrics_addr(i) for i in range(N_NODES)]
+        names = [f"node{i}" for i in range(N_NODES)]
+
+        h0 = max(_height(p) for p in ports)
+        t_load0 = time.monotonic()
+        loader = SustainedLoader(
+            endpoints=[f"http://127.0.0.1:{p}" for p in ports],
+            workers=4, tx_size=64,
+        )
+        report = loader.run([(40, 5.0)])
+        assert report["accepted"] > 0, report
+        # >= +3 strictly-increasing committed heights under load
+        _wait_heights(ports, h0 + 3)
+        load_span = time.monotonic() - t_load0
+
+        # -- scrape + stitch ------------------------------------------
+        scrapes = fleetobs.scrape_fleet(targets, names=names)
+        errs = {s.name: s.error for s in scrapes if s.error}
+        assert not errs, errs
+
+        merged = fleetobs.merge_traces(scrapes)
+        assert merged["traceEvents"], "stitched trace is empty"
+        out = tmp_path / "fleet_trace.json"
+        out.write_text(json.dumps(merged))
+        assert out.stat().st_size > 0
+
+        stitched = fleetobs.stitch_heights(scrapes)
+        complete = fleetobs.complete_heights(stitched, min_origins=2)
+        assert complete, (
+            "no complete proposal->hop->quorum->commit tree with hops "
+            f"from >= 2 origins; stitched={ {h: {k: (sorted(v) if isinstance(v, set) else v) for k, v in e.items()} for h, e in stitched.items()} }"
+        )
+        # hop spans from >= 2 distinct ORIGIN nodes in one tree
+        assert any(len(stitched[h]["origins"]) >= 2 for h in complete)
+
+        lat = fleetobs.height_latencies_ms(stitched)
+        assert lat, "no cross-node height latencies measurable"
+        for h, ms in lat.items():
+            assert 0.0 <= ms < 60_000.0, (h, ms)
+        p95 = fleetobs.percentile(list(lat.values()), 95.0)
+        assert p95 > 0.0
+
+        # -- ledger rows (perfdiff-gated units) -----------------------
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import perfdiff
+        import perfledger
+
+        # `make fleet-smoke` (CMT_TPU_FLEET_LEDGER=1) appends to the
+        # real ledger; a bare tier-1 run writes a scratch copy so test
+        # runs never dirty the tree
+        if os.environ.get("CMT_TPU_FLEET_LEDGER"):
+            ledger_path = perfledger.default_path()
+        else:
+            ledger_path = str(tmp_path / "perf_ledger.json")
+        measured = time.strftime("%Y-%m-%dT%H:%M:%S")
+        rows = [
+            perfledger.make_entry(
+                "height_latency_p95_4node", round(p95, 3), "ms",
+                "fleet_smoke", measured=measured,
+                heights=len(lat), nodes=N_NODES,
+            ),
+            perfledger.make_entry(
+                "localnet_sustained_4node",
+                report["accepted_per_sec"], "tx/sec",
+                "fleet_smoke", measured=measured,
+                accepted=report["accepted"], shed=report["shed"],
+                errors=report["errors"],
+                load_span_s=round(load_span, 1), nodes=N_NODES,
+            ),
+        ]
+        perfledger.append(rows, path=ledger_path)
+        doc = perfledger.load(ledger_path)
+        got = {
+            e["config"]: e for e in doc["entries"]
+            if e.get("source") == "fleet_smoke"
+        }
+        assert "height_latency_p95_4node" in got
+        assert "localnet_sustained_4node" in got
+        # perfdiff gating direction: latency regresses UP
+        assert got["height_latency_p95_4node"]["unit"] in (
+            perfdiff.LOWER_BETTER_UNITS
+        )
+
+        # -- /debug/fleet live on the aggregator ----------------------
+        with urllib.request.urlopen(
+            f"http://{_metrics_addr(0)}/debug/fleet", timeout=10
+        ) as resp:
+            payload = json.loads(resp.read())
+        rollup = payload["rollup"]
+        assert len(rollup["nodes"]) == N_NODES  # 3 peers + self
+        assert rollup["max_height"] >= h0 + 3
+        by_err = [n for n in rollup["nodes"] if n["error"]]
+        assert not by_err, by_err
+        # the index route knows about it too
+        with urllib.request.urlopen(
+            f"http://{_metrics_addr(0)}/debug", timeout=5
+        ) as resp:
+            index = json.loads(resp.read())
+        assert any(
+            e["path"] == "/debug/fleet" for e in index["endpoints"]
+        )
+
+        # -- mixed-version interop ------------------------------------
+        # the untagged (pre-fleet) node committed right along (it is
+        # in the _wait_heights set above) and records NO hops...
+        untagged = scrapes[UNTAGGED]
+        assert sum(
+            v for _, v in fleetobs.series(
+                untagged, "p2p_gossip_hop_seconds_count"
+            )
+        ) == 0.0
+        # ...and emits NO fleet-plane span types at all: the escape
+        # hatch reproduces pre-fleet rings, not just pre-fleet sends
+        assert not [
+            e for e in untagged.span_events()
+            if e["name"] in ("p2p/recv_hop", "height/proposal_origin_wall")
+        ]
+        # ...while tagged nodes hop-recorded stamped gossip, and no
+        # histogram ever saw a negative sample (sum >= 0 with counts)
+        tagged_counts = 0.0
+        for s in scrapes:
+            if s.name == f"node{UNTAGGED}":
+                continue
+            c = sum(
+                v for _, v in fleetobs.series(
+                    s, "p2p_gossip_hop_seconds_count"
+                )
+            )
+            t = sum(
+                v for _, v in fleetobs.series(
+                    s, "p2p_gossip_hop_seconds_sum"
+                )
+            )
+            tagged_counts += c
+            assert t >= 0.0
+        assert tagged_counts > 0.0

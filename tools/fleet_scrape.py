@@ -1,0 +1,152 @@
+"""Fleet scraper — merge N nodes' observability rings into one view.
+
+    python tools/fleet_scrape.py --targets 127.0.0.1:26660,127.0.0.1:26662
+    python tools/fleet_scrape.py --targets ... --out /tmp/fleet_trace.json
+    python tools/fleet_scrape.py --targets ... --heights --json
+
+Scrapes each node's ``/metrics``, ``/trace`` and ``/debug/flight``
+(the metrics-server surfaces), aligns them on wall clock, and:
+
+- writes ONE Chrome trace-event file (``--out``) with pid = node —
+  load it in Perfetto to see proposal → gossip hop → quorum → commit
+  across the fleet on a single timeline;
+- prints the fleet rollup (per-node committed height + lag behind the
+  fleet max, one-hot dispatch tier, verify-queue depths, gossip-hop
+  aggregates, per-peer clock offsets) — the skew/lag table;
+- with ``--heights``, prints the stitched per-height trees and the
+  cross-node proposal→commit latency p50/p95 (the
+  ``height_latency_p95_4node`` SLO's formula).
+
+The same machinery serves live on any node at ``/debug/fleet``
+(peers from CMT_TPU_FLEET_PEERS).  See docs/observability.md
+"Fleet plane" for the clock-offset caveat.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_rollup(rollup: dict) -> str:
+    lines = [
+        f"fleet: {len(rollup['nodes'])} nodes, max height "
+        f"{rollup['max_height']}, skew {rollup['height_skew']}, "
+        f"{rollup['scrape_errors']} scrape errors",
+        f"{'node':<24} {'height':>7} {'lag':>4} {'tier':<12} "
+        f"{'hops':>6} {'hop avg ms':>10}  queue depth",
+    ]
+    for n in rollup["nodes"]:
+        if n["error"]:
+            lines.append(f"{n['node']:<24} SCRAPE ERROR: {n['error']}")
+            continue
+        q = ",".join(
+            f"{k}={int(v)}" for k, v in sorted(
+                (n.get("verify_queue_depth") or {}).items()
+            )
+        )
+        lines.append(
+            f"{n['node']:<24} {n['height'] if n['height'] is not None else '-':>7} "
+            f"{n['height_lag'] if n['height_lag'] is not None else '-':>4} "
+            f"{(n['dispatch_tier'] or '-'):<12} "
+            f"{n['gossip_hops']:>6} "
+            f"{(n['gossip_hop_avg_ms'] if n['gossip_hop_avg_ms'] is not None else '-'):>10}  {q}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge N nodes' observability rings into one view"
+    )
+    ap.add_argument(
+        "--targets", required=True,
+        help="comma-separated metrics-server addresses (host:port)",
+    )
+    ap.add_argument(
+        "--names", default="",
+        help="comma-separated display names (default: the targets)",
+    )
+    ap.add_argument(
+        "--out", default="",
+        help="write the merged Chrome trace-event JSON here",
+    )
+    ap.add_argument(
+        "--heights", action="store_true",
+        help="print stitched per-height trees + latency percentiles",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the full /debug/fleet payload as JSON on stdout",
+    )
+    ap.add_argument("--timeout", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    from cometbft_tpu.utils import fleetobs
+
+    targets = fleetobs.fleet_peer_targets(args.targets)
+    names = [n for n in args.names.split(",") if n] or None
+    scrapes = fleetobs.scrape_fleet(
+        targets, names=names, timeout=args.timeout
+    )
+    if all(s.error for s in scrapes):
+        print("every target failed to scrape:", file=sys.stderr)
+        for s in scrapes:
+            print(f"  {s.name}: {s.error}", file=sys.stderr)
+        return 1
+
+    payload = fleetobs.fleet_payload(scrapes)
+
+    if args.out:
+        merged = fleetobs.merge_traces(scrapes)
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(merged, f)
+        os.replace(tmp, args.out)
+        print(
+            f"wrote {len(merged['traceEvents'])} events -> {args.out}",
+            file=sys.stderr,
+        )
+
+    if args.json:
+        json.dump(payload, sys.stdout, indent=1, default=str)
+        print()
+        return 0
+
+    print(_fmt_rollup(payload["rollup"]))
+    if args.heights:
+        lat = {
+            h: ent["latency_ms"]
+            for h, ent in payload["stitched_heights"].items()
+            if ent.get("latency_ms") is not None
+        }
+        complete = payload["complete_heights"]
+        print(
+            f"\nstitched heights: {len(payload['stitched_heights'])} "
+            f"({len(complete)} complete: {complete})"
+        )
+        for h, ent in payload["stitched_heights"].items():
+            print(
+                f"  h={h} proposal={ent['proposal']} hops={ent['hops']} "
+                f"origins={ent['origins']} quorum={ent['quorum']} "
+                f"commit={ent['commit']} on={ent['committed_on']} "
+                f"latency_ms={ent.get('latency_ms')}"
+            )
+        if lat:
+            vals = list(lat.values())
+            print(
+                f"cross-node proposal->commit latency: "
+                f"p50={fleetobs.percentile(vals, 50):.1f}ms "
+                f"p95={fleetobs.percentile(vals, 95):.1f}ms "
+                f"over {len(vals)} heights"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

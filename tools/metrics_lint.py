@@ -69,6 +69,7 @@ SUBSYSTEMS = {
     "StoreMetrics": "store",
     "EvidenceMetrics": "evidence",
     "LightMetrics": "light",
+    "FleetMetrics": "fleet",
 }
 
 #: structs whose every field must ALSO be documented in
@@ -94,6 +95,15 @@ DOC_CHECKED = (
     # the light serving plane (ISSUE 13): cache hit rate and serve
     # latency are the serving SLO surface
     "LightMetrics",
+    # the fleet plane (ISSUE 15): the cross-node rollup is the first
+    # table an operator reads — every series in it must be
+    # interpretable from the docs
+    "FleetMetrics",
+    # the wire plane joined when the fleet plane added
+    # p2p_gossip_hop_seconds / p2p_peer_clock_offset_seconds: hop
+    # latency is the SLO's numerator, so the whole family is now
+    # doc-gated both directions
+    "P2PMetrics",
 )
 
 DOC_FILES = (
