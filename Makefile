@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -15,7 +15,7 @@ PY ?= python
 # AND jitcheck too, so one prerequisite covers them (and all run
 # inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke perf-gate
+test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -208,6 +208,20 @@ route-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu CMT_TPU_FLEET_LEDGER=1 $(PY) -m pytest \
 		tests/test_fleet.py -k "FleetSmoke" -q
+
+# attribution smoke: the critical-path proof (ISSUE 16) — a
+# single-validator node under the always-on sampling profiler must
+# commit >= +3 heights, serve non-empty SPAN-TAGGED folded stacks at
+# /debug/profile, decompose every committed height into the stage
+# taxonomy with residual < 20% of the wall, and a seeded 200 ms
+# store/save_block slowdown must be NAMED dominant both by the
+# `attribution_height_critical_stage` gauge and by perfdiff's
+# stage explanation (`perfdiff --selftest` runs inside).  Tier-1 runs
+# the full tests/test_critpath.py + tests/test_profiler.py suites
+# too; `make test` gates on this target alongside the other smokes
+attr-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_profiler.py \
+		tests/test_critpath.py -k "AttrSmoke or SeededStoreSlowdown" -q
 
 # perf regression gate: proves perfdiff's calibration on the seeded
 # fixture pair (a 20% regression MUST fail, 3% noise MUST pass) —

@@ -141,6 +141,38 @@ def _height_pipeline_provenance(n_heights: int = 3) -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}  # fail the bench
 
 
+def _start_profiler():
+    """Best-effort: a dedicated sampling profiler for this bench
+    process (utils/profiler.py) so every measured row carries its
+    top-k leaf hotspots as ledger provenance — what the number was
+    spending its host CPU on.  97 Hz (prime) is cheap against a
+    multi-second bench and fine-grained enough to rank hotspots."""
+    try:
+        from cometbft_tpu.utils.profiler import SamplingProfiler
+
+        p = SamplingProfiler(hz=97, capacity=8192)
+        p.start()
+        return p
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        log(f"bench profiler unavailable (ignored): {exc}")
+        return None
+
+
+def _attach_hotspots(p, *rows: dict, k: int = 5) -> None:
+    """Stop ``p`` and record its top-k hotspots on each row (the
+    ``hotspots`` provenance key tools/perfledger.py carries)."""
+    if p is None:
+        return
+    try:
+        p.stop()
+        hot = p.top_functions(k)
+        if hot:
+            for r in rows:
+                r.setdefault("hotspots", hot)
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        log(f"hotspot attach failed (ignored): {exc}")
+
+
 def _base_result(value: float, platform: str) -> dict:
     """The headline JSON shape — ONE definition for every path."""
     return {
@@ -167,6 +199,7 @@ def main(checkpoint=None) -> dict:
     watchdog SIGKILL mid-benchmark (e.g. during the keyed section's
     compile) cannot discard an already-measured number."""
     _enable_compile_cache()
+    _bench_prof = _start_profiler()
     import jax
 
     from cometbft_tpu.utils.trace import TRACER as _tr
@@ -254,6 +287,7 @@ def main(checkpoint=None) -> dict:
             + ")"
         )
         result["jit_compiles"] = _jg.compile_counts()  # empty: no device
+        _attach_hotspots(_bench_prof, result)
         if os.environ.get("CMT_BENCH_PIPELINE", "1") != "0":
             result["height_pipeline"] = _height_pipeline_provenance()
         return result
@@ -499,6 +533,7 @@ def main(checkpoint=None) -> dict:
     # measured sections (assertable steady-state provenance)
     result["jit_compiles"] = _jg.compile_counts()
     result["steady_retraces"] = steady_retraces
+    _attach_hotspots(_bench_prof, result)
     if os.environ.get("CMT_BENCH_PIPELINE", "1") != "0":
         # per-stage height-pipeline breakdown on this machine (the
         # replication-plane analog of the per-seam compile counts)
@@ -514,6 +549,7 @@ def keyed_mesh_main() -> dict:
     MULTICHIP_KEYED.json (the MULTICHIP provenance for the keyed tier;
     tools/device_campaign.py runs this as its keyed_mesh step)."""
     _enable_compile_cache()
+    _bench_prof = _start_profiler()
     import jax
 
     import numpy as np
@@ -605,6 +641,7 @@ def keyed_mesh_main() -> dict:
     log("wrote MULTICHIP_KEYED.json")
     from tools import perfledger
 
+    _attach_hotspots(_bench_prof, result)
     perfledger.append_rows([result], source="bench --keyed-mesh")
     install_crypto_metrics(None)
     return result
@@ -623,6 +660,7 @@ def pipelined_main() -> dict:
     sync-vs-pipelined regressions, and the measured
     crypto_host_device_overlap_ratio ships in the pipelined row."""
     _enable_compile_cache()
+    _bench_prof = _start_profiler()
     import numpy as np  # noqa: F401 — keep jax import order stable
 
     from cometbft_tpu.crypto import batch as crypto_batch
@@ -751,9 +789,128 @@ def pipelined_main() -> dict:
             "measured": measured,
         },
     ]
+    _attach_hotspots(_bench_prof, result, *rows)
     perfledger.append_rows(rows, source="bench --pipelined")
     install_crypto_metrics(None)
     install_health_metrics(None)
+    return result
+
+
+def host_phase_profile_main(out: str | None = None) -> dict:
+    """``bench.py --host-phase-profile``: drive the crypto HOST phase
+    (the ROADMAP item-3 bottleneck: SHA-512 cache-key prehash, input
+    packing, Merkle root) under the sampling profiler, span-tagged,
+    and write the attributed evidence to
+    docs/data/host_phase_profile.json — the committed artifact behind
+    the prehash/pack/Merkle dominance claim in
+    docs/device_kernel_perf.md.  Stdlib + numpy only (no device):
+    the host phase is host work by definition, so the artifact is
+    reproducible on any box."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.crypto.verify_queue import cache_key
+    from cometbft_tpu.utils.profiler import SamplingProfiler
+    from cometbft_tpu.utils.trace import TRACER
+
+    n = int(os.environ.get("CMT_BENCH_N", "4096"))
+    rounds = int(os.environ.get("CMT_BENCH_ITERS", "6"))
+    priv = ed.priv_key_from_secret(b"host-phase-profile")
+    pub = priv.pub_key().bytes()
+    rng = np.random.RandomState(3)
+    msgs = [rng.bytes(120) for _ in range(n)]
+    sigs = [priv.sign(m) for m in msgs]
+    txs = [rng.bytes(250) for _ in range(n)]
+
+    # 331 Hz (prime): the phases below run ~hundreds of ms each, so
+    # the default 19 Hz would rank them on a handful of samples
+    p = SamplingProfiler(hz=331, capacity=8192, tracer=TRACER)
+    p.start()
+    timings: dict[str, float] = {}
+    try:
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            with TRACER.span("host_phase/prehash", cat="crypto"):
+                for m, s in zip(msgs, sigs):
+                    cache_key(pub, m, s)
+            t1 = time.perf_counter()
+            with TRACER.span("host_phase/pack", cat="crypto"):
+                np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(
+                    n, 64
+                )
+                np.frombuffer(
+                    b"".join(pub for _ in range(n)), dtype=np.uint8
+                ).reshape(n, 32)
+                lens = np.asarray([len(m) for m in msgs], np.int32)
+                pad = int(lens.max())
+                np.frombuffer(
+                    b"".join(m.ljust(pad, b"\0") for m in msgs),
+                    dtype=np.uint8,
+                ).reshape(n, pad)
+            t2 = time.perf_counter()
+            with TRACER.span("host_phase/merkle", cat="crypto"):
+                merkle.hash_from_byte_slices(txs)
+            t3 = time.perf_counter()
+            timings["prehash"] = timings.get("prehash", 0.0) + (t1 - t0)
+            timings["pack"] = timings.get("pack", 0.0) + (t2 - t1)
+            timings["merkle"] = timings.get("merkle", 0.0) + (t3 - t2)
+    finally:
+        p.stop()
+    total = sum(timings.values())
+    spans = p.span_seconds()
+    phase_samples = {
+        k[len("host_phase/"):]: v
+        for k, v in spans.items()
+        if k.startswith("host_phase/")
+    }
+    result = {
+        "config": "crypto/host_phase",
+        "n": n,
+        "rounds": rounds,
+        "wall_s": round(total, 3),
+        "phase_seconds": {k: round(v, 4) for k, v in timings.items()},
+        "phase_share": {
+            k: round(v / total, 4) for k, v in timings.items()
+        },
+        "phase_samples": phase_samples,
+        "sigs_per_sec_prehash": (
+            round(n * rounds / timings["prehash"], 1)
+            if timings.get("prehash") else None
+        ),
+        "hz": p.hz,
+        "samples": p.payload()["samples"],
+        "hotspots": p.top_functions(10),
+        "measured": time.strftime("%Y-%m-%d %H:%M"),
+        "note": (
+            "host phase driven standalone (no device): SHA-512 "
+            "cache-key prehash + input packing + Merkle root — the "
+            "ROADMAP item-3 dominance evidence"
+        ),
+    }
+    out = out or os.path.join(
+        REPO, "docs", "data", "host_phase_profile.json"
+    )
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, out)
+    log(f"wrote {out}")
+    from tools import perfledger
+
+    perfledger.append_rows(
+        [
+            {
+                "config": "host_phase_prehash",
+                "value": result["sigs_per_sec_prehash"],
+                "unit": "sigs/sec",
+                "hotspots": result["hotspots"][:5],
+                "measured": result["measured"],
+            }
+        ],
+        source="bench --host-phase-profile",
+    )
     return result
 
 
@@ -994,5 +1151,7 @@ if __name__ == "__main__":
         print(json.dumps(keyed_mesh_main()), flush=True)
     elif "--pipelined" in sys.argv[1:]:
         print(json.dumps(pipelined_main()), flush=True)
+    elif "--host-phase-profile" in sys.argv[1:]:
+        print(json.dumps(host_phase_profile_main()), flush=True)
     else:
         run()

@@ -284,6 +284,19 @@ def main() -> None:
             device=str(dev),
         )
 
+    # attribution plane: sample the whole matrix once, window each
+    # row's hotspots to the interval since the previous record — the
+    # row's provenance says what the host CPU ran while it measured
+    prof = None
+    try:
+        from cometbft_tpu.utils.profiler import SamplingProfiler
+
+        prof = SamplingProfiler(hz=97, capacity=8192)
+        prof.start()
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        log(f"profiler unavailable (continuing without): {exc}")
+    last_record = [time.time()]
+
     def record(config: str, value: float, unit: str, **extra):
         row = {"config": config, "value": round(value, 2), "unit": unit}
         row.update(extra)
@@ -297,12 +310,22 @@ def main() -> None:
         compiles = compiles_delta()
         if compiles:
             row["jit_compiles"] = compiles
+        if prof is not None:
+            try:
+                window = max(time.time() - last_record[0], 0.0)
+                hot = prof.top_functions(5, seconds=window)
+                if hot:
+                    row["hotspots"] = hot
+            except Exception:  # noqa: BLE001 — provenance only
+                pass
+        last_record[0] = time.time()
         row["measured"] = time.strftime("round 6, %Y-%m-%d")
         results.append(row)
         print(json.dumps(row), flush=True)
         checkpoint()
         # every measured row lands in the perf ledger with its
-        # provenance (tier, compiles) — the regression gate's input
+        # provenance (tier, compiles, hotspots) — the regression
+        # gate's input
         from tools import perfledger
 
         perfledger.append_rows([row], source="bench_all")
@@ -953,6 +976,8 @@ def main() -> None:
     )
 
     checkpoint()
+    if prof is not None:
+        prof.stop()
     log("wrote BENCH_ALL.json")
 
 

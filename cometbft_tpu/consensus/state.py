@@ -1256,6 +1256,12 @@ class ConsensusState(BaseService):
                 num_txs=len(block.data.txs),
                 hash=(block.hash() or b"").hex()[:12],
             )
+            # attribution plane: decompose the span tree just recorded
+            # into stage budgets (best-effort inside observe_height —
+            # the commit must not depend on the diagnostics plane)
+            from cometbft_tpu.utils import critpath
+
+            critpath.observe_height(height, tracer=_tracer)
         self._schedule_round_0()
 
     # -- votes -----------------------------------------------------------

@@ -681,7 +681,7 @@ class VerifyQueue(BaseService):
             with _tracer.span(
                 "verify_queue/prepare", cat="crypto", batch=len(reqs),
                 priority=priority,
-            ):
+            ) as prep_span:
                 work: list[_Request] = []
                 for r in reqs:
                     r.key = cache_key(r.pub_key.bytes(), r.msg, r.sig)
@@ -714,6 +714,12 @@ class VerifyQueue(BaseService):
                 else:
                     with self._qmtx:
                         self._stats["cache_resolved"] += len(reqs)
+                # stage mark for the attribution plane: how much of
+                # this prepare was the speculative cache resolving
+                # (critpath's verify_spec) vs real plan/pack work
+                prep_span.set(
+                    hits=len(reqs) - len(work), misses=len(work)
+                )
             prep.prep_seconds = time.perf_counter() - t0
         finally:
             # overlap accounting: host prep that ran while a launch was

@@ -57,6 +57,10 @@ _INSPECT_ROUTES = (
     # CMT_TPU_FLEET_PEERS still aggregates the rest of the localnet
     # (its own row is trace/flight-only — no live registry)
     "debug/fleet",
+    # sampling-profiler stacks: the inspector's own CPU time (store
+    # reads, RPC handling) is attributable too when CMT_TPU_PROFILE_HZ
+    # is set; honest {"enabled": false} otherwise (utils/profiler.py)
+    "debug/profile",
     # verified header ranges from the stopped node's stores — a light
     # client can keep syncing off an inspector (light/serve.py)
     "light_sync",

@@ -1082,6 +1082,59 @@ def install_fleet_metrics(metrics: FleetMetrics | None) -> None:
     _FLEET = metrics if metrics is not None else FleetMetrics(None)
 
 
+class AttributionMetrics:
+    """Attribution plane (utils/critpath.py) — a committed height's
+    wall decomposed into the fixed stage taxonomy.  No metricsgen
+    analog: the reference exports per-step durations, but nothing
+    names WHICH stage owned a height end-to-end
+    (docs/observability.md "Attribution plane")."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.height_stage_seconds = _NOP
+            self.height_critical_stage = _NOP
+            return
+        s = "attribution"
+        self.height_stage_seconds = reg.histogram(
+            s, "height_stage_seconds",
+            "Per-committed-height wall attributed to each critical-"
+            "path stage (utils/critpath.py taxonomy); stage budgets "
+            "sum (with residual) to the height wall by construction.",
+            labels=("stage",),
+            buckets=DEFAULT_TIME_BUCKETS,
+        )
+        self.height_critical_stage = reg.gauge(
+            s, "height_critical_stage",
+            "One-hot over the stage taxonomy: 1 on the stage that "
+            "owned the most wall in the last committed height, 0 "
+            "elsewhere — the first thing to read when height latency "
+            "regresses.",
+            labels=("stage",),
+        )
+
+
+#: Process-wide sink for the attribution plane — critpath's
+#: observe_height runs from the consensus commit path but the
+#: decomposition helpers are also driven by tools with no node handle.
+#: Same contract as the crypto sink: no-op by default, node assembly
+#: installs the real struct, last installed wins.
+_ATTRIBUTION = AttributionMetrics(None)
+
+
+def attribution_metrics() -> AttributionMetrics:
+    """The currently installed attribution-plane sink (never None)."""
+    return _ATTRIBUTION
+
+
+def install_attribution_metrics(metrics: AttributionMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide attribution sink (None
+    resets to the no-op)."""
+    global _ATTRIBUTION
+    _ATTRIBUTION = (
+        metrics if metrics is not None else AttributionMetrics(None)
+    )
+
+
 class NodeMetrics:
     """Bundle wired at node assembly (node/node.go:334)."""
 
@@ -1095,6 +1148,7 @@ class NodeMetrics:
         self.health = HealthMetrics(reg)
         self.light = LightMetrics(reg)
         self.fleet = FleetMetrics(reg)
+        self.attribution = AttributionMetrics(reg)
         self.rpc = RPCMetrics(reg)
         self.event_bus = EventBusMetrics(reg)
         self.blocksync = BlockSyncMetrics(reg)
@@ -1106,6 +1160,7 @@ class NodeMetrics:
 
 
 __all__ = [
+    "AttributionMetrics",
     "BlockSyncMetrics",
     "ConsensusMetrics",
     "CryptoMetrics",
@@ -1123,9 +1178,11 @@ __all__ = [
     "StateSyncMetrics",
     "StoreMetrics",
     "WALMetrics",
+    "attribution_metrics",
     "crypto_metrics",
     "fleet_metrics",
     "health_metrics",
+    "install_attribution_metrics",
     "install_crypto_metrics",
     "install_fleet_metrics",
     "install_health_metrics",

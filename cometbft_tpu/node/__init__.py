@@ -136,6 +136,7 @@ class Node(BaseService):
         # 0. metrics plane (node/node.go:334 metricsProvider)
         from cometbft_tpu.metrics import (
             NodeMetrics,
+            install_attribution_metrics,
             install_crypto_metrics,
             install_fleet_metrics,
             install_health_metrics,
@@ -169,6 +170,9 @@ class Node(BaseService):
             # the fleet plane (/debug/fleet + tools/fleet_scrape.py)
             # scrapes with no node handle — same sink pattern
             install_fleet_metrics(self.metrics.fleet)
+            # the attribution plane (utils/critpath.py observe_height
+            # runs from the consensus commit path) — same sink pattern
+            install_attribution_metrics(self.metrics.attribution)
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
@@ -731,6 +735,28 @@ class Node(BaseService):
                     self.logger.error(
                         "health prober failed to start", err=repr(exc)
                     )
+        # always-on sampling profiler (utils/profiler.py): env knobs
+        # validate fail-loudly HERE (a malformed CMT_TPU_PROFILE_HZ /
+        # _DEPTH / _RING fails the node LOUDLY instead of silently
+        # sampling at a rate the operator didn't choose); runtime
+        # start failures beyond that are a diagnostics loss, never a
+        # node-down.  Stopped (joined) in on_stop so the PR 3 thread
+        # leak gate covers the sampler.
+        self.profiler = None
+        from cometbft_tpu.utils import profiler as _profiler
+
+        _profiler.profile_hz_from_env()
+        _profiler.profile_depth_from_env()
+        _profiler.profile_ring_from_env()
+        try:
+            self.profiler = _profiler.start_from_env(
+                logger=self.logger.with_fields(module="profiler")
+            )
+        except Exception as exc:  # noqa: BLE001 — optional plane
+            self.profiler = None
+            self.logger.error(
+                "sampling profiler failed to start", err=repr(exc)
+            )
         # pprof-analog diagnostics plane (node.go:589 startPprofServer);
         # failures here must never take the node down — it is an
         # optional debug feature.  The SIGUSR1 stack-dump handler is
@@ -885,6 +911,9 @@ class Node(BaseService):
             # draining queue; drain resolves every in-flight future
             self.verify_queue,
             self.health_prober,
+            # the sampler joins its thread in stop(), so the leak
+            # gate (assert_no_thread_leaks, daemons_too) stays clean
+            getattr(self, "profiler", None),
             self.metrics_server,
             getattr(self, "diagnostics_server", None),
         )

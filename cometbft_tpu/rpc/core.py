@@ -229,6 +229,8 @@ class Environment:
             "debug/dispatch": self.debug_dispatch,
             # GET /debug/fleet: cross-node rollup + stitched heights
             "debug/fleet": self.debug_fleet,
+            # GET /debug/profile: span-tagged sampling-profiler stacks
+            "debug/profile": self.debug_profile,
         }
         if self.unsafe:
             # routes.go:55 AddUnsafeRoutes (config.RPC.Unsafe)
@@ -470,6 +472,18 @@ class Environment:
             self_registry=self.metrics_registry,
         )
         return fleetobs.fleet_payload(scrapes)
+
+    def debug_profile(self, seconds=None) -> dict:
+        """Sampling-profiler payload (utils/profiler.py): span-tagged
+        folded stacks, per-span sample rollup, and leaf-frame hotspots
+        — ``?seconds=N`` limits to the trailing window.  Served on a
+        live node AND in inspect mode; honest about being disabled
+        (docs/observability.md "Attribution plane")."""
+        from cometbft_tpu.utils.profiler import profile_payload
+
+        return profile_payload(
+            None if seconds is None else float(seconds)
+        )
 
     def genesis_route(self) -> dict:
         import json as _json

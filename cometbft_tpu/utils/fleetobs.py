@@ -619,6 +619,27 @@ def fleet_payload(
         ),
     }
     payload["clock_corrections"] = corrections
+    # attribution plane: each committed height's wall decomposed into
+    # the critpath stage taxonomy on the same corrected axis (the
+    # stage budget an operator reads AFTER the p95 row says "slow")
+    try:
+        from cometbft_tpu.utils import critpath
+
+        budgets = critpath.stage_budgets(
+            scrapes, corrections=corrections
+        )
+        payload["stage_budgets"] = {
+            h: d for h, d in sorted(budgets.items())
+        }
+        p95 = critpath.budget_at_percentile(budgets, 95.0)
+        payload["stage_budget_p95"] = p95
+        if p95 is not None:
+            payload["critical_stage_p95"] = critpath.dominant_stage(
+                p95["stages"]
+            )
+    except Exception:  # noqa: BLE001 — diagnostics, never the payload
+        payload["stage_budgets"] = {}
+        payload["stage_budget_p95"] = None
     if include_trace:
         payload["merged_trace"] = merge_traces(
             scrapes, corrections=corrections

@@ -1,0 +1,367 @@
+"""Always-on sampling profiler — span-tagged folded stacks, stdlib only.
+
+The span tracer (utils/trace.py) says WHICH pipeline stage owned a
+height's wall; this module says WHAT CODE the CPU ran inside it.  A
+daemon thread samples ``sys._current_frames()`` at a validated
+``CMT_TPU_PROFILE_HZ`` (default 19 Hz — deliberately prime, so the
+sampler can't phase-lock to a 10/20/50 ms periodic loop and
+systematically miss it; 0 disables), folds each thread's stack into
+the collapsed format flame-graph tooling eats directly
+(``frame;frame;frame count``), and prefixes every sample with the
+sampled thread's innermost open span (``span:store/save_block;...``)
+so a flame graph is attributable to the critical-path taxonomy in
+utils/critpath.py.
+
+Design constraints, in order:
+
+- **Hot-path cost**: ~19 stack walks per second across all threads —
+  microseconds per tick; the sampled threads pay nothing (the GIL
+  serializes the walk, same as any profiler built on
+  ``sys._current_frames``).
+- **Bounded retention**: samples land in a ``deque(maxlen=N)`` tick
+  ring (CMT_TPU_PROFILE_RING, default 4096 ticks ≈ 3.5 min at 19 Hz)
+  for windowed ``?seconds=N`` queries, plus a since-start counter
+  capped at the same N distinct stacks (overflow counts in
+  ``dropped``, never grows).
+- **No dependencies**: stdlib only, importable from every plane.
+
+Env knobs (the documented fail-loudly contract — node assembly
+validates them the way it validates the ring-size vars):
+
+- ``CMT_TPU_PROFILE_HZ`` — samples/second; integer >= 0, 0 disables
+  (default 19).
+- ``CMT_TPU_PROFILE_DEPTH`` — max frames kept per stack (default 48).
+- ``CMT_TPU_PROFILE_RING`` — tick-ring / distinct-stack capacity
+  (default 4096).
+
+Surfaces: ``/debug/profile?seconds=N`` on the metrics server (add
+``&format=collapsed`` for text), the ``debug/profile`` JSON-RPC route
+(inspect mode included), and bench.py's per-row ``hotspots``
+provenance (docs/observability.md "Attribution plane").
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.flight import ring_size_from_env
+
+_DEFAULT_HZ = 19
+_DEFAULT_DEPTH = 48
+_DEFAULT_RING = 4096
+_MAX_HZ = 1000
+
+#: the span tag given to samples from threads with no open span
+UNTAGGED = "-"
+
+
+def profile_hz_from_env(
+    var: str = "CMT_TPU_PROFILE_HZ", default: int = _DEFAULT_HZ
+) -> int:
+    """Sampling rate from the environment, fail-loudly (the
+    ``ring_size_from_env`` contract): unset/empty means ``default``,
+    anything else must parse as an integer in [0, 1000] — 0 disables
+    the profiler, a typo'd value raises instead of silently sampling
+    at a default the operator didn't choose."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        hz = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var}={raw!r} is not an integer (expected 0..{_MAX_HZ}; "
+            "0 disables the profiler)"
+        ) from None
+    if hz < 0 or hz > _MAX_HZ:
+        raise ValueError(
+            f"{var}={hz} out of range (expected 0..{_MAX_HZ}; "
+            "0 disables the profiler)"
+        )
+    return hz
+
+
+def profile_depth_from_env() -> int:
+    return ring_size_from_env(
+        "CMT_TPU_PROFILE_DEPTH", _DEFAULT_DEPTH, minimum=4
+    )
+
+
+def profile_ring_from_env() -> int:
+    return ring_size_from_env("CMT_TPU_PROFILE_RING", _DEFAULT_RING)
+
+
+def _frame_label(code) -> str:
+    """``pkg/module.py:function`` — short enough to read in a flame
+    graph, long enough to disambiguate same-named functions."""
+    fn = code.co_filename.replace("\\", "/")
+    parts = fn.rsplit("/", 2)
+    short = "/".join(parts[-2:]) if len(parts) >= 2 else fn
+    return f"{short}:{code.co_name}"
+
+
+class SamplingProfiler:
+    """The sampler thread plus its two bounded stores (tick ring for
+    windowed queries, capped counter for since-start totals)."""
+
+    def __init__(
+        self,
+        hz: int | None = None,
+        depth: int | None = None,
+        capacity: int | None = None,
+        tracer=None,
+    ):
+        self.hz = profile_hz_from_env() if hz is None else int(hz)
+        self.depth = profile_depth_from_env() if depth is None else depth
+        self.capacity = (
+            profile_ring_from_env() if capacity is None else capacity
+        )
+        if tracer is None:
+            from cometbft_tpu.utils.trace import TRACER
+
+            tracer = TRACER
+        self._tracer = tracer
+        #: (wall_time, tuple-of-folded-stacks) per sampler tick
+        self._ring: deque = deque(maxlen=max(self.capacity, 1))
+        self._totals: dict[str, int] = {}
+        #: interned folded-stack strings: samples repeat heavily, so
+        #: the ring holds ~capacity references, not ~capacity copies
+        self._intern: dict[str, str] = {}
+        self._mtx = cmtsync.Mutex()
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._samples = 0
+        self._dropped = 0
+        self._started_wall: float | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Start the sampler thread (no-op when hz == 0 or already
+        running)."""
+        if self.hz <= 0 or self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._started_wall = time.time()
+        self._thread = threading.Thread(
+            target=self._run, name="profiler-sampler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop and JOIN the sampler — the thread is gone when this
+        returns, so the PR 3 leak gate (assert_no_thread_leaks,
+        daemons_too) covers it."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop_evt.set()
+        t.join(timeout=5.0)
+        self._thread = None
+
+    def is_running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self) -> None:
+        period = 1.0 / self.hz
+        while not self._stop_evt.wait(period):
+            try:
+                self._sample_once()
+            except Exception:  # noqa: BLE001 — a diagnostics plane
+                pass  # must never take the process down
+
+    # -- sampling ------------------------------------------------------
+
+    def _sample_once(self) -> None:
+        own = threading.get_ident()
+        frames = sys._current_frames()
+        spans = self._tracer.current_spans()
+        now = time.time()
+        folded: list[str] = []
+        for tid, frame in frames.items():
+            if tid == own:
+                continue  # the sampler never profiles itself
+            stack: list[str] = []
+            f, n = frame, 0
+            while f is not None and n < self.depth:
+                stack.append(_frame_label(f.f_code))
+                f = f.f_back
+                n += 1
+            stack.reverse()
+            key = (
+                f"span:{spans.get(tid, UNTAGGED)};" + ";".join(stack)
+            )
+            cached = self._intern.get(key)
+            if cached is None:
+                if len(self._intern) >= 4 * max(self.capacity, 1):
+                    self._intern.clear()  # bounded, rebuilt on demand
+                self._intern[key] = cached = key
+            folded.append(cached)
+        with self._mtx:
+            self._samples += 1
+            self._ring.append((now, tuple(folded)))
+            for key in folded:
+                if key in self._totals:
+                    self._totals[key] += 1
+                elif len(self._totals) < max(self.capacity, 1):
+                    self._totals[key] = 1
+                else:
+                    self._dropped += 1
+
+    # -- queries -------------------------------------------------------
+
+    def stacks(self, seconds: float | None = None) -> dict[str, int]:
+        """folded stack -> sample count; ``seconds`` limits to the
+        trailing window (None = since start, from the capped
+        counter)."""
+        with self._mtx:
+            if seconds is None:
+                return dict(self._totals)
+            cutoff = time.time() - max(float(seconds), 0.0)
+            out: dict[str, int] = {}
+            for t, keys in self._ring:
+                if t < cutoff:
+                    continue
+                for k in keys:
+                    out[k] = out.get(k, 0) + 1
+            return out
+
+    def collapsed(self, seconds: float | None = None) -> str:
+        """Brendan-Gregg collapsed-stack text — pipe straight into
+        flamegraph.pl / speedscope."""
+        got = self.stacks(seconds)
+        return "\n".join(
+            f"{k} {c}"
+            for k, c in sorted(got.items(), key=lambda kv: -kv[1])
+        )
+
+    def span_seconds(self, seconds: float | None = None) -> dict[str, int]:
+        """span tag -> sample count: the cheap 'which stage burns CPU'
+        rollup (sample counts, convert via hz for seconds)."""
+        out: dict[str, int] = {}
+        for k, c in self.stacks(seconds).items():
+            tag = k.split(";", 1)[0][len("span:"):]
+            out[tag] = out.get(tag, 0) + c
+        return out
+
+    def top_functions(
+        self, k: int = 5, seconds: float | None = None
+    ) -> list[dict]:
+        """Leaf-frame hotspots: [{frame, count, share}] sorted by
+        count — what bench.py records as per-row ``hotspots``
+        provenance."""
+        leaves: dict[str, int] = {}
+        total = 0
+        for key, c in self.stacks(seconds).items():
+            leaf = key.rsplit(";", 1)[-1]
+            leaves[leaf] = leaves.get(leaf, 0) + c
+            total += c
+        return [
+            {
+                "frame": frame,
+                "count": count,
+                "share": round(count / total, 4) if total else 0.0,
+            }
+            for frame, count in sorted(
+                leaves.items(), key=lambda kv: -kv[1]
+            )[: max(k, 0)]
+        ]
+
+    def payload(self, seconds: float | None = None) -> dict:
+        """The ``/debug/profile`` JSON: folded stacks + per-span
+        rollup + leaf hotspots for the requested window."""
+        got = self.stacks(seconds)
+        with self._mtx:
+            samples, dropped = self._samples, self._dropped
+        return {
+            "enabled": True,
+            "hz": self.hz,
+            "depth": self.depth,
+            "capacity": self.capacity,
+            "running": self.is_running(),
+            "seconds": seconds,
+            "samples": samples,
+            "dropped_stacks": dropped,
+            "started_wall": self._started_wall,
+            "stacks": [
+                {"stack": k, "count": c}
+                for k, c in sorted(got.items(), key=lambda kv: -kv[1])
+            ],
+            "spans": self.span_seconds(seconds),
+            "hotspots": self.top_functions(10, seconds),
+        }
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._ring.clear()
+            self._totals.clear()
+            self._intern.clear()
+            self._samples = 0
+            self._dropped = 0
+
+
+# -- the process-wide profiler (sink pattern, crypto/fleet analog) --------
+
+_PROFILER: SamplingProfiler | None = None
+
+
+def profiler() -> SamplingProfiler | None:
+    """The installed process-wide profiler, or None when disabled."""
+    return _PROFILER
+
+
+def install_profiler(p: SamplingProfiler | None) -> None:
+    global _PROFILER
+    _PROFILER = p
+
+
+def start_from_env(logger=None) -> SamplingProfiler | None:
+    """Validate the env knobs (fail-loudly — a malformed
+    CMT_TPU_PROFILE_HZ must fail node assembly, not silently profile
+    at a rate the operator didn't choose), then start and install the
+    process-wide sampler.  Returns None when disabled (hz == 0)."""
+    hz = profile_hz_from_env()
+    profile_depth_from_env()
+    profile_ring_from_env()
+    if hz == 0:
+        return None
+    p = SamplingProfiler(hz=hz)
+    p.start()
+    install_profiler(p)
+    if logger is not None:
+        logger.info("sampling profiler started", hz=hz)
+    return p
+
+
+def profile_payload(seconds: float | None = None) -> dict:
+    """The ``/debug/profile`` payload — honest about being off."""
+    p = profiler()
+    if p is None:
+        return {
+            "enabled": False,
+            "hz": 0,
+            "samples": 0,
+            "stacks": [],
+            "spans": {},
+            "hotspots": [],
+            "hint": "set CMT_TPU_PROFILE_HZ (default 19; 0 disables)",
+        }
+    return p.payload(seconds)
+
+
+__all__ = [
+    "SamplingProfiler",
+    "UNTAGGED",
+    "install_profiler",
+    "profile_depth_from_env",
+    "profile_hz_from_env",
+    "profile_payload",
+    "profile_ring_from_env",
+    "profiler",
+    "start_from_env",
+]

@@ -94,6 +94,11 @@ class _Span:
         stack = self._tracer._stack()
         self._parent = stack[-1].name if stack else None
         stack.append(self)
+        # per-thread current-span map: what the sampling profiler
+        # (utils/profiler.py) reads to tag a sampled stack with the
+        # pipeline stage it ran under.  Plain dict store — atomic under
+        # the GIL, and this is the lexical-span hot path.
+        self._tracer._active[threading.get_ident()] = self.name
         self._t0 = time.perf_counter()
         return self
 
@@ -102,6 +107,11 @@ class _Span:
         stack = self._tracer._stack()
         if stack and stack[-1] is self:
             stack.pop()
+        tid = threading.get_ident()
+        if stack:
+            self._tracer._active[tid] = stack[-1].name
+        else:
+            self._tracer._active.pop(tid, None)
         if exc_type is not None:
             self.args["error"] = exc_type.__name__
         self._tracer._record(
@@ -140,6 +150,10 @@ class SpanTracer:
         self._events: deque[dict] = deque(maxlen=max(capacity, 1))
         self._mtx = threading.Lock()
         self._tls = threading.local()
+        #: tid -> innermost OPEN lexical span name; entries are removed
+        #: when a thread's span stack drains, so the map stays bounded
+        #: by threads with a span in flight (read by the profiler)
+        self._active: dict[int, str] = {}
         #: perf_counter origin; event ts values are microseconds since
         #: this instant (Chrome traces need any consistent monotonic us)
         self.epoch = time.perf_counter()
@@ -219,6 +233,16 @@ class SpanTracer:
                     for t, n in self._thread_names.items()
                     if t in live
                 }
+
+    # -- introspection -------------------------------------------------
+
+    def current_spans(self) -> dict[int, str]:
+        """Snapshot of tid -> innermost open lexical span name — the
+        attribution seam the sampling profiler tags samples with.
+        Spans recorded via ``add_complete`` (the consensus step spans)
+        never appear here: they are reconstructed after the fact, not
+        open while their work runs."""
+        return dict(self._active)
 
     # -- export --------------------------------------------------------
 

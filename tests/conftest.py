@@ -42,6 +42,7 @@ os.environ.setdefault("CMT_TPU_ROUTE", "0")
 # just be overridden when ops imports.
 
 import random
+import sys
 
 import pytest
 
@@ -127,3 +128,58 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return random.Random(0x5EED)
+
+
+# -- tier-1 wall-clock harvest (attribution plane, ISSUE 16) --------------
+#
+# The tier-1 gate has a 15-minute single-core budget (pytest_configure
+# above) but nothing was MEASURING it — suite growth eats the budget
+# silently until the gate times out.  Harvest per-module durations
+# (setup + call + teardown, the real wall a module costs the gate) and,
+# when CMT_TPU_TIER1_LEDGER=1 marks an intentional full green run,
+# append a perfdiff-gated ``tier1_wall_seconds`` ledger row — unit "s",
+# so the gate treats it as latency (regresses UP).  Top-cost modules
+# ride along as provenance: a regression names the module that grew.
+
+_module_seconds: dict[str, float] = {}
+
+
+def pytest_runtest_logreport(report):
+    mod = report.nodeid.split("::", 1)[0]
+    _module_seconds[mod] = _module_seconds.get(mod, 0.0) + float(
+        getattr(report, "duration", 0.0) or 0.0
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if os.environ.get("CMT_TPU_TIER1_LEDGER") != "1":
+        return
+    if exitstatus != 0 or not _module_seconds:
+        return  # only green runs become ledger points
+    total = sum(_module_seconds.values())
+    top = sorted(
+        _module_seconds.items(), key=lambda kv: -kv[1]
+    )[:5]
+    try:
+        import time as _time
+
+        from tools import perfledger
+
+        perfledger.append_rows(
+            [
+                {
+                    "config": "tier1_wall_seconds",
+                    "value": round(total, 1),
+                    "unit": "s",
+                    "note": "top modules: " + ", ".join(
+                        f"{os.path.basename(m)} {s:.1f}s"
+                        for m, s in top
+                    ),
+                    "measured": _time.strftime("%Y-%m-%d %H:%M"),
+                }
+            ],
+            source="tier1",
+        )
+    except Exception as exc:  # noqa: BLE001 — provenance only
+        print(f"tier1 ledger append failed (ignored): {exc}",
+              file=sys.stderr)

@@ -1,0 +1,474 @@
+"""Attribution-plane tests (utils/critpath): local and stitched
+critical-path decomposition, the sum-to-wall contract, degradation on
+missing/untagged spans, the AttributionMetrics feed at commit, and the
+perfdiff stage-explanation path (the ISSUE 16 acceptance: a seeded
+store/save_block slowdown must be NAMED, not just detected)."""
+
+from __future__ import annotations
+
+import time
+
+from cometbft_tpu.utils import critpath
+from cometbft_tpu.utils.critpath import (
+    STAGES,
+    budget_at_percentile,
+    committed_heights,
+    decompose_local,
+    decompose_stitched,
+    dominant_stage,
+    observe_height,
+    stage_budgets,
+)
+
+EPS = 1e-6
+
+
+def _ev(name: str, ts: float, dur: float = 0.0, **args) -> dict:
+    """One Chrome-trace complete event, seconds in -> microseconds."""
+    return {
+        "ph": "X", "name": name, "ts": ts * 1e6, "dur": dur * 1e6,
+        "args": args,
+    }
+
+
+def _local_tree(height: int = 5) -> list[dict]:
+    """A complete single-node height: every taxonomy stage has a
+    mark.  Root [10.0, 11.0]; send stamp 0.05 in (via wall_epoch
+    1000.0), proposal at 10.10, +2/3 precommit at 10.60, verify
+    prepare [10.15, 10.25] overlapping launch [10.20, 10.40], then
+    the commit pipeline: store 0.2, wal 0.03, exec 0.1, index 0.05."""
+    return [
+        _ev("height/pipeline", 10.0, 1.0, height=height),
+        _ev(
+            "height/proposal_origin_wall", 10.04, 0.0, height=height,
+            origin="aa" * 8, send_wall=1010.05,
+        ),
+        _ev("height/proposal_received", 10.10, 0.0, height=height),
+        _ev("verify_queue/prepare", 10.15, 0.10),
+        _ev("verify_queue/launch", 10.20, 0.20),
+        _ev("height/quorum_precommit", 10.60, 0.0, height=height),
+        _ev("store/save_block", 10.60, 0.20, height=height),
+        _ev("wal/write_end_height", 10.80, 0.03, height=height),
+        _ev("exec/apply_block", 10.83, 0.10, height=height),
+        _ev("indexer/index_block", 10.93, 0.05, height=height),
+    ]
+
+
+class TestLocalDecompose:
+    def test_complete_tree_decomposes_exactly(self):
+        d = decompose_local(_local_tree(), 5, wall_epoch=1000.0)
+        assert d is not None and d["height"] == 5
+        st = d["stages"]
+        assert set(st) == set(STAGES)
+        # the contract: budgets sum (with residual) to the wall exactly
+        assert abs(sum(st.values()) - d["wall_s"]) < EPS
+        assert abs(d["wall_s"] - 1.0) < EPS
+        assert abs(st["proposal_wait"] - 0.05) < EPS
+        assert abs(st["gossip_hop"] - 0.05) < EPS
+        # prep [10.15,10.25] + launch [10.20,10.40] union = 0.25s,
+        # split by each side's share of 0.1 + 0.2
+        assert abs(st["verify_spec"] - 0.25 * (0.1 / 0.3)) < EPS
+        assert abs(st["verify_launch"] - 0.25 * (0.2 / 0.3)) < EPS
+        # vote window 0.5s minus the 0.25s verify union
+        assert abs(st["quorum_wait"] - 0.25) < EPS
+        assert abs(st["store_save"] - 0.20) < EPS
+        assert abs(st["wal_fsync"] - 0.03) < EPS
+        assert abs(st["abci_execute"] - 0.10) < EPS
+        assert abs(st["index"] - 0.05) < EPS
+        assert st["residual"] >= 0.0
+
+    def test_missing_stage_degrades_to_residual_never_crashes(self):
+        # drop the store span: its 0.2s must land in residual, the
+        # budget must still sum to the wall, nothing may raise
+        events = [
+            e for e in _local_tree() if e["name"] != "store/save_block"
+        ]
+        d = decompose_local(events, 5, wall_epoch=1000.0)
+        st = d["stages"]
+        assert st["store_save"] == 0.0
+        assert abs(sum(st.values()) - d["wall_s"]) < EPS
+        full = decompose_local(_local_tree(), 5, wall_epoch=1000.0)
+        assert abs(
+            st["residual"] - (full["stages"]["residual"] + 0.20)
+        ) < EPS
+
+    def test_root_only_tree_is_all_residual(self):
+        events = [_ev("height/pipeline", 10.0, 0.8, height=9)]
+        d = decompose_local(events, 9)
+        assert abs(d["stages"]["residual"] - 0.8) < EPS
+        assert abs(sum(d["stages"].values()) - d["wall_s"]) < EPS
+
+    def test_untagged_gossip_collapses_into_proposal_wait(self):
+        # CMT_TPU_TRACE_CTX=0 senders stamp no origin wall: the whole
+        # pre-proposal interval is proposal_wait, gossip_hop zero —
+        # degraded, not wrong
+        events = [
+            e
+            for e in _local_tree()
+            if e["name"] != "height/proposal_origin_wall"
+        ]
+        d = decompose_local(events, 5, wall_epoch=1000.0)
+        st = d["stages"]
+        assert abs(st["proposal_wait"] - 0.10) < EPS
+        assert st["gossip_hop"] == 0.0
+        assert abs(sum(st.values()) - d["wall_s"]) < EPS
+        # same degradation without the wall anchor (pre-fleet ring)
+        d2 = decompose_local(_local_tree(), 5, wall_epoch=None)
+        assert d2["stages"]["gossip_hop"] == 0.0
+
+    def test_no_root_returns_none(self):
+        assert decompose_local([_ev("store/save_block", 1, 0.1,
+                                    height=3)], 3) is None
+        assert decompose_local([], 3) is None
+
+    def test_committed_heights_sorted_unique(self):
+        events = [
+            _ev("height/pipeline", 1.0, 0.1, height=7),
+            _ev("height/pipeline", 2.0, 0.1, height=3),
+            _ev("height/pipeline", 3.0, 0.1, height=7),
+            _ev("height/pipeline", 4.0, 0.1),  # untagged: ignored
+        ]
+        assert committed_heights(events) == [3, 7]
+
+    def test_dominant_stage_ties_break_in_pipeline_order(self):
+        st = {s: 0.0 for s in STAGES}
+        st["store_save"] = 0.2
+        st["abci_execute"] = 0.2  # later in the pipeline
+        assert dominant_stage(st) == "store_save"
+        assert dominant_stage({s: 0.0 for s in STAGES}) == STAGES[0]
+
+    def test_overattribution_squeezes_back_to_wall(self):
+        # an index span wider than the root (async tail) must not
+        # break the sum-to-wall contract
+        events = [
+            _ev("height/pipeline", 10.0, 0.1, height=2),
+            _ev("indexer/index_block", 9.0, 5.0, height=2),
+        ]
+        d = decompose_local(events, 2)
+        assert abs(sum(d["stages"].values()) - d["wall_s"]) < EPS
+
+
+class TestObserveHeight:
+    def _fake_tracer(self, events, epoch=1000.0):
+        class T:
+            epoch_wall = epoch
+
+            def events(self):
+                return events
+
+        return T()
+
+    def test_feeds_attribution_metrics(self):
+        from cometbft_tpu.metrics import AttributionMetrics
+        from cometbft_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        m = AttributionMetrics(reg)
+        d = observe_height(
+            5, tracer=self._fake_tracer(_local_tree()), metrics=m
+        )
+        assert d["critical_stage"] == "quorum_wait"
+        assert m.height_critical_stage.labels(
+            stage="quorum_wait"
+        ).get() == 1.0
+        assert m.height_critical_stage.labels(
+            stage="store_save"
+        ).get() == 0.0
+        text = reg.expose()
+        assert "attribution_height_stage_seconds" in text
+        assert "attribution_height_critical_stage" in text
+
+    def test_never_raises_from_the_commit_path(self):
+        class Broken:
+            epoch_wall = 0.0
+
+            def events(self):
+                raise RuntimeError("ring on fire")
+
+        assert observe_height(5, tracer=Broken()) is None
+        assert observe_height(
+            99, tracer=self._fake_tracer(_local_tree())
+        ) is None  # unknown height: no root, no crash
+
+
+# -- stitched (cross-node) fixture ----------------------------------------
+
+_IDS = ["a%d" % i * 32 for i in range(4)]  # 64-char node ids
+
+
+def _fleet_scrapes():
+    """Four NodeScrape fixtures for one committed height 7, on a
+    known true-wall axis: n0 proposes at wall 2000.0, replicas
+    receive at +30..50 ms (n3 slowest), quorum at +130 ms, n3 is the
+    gating node (commit end +400 ms) with store_save seeded as the
+    dominant stage (170 ms).  n1's clock runs 0.5 s ahead — its
+    stamps only line up if decompose_stitched applies the
+    clock-correction plane."""
+    from cometbft_tpu.utils.fleetobs import NodeScrape
+
+    offsets = {"n0": 0.0, "n1": 0.5, "n2": 0.0, "n3": 0.0}
+    epochs = {"n0": 1999.0, "n1": 1999.6, "n2": 1999.2, "n3": 1999.3}
+    origin = _IDS[0][:16]
+
+    def ts(name, true_wall):
+        # local ring timestamp for a true-wall instant on this node
+        return true_wall + offsets[name] - epochs[name]
+
+    def metrics_for(name):
+        own = _IDS[["n0", "n1", "n2", "n3"].index(name)]
+        return [
+            (
+                "p2p_peer_clock_offset_seconds", {"peer_id": pid},
+                offsets[["n0", "n1", "n2", "n3"][_IDS.index(pid)]],
+            )
+            for pid in _IDS
+            if pid != own
+        ]
+
+    recv = {"n1": 2000.04, "n2": 2000.03, "n3": 2000.05}
+    qpc = {"n0": 2000.12, "n1": 2000.11, "n2": 2000.10, "n3": 2000.13}
+    commit_end = {"n0": 2000.30, "n1": 2000.28, "n2": 2000.26,
+                  "n3": 2000.40}
+    scrapes = []
+    for name in ("n0", "n1", "n2", "n3"):
+        events = [
+            _ev(
+                "height/pipeline", ts(name, 1999.95),
+                commit_end[name] - 1999.95, height=7,
+            ),
+            _ev(
+                "height/quorum_precommit", ts(name, qpc[name]), 0.0,
+                height=7,
+            ),
+        ]
+        if name == "n0":
+            events.append(
+                _ev(
+                    "height/proposal_received", ts(name, 2000.001),
+                    0.0, height=7,
+                )
+            )
+        else:
+            # replicas carry the origin's send stamp (in the ORIGIN's
+            # clock — n0's, which is the reference here)
+            events.append(
+                _ev(
+                    "height/proposal_received", ts(name, recv[name]),
+                    0.0, height=7, origin=origin, send_wall=2000.0,
+                )
+            )
+            events.append(
+                _ev(
+                    "p2p/recv_hop", ts(name, recv[name]), 0.0,
+                    height=7, origin=origin, send_wall=2000.0,
+                )
+            )
+        if name == "n3":  # the gating node's commit pipeline
+            events += [
+                _ev("verify_queue/prepare", ts(name, 2000.06), 0.02),
+                _ev("verify_queue/launch", ts(name, 2000.07), 0.03),
+                _ev("store/save_block", ts(name, 2000.15), 0.17,
+                    height=7),
+                _ev("wal/write_end_height", ts(name, 2000.32), 0.02,
+                    height=7),
+                _ev("exec/apply_block", ts(name, 2000.34), 0.04,
+                    height=7),
+                _ev("indexer/index_block", ts(name, 2000.38), 0.015,
+                    height=7),
+            ]
+        scrapes.append(
+            NodeScrape(
+                name=name,
+                metrics=metrics_for(name),
+                trace={
+                    "traceEvents": events,
+                    "otherData": {"wall_epoch": epochs[name]},
+                },
+            )
+        )
+    return scrapes
+
+
+class TestStitchedDecompose:
+    def test_complete_fleet_height_decomposes_on_corrected_axis(self):
+        scrapes = _fleet_scrapes()
+        d = decompose_stitched(scrapes, 7)
+        assert d is not None
+        # wall = first corrected origin send -> latest corrected
+        # commit end: 2000.0 -> 2000.40, despite n1's skewed clock
+        assert abs(d["wall_s"] - 0.40) < 1e-4
+        assert d["gating_node"] == "n3"
+        st = d["stages"]
+        assert abs(sum(st.values()) - d["wall_s"]) < EPS
+        # gossip runs to the SLOWEST replica's receipt (n3, +50 ms)
+        assert abs(st["gossip_hop"] - 0.05) < 1e-4
+        assert abs(st["store_save"] - 0.17) < 1e-4
+        assert dominant_stage(st) == "store_save"
+
+    def test_wall_matches_fleetobs_latency_exactly(self):
+        # the SLO row and the stage budget must describe the SAME
+        # wall, or the ledger rows can't reconcile
+        from cometbft_tpu.utils import fleetobs
+
+        scrapes = _fleet_scrapes()
+        stitched = fleetobs.stitch_heights(scrapes)
+        lat_ms = fleetobs.height_latencies_ms(stitched)[7]
+        d = decompose_stitched(scrapes, 7)
+        assert abs(d["wall_s"] * 1e3 - lat_ms) < 0.01
+
+    def test_stage_budgets_and_percentile_pick_actual_height(self):
+        scrapes = _fleet_scrapes()
+        budgets = stage_budgets(scrapes)
+        assert list(budgets) == [7]
+        p95 = budget_at_percentile(budgets, 95.0)
+        # nearest-rank returns an ACTUAL height's decomposition, so
+        # per-stage ledger rows sum to the latency row by construction
+        assert p95 is budgets[7]
+        assert budget_at_percentile({}, 95.0) is None
+
+    def test_uncommitted_height_returns_none(self):
+        assert decompose_stitched(_fleet_scrapes(), 8) is None
+
+
+class TestSeededStoreSlowdown:
+    """ISSUE 16 acceptance: a seeded 200 ms store/save_block slowdown
+    must be NAMED dominant by the live ``height_critical_stage``
+    gauge (and by perfdiff's explanation — TestPerfdiffExplain
+    below), not just detected as a latency regression."""
+
+    def test_slow_save_block_named_dominant_by_gauge(self, tmp_path,
+                                                     monkeypatch):
+        from cometbft_tpu.abci.kvstore import KVStoreApp
+        from cometbft_tpu.config import test_config as make_test_config
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+        from cometbft_tpu.utils import trace as trace_mod
+
+        # seed the slowdown INSIDE the store/save_block span (the
+        # state-ops encode runs within the span + write lock), so the
+        # attribution plane sees it the way a slow disk would present
+        real_ops = BlockStore._save_state_ops
+
+        def slow_ops(self):
+            time.sleep(0.2)  # the seeded store regression
+            return real_ops(self)
+
+        monkeypatch.setattr(BlockStore, "_save_state_ops", slow_ops)
+        pv = FilePV(ed.priv_key_from_secret(b"critpath-val"))
+        gen = GenesisDoc(
+            chain_id="critpath-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=(GenesisValidator(pv.pub_key, 10),),
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.instrumentation.prometheus = True  # live NodeMetrics
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        cfg.ensure_dirs()
+        trace_mod.TRACER.clear()
+        node = Node(
+            cfg, app=KVStoreApp(), genesis=gen, priv_validator=pv
+        )
+        node.start()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline and node.height() < 3:
+                time.sleep(0.05)
+            assert node.height() >= 3
+        finally:
+            node.stop()
+        # read the gauge AFTER stop: height() advances at store-save
+        # time, a beat before observe_height runs at the end of the
+        # commit pipeline — stopping drains it, freezing the one-hot
+        # at the last committed height
+        m = node.metrics.attribution
+        assert m.height_critical_stage.labels(
+            stage="store_save"
+        ).get() == 1.0
+        for stage in STAGES:
+            if stage == "store_save":
+                continue
+            assert m.height_critical_stage.labels(
+                stage=stage
+            ).get() == 0.0
+        # and the decomposition itself shows the seeded sleep
+        events = trace_mod.TRACER.events()
+        h = committed_heights(events)[-1]
+        d = decompose_local(
+            events, h, wall_epoch=trace_mod.TRACER.epoch_wall
+        )
+        assert d["stages"]["store_save"] >= 0.19
+
+
+class TestPerfdiffExplain:
+    """The other half of the acceptance: the committed perf-gate
+    fixtures seed the same store_save slowdown, and perfdiff must
+    EXPLAIN the latency regression with it."""
+
+    def _load(self, name):
+        import json
+        import os
+
+        from tools.perfdiff import FIXTURE_DIR
+
+        with open(os.path.join(FIXTURE_DIR, name + ".json")) as f:
+            return json.load(f)
+
+    def test_stage_rows_reconcile_with_latency_row(self):
+        import tools.perfdiff as perfdiff
+
+        for name in ("baseline", "regressed", "noise"):
+            doc = self._load(name)
+            latest = perfdiff._latest_by_config(doc)
+            lat = latest["height_latency_p95_4node"]["value"]
+            total = sum(
+                latest[f"height_stage_p95_{s}_4node"]["value"]
+                for s in STAGES
+            )
+            assert abs(total - lat) / lat < 0.10, (name, total, lat)
+
+    def test_explain_names_store_save_dominant(self):
+        from tools.perfdiff import compare, explain_stages
+
+        baseline, regressed = (
+            self._load("baseline"), self._load("regressed"),
+        )
+        regs, _ = compare(baseline, regressed)
+        assert "height_latency_p95_4node" in {
+            r["config"] for r in regs
+        }
+        stages = explain_stages(
+            baseline, regressed, "height_latency_p95_4node"
+        )
+        assert stages and stages[0]["stage"] == "store_save"
+        assert stages[0]["share"] > 0.9  # it IS the regression
+
+    def test_report_prints_the_explanation(self, capsys):
+        from tools.perfdiff import _report, compare
+
+        baseline, regressed = (
+            self._load("baseline"), self._load("regressed"),
+        )
+        regs, comps = compare(baseline, regressed)
+        _report(regs, comps, baseline, regressed)
+        err = capsys.readouterr().err
+        assert "explained by store_save" in err
+
+    def test_selftest_passes(self, capsys):
+        from tools.perfdiff import selftest
+
+        assert selftest() == 0
+        assert "store_save named dominant" in capsys.readouterr().out
+
+    def test_non_latency_config_has_no_explanation(self):
+        from tools.perfdiff import explain_stages
+
+        assert explain_stages(
+            self._load("baseline"), self._load("regressed"),
+            "ed25519_batch_verify_throughput",
+        ) == []

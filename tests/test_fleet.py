@@ -866,6 +866,39 @@ class TestFleetSmoke:
                 load_span_s=round(load_span, 1), nodes=N_NODES,
             ),
         ]
+
+        # -- attribution plane: per-stage SLO rows (ISSUE 16) ---------
+        # decompose every committed height from the SAME scrapes, take
+        # the stage budget OF the nearest-rank p95 height, and append
+        # one perfdiff-gated row per stage — the rows that let
+        # perfdiff EXPLAIN a height_latency_p95_4node regression
+        from cometbft_tpu.utils import critpath
+
+        budgets = critpath.stage_budgets(scrapes)
+        assert budgets, "no height decomposed into stage budgets"
+        for h, b in budgets.items():
+            # 6-dp rounding on 10 stages: up to ~5e-6 of slack
+            assert abs(
+                sum(b["stages"].values()) - b["wall_s"]
+            ) < 1e-5, (h, b)
+        p95_budget = critpath.budget_at_percentile(budgets, 95.0)
+        assert p95_budget is not None
+        stage_ms = {
+            s: round(p95_budget["stages"][s] * 1e3, 3)
+            for s in critpath.STAGES
+        }
+        rows += [
+            perfledger.make_entry(
+                f"height_stage_p95_{stage}_4node", ms, "ms",
+                "fleet_smoke", measured=measured,
+                height=p95_budget["height"],
+                gating_node=p95_budget["gating_node"],
+                critical_stage=critpath.dominant_stage(
+                    p95_budget["stages"]
+                ),
+            )
+            for stage, ms in stage_ms.items()
+        ]
         perfledger.append(rows, path=ledger_path)
         doc = perfledger.load(ledger_path)
         got = {
@@ -878,6 +911,25 @@ class TestFleetSmoke:
         assert got["height_latency_p95_4node"]["unit"] in (
             perfdiff.LOWER_BETTER_UNITS
         )
+        # every stage row landed, in the same gated unit
+        for stage in critpath.STAGES:
+            cfg = f"height_stage_p95_{stage}_4node"
+            assert cfg in got, cfg
+            assert got[cfg]["unit"] in perfdiff.LOWER_BETTER_UNITS
+        # reconciliation: the stage rows sum (residual included) to
+        # the latency row within 10% — the p95 ranks run over two
+        # slightly different height sets (latencies need only a send
+        # + commit stamp; budgets need the pipeline root), so exact
+        # equality holds per height, near-equality at the percentile
+        stage_sum = sum(stage_ms.values())
+        lat_row = float(got["height_latency_p95_4node"]["value"])
+        assert abs(stage_sum - lat_row) <= 0.10 * lat_row, (
+            stage_sum, lat_row, stage_ms,
+        )
+        # ...and within the DECOMPOSED height the sum is exact
+        assert abs(
+            stage_sum - p95_budget["wall_s"] * 1e3
+        ) < 0.01, (stage_sum, p95_budget)
 
         # -- /debug/fleet live on the aggregator ----------------------
         with urllib.request.urlopen(
@@ -889,6 +941,11 @@ class TestFleetSmoke:
         assert rollup["max_height"] >= h0 + 3
         by_err = [n for n in rollup["nodes"] if n["error"]]
         assert not by_err, by_err
+        # the attribution plane rides the same payload: per-height
+        # stage budgets plus the p95 budget + its critical stage
+        assert payload["stage_budgets"], payload.get("stage_budgets")
+        assert payload["stage_budget_p95"] is not None
+        assert payload["critical_stage_p95"] in critpath.STAGES
         # the index route knows about it too
         with urllib.request.urlopen(
             f"http://{_metrics_addr(0)}/debug", timeout=5

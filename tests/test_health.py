@@ -646,6 +646,10 @@ class TestPerfDiff:
         assert {r["config"] for r in regs} == {
             "keyed_batch_verify", "blocksync_replay_1kval",
             "verify_commit_10000",
+            # attribution-plane rows: the seeded store_save slowdown
+            # regresses the height-latency SLO row AND its stage row
+            "height_latency_p95_4node",
+            "height_stage_p95_store_save_4node",
         }
         # latency regressed UP, throughput DOWN — both flagged worse
         assert all(r["delta"] > 0.10 for r in regs)
@@ -658,7 +662,8 @@ class TestPerfDiff:
             self._load("baseline.json"), self._load("noise.json")
         )
         assert regs == []
-        assert len(comps) == 3
+        # 3 original rows + height_latency_p95_4node + 10 stage rows
+        assert len(comps) == 14
 
     def test_cli_exit_codes(self, capsys):
         pd = self._import()

@@ -1,0 +1,389 @@
+"""Sampling-profiler tests (utils/profiler): span-tagged folded
+stacks, bounded retention, the fail-loudly env contract, thread
+hygiene, and the attribution-plane smoke (`make attr-smoke`): a live
+node serving non-empty span-tagged stacks at /debug/profile while
+every committed height decomposes with residual < 20% of its wall."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.utils import profiler as prof_mod
+from cometbft_tpu.utils.profiler import (
+    UNTAGGED,
+    SamplingProfiler,
+    profile_depth_from_env,
+    profile_hz_from_env,
+    profile_payload,
+    profile_ring_from_env,
+    start_from_env,
+)
+from cometbft_tpu.utils.sync import assert_no_thread_leaks
+from cometbft_tpu.utils.trace import SpanTracer
+
+
+def _busy(stop_evt: threading.Event) -> None:
+    while not stop_evt.wait(0.0005):
+        sum(i * i for i in range(200))
+
+
+class TestSampling:
+    def test_captures_span_tagged_stacks(self):
+        tracer = SpanTracer(capacity=64, enabled=True)
+        p = SamplingProfiler(hz=200, capacity=1024, tracer=tracer)
+        stop = threading.Event()
+
+        def worker():
+            with tracer.span("test/busy", cat="test"):
+                _busy(stop)
+
+        th = threading.Thread(target=worker)
+        with assert_no_thread_leaks(grace=5.0, daemons_too=True):
+            p.start()
+            th.start()
+            time.sleep(0.3)
+            stop.set()
+            th.join(5)
+            p.stop()
+        stacks = p.stacks()
+        assert stacks, "no samples captured at 200 Hz in 0.3 s"
+        # every folded stack carries the span prefix
+        assert all(k.startswith("span:") for k in stacks)
+        # the busy thread was tagged with its innermost open span
+        assert any(k.startswith("span:test/busy;") for k in stacks)
+        spans = p.span_seconds()
+        assert spans.get("test/busy", 0) > 0
+        # collapsed output is flamegraph-ready: "stack count" lines
+        for line in p.collapsed().splitlines():
+            stack, count = line.rsplit(" ", 1)
+            assert stack and int(count) > 0
+
+    def test_untagged_threads_get_the_default_tag(self):
+        p = SamplingProfiler(hz=200, capacity=256)
+        stop = threading.Event()
+        th = threading.Thread(target=_busy, args=(stop,))
+        p.start()
+        th.start()
+        time.sleep(0.2)
+        stop.set()
+        th.join(5)
+        p.stop()
+        assert any(
+            k.startswith(f"span:{UNTAGGED};") for k in p.stacks()
+        )
+
+    def test_sampler_never_profiles_itself(self):
+        p = SamplingProfiler(hz=500, capacity=256)
+        p.start()
+        time.sleep(0.2)
+        p.stop()
+        assert not any(
+            "profiler.py:_sample_once" in k for k in p.stacks()
+        )
+
+    def test_thread_hammer_survives_churn(self):
+        # threads born and dying mid-sample: sys._current_frames()
+        # snapshots must never crash the sampler or leak entries
+        p = SamplingProfiler(hz=500, capacity=2048)
+        with assert_no_thread_leaks(grace=5.0, daemons_too=True):
+            p.start()
+            for _ in range(8):
+                threads = [
+                    threading.Thread(
+                        target=lambda: sum(
+                            i * i for i in range(3000)
+                        )
+                    )
+                    for _ in range(12)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(5)
+            p.stop()
+        assert p.is_running() is False
+        with p._mtx:
+            samples = p._samples
+        assert samples > 0
+
+    def test_windowed_query_excludes_old_ticks(self):
+        p = SamplingProfiler(hz=100, capacity=256)
+        stop = threading.Event()
+        th = threading.Thread(target=_busy, args=(stop,))
+        p.start()
+        th.start()
+        time.sleep(0.2)
+        stop.set()
+        th.join(5)
+        p.stop()
+        assert p.stacks(seconds=60)  # the whole run
+        assert p.stacks(seconds=0) == {}  # zero-width window
+        total = sum(p.stacks().values())
+        windowed = sum(p.stacks(seconds=60).values())
+        assert windowed <= total
+
+    def test_retention_is_bounded(self):
+        p = SamplingProfiler(hz=0, capacity=4)
+        # feed totals past capacity directly (the overflow path)
+        with p._mtx:
+            for i in range(10):
+                key = f"span:-;stack{i}"
+                if key in p._totals:
+                    p._totals[key] += 1
+                elif len(p._totals) < p.capacity:
+                    p._totals[key] = 1
+                else:
+                    p._dropped += 1
+        assert len(p._totals) == 4
+        assert p._dropped == 6
+        assert p.payload()["dropped_stacks"] == 6
+
+    def test_top_functions_ranked_by_leaf_count(self):
+        p = SamplingProfiler(hz=0, capacity=64)
+        with p._mtx:
+            p._totals.update(
+                {
+                    "span:-;a.py:f;b.py:hot": 30,
+                    "span:-;c.py:g;b.py:hot": 20,
+                    "span:-;a.py:f;d.py:cold": 10,
+                }
+            )
+        top = p.top_functions(2)
+        assert top[0] == {
+            "frame": "b.py:hot", "count": 50, "share": round(50 / 60, 4)
+        }
+        assert top[1]["frame"] == "d.py:cold"
+
+    def test_hz_zero_never_starts(self):
+        p = SamplingProfiler(hz=0)
+        p.start()
+        assert p.is_running() is False
+        p.stop()  # no-op, no raise
+
+    def test_stop_joins_and_is_idempotent(self):
+        p = SamplingProfiler(hz=100, capacity=64)
+        with assert_no_thread_leaks(grace=5.0, daemons_too=True):
+            p.start()
+            assert p.is_running()
+            p.stop()
+            p.stop()
+        assert p.is_running() is False
+
+
+class TestEnvContract:
+    """The fail-loudly knob contract: unset -> default, 0 -> disabled,
+    junk -> ValueError at NODE ASSEMBLY (not a silent fallback)."""
+
+    def test_hz_default_and_parse(self, monkeypatch):
+        monkeypatch.delenv("CMT_TPU_PROFILE_HZ", raising=False)
+        assert profile_hz_from_env() == 19
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "")
+        assert profile_hz_from_env() == 19
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "0")
+        assert profile_hz_from_env() == 0
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "97")
+        assert profile_hz_from_env() == 97
+
+    @pytest.mark.parametrize("bad", ["abc", "-1", "1001", "19.5"])
+    def test_hz_junk_fails_loudly(self, monkeypatch, bad):
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", bad)
+        with pytest.raises(ValueError) as ei:
+            profile_hz_from_env()
+        # the error must teach the contract
+        assert "0 disables the profiler" in str(ei.value)
+
+    def test_depth_and_ring_follow_ring_size_contract(self, monkeypatch):
+        monkeypatch.delenv("CMT_TPU_PROFILE_DEPTH", raising=False)
+        monkeypatch.delenv("CMT_TPU_PROFILE_RING", raising=False)
+        assert profile_depth_from_env() == 48
+        assert profile_ring_from_env() == 4096
+        monkeypatch.setenv("CMT_TPU_PROFILE_DEPTH", "16")
+        assert profile_depth_from_env() == 16
+        monkeypatch.setenv("CMT_TPU_PROFILE_DEPTH", "nope")
+        with pytest.raises(ValueError):
+            profile_depth_from_env()
+        monkeypatch.setenv("CMT_TPU_PROFILE_RING", "-5")
+        with pytest.raises(ValueError):
+            profile_ring_from_env()
+
+    def test_start_from_env_validates_all_knobs(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "junk")
+        with pytest.raises(ValueError):
+            start_from_env()
+        # a malformed ring must fail EVEN when hz disables sampling —
+        # validation is the contract, not a side effect of starting
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "0")
+        monkeypatch.setenv("CMT_TPU_PROFILE_RING", "junk")
+        with pytest.raises(ValueError):
+            start_from_env()
+
+    def test_start_from_env_zero_returns_none(self, monkeypatch):
+        monkeypatch.delenv("CMT_TPU_PROFILE_RING", raising=False)
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "0")
+        installed = prof_mod.profiler()
+        assert start_from_env() is None
+        assert prof_mod.profiler() is installed  # untouched
+
+    def test_start_from_env_installs_and_runs(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "50")
+        before = prof_mod.profiler()
+        p = start_from_env()
+        try:
+            assert p is not None and p.is_running()
+            assert prof_mod.profiler() is p
+            assert p.hz == 50
+        finally:
+            p.stop()
+            prof_mod.install_profiler(before)
+
+
+class TestPayload:
+    def test_disabled_payload_is_honest(self):
+        before = prof_mod.profiler()
+        prof_mod.install_profiler(None)
+        try:
+            body = profile_payload()
+            assert body["enabled"] is False
+            assert body["stacks"] == [] and body["hotspots"] == []
+            assert "CMT_TPU_PROFILE_HZ" in body["hint"]
+        finally:
+            prof_mod.install_profiler(before)
+
+    def test_payload_shape(self):
+        p = SamplingProfiler(hz=200, capacity=256)
+        stop = threading.Event()
+        th = threading.Thread(target=_busy, args=(stop,))
+        p.start()
+        th.start()
+        time.sleep(0.2)
+        stop.set()
+        th.join(5)
+        p.stop()
+        body = p.payload()
+        assert body["enabled"] and body["hz"] == 200
+        assert body["samples"] > 0
+        assert body["stacks"] and all(
+            s["stack"].startswith("span:") and s["count"] > 0
+            for s in body["stacks"]
+        )
+        # stacks sorted hottest-first
+        counts = [s["count"] for s in body["stacks"]]
+        assert counts == sorted(counts, reverse=True)
+        assert body["hotspots"][0]["count"] >= body["hotspots"][-1]["count"]
+        json.dumps(body)  # JSON-serializable end to end
+
+
+class TestAttrSmoke:
+    """`make attr-smoke` (gated into `make test`): a single-validator
+    node under the always-on profiler commits >= +3 heights, serves
+    non-empty span-tagged folded stacks at /debug/profile, every
+    committed height's stage budget leaves residual < 20% of the
+    wall, and the perfdiff gate's selftest (which proves the
+    stage-explanation path) passes."""
+
+    def test_attribution_plane_end_to_end(self, tmp_path, monkeypatch):
+        from cometbft_tpu.abci.kvstore import KVStoreApp
+        from cometbft_tpu.config import test_config as make_test_config
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+        from cometbft_tpu.utils import critpath
+        from cometbft_tpu.utils import trace as trace_mod
+
+        monkeypatch.setenv("CMT_TPU_PROFILE_HZ", "199")
+        pv = FilePV(ed.priv_key_from_secret(b"attr-smoke-val"))
+        gen = GenesisDoc(
+            chain_id="attr-smoke-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=(GenesisValidator(pv.pub_key, 10),),
+        )
+        cfg = make_test_config(str(tmp_path))
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        cfg.ensure_dirs()
+        trace_mod.TRACER.clear()
+        node = Node(
+            cfg, app=KVStoreApp(), genesis=gen, priv_validator=pv
+        )
+        node.start()
+        try:
+            assert node.profiler is not None and node.profiler.is_running()
+            h0 = node.height()
+            deadline = time.time() + 30
+            while time.time() < deadline and node.height() < h0 + 3:
+                time.sleep(0.05)
+            assert node.height() >= h0 + 3
+            port = node.metrics_server.port
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile", timeout=5
+                ).read()
+            )
+            assert body["enabled"] and body["samples"] > 0
+            assert body["stacks"], "profiler served no folded stacks"
+            assert all(
+                s["stack"].startswith("span:") for s in body["stacks"]
+            )
+            # the collapsed text surface serves the same window
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}"
+                "/debug/profile?format=collapsed",
+                timeout=5,
+            ).read().decode()
+            assert text.startswith("span:")
+        finally:
+            node.stop()
+        # the profiler thread is GONE after node stop (leak gate)
+        assert node.profiler.is_running() is False
+        assert not any(
+            t.name == "profiler-sampler" for t in threading.enumerate()
+        )
+        # every committed height decomposes with an honest budget:
+        # residual (the "don't know" bucket) stays under 20% of wall
+        events = trace_mod.TRACER.events()
+        heights = critpath.committed_heights(events)
+        assert len(heights) >= 3
+        for h in heights:
+            d = critpath.decompose_local(
+                events, h, wall_epoch=trace_mod.TRACER.epoch_wall
+            )
+            assert d is not None
+            st = d["stages"]
+            # 6-dp rounding on 10 stages: up to ~5e-6 of slack
+            assert abs(sum(st.values()) - d["wall_s"]) < 1e-5
+            assert st["residual"] < 0.20 * d["wall_s"], (h, d)
+        # the regression-explanation gate holds (perfdiff --selftest)
+        from tools.perfdiff import selftest
+
+        assert selftest() == 0
+
+    def test_rpc_route_serves_profile_payload(self):
+        # the JSON-RPC surface (inspect mode included) serves the
+        # same payload without a node handle
+        from cometbft_tpu.inspect import _INSPECT_ROUTES
+        from cometbft_tpu.rpc.core import Environment
+
+        env = Environment()
+        assert "debug/profile" in env.routes()
+        assert "debug/profile" in _INSPECT_ROUTES
+        p = SamplingProfiler(hz=100, capacity=64)
+        before = prof_mod.profiler()
+        prof_mod.install_profiler(p)
+        p.start()
+        try:
+            time.sleep(0.15)
+            body = env.debug_profile(seconds="60")
+            assert body["enabled"] is True
+            assert body["hz"] == 100
+        finally:
+            p.stop()
+            prof_mod.install_profiler(before)

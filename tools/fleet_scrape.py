@@ -145,6 +145,30 @@ def main(argv: list[str] | None = None) -> int:
                 f"p95={fleetobs.percentile(vals, 95):.1f}ms "
                 f"over {len(vals)} heights"
             )
+        # attribution plane: per-height stage budgets on the same
+        # corrected axis (utils/critpath.py), then the p95 height's
+        # budget — the row that explains the p95 number above
+        budgets = payload.get("stage_budgets") or {}
+        if budgets:
+            print(f"\nstage budgets ({len(budgets)} heights):")
+            for h, d in budgets.items():
+                top = sorted(
+                    d["stages"].items(), key=lambda kv: -kv[1]
+                )[:3]
+                tops = " ".join(
+                    f"{s}={v * 1e3:.1f}ms" for s, v in top if v > 0
+                )
+                print(
+                    f"  h={h} wall={d['wall_s'] * 1e3:.1f}ms "
+                    f"gate={d.get('gating_node')} {tops}"
+                )
+            p95b = payload.get("stage_budget_p95")
+            if p95b:
+                print(
+                    f"p95 height h={p95b['height']} "
+                    f"wall={p95b['wall_s'] * 1e3:.1f}ms critical stage: "
+                    f"{payload.get('critical_stage_p95')}"
+                )
     return 0
 
 

@@ -70,6 +70,7 @@ SUBSYSTEMS = {
     "EvidenceMetrics": "evidence",
     "LightMetrics": "light",
     "FleetMetrics": "fleet",
+    "AttributionMetrics": "attribution",
 }
 
 #: structs whose every field must ALSO be documented in
@@ -104,6 +105,10 @@ DOC_CHECKED = (
     # latency is the SLO's numerator, so the whole family is now
     # doc-gated both directions
     "P2PMetrics",
+    # the attribution plane (ISSUE 16): the stage budget is the first
+    # thing read after a latency regression — every series must be
+    # interpretable from the docs
+    "AttributionMetrics",
 )
 
 DOC_FILES = (
@@ -118,6 +123,12 @@ DOC_FILES = (
 DOC_NON_SERIES = frozenset((
     "light_client",
     "light_serve_sustained",
+    # critpath stage names in the observability.md taxonomy table:
+    # they parse as <subsystem>_<field> under the abci/store/wal
+    # prefixes but denote attribution stages, not series
+    "abci_execute",
+    "store_save",
+    "wal_fsync",
 ))
 
 
@@ -331,20 +342,42 @@ def find_doc_unregistered() -> dict[str, list[str]]:
     return stale
 
 
+def find_undocumented_stages() -> list[str]:
+    """Stale-taxonomy guard (same shape as jitcheck's stale-waiver
+    check): every stage label utils/critpath.py can emit must appear
+    in the docs/observability.md stage table — a stage added to the
+    taxonomy without a documented meaning is a budget row nobody can
+    act on.  Returns the missing stage names."""
+    from cometbft_tpu.utils.critpath import STAGES
+
+    text = open(
+        os.path.join(REPO, "docs", "observability.md")
+    ).read()
+    return [s for s in STAGES if f"`{s}`" not in text]
+
+
 def main() -> int:
     missing = find_unreferenced()
     unregistered = find_unregistered()
     undocumented = find_undocumented()
     doc_stale = find_doc_unregistered()
+    stale_stages = find_undocumented_stages()
     rc = 0
     if not missing and not unregistered and not undocumented and (
         not doc_stale
-    ):
+    ) and not stale_stages:
         print(f"metrics-lint: {len(registered_fields())} fields, all "
               "referenced; no unregistered update sites; replication-"
-              "plane fields documented, no stale doc series")
+              "plane fields documented, no stale doc series; stage "
+              "taxonomy documented")
     else:
         rc = 1
+    for stage in stale_stages:
+        print(
+            f"metrics-lint: critpath stage `{stage}` is emitted but "
+            "missing from the docs/observability.md stage table",
+            file=sys.stderr,
+        )
     for field, owners in missing.items():
         print(
             f"metrics-lint: {'/'.join(owners)}.{field} is registered "

@@ -105,7 +105,65 @@ def _load(path: str) -> dict:
         return json.load(f)
 
 
-def _report(regressions: list[dict], comparisons: list[dict]) -> None:
+#: the configs the stage-attribution rows explain: a regressed
+#: ``height_latency_p95_<suffix>`` looks for sibling
+#: ``height_stage_p95_<stage>_<suffix>`` rows (utils/critpath.py
+#: taxonomy, appended by the same fleet smoke)
+_LATENCY_PREFIX = "height_latency_p95_"
+_STAGE_PREFIX = "height_stage_p95_"
+
+
+def explain_stages(
+    old_doc: dict, new_doc: dict, config: str
+) -> list[dict]:
+    """Attribute a ``height_latency_p95_*`` delta to its stage rows:
+    for each critpath stage present on both sides, the absolute delta
+    and its share of the latency regression — sorted worst first.
+    Empty when ``config`` isn't a height-latency row or no stage rows
+    exist (older ledgers), so callers can print-if-any."""
+    if not config.startswith(_LATENCY_PREFIX):
+        return []
+    suffix = config[len(_LATENCY_PREFIX):]
+    from cometbft_tpu.utils.critpath import STAGES
+
+    old = _latest_by_config(old_doc)
+    new = _latest_by_config(new_doc)
+    try:
+        lat_delta = float(new[config]["value"]) - float(
+            old[config]["value"]
+        )
+    except (KeyError, TypeError, ValueError):
+        lat_delta = 0.0
+    out: list[dict] = []
+    for stage in STAGES:
+        cfg = f"{_STAGE_PREFIX}{stage}_{suffix}"
+        o, n = old.get(cfg), new.get(cfg)
+        if o is None or n is None:
+            continue
+        try:
+            ov, nv = float(o["value"]), float(n["value"])
+        except (TypeError, ValueError):
+            continue
+        delta = nv - ov
+        out.append(
+            {
+                "stage": stage, "old": ov, "new": nv,
+                "delta_ms": round(delta, 3),
+                "share": (
+                    round(delta / lat_delta, 4) if lat_delta else None
+                ),
+            }
+        )
+    out.sort(key=lambda r: -r["delta_ms"])
+    return out
+
+
+def _report(
+    regressions: list[dict],
+    comparisons: list[dict],
+    old_doc: dict | None = None,
+    new_doc: dict | None = None,
+) -> None:
     for row in comparisons:
         mark = "REGRESSION" if row["regressed"] else "ok"
         print(
@@ -115,6 +173,25 @@ def _report(regressions: list[dict], comparisons: list[dict]) -> None:
             f"{row['threshold'] * 100:.0f}%) {mark}",
             file=sys.stderr if row["regressed"] else sys.stdout,
         )
+        if (
+            row["regressed"]
+            and old_doc is not None
+            and new_doc is not None
+        ):
+            stages = explain_stages(old_doc, new_doc, row["config"])
+            for s in stages:
+                if s["delta_ms"] <= 0:
+                    continue
+                share = (
+                    f" ({s['share'] * 100:.0f}% of the regression)"
+                    if s["share"] is not None else ""
+                )
+                print(
+                    f"perfdiff:   explained by {s['stage']}: "
+                    f"{s['old']:g} -> {s['new']:g} ms "
+                    f"(+{s['delta_ms']:g}ms){share}",
+                    file=sys.stderr,
+                )
     if not comparisons:
         print("perfdiff: no comparable configs", file=sys.stderr)
 
@@ -131,10 +208,30 @@ def selftest() -> int:
     regs, comps = compare(baseline, regressed)
     if not comps:
         failures.append("fixture pair produced no comparisons")
-    missed = [c["config"] for c in comps if not c["regressed"]]
+    # stage-attribution rows are seeded so ONE stage owns the latency
+    # regression — the others hold steady by design, so the
+    # every-config-must-trip check applies to the non-stage rows
+    missed = [
+        c["config"] for c in comps
+        if not c["regressed"]
+        and not c["config"].startswith(_STAGE_PREFIX)
+    ]
     if missed:
         failures.append(
             f"seeded 20% regression NOT detected for: {missed}"
+        )
+    # the explanation path: the regressed latency row must be
+    # attributable, and the seeded slow stage must rank first
+    lat_cfg = "height_latency_p95_4node"
+    if lat_cfg not in {r["config"] for r in regs}:
+        failures.append(f"seeded {lat_cfg} regression not detected")
+    stages = explain_stages(baseline, regressed, lat_cfg)
+    if not stages:
+        failures.append("stage rows produced no regression explanation")
+    elif stages[0]["stage"] != "store_save":
+        failures.append(
+            "seeded store_save slowdown not named dominant "
+            f"(got {stages[0]['stage']})"
         )
     regs_noise, comps_noise = compare(baseline, noise)
     if not comps_noise:
@@ -151,7 +248,7 @@ def selftest() -> int:
     print(
         f"perf-gate: ok — seeded 20% regression detected on "
         f"{len(comps)} config(s), {len(comps_noise)} noise-level "
-        "delta(s) passed"
+        "delta(s) passed, store_save named dominant stage"
     )
     return 0
 
@@ -181,7 +278,7 @@ def main(argv: list[str] | None = None) -> int:
     regressions, comparisons = compare(
         old_doc, new_doc, threshold=args.threshold, configs=args.configs
     )
-    _report(regressions, comparisons)
+    _report(regressions, comparisons, old_doc, new_doc)
     return 1 if regressions else 0
 
 

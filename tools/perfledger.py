@@ -58,6 +58,10 @@ PROVENANCE_KEYS = (
     "sigs_per_sec_per_chip", "sigs_per_sec", "latency_ms",
     "commits_per_sec", "nval", "batch", "note", "path", "vs_baseline",
     "target_ms", "rc",
+    # attribution plane: the row's top-k leaf-frame hotspots sampled
+    # while it was measured (utils/profiler.py) — what the number was
+    # spending its host CPU on
+    "hotspots",
 )
 
 
