@@ -4,18 +4,20 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck determcheck hotpathcheck envcheck determinism-smoke test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
 # randomized-manifest e2e, interpret-mode pallas trace) are skipped;
 # target <15 min single-core (reference analog: tests.mk:66-87 CI
 # package splits). The r4 default gate had grown to 48 min.
-# All three lints gate the default flow — metrics-lint runs lockcheck
-# AND jitcheck too, so one prerequisite covers them (and all run
-# inside tier-1 via tests/test_metrics.py + tests/test_lockcheck.py +
-# tests/test_jitcheck.py).
-test: metrics-lint flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate
+# All five lints gate the default flow — metrics-lint runs lockcheck,
+# jitcheck, determcheck, hotpathcheck AND envcheck too, so one
+# prerequisite covers them (and all run inside tier-1 via
+# tests/test_metrics.py + tests/test_lockcheck.py +
+# tests/test_jitcheck.py + tests/test_determcheck.py +
+# tests/test_hotpathcheck.py + tests/test_envcheck.py).
+test: metrics-lint determinism-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -95,6 +97,40 @@ lockcheck:
 # kernel shape/dtype contracts declared and well-formed
 jitcheck:
 	$(PY) tools/jitcheck.py
+
+# static replay-determinism lint (docs/determinism.md): nothing
+# reachable from the registered transition roots reads the wall clock,
+# randomness, the environment, or iterates a set — the state machine
+# stays a pure function of (block, prior state); audited
+# '# deterministic:' waivers
+determcheck:
+	$(PY) tools/determcheck.py
+
+# static critical-path blocking lint (docs/determinism.md sibling):
+# nothing reachable from the consensus step handlers / WAL / block
+# persistence sleeps, spawns, or waits unbounded without a
+# '# blocking ok: <stage>' waiver billing it to a critpath stage
+hotpathcheck:
+	$(PY) tools/hotpathcheck.py
+
+# env-knob registry lint: every CMT_TPU_* read goes through a
+# fail-loudly validated reader (cometbft_tpu/utils/env.py) or carries
+# an audited '# env ok:' waiver, is documented in the
+# docs/observability.md env table, and every documented knob is
+# still read (inverse)
+envcheck:
+	$(PY) tools/envcheck.py
+
+# replay-determinism smoke (ISSUE 18 acceptance): a live node with
+# CMT_TPU_DETERMINISM=1 commits >= 5 heights writing per-height
+# transition digests into the WAL, replays them digest-clean on
+# restart (wal_replay + handshake + startup surfaces), and a seeded
+# store tamper is caught as a DivergenceError naming the first
+# diverging field.  Tier-1 runs these too; `make test` gates on this
+# target alongside the other smokes
+determinism-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_determcheck.py \
+		-k "Smoke" -q
 
 # go test -race analog for the DEVICE plane: the jit/contract suite
 # under CMT_TPU_JITGUARD=1 — a post-warmup retrace raises RetraceError

@@ -90,9 +90,9 @@ class SocketClient:
             try:
                 if kind == "unix":
                     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    s.connect(target)
+                    s.connect(target)  # blocking ok: abci_execute — lazy (re)connect is the round-trip's cold path; no progress without the app
                 else:
-                    s = socket.create_connection(target, timeout=5.0)
+                    s = socket.create_connection(target, timeout=5.0)  # blocking ok: abci_execute — lazy (re)connect is the round-trip's cold path; no progress without the app
                 if self._request_timeout > 0:
                     s.settimeout(self._request_timeout)
                 else:
@@ -102,7 +102,7 @@ class SocketClient:
                 return
             except OSError as exc:
                 last_exc = exc
-                time.sleep(0.1)
+                time.sleep(0.1)  # blocking ok: abci_execute — deadline-bounded connect retry backoff
         raise AbciClientError(
             f"cannot connect to ABCI app at {self.addr}: {last_exc}"
         ) from last_exc
@@ -137,7 +137,7 @@ class SocketClient:
             try:
                 self._ensure_connected_locked()
                 payload = codec.encode_request(req)
-                self._sock.sendall(
+                self._sock.sendall(  # blocking ok: abci_execute — the ABCI round-trip IS the stage (exec/apply_block span times it)
                     encode_uvarint(len(payload)) + payload
                 )
                 resp = self._read_response()

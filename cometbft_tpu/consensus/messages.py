@@ -29,6 +29,7 @@ from cometbft_tpu.types.block import BlockID
 from cometbft_tpu.types.part_set import Part
 from cometbft_tpu.types.vote import Proposal, Vote
 from cometbft_tpu.utils.bit_array import BitArray
+from cometbft_tpu.utils.env import flag_from_env
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter, _unzigzag
 from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 
@@ -72,7 +73,7 @@ def stamping_enabled() -> bool:
     (CMT_TPU_TRACE_CTX, default on).  Off = behave like a pre-fleet
     node: send untagged, record no hops — receiving tagged messages
     still works, which is the mixed-version interop contract."""
-    return os.environ.get("CMT_TPU_TRACE_CTX", "1") != "0"
+    return flag_from_env("CMT_TPU_TRACE_CTX", default=True)
 
 
 def make_trace_ctx(origin: str, height: int, round_: int) -> TraceContext:
@@ -96,7 +97,7 @@ def _dec_trace_ctx(data: bytes) -> TraceContext:
         origin=_bz(f.get(1, [b""])[0]).decode("utf-8", "replace"),
         height=_iv(f.get(2, [0])[0]),
         round=_unzigzag(_iv(f.get(3, [0])[0])),
-        send_wall=_iv(f.get(4, [0])[0]) / 1e9,
+        send_wall=_iv(f.get(4, [0])[0]) / 1e9,  # deterministic: trace-plane timestamp, diagnostics only — never enters state
     )
 
 
@@ -300,7 +301,14 @@ def decode_message_traced(data: bytes):
             raise MessageError("repeated trace context")
         try:
             ctx = _dec_trace_ctx(_bz(raw_ctx[0]))
-        except Exception:  # noqa: BLE001 — bad ctx is ignored, not fatal
+        except Exception as exc:  # noqa: BLE001 — bad ctx is ignored, not
+            # fatal: the message body still decodes; leave a breadcrumb
+            # naming the type (the PR 9 convention) instead of nothing
+            from cometbft_tpu.utils.flight import FLIGHT
+
+            FLIGHT.record(
+                "trace_ctx_rejected", err=type(exc).__name__
+            )
             ctx = None
     if len(f) != 1:
         raise MessageError("consensus message must have exactly one body")

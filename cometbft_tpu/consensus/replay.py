@@ -19,7 +19,7 @@ from cometbft_tpu.abci.types import (
     ValidatorUpdate,
 )
 from cometbft_tpu.mempool import NopMempool
-from cometbft_tpu.state import State, Store
+from cometbft_tpu.state import State, Store, determinism
 from cometbft_tpu.state.execution import (
     BlockExecutor,
     abci_validator_updates_to_changes,
@@ -46,12 +46,14 @@ class Handshaker:
         block_store,
         genesis: GenesisDoc,
         logger: Logger | None = None,
+        metrics=None,
     ):
         self.state_store = state_store
         self.state = state
         self.block_store = block_store
         self.genesis = genesis
         self.logger = logger or default_logger().with_fields(module="handshake")
+        self.metrics = metrics  # ConsensusMetrics or None
         self.n_blocks_replayed = 0
 
     def handshake(self, proxy_app) -> State:
@@ -227,5 +229,21 @@ class Handshaker:
             )
         )
         proxy_app.consensus.commit()
+        if determinism.enabled():
+            # the app-nondeterminism direction: the fresh re-execution
+            # must reproduce the FinalizeBlock response the original
+            # run persisted (tx results, valset deltas, app hash)
+            saved = self.state_store.load_finalize_block_response(height)
+            if saved is not None:
+                determinism.compare(
+                    determinism.transition_digest(
+                        height, meta.block_id, saved
+                    ),
+                    determinism.transition_digest(
+                        height, meta.block_id, resp
+                    ),
+                    surface="handshake",
+                    metrics=self.metrics,
+                )
         self.logger.info("replayed block to app", height=height)
         return resp.app_hash

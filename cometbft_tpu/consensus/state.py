@@ -44,7 +44,7 @@ from cometbft_tpu.consensus.ticker import (
     TimeoutTicker,
 )
 from cometbft_tpu.abci.types import ExtendVoteRequest, VerifyVoteExtensionRequest
-from cometbft_tpu.state import State
+from cometbft_tpu.state import State, determinism
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.types.block import Block, BlockID, Commit
 from cometbft_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
@@ -64,7 +64,13 @@ from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.time import now_ns
 from cometbft_tpu.utils.trace import NOP_SPAN, TRACER as _tracer
-from cometbft_tpu.wal import KIND_MSG_INFO, KIND_TIMEOUT, NopWAL, WALRecord
+from cometbft_tpu.wal import (
+    KIND_MSG_INFO,
+    KIND_TIMEOUT,
+    KIND_TRANSITION_DIGEST,
+    NopWAL,
+    WALRecord,
+)
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 
 
@@ -381,6 +387,23 @@ class ConsensusState(BaseService):
         elif rec.kind == KIND_TIMEOUT:
             ti = decode_timeout_info(rec.data)
             self._handle_timeout(ti)
+        elif rec.kind == KIND_TRANSITION_DIGEST:
+            # CMT_TPU_DETERMINISM: the digest committed before the
+            # crash must still be derivable from the stores we are
+            # replaying on top of — a mismatch means replay would
+            # rebuild a DIFFERENT state than the one that ran
+            if determinism.enabled():
+                recorded = determinism.TransitionDigest.decode(rec.data)
+                recomputed = determinism.recompute_from_stores(
+                    recorded.height,
+                    self.block_store,
+                    self.block_exec.state_store,
+                )
+                if recomputed is not None:
+                    determinism.compare(
+                        recorded, recomputed,
+                        surface="wal_replay", metrics=self.metrics,
+                    )
 
     # -- the single-writer core (state.go:795 receiveRoutine) ------------
 
@@ -507,7 +530,7 @@ class ConsensusState(BaseService):
         self.metrics.validators.set(len(validators))
         self.metrics.validators_power.set(validators.total_voting_power())
         if self.commit_time_ns == 0:
-            self.start_time_ns = now_ns() + self.config.timeout_commit_ns
+            self.start_time_ns = now_ns() + self.config.timeout_commit_ns  # deterministic: round scheduling, not state — decides WHEN, never WHAT
         else:
             self.start_time_ns = (
                 self.commit_time_ns + self.config.timeout_commit_ns
@@ -543,7 +566,7 @@ class ConsensusState(BaseService):
         self._height_t0 = time.perf_counter()
 
     def _schedule_round_0(self) -> None:  # holds _rs_mtx
-        sleep = max(self.start_time_ns - now_ns(), 0)
+        sleep = max(self.start_time_ns - now_ns(), 0)  # deterministic: round scheduling, not state — decides WHEN, never WHAT
         self._ticker.schedule(
             TimeoutInfo(sleep, self.height, 0, STEP_NEW_HEIGHT)
         )
@@ -729,7 +752,7 @@ class ConsensusState(BaseService):
             block_id=block_id,
             timestamp_ns=block.header.time_ns
             if not self.state.consensus_params.pbts_enabled(height)
-            else now_ns(),
+            else now_ns(),  # deterministic: proposer's signed PBTS stamp — every node re-validates it via _proposal_is_timely
         )
         try:
             proposal = self.priv_validator.sign_proposal(
@@ -780,7 +803,7 @@ class ConsensusState(BaseService):
         # (types/vote.go IsTimely contract); during WAL replay the
         # original receive timestamp comes from the record.
         self._proposal_recv_time_ns = (
-            self._replay_msg_time_ns if self._replay_mode else now_ns()
+            self._replay_msg_time_ns if self._replay_mode else now_ns()  # deterministic: live branch only — replay takes the recorded WAL receipt time
         )
         if self.proposal_block_parts is None:
             self.proposal_block_parts = PartSet(
@@ -796,7 +819,14 @@ class ConsensusState(BaseService):
                         ),
                         from_peer,
                     )
-                except Exception:  # noqa: BLE001 — bad proofs skipped
+                except Exception as exc:  # noqa: BLE001 — bad proofs skipped
+                    # the PR 9 convention: a swallowed error leaves a
+                    # flight breadcrumb naming the type, never nothing
+                    FLIGHT.record(
+                        "early_part_rejected",
+                        height=self.height,
+                        err=type(exc).__name__,
+                    )
                     continue
         if not self._replay_mode:
             # zero-duration mark: where in the height's timeline the
@@ -990,7 +1020,7 @@ class ConsensusState(BaseService):
         prevote cannot flip the verdict."""
         sp = self.state.consensus_params.synchrony
         t = self.proposal.timestamp_ns
-        recv = self._proposal_recv_time_ns or now_ns()
+        recv = self._proposal_recv_time_ns or now_ns()  # deterministic: PBTS is DEFINED on local receive time — precision/message_delay absorb the skew
         lhs = t - sp.precision_ns
         rhs = t + sp.precision_ns + sp.message_delay_ns
         return lhs <= recv <= rhs
@@ -1098,7 +1128,7 @@ class ConsensusState(BaseService):
         if self.height != height or self.step >= STEP_COMMIT:
             return
         self.commit_round = commit_round
-        self.commit_time_ns = now_ns()
+        self.commit_time_ns = now_ns()  # deterministic: round scheduling, not state — decides WHEN, never WHAT
         self._set_step(STEP_COMMIT)
         if not self._replay_mode:
             _tracer.add_complete(
@@ -1143,8 +1173,15 @@ class ConsensusState(BaseService):
                             ),
                             from_peer,
                         )
-                    except Exception:  # noqa: BLE001 — stashed parts are
-                        continue  # unvalidated; bad proofs just get skipped
+                    except Exception as exc:  # noqa: BLE001 — stashed parts
+                        # are unvalidated; bad proofs get skipped, but
+                        # never silently (the PR 9 convention)
+                        FLIGHT.record(
+                            "early_part_rejected",
+                            height=height,
+                            err=type(exc).__name__,
+                        )
+                        continue
                 if self.proposal_block is None:
                     return  # wait for parts via gossip
         self._try_finalize_commit(height)
@@ -1216,6 +1253,16 @@ class ConsensusState(BaseService):
                 BlockID(hash=block.hash(), part_set_header=parts.header),
                 block,
             )
+            if determinism.enabled() and not self._replay_mode:
+                # the digest record rides AFTER end_height(H), so it is
+                # part of height H+1's replay window (and the startup
+                # sweep sees every record regardless of position);
+                # fsynced so the guard's evidence survives a crash
+                d = self.block_exec.last_transition_digest
+                if d is not None and d.height == height:
+                    self.wal.write_sync(
+                        KIND_TRANSITION_DIGEST, d.encode()
+                    )
         self.logger.info(
             "committed block",
             height=height,
@@ -1238,7 +1285,7 @@ class ConsensusState(BaseService):
         prev = self.block_store.load_block_meta(height - 1)
         if prev is not None and prev.header.time_ns:
             m.block_interval_seconds.observe(
-                max(0.0, (block.header.time_ns - prev.header.time_ns) / 1e9)
+                max(0.0, (block.header.time_ns - prev.header.time_ns) / 1e9)  # deterministic: metrics observation only — never enters state
             )
         self._update_to_state(new_state)
         if not self._replay_mode:
@@ -1362,7 +1409,7 @@ class ConsensusState(BaseService):
                         self.validators.get_proposer().address.hex()
                     )
                 ).set(
-                    max(0.0, (now_ns() - self.proposal.timestamp_ns) / 1e9)
+                    max(0.0, (now_ns() - self.proposal.timestamp_ns) / 1e9)  # deterministic: metrics observation only — never enters state
                 )
                 _tracer.add_complete(
                     "height/quorum_prevote", time.perf_counter(), 0.0,
@@ -1463,7 +1510,7 @@ class ConsensusState(BaseService):
             height=self.height,
             round=self.round,
             block_id=block_id,
-            timestamp_ns=max(now_ns(), self.state.last_block_time_ns + 1),
+            timestamp_ns=max(now_ns(), self.state.last_block_time_ns + 1),  # deterministic: votes carry signed LOCAL time by protocol — BFT time is their median
             validator_address=addr,
             validator_index=idx,
         )

@@ -68,7 +68,7 @@ class TimeoutTicker(BaseService):
             if self._pending is not None and ti.hrs() < self._pending.hrs():
                 return  # ignore stale schedule
             self._pending = ti
-            self._deadline_ns = now_ns() + ti.duration_ns
+            self._deadline_ns = now_ns() + ti.duration_ns  # deterministic: timeout scheduling, not state — replay re-fires from the recorded WAL timeout record
             self._cv.notify()
 
     def on_start(self) -> None:

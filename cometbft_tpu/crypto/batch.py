@@ -24,6 +24,7 @@ from cometbft_tpu.crypto import BatchVerifier, PubKey
 from cometbft_tpu.crypto import ed25519 as _ed
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.env import flag_from_env, float_from_env
 
 # Device availability is probed in a SUBPROCESS: a wedged accelerator
 # plugin can hang `import jax` inside C where the GIL never releases —
@@ -36,8 +37,8 @@ from cometbft_tpu.utils import sync as cmtsync
 # deterministic.  A failed probe retries after _PROBE_RETRY_S.
 _probe_lock = cmtsync.Mutex()
 _device_state = {"status": "unknown", "ndev": 0, "failed_at": 0.0}
-_PROBE_TIMEOUT_S = float(os.environ.get("CMT_TPU_PROBE_TIMEOUT_S", 20))
-_PROBE_RETRY_S = float(os.environ.get("CMT_TPU_PROBE_RETRY_S", 120))
+_PROBE_TIMEOUT_S = float_from_env("CMT_TPU_PROBE_TIMEOUT_S", 20.0, minimum=0.001)
+_PROBE_RETRY_S = float_from_env("CMT_TPU_PROBE_RETRY_S", 120.0, minimum=0.001)
 
 
 def _probe_subprocess() -> None:
@@ -143,7 +144,7 @@ def _ed25519_factory() -> BatchVerifier:
     # tiers instead of mixing factory-time and batch-time samples.
     from cometbft_tpu.crypto.dispatch import LadderHostVerifier
 
-    if os.environ.get("CMT_TPU_DISABLE_DEVICE_VERIFY"):
+    if flag_from_env("CMT_TPU_DISABLE_DEVICE_VERIFY"):
         _crypto_metrics().dispatch_decisions.labels(
             route="host", reason="disabled"
         ).inc()
@@ -155,7 +156,7 @@ def _ed25519_factory() -> BatchVerifier:
                 route="host", reason="device_unavailable"
             ).inc()
             return LadderHostVerifier()
-        if ndev > 1 and not os.environ.get("CMT_TPU_DISABLE_MESH_VERIFY"):
+        if ndev > 1 and not flag_from_env("CMT_TPU_DISABLE_MESH_VERIFY"):
             # multi-chip: shard the batch over a 1-D mesh — every
             # caller of this seam scales across chips transparently
             from cometbft_tpu.parallel.mesh import ShardedTpuBatchVerifier

@@ -94,6 +94,7 @@ from cometbft_tpu.crypto import ed25519 as _ed
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.utils import sync as cmtsync
 from cometbft_tpu.utils.flight import FLIGHT
+from cometbft_tpu.utils.env import flag_from_env
 from cometbft_tpu.utils.flight import ring_size_from_env as _int_env
 from cometbft_tpu.utils.log import default_logger
 
@@ -218,12 +219,7 @@ def cooldown_max_from_env() -> float:
 def route_enabled_from_env() -> bool:
     """Cost-based shape-aware routing on/off (default on).  Fail-loudly
     contract: anything but 0/1 raises naming the variable."""
-    raw = os.environ.get("CMT_TPU_ROUTE")
-    if raw is None or raw.strip() == "":
-        return True
-    if raw.strip() in ("0", "1"):
-        return raw.strip() == "1"
-    raise ValueError(f"CMT_TPU_ROUTE must be 0 or 1, got {raw!r}")
+    return flag_from_env("CMT_TPU_ROUTE", default=True)
 
 
 def route_min_samples_from_env() -> int:
@@ -416,9 +412,9 @@ class Chaos:
         """Re-read the env (tests toggle chaos per-case; production
         reads it once at process start)."""
         plan = None
-        if os.environ.get("CMT_TPU_CHAOS"):
+        if flag_from_env("CMT_TPU_CHAOS"):
             spec = os.environ.get(
-                "CMT_TPU_CHAOS_PLAN",
+                "CMT_TPU_CHAOS_PLAN",  # env ok: free-form fault plan — ChaosPlan.parse validates fail-loudly naming the variable
                 # default drill: seeded loss-then-recovery cycles
                 "seed=0,on=2,off=8,n=8,kinds=device_loss|mislaunch",
             )

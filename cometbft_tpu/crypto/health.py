@@ -791,7 +791,7 @@ USAGE = DeviceUsage()
 def perf_ledger_path() -> str:
     """docs/data/perf_ledger.json (CMT_TPU_PERF_LEDGER overrides) —
     the merged perf trajectory tools/perfledger.py maintains."""
-    env = os.environ.get("CMT_TPU_PERF_LEDGER")
+    env = os.environ.get("CMT_TPU_PERF_LEDGER")  # env ok: free-form filesystem path — no parse to fail
     if env:
         return env
     repo = os.path.dirname(

@@ -97,7 +97,7 @@ class Pool:
                 oldest_ns = ev.timestamp_ns
         self.metrics.pool_size.set(count)
         self.metrics.oldest_age_seconds.set(
-            max(0.0, (now_ns() - oldest_ns) / 1e9)
+            max(0.0, (now_ns() - oldest_ns) / 1e9)  # deterministic: metrics observation only — never enters state
             if oldest_ns is not None
             else 0.0
         )
@@ -136,7 +136,7 @@ class Pool:
         ):
             raise EvidenceExpiredError(
                 f"evidence from height {ev.height} is too old "
-                f"({age_blocks} blocks, {age_ns / 1e9:.0f}s)"
+                f"({age_blocks} blocks, {age_ns // 1_000_000_000}s)"
             )
 
     def _verify_duplicate_vote(
@@ -442,7 +442,14 @@ class Pool:
     def _prune_expired(self, state: State) -> None:
         params = state.consensus_params.evidence
         height = state.last_block_height
-        now = state.last_block_time_ns or now_ns()
+        # expiry is judged in BLOCK time, never host time: every node
+        # prunes the same evidence at the same height, and replay
+        # reconstructs the same pool (determcheck; evidence.go uses
+        # state.LastBlockTime the same way).  Pre-genesis (time 0)
+        # nothing can expire.
+        now = state.last_block_time_ns
+        if now == 0:
+            return
         drop = []
         with self._mtx:
             for key, raw in self.db.prefix_iterator(_PREFIX_PENDING):

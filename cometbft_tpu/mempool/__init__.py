@@ -522,7 +522,7 @@ class CListMempool:
 
     def lock(self) -> None:
         """Held across FinalizeBlock→Commit (state/execution.go:405)."""
-        self._mtx.acquire()
+        self._mtx.acquire()  # blocking ok: abci_execute — mempool is locked across the commit-side update, inside the exec/apply_block span
 
     def unlock(self) -> None:
         self._mtx.release()

@@ -48,6 +48,7 @@ class ConsensusMetrics:
             self.block_interval_seconds = self.committed_height = _NOP
             self.block_parts = self.quorum_prevote_delay = _NOP
             self.step_duration_seconds = _NOP
+            self.replay_divergence_total = _NOP
             return
         s = "consensus"
         self.height = reg.gauge(s, "height", "Height of the chain.")
@@ -97,6 +98,13 @@ class ConsensusMetrics:
             "(metrics.go StepDurationSeconds).",
             buckets=DEFAULT_TIME_BUCKETS,
             labels=("step",),
+        )
+        self.replay_divergence_total = reg.counter(
+            s, "replay_divergence_total",
+            "Transition-digest mismatches caught by the "
+            "CMT_TPU_DETERMINISM replay guard, by surface "
+            "(wal_replay|handshake|startup).",
+            labels=("surface",),
         )
 
 

@@ -46,6 +46,7 @@ from cometbft_tpu.proxy import (
 )
 from cometbft_tpu.state import (
     Store as StateStore,
+    determinism,
     load_state_from_db_or_genesis,
 )
 from cometbft_tpu.state.execution import BlockExecutor
@@ -53,6 +54,7 @@ from cometbft_tpu.store import BlockStore
 from cometbft_tpu.types.event_bus import EventBus
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 from cometbft_tpu.utils.db import open_db
+from cometbft_tpu.utils.env import flag_from_env
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.time import now_ns
@@ -671,7 +673,7 @@ class Node(BaseService):
         # (consensus add_vote, blocksync prefetch) start below, and
         # every caller degrades to the synchronous path if this fails
         # — the queue is an accelerator, never a liveness dependency
-        if os.environ.get("CMT_TPU_VERIFY_QUEUE", "1") != "0":
+        if flag_from_env("CMT_TPU_VERIFY_QUEUE", default=True):
             from cometbft_tpu.crypto.verify_queue import (
                 VerifyQueue,
                 checktx_batch_from_env,
@@ -816,6 +818,7 @@ class Node(BaseService):
             self.block_store,
             self.genesis,
             logger=self.logger.with_fields(module="handshake"),
+            metrics=self.consensus.metrics,
         )
         self.state = hs.handshake(self.proxy_app)
         # round state is guarded; the ticker/receive threads aren't
@@ -853,6 +856,19 @@ class Node(BaseService):
                 )
 
         if isinstance(self.wal, WAL):
+            if determinism.enabled():
+                # before the WAL starts moving: every committed-height
+                # digest still in the log must reproduce from the
+                # stores we are about to build on
+                n = determinism.verify_wal_digests(
+                    self.wal, self.block_store, self.state_store,
+                    metrics=self.consensus.metrics,
+                )
+                if n:
+                    self.logger.info(
+                        "determinism guard: wal digests verified",
+                        heights=n,
+                    )
             self.wal.start()
 
         # p2p (node.go:613-626): listen, start switch (which starts the

@@ -22,15 +22,17 @@ import os
 
 import jax
 
+from cometbft_tpu.utils.env import flag_from_env
+
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: the verify kernel's first compile is
 # ~90s; caching it across processes turns every later startup into a
 # few-second cache load. Opt out with CMT_TPU_NO_COMPILE_CACHE=1.
-if not os.environ.get("CMT_TPU_NO_COMPILE_CACHE"):
+if not flag_from_env("CMT_TPU_NO_COMPILE_CACHE"):
     try:
         _cache_dir = os.environ.get(
-            "CMT_TPU_COMPILE_CACHE_DIR",
+            "CMT_TPU_COMPILE_CACHE_DIR",  # env ok: free-form filesystem path — no parse to fail
             os.path.join(
                 os.path.expanduser("~"), ".cache", "cometbft_tpu_xla"
             ),

@@ -50,6 +50,7 @@ from cometbft_tpu.crypto import health as _health
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import curve as C
 from cometbft_tpu.ops import field as _F
+from cometbft_tpu.utils.env import flag_from_env, int_from_env
 from cometbft_tpu.ops import jitguard as _jitguard
 from cometbft_tpu.utils.trace import TRACER as _tracer
 from cometbft_tpu.ops import scalar as SC
@@ -64,7 +65,7 @@ _MIN_BATCH = 8
 #: splits into pipelined launches. Derived from round-3 measurement:
 #: 8192 sustains peak device rate; 65536 in one launch hits an
 #: XLA memory cliff.
-MAX_LAUNCH = int(os.environ.get("CMT_TPU_MAX_LAUNCH", 8192))
+MAX_LAUNCH = int_from_env("CMT_TPU_MAX_LAUNCH", 8192, minimum=1)
 
 
 def nblocks_for_bucket(bucket: int) -> int:
@@ -389,7 +390,7 @@ def verify_arrays_async(pub: np.ndarray, sig: np.ndarray, msgs: list[bytes]):
     CMT_TPU_JITGUARD transfer window admits.  Each device array is
     pow2/chunk padded — slice to its chunk_len."""
     n = len(msgs)
-    homogeneous = n > MAX_LAUNCH and not os.environ.get(
+    homogeneous = n > MAX_LAUNCH and not flag_from_env(
         "CMT_TPU_MULTI_LAUNCH"
     )
     if homogeneous:
@@ -517,7 +518,7 @@ def verify_stream(jobs, max_in_flight: int = 8, dispatch=None):
 DEVICE_MIN_BATCH = 64
 
 CALIBRATION_PATH = os.environ.get(
-    "CMT_TPU_CALIBRATION",
+    "CMT_TPU_CALIBRATION",  # env ok: free-form filesystem path — no parse to fail
     os.path.join(
         os.path.expanduser("~"), ".cache", "cometbft_tpu",
         "device_calibration.json",
@@ -552,9 +553,14 @@ def _measure_link_rtt() -> float:
 def runtime_device_min_batch() -> int:
     """The dispatch threshold: env override > calibrated crossover."""
     global _runtime_threshold
-    env = os.environ.get("CMT_TPU_DEVICE_MIN_BATCH")
+    env = os.environ.get("CMT_TPU_DEVICE_MIN_BATCH")  # env ok: explicit 0 means "always device" — a minimum floor cannot express the unset-vs-0 distinction
     if env:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(
+                f"CMT_TPU_DEVICE_MIN_BATCH={env!r} is not an integer"
+            ) from None
     if _runtime_threshold is not None:
         return _runtime_threshold
     t_cpu, t_dev = _DEFAULT_T_CPU_SIG, _DEFAULT_T_DEV_SIG
@@ -698,7 +704,7 @@ class TpuBatchVerifier(BatchVerifier):
             ladder.active(t) for t in self._keyed_tiers()
         )
         if device_usable and msg_fits and keyed_admissible and (
-            not os.environ.get("CMT_TPU_DISABLE_PRECOMPUTE")
+            not flag_from_env("CMT_TPU_DISABLE_PRECOMPUTE")
         ):
             # when every keyed tier is demoted the lookup is skipped
             # entirely: a dead device must not stall the plan phase

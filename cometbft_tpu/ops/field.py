@@ -136,14 +136,18 @@ def neg(a):
 
 import os as _os
 
+from cometbft_tpu.utils.env import choice_from_env, flag_from_env
+
 #: column-formation strategy; the full verify kernel is HBM-bound, so
 #: the winner is whichever materializes fewest bytes inside XLA's big
 #: fused graphs — measured end-to-end (tools/bench_kernel_ab.py), not
 #: in isolated loops (where all variants fuse perfectly).
-COLS_IMPL = _os.environ.get("CMT_TPU_COLS_IMPL", "stack")
-SQUARE_IMPL = _os.environ.get("CMT_TPU_SQUARE_IMPL", "fast")
+COLS_IMPL = choice_from_env(
+    "CMT_TPU_COLS_IMPL", "stack", ("stack", "stack16", "tree", "pallas")
+)
+SQUARE_IMPL = choice_from_env("CMT_TPU_SQUARE_IMPL", "fast", ("fast", "mul"))
 #: debug-mode runtime guards (host callbacks; never on in production)
-_DEBUG_CHECKS = bool(_os.environ.get("CMT_TPU_DEBUG_CHECKS"))
+_DEBUG_CHECKS = flag_from_env("CMT_TPU_DEBUG_CHECKS")
 
 
 def trace_config() -> tuple:
@@ -328,7 +332,7 @@ def _square_rows(a):
     return _relax_rows(_fold_high_rows(cols))
 
 
-_PALLAS_INTERPRET = bool(_os.environ.get("CMT_TPU_PALLAS_INTERPRET"))
+_PALLAS_INTERPRET = flag_from_env("CMT_TPU_PALLAS_INTERPRET")
 
 
 def _pallas_elementwise(rows_fn, nin: int):

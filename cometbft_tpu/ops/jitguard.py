@@ -46,8 +46,9 @@ import traceback
 from contextlib import contextmanager
 
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
+from cometbft_tpu.utils.env import flag_from_env
 
-_ENABLED = bool(os.environ.get("CMT_TPU_JITGUARD"))
+_ENABLED = flag_from_env("CMT_TPU_JITGUARD")
 
 def _is_transfer_guard_error(exc: Exception) -> bool:
     """Attribute a trip to the metrics counter only for the error the

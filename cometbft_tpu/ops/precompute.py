@@ -52,13 +52,14 @@ from cometbft_tpu.ops import field as F
 from cometbft_tpu.ops import jitguard
 from cometbft_tpu.ops.ed25519_verify import _next_pow2
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils.env import int_from_env
 
 #: largest set that gets 8-bit per-key combs (3.4 MB/key on device)
-KEY8_MAX = int(os.environ.get("CMT_TPU_KEY8_MAX", 256))
+KEY8_MAX = int_from_env("CMT_TPU_KEY8_MAX", 256)
 #: largest set we precompute tables for at all
-TABLE_MAX_KEYS = int(os.environ.get("CMT_TPU_TABLE_MAX_KEYS", 16384))
+TABLE_MAX_KEYS = int_from_env("CMT_TPU_TABLE_MAX_KEYS", 16384)
 #: total device bytes across cached sets before LRU eviction
-TABLE_CACHE_MB = int(os.environ.get("CMT_TPU_TABLE_CACHE_MB", 6144))
+TABLE_CACHE_MB = int_from_env("CMT_TPU_TABLE_CACHE_MB", 6144)
 
 
 # -- fixed-base 8-bit comb (host-built, shared) ------------------------

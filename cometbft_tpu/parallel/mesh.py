@@ -36,6 +36,7 @@ except ImportError:  # pragma: no cover - older jax: keyed_mesh tier off
     _shard_map = None
 
 from cometbft_tpu.crypto import health as _health
+from cometbft_tpu.utils.env import flag_from_env
 from cometbft_tpu.metrics import crypto_metrics as _crypto_metrics
 from cometbft_tpu.ops import field as _field
 from cometbft_tpu.ops import jitguard as _jitguard
@@ -263,7 +264,7 @@ class ShardedTpuBatchVerifier(TpuBatchVerifier):
         return (
             self._ndev > 1
             and _shard_map is not None
-            and not os.environ.get("CMT_TPU_DISABLE_SHARDED_KEYED")
+            and not flag_from_env("CMT_TPU_DISABLE_SHARDED_KEYED")
         )
 
     def _keyed_tiers(self) -> list[str]:

@@ -466,7 +466,7 @@ class Environment:
 
         scrapes = fleetobs.scrape_fleet(
             fleetobs.fleet_peer_targets(
-                _os.environ.get("CMT_TPU_FLEET_PEERS")
+                _os.environ.get("CMT_TPU_FLEET_PEERS")  # env ok: free-form peer list — fleet_peer_targets validates each address
             ),
             include_self=True,
             self_registry=self.metrics_registry,

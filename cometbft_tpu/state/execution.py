@@ -10,6 +10,8 @@ Commit verification of the previous block funnels into
 
 from __future__ import annotations
 
+import time
+
 from cometbft_tpu.abci.types import (
     CommitInfo,
     FinalizeBlockRequest,
@@ -24,7 +26,7 @@ from cometbft_tpu.abci.types import (
     results_hash,
 )
 from cometbft_tpu.crypto.ed25519 import Ed25519PubKey
-from cometbft_tpu.state import State, Store
+from cometbft_tpu.state import State, Store, determinism
 from cometbft_tpu.types.block import Block, BlockID, Commit
 from cometbft_tpu.types.evidence import (
     DuplicateVoteEvidence,
@@ -40,6 +42,7 @@ from cometbft_tpu.types.event_bus import (
 from cometbft_tpu.types.validation import verify_commit
 from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.utils.fail import fail_point
+from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.time import now_ns
 from cometbft_tpu.utils.trace import TRACER
@@ -387,6 +390,9 @@ class BlockExecutor:
         self.logger = logger or default_logger().with_fields(module="executor")
         self.retain_height = 0  # last app-requested retain height
         self.pruner = None  # wired by the node (state/pruner.py)
+        # CMT_TPU_DETERMINISM=1: TransitionDigest of the most recent
+        # apply_block, for the consensus layer to log into the WAL
+        self.last_transition_digest = None
 
     # -- proposal path ---------------------------------------------------
 
@@ -419,7 +425,7 @@ class BlockExecutor:
         if height == state.initial_height:
             time_ns = state.last_block_time_ns
         elif state.consensus_params.pbts_enabled(height):
-            time_ns = max(now_ns(), state.last_block_time_ns + 1)
+            time_ns = max(now_ns(), state.last_block_time_ns + 1)  # deterministic: proposer's PBTS block-time stamp — validators re-check it via _proposal_is_timely
         else:
             time_ns = median_time(last_commit, state.last_validators)
 
@@ -502,7 +508,9 @@ class BlockExecutor:
     ) -> State:
         self.validate_block(state, block)
 
-        start = now_ns()
+        # duration clock, not wall clock: the measurement feeds metrics
+        # only, and determcheck keeps wall-time reads off the apply path
+        start = time.perf_counter()
         req = FinalizeBlockRequest(
             txs=block.data.txs,
             decided_last_commit=build_last_commit_info(
@@ -517,8 +525,8 @@ class BlockExecutor:
             syncing_to_height=syncing_to_height or block.header.height,
         )
         resp = self.proxy_app.finalize_block(req)
-        elapsed_ms = (now_ns() - start) / 1e6
-        self.metrics.block_processing_time.observe(elapsed_ms / 1e3)
+        elapsed_s = time.perf_counter() - start
+        self.metrics.block_processing_time.observe(elapsed_s)
         if resp.validator_updates:
             self.metrics.validator_set_updates.inc()
         if resp.consensus_param_updates is not None:
@@ -527,7 +535,7 @@ class BlockExecutor:
             "finalized block",
             height=block.header.height,
             num_txs=len(block.data.txs),
-            ms=round(elapsed_ms, 2),
+            ms=round(elapsed_s * 1e3, 2),
         )
         if len(resp.tx_results) != len(block.data.txs):
             raise BlockExecutionError(
@@ -542,6 +550,16 @@ class BlockExecutor:
         fail_point()  # crash point 2 (execution.go:277)
 
         new_state = update_state(state, block_id, block, resp)
+
+        if determinism.enabled():
+            self.last_transition_digest = determinism.transition_digest(
+                block.header.height, block_id, resp
+            )
+            FLIGHT.record(
+                "determinism_digest",
+                height=block.header.height,
+                digest=self.last_transition_digest.digest[:16],
+            )
 
         # Commit: lock mempool so no CheckTx lands between app Commit and
         # mempool Update (execution.go:405)

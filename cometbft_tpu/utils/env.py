@@ -1,0 +1,90 @@
+"""Validated `CMT_TPU_*` env-knob readers — the one contract.
+
+Every knob read in the tree must fail LOUDLY on a malformed value,
+naming the variable and its constraint (the `ring_size_from_env`
+contract from utils/flight.py, generalized).  A typo'd
+``CMT_TPU_CHECKTX_BATCH=8O`` that silently falls back to the default
+is a production incident that looks like a perf regression; a
+ValueError at import is a one-line fix.
+
+tools/envcheck.py enforces this statically: every ``CMT_TPU_*``
+getenv site must route through one of these helpers (or an
+equivalently registered validator), be a boolean/presence read that
+cannot fail-parse, or carry an audited ``# env ok: <reason>`` waiver.
+The same lint checks every knob is documented in
+docs/observability.md's env table — and that every documented knob is
+still read somewhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "int_from_env",
+    "float_from_env",
+    "flag_from_env",
+    "choice_from_env",
+]
+
+
+def int_from_env(var: str, default: int, minimum: int = 0) -> int:
+    """A validated integer knob: unset/empty -> default; otherwise an
+    integer >= ``minimum`` or a ValueError naming both."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var} must be an integer >= {minimum}, got {raw!r}"
+        ) from None
+    if value < minimum:
+        raise ValueError(f"{var} must be >= {minimum}, got {value}")
+    return value
+
+
+def float_from_env(var: str, default: float, minimum: float = 0.0) -> float:
+    """A validated float knob (same contract as :func:`int_from_env`)."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var} must be a number >= {minimum}, got {raw!r}"
+        ) from None
+    if value < minimum:
+        raise ValueError(f"{var} must be >= {minimum}, got {value}")
+    return value
+
+
+def flag_from_env(var: str, default: bool = False) -> bool:
+    """A validated on/off knob: unset/empty -> default, "1"/"0" ->
+    True/False, anything else a ValueError (a half-typed
+    ``CMT_TPU_DETERMINISM=yes`` must not silently disable the guard
+    the operator asked for)."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    if raw == "1":
+        return True
+    if raw == "0":
+        return False
+    raise ValueError(f"{var} must be '1' or '0' (or unset), got {raw!r}")
+
+
+def choice_from_env(var: str, default: str, choices: tuple[str, ...]) -> str:
+    """A validated enum knob: the value must be one of ``choices``
+    (a silently ignored ``CMT_TPU_COLS_IMPL=matmull`` typo would
+    quietly bench the wrong kernel)."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f"{var} must be one of {sorted(choices)}, got {raw!r}"
+        )
+    return raw

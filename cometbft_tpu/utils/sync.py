@@ -45,10 +45,12 @@ import time
 import traceback
 import weakref
 
-_ENABLED = bool(os.environ.get("CMT_TPU_DEADLOCK"))
-_TIMEOUT = float(os.environ.get("CMT_TPU_DEADLOCK_TIMEOUT", "30"))
-_LOCKGRAPH = bool(os.environ.get("CMT_TPU_LOCKGRAPH"))
-_RACE = bool(os.environ.get("CMT_TPU_RACE"))
+from cometbft_tpu.utils.env import flag_from_env, float_from_env
+
+_ENABLED = flag_from_env("CMT_TPU_DEADLOCK")
+_TIMEOUT = float_from_env("CMT_TPU_DEADLOCK_TIMEOUT", 30.0, minimum=0.001)
+_LOCKGRAPH = flag_from_env("CMT_TPU_LOCKGRAPH")
+_RACE = flag_from_env("CMT_TPU_RACE")
 
 
 class PotentialDeadlock(Exception):

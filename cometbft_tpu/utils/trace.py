@@ -145,7 +145,9 @@ class SpanTracer:
                 "CMT_TPU_TRACE_RING", _DEFAULT_RING
             )
         if enabled is None:
-            enabled = os.environ.get("CMT_TPU_TRACE", "1") != "0"
+            from cometbft_tpu.utils.env import flag_from_env
+
+            enabled = flag_from_env("CMT_TPU_TRACE", default=True)
         self.enabled = enabled
         self._events: deque[dict] = deque(maxlen=max(capacity, 1))
         self._mtx = threading.Lock()

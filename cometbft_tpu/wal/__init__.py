@@ -32,6 +32,10 @@ from cometbft_tpu.wal.autofile import Group
 KIND_END_HEIGHT = 1
 KIND_MSG_INFO = 2
 KIND_TIMEOUT = 3
+# CMT_TPU_DETERMINISM=1 extension: a per-height transition digest
+# (state/determinism.py TransitionDigest) written right after the
+# height's end-height marker; replay recomputes and compares it.
+KIND_TRANSITION_DIGEST = 4
 
 MAX_MSG_SIZE_BYTES = 2 * 1024 * 1024
 
@@ -146,7 +150,7 @@ class WAL(BaseService):
         """fsync the head, timed (the replication plane's disk-latency
         tripwire: a slow fsync here IS commit latency)."""
         t0 = time.perf_counter()
-        self._group.sync()
+        self._group.sync()  # blocking ok: wal_fsync — this IS the stage; fsync_duration_seconds times it
         elapsed = time.perf_counter() - t0
         self.metrics.fsync_duration_seconds.observe(elapsed)
         FLIGHT.record("wal_fsync", ms=round(elapsed * 1e3, 3))
@@ -236,6 +240,7 @@ __all__ = [
     "KIND_END_HEIGHT",
     "KIND_MSG_INFO",
     "KIND_TIMEOUT",
+    "KIND_TRANSITION_DIGEST",
     "NopWAL",
     "WAL",
     "WALCorruptionError",

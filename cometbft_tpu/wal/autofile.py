@@ -64,7 +64,7 @@ class Group:
     def sync(self) -> None:
         with self._mtx:
             self._head.flush()
-            os.fsync(self._head.fileno())
+            os.fsync(self._head.fileno())  # blocking ok: wal_fsync — the group-head durability barrier the stage measures
 
     def head_size(self) -> int:
         with self._mtx:
@@ -89,11 +89,11 @@ class Group:
 
     def _rotate_locked(self) -> None:
         self._head.flush()
-        os.fsync(self._head.fileno())
+        os.fsync(self._head.fileno())  # blocking ok: wal_fsync — rotation seals the retiring head; height-boundary only
         self._head.close()
         self._max_index += 1
         os.replace(self.head_path, self.chunk_path(self._max_index))
-        self._head = open(self.head_path, "ab")
+        self._head = open(self.head_path, "ab")  # blocking ok: wal_fsync — reopening the head after rotation; height-boundary only
 
     def _check_total_size_locked(self) -> None:
         if self.total_size_limit <= 0:

@@ -1,0 +1,473 @@
+"""Replay-determinism toolchain: the static lint (tools/determcheck.py),
+the shared lint machinery it rides on (tools/lintlib.py), and the
+runtime transition-digest guard (CMT_TPU_DETERMINISM=1,
+cometbft_tpu/state/determinism.py) — docs/determinism.md is the manual."""
+
+from __future__ import annotations
+
+import textwrap
+import time
+
+import pytest
+
+from cometbft_tpu.state import determinism
+from cometbft_tpu.state.determinism import (
+    DIGEST_FIELDS,
+    DivergenceError,
+    TransitionDigest,
+    transition_digest,
+)
+from cometbft_tpu.abci.types import (
+    ExecTxResult,
+    FinalizeBlockResponse,
+    ValidatorUpdate,
+)
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+
+import tools.determcheck as determcheck
+import tools.lintlib as lintlib
+
+
+def lint(src: str, rel: str = "cometbft_tpu/state/execution.py"):
+    """Fixture rel defaults to a root file so ``def update_state``
+    seeds the real root set."""
+    return determcheck.check_source(textwrap.dedent(src), rel)
+
+
+# -- the shared machinery ------------------------------------------------
+
+
+class TestLintlib:
+    def test_callgraph_reaches_by_basename(self):
+        files = [(
+            "cometbft_tpu/a.py",
+            textwrap.dedent(
+                """
+                def root():
+                    helper()
+
+                def helper():
+                    leaf()
+
+                def leaf():
+                    pass
+
+                def island():
+                    pass
+                """
+            ),
+        )]
+        g = lintlib.CallGraph(files)
+        parents = g.reachable([("cometbft_tpu/a.py", "root")], stops=frozenset())
+        names = {q for (_, q) in parents}
+        assert names == {"root", "helper", "leaf"}
+        assert "island" not in names
+
+    def test_ctor_reached_via_class_name_only(self):
+        """``Thing()`` reaches ``Thing.__init__``; a bare
+        ``super().__init__()`` must NOT edge into every constructor."""
+        files = [(
+            "cometbft_tpu/a.py",
+            textwrap.dedent(
+                """
+                class Thing:
+                    def __init__(self):
+                        pass
+
+                class Other:
+                    def __init__(self):
+                        super().__init__()
+
+                def makes():
+                    return Thing()
+
+                def inherits():
+                    return Other()
+                """
+            ),
+        )]
+        g = lintlib.CallGraph(files)
+        via_class = g.reachable(
+            [("cometbft_tpu/a.py", "makes")], stops=frozenset()
+        )
+        assert ("cometbft_tpu/a.py", "Thing.__init__") in via_class
+        via_super = g.reachable(
+            [("cometbft_tpu/a.py", "inherits")], stops=frozenset()
+        )
+        # Other's ctor is reached (class alias), Thing's is not —
+        # super().__init__() does not fan out across the scan set
+        assert ("cometbft_tpu/a.py", "Other.__init__") in via_super
+        assert ("cometbft_tpu/a.py", "Thing.__init__") not in via_super
+
+    def test_stops_cut_the_walk(self):
+        files = [(
+            "cometbft_tpu/a.py",
+            "def root():\n    record()\n\ndef record():\n    bad()\n\ndef bad():\n    pass\n",
+        )]
+        g = lintlib.CallGraph(files)
+        parents = g.reachable(
+            [("cometbft_tpu/a.py", "root")], stops=frozenset({"record"})
+        )
+        names = {q for (_, q) in parents}
+        assert names == {"root"}
+
+    def test_chain_renders_call_path(self):
+        files = [(
+            "cometbft_tpu/a.py",
+            "def root():\n    mid()\n\ndef mid():\n    leaf()\n\ndef leaf():\n    pass\n",
+        )]
+        g = lintlib.CallGraph(files)
+        parents = g.reachable([("cometbft_tpu/a.py", "root")], stops=frozenset())
+        chain = g.chain(parents, ("cometbft_tpu/a.py", "leaf"))
+        assert chain == "leaf ← mid ← root"
+
+    def test_waiver_re_grammar(self):
+        pat = lintlib.waiver_re("deterministic")
+        m = pat.search("x = 1  # deterministic: scheduling only")
+        assert m and m.group(1) == "scheduling only"
+        assert pat.search("# deterministic:") is None  # reason required
+
+
+# -- determcheck fixtures ------------------------------------------------
+
+
+class TestDetermcheckFixtures:
+    def test_clean_transition_passes(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                total = 0
+                for tx in block.txs:
+                    total += len(tx)
+                return total // max(len(block.txs), 1)
+            """
+        )
+        assert rep.ok and rep.roots == 1 and not rep.waivers
+
+    def test_wall_clock_in_root_flagged(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                return now_ns()
+            """
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "wall-clock" in v.message and "update_state" in v.message
+
+    def test_reachable_helper_flagged_with_chain(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                return stamp(block)
+
+            def stamp(block):
+                import time
+                return time.time()
+            """
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "stamp" in v.message and "update_state" in v.message
+
+    def test_unreachable_nondeterminism_not_flagged(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                return len(block.txs)
+
+            def bench_only():
+                import time
+                return time.time()
+            """
+        )
+        assert rep.ok
+
+    def test_waiver_silences_and_is_counted(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                return now_ns()  # deterministic: scheduling, not state
+            """
+        )
+        assert rep.ok
+        assert len(rep.waivers) == 1
+        assert rep.waivers[0].reason == "scheduling, not state"
+
+    def test_stale_waiver_flagged(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                return len(block.txs)  # deterministic: nothing here
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "stale" in rep.violations[0].message
+
+    def test_set_iteration_flagged_dict_not(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                seen = set(block.txs)
+                out = []
+                for tx in seen:
+                    out.append(tx)
+                for k in state.data:
+                    out.append(k)
+                return out
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "set" in rep.violations[0].message
+
+    def test_float_division_flagged_intdiv_clean(self):
+        rep = lint(
+            """
+            def update_state(state, block):
+                a = len(block.txs) // 2
+                return len(block.txs) / 2
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "division" in rep.violations[0].message
+
+    def test_env_read_and_randomness_flagged(self):
+        rep = lint(
+            """
+            import os, random
+
+            def update_state(state, block):
+                if os.getenv("CMT_TPU_X"):
+                    return random.random()
+                return 0
+            """
+        )
+        msgs = " ".join(v.message for v in rep.violations)
+        assert "environment" in msgs and "randomness" in msgs
+
+
+# -- the repo-tree gates -------------------------------------------------
+
+
+class TestDetermcheckTree:
+    def test_repo_is_clean(self):
+        rep = determcheck.check_tree()
+        assert rep.ok, "\n".join(
+            f"{v.file}:{v.line}: {v.message}" for v in rep.violations
+        )
+        # every root resolved and the walk actually covered the tree
+        assert rep.roots == len(determcheck.DETERMINISM_ROOTS)
+        assert rep.reachable > 100
+        # every waiver carries a real reason
+        assert all(w.reason for w in rep.waivers)
+
+    def test_main_exit_zero(self, capsys):
+        assert determcheck.main([]) == 0
+        assert "determcheck" in capsys.readouterr().out
+
+    def test_renamed_root_is_loud(self, monkeypatch):
+        """A root that stops resolving must fail the lint, not fall
+        out of coverage silently."""
+        monkeypatch.setattr(
+            determcheck, "DETERMINISM_ROOTS",
+            determcheck.DETERMINISM_ROOTS
+            + (("cometbft_tpu/state/execution.py", "renamed_away"),
+               ("cometbft_tpu/state/gone.py", "whatever")),
+        )
+        rep = determcheck.check_tree()
+        msgs = " ".join(v.message for v in rep.violations)
+        assert "renamed_away" in msgs  # unresolved root
+        assert "file missing" in msgs  # vanished root file
+
+
+# -- the runtime digest guard --------------------------------------------
+
+
+def _mk_response(app_hash=b"\x01" * 32, tx_data=b"ok"):
+    return FinalizeBlockResponse(
+        events=(),
+        tx_results=(ExecTxResult(code=0, data=tx_data),),
+        validator_updates=(
+            ValidatorUpdate("ed25519", b"\x02" * 32, 10),
+        ),
+        consensus_param_updates=None,
+        app_hash=app_hash,
+    )
+
+
+def _mk_block_id(h=b"\x03" * 32):
+    return BlockID(hash=h, part_set_header=PartSetHeader(1, b"\x04" * 32))
+
+
+class TestTransitionDigest:
+    def test_digest_deterministic_and_roundtrips(self):
+        a = transition_digest(5, _mk_block_id(), _mk_response())
+        b = transition_digest(5, _mk_block_id(), _mk_response())
+        assert a == b and a.height == 5
+        assert set(a.fields) == set(DIGEST_FIELDS)
+        decoded = TransitionDigest.decode(a.encode())
+        assert decoded == a
+
+    def test_compare_equal_is_quiet(self):
+        a = transition_digest(5, _mk_block_id(), _mk_response())
+        determinism.compare(a, a, surface="wal_replay")
+
+    def test_mutated_tx_result_names_first_field(self):
+        """ISSUE 18 acceptance: a seeded divergence (mutate one stored
+        tx result) raises DivergenceError carrying BOTH digests and
+        naming tx_results as the first diverging field."""
+        recorded = transition_digest(5, _mk_block_id(), _mk_response())
+        recomputed = transition_digest(
+            5, _mk_block_id(), _mk_response(tx_data=b"tampered")
+        )
+        with pytest.raises(DivergenceError) as ei:
+            determinism.compare(recorded, recomputed, surface="handshake")
+        err = ei.value
+        assert err.first_field == "tx_results"
+        assert err.surface == "handshake"
+        assert err.recorded.digest != err.recomputed.digest
+        msg = str(err)
+        assert "tx_results" in msg and "height 5" in msg
+
+    def test_mutated_app_hash_names_app_hash(self):
+        recorded = transition_digest(7, _mk_block_id(), _mk_response())
+        recomputed = transition_digest(
+            7, _mk_block_id(), _mk_response(app_hash=b"\x09" * 32)
+        )
+        with pytest.raises(DivergenceError) as ei:
+            determinism.compare(recorded, recomputed, surface="startup")
+        assert ei.value.first_field == "app_hash"
+
+    def test_divergence_increments_metric(self):
+        from cometbft_tpu.metrics import ConsensusMetrics
+        from cometbft_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        m = ConsensusMetrics(reg)
+        recorded = transition_digest(5, _mk_block_id(), _mk_response())
+        recomputed = transition_digest(
+            5, _mk_block_id(), _mk_response(tx_data=b"x")
+        )
+        with pytest.raises(DivergenceError):
+            determinism.compare(
+                recorded, recomputed, surface="wal_replay", metrics=m
+            )
+        text = reg.expose()
+        assert 'consensus_replay_divergence_total' in text
+        assert 'surface="wal_replay"' in text
+
+    def test_enabled_flag_contract(self, monkeypatch):
+        monkeypatch.delenv("CMT_TPU_DETERMINISM", raising=False)
+        assert determinism.enabled() is False
+        monkeypatch.setenv("CMT_TPU_DETERMINISM", "1")
+        assert determinism.enabled() is True
+        monkeypatch.setenv("CMT_TPU_DETERMINISM", "yes")
+        with pytest.raises(ValueError, match="CMT_TPU_DETERMINISM"):
+            determinism.enabled()
+
+
+# -- the live-node determinism smoke -------------------------------------
+
+
+class TestDeterminismSmoke:
+    def test_node_replays_digest_clean(self, tmp_path, monkeypatch):
+        """ISSUE 18 acceptance: a node with CMT_TPU_DETERMINISM=1
+        commits >= 5 heights writing per-height transition digests into
+        the WAL, and a restart over the same home replays them
+        digest-clean (wal_replay + handshake + startup surfaces all
+        quiet), with the guard demonstrably armed (digest events in the
+        flight recorder)."""
+        from cometbft_tpu.utils.flight import FLIGHT
+        from tests.test_consensus import make_node, wait_for_height
+
+        monkeypatch.setenv("CMT_TPU_DETERMINISM", "1")
+        node, _ = make_node(tmp_path, backend="sqlite")
+        node.start()
+        try:
+            node.mempool.check_tx(b"det=1")
+            wait_for_height(node, 5)
+        finally:
+            node.stop()
+        h1 = node.height()
+        assert h1 >= 5
+
+        # digests were recorded while committing
+        tail = FLIGHT.format_tail(2000)
+        assert "determinism_digest" in tail
+
+        # restart over the same home: WAL replay + handshake recompute
+        # every recorded digest — any divergence would raise and keep
+        # the node from starting.  The flight ring is process-global
+        # and earlier tests (TestTransitionDigest) record deliberate
+        # divergence events, so scope the check to events after a
+        # marker rather than the whole tail.
+        FLIGHT.record("det_smoke_restart_marker")
+        node2, _ = make_node(tmp_path, backend="sqlite")
+        node2.start()
+        try:
+            wait_for_height(node2, h1 + 1)
+            assert node2.height() >= h1 + 1
+        finally:
+            node2.stop()
+        since_marker = FLIGHT.format_tail(2000).split(
+            "det_smoke_restart_marker"
+        )[-1]
+        assert "determinism_divergence" not in since_marker
+
+    def test_tampered_store_fails_restart(self, tmp_path, monkeypatch):
+        """Flip one byte of a stored tx result between runs: the
+        startup digest verification must refuse to come up quietly."""
+        from tests.test_consensus import make_node, wait_for_height
+
+        monkeypatch.setenv("CMT_TPU_DETERMINISM", "1")
+        node, _ = make_node(tmp_path, backend="sqlite")
+        node.start()
+        try:
+            node.mempool.check_tx(b"k=v")
+            wait_for_height(node, 3)
+        finally:
+            node.stop()
+        h = node.height()
+
+        # tamper: reload the last committed response, mutate one tx
+        # result, write it back (simulates silent store corruption /
+        # a nondeterministic app re-execution).  stop() closed the
+        # node's handles, so reopen the same on-disk store.
+        from cometbft_tpu.state import Store
+        from cometbft_tpu.utils.db import open_db
+
+        db = open_db("state", "sqlite", node.config.db_dir)
+        store = Store(db)
+        target = None
+        for height in range(h, 0, -1):
+            resp = store.load_finalize_block_response(height)
+            if resp is not None and resp.tx_results:
+                target = height
+                break
+        assert target is not None, "no stored response with tx results"
+        resp = store.load_finalize_block_response(target)
+        tampered = FinalizeBlockResponse(
+            events=resp.events,
+            tx_results=tuple(
+                ExecTxResult(code=r.code, data=r.data + b"!")
+                for r in resp.tx_results
+            ),
+            validator_updates=resp.validator_updates,
+            consensus_param_updates=resp.consensus_param_updates,
+            app_hash=resp.app_hash,
+        )
+        store.save_finalize_block_response(target, tampered)
+        db.close()
+
+        node2, _ = make_node(tmp_path, backend="sqlite")
+        with pytest.raises(DivergenceError) as ei:
+            node2.start()
+        assert ei.value.first_field == "tx_results"
+        assert ei.value.recorded.height == target
+        try:
+            node2.stop()
+        except Exception:  # noqa: BLE001 — best-effort teardown of a
+            pass  # node that refused to start
+
+
+_ = time  # imported for parity with sibling suites

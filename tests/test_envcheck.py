@@ -1,0 +1,188 @@
+"""Env-knob registry lint (tools/envcheck.py) and the fail-loudly
+reader contract it enforces (cometbft_tpu/utils/env.py)."""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from cometbft_tpu.utils.env import (
+    choice_from_env,
+    flag_from_env,
+    float_from_env,
+    int_from_env,
+)
+
+import tools.envcheck as envcheck
+
+
+def lint(src: str, rel: str = "cometbft_tpu/fixture.py"):
+    return envcheck.check_source(textwrap.dedent(src), rel)
+
+
+class TestEnvcheckFixtures:
+    def test_validated_read_passes(self):
+        rep = lint(
+            """
+            from cometbft_tpu.utils.env import int_from_env
+
+            BATCH = int_from_env("CMT_TPU_BATCH", 8, minimum=1)
+            """
+        )
+        assert rep.ok
+        assert rep.read_vars == {"CMT_TPU_BATCH"}
+        assert rep.validated_reads == 1 and rep.raw_reads == 0
+
+    def test_raw_getenv_flagged(self):
+        rep = lint(
+            """
+            import os
+
+            BATCH = os.getenv("CMT_TPU_BATCH", "8")
+            """
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "CMT_TPU_BATCH" in v.message and "raw" in v.message
+
+    def test_aliased_environ_get_caught(self):
+        """``import os as _os`` must not launder a raw read."""
+        rep = lint(
+            """
+            import os as _os
+
+            PEERS = _os.environ.get("CMT_TPU_PEERS")
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "CMT_TPU_PEERS" in rep.violations[0].message
+
+    def test_environ_subscript_caught(self):
+        rep = lint(
+            """
+            import os
+
+            X = os.environ["CMT_TPU_X"]
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "CMT_TPU_X" in rep.violations[0].message
+
+    def test_env_ok_waiver_silences(self):
+        rep = lint(
+            """
+            import os
+
+            PATH = os.getenv("CMT_TPU_PATH")  # env ok: free-form path
+            """
+        )
+        assert rep.ok
+        assert len(rep.waivers) == 1
+        assert rep.waivers[0].reason == "free-form path"
+        # waived reads still count as reads for the doc cross-check
+        assert rep.read_vars == {"CMT_TPU_PATH"}
+
+    def test_stale_waiver_flagged(self):
+        rep = lint(
+            """
+            X = 1  # env ok: nothing here
+            """
+        )
+        assert len(rep.violations) == 1
+        assert "stale" in rep.violations[0].message
+
+    def test_parameter_default_counts_as_read(self):
+        """profiler pattern: the validated reader carries its variable
+        as a parameter default, not a call-site literal."""
+        rep = lint(
+            """
+            def profile_hz_from_env(var="CMT_TPU_PROFILE_HZ", default=0):
+                return default
+            """
+        )
+        assert rep.ok
+        assert rep.read_vars == {"CMT_TPU_PROFILE_HZ"}
+
+    def test_non_cmt_vars_ignored(self):
+        rep = lint(
+            """
+            import os
+
+            HOME = os.getenv("HOME")
+            PLAT = os.environ.get("JAX_PLATFORMS", "")
+            """
+        )
+        assert rep.ok and not rep.read_vars
+
+    def test_doc_table_vars_parse(self):
+        doc = textwrap.dedent(
+            """
+            | Variable | Default |
+            |---|---|
+            | `CMT_TPU_FOO` | 8 |
+            | `CMT_TPU_BAR` | off |
+            not a row `CMT_TPU_BAZ`
+            """
+        )
+        assert envcheck.doc_table_vars(doc) == {
+            "CMT_TPU_FOO", "CMT_TPU_BAR"
+        }
+
+
+class TestEnvcheckTree:
+    def test_repo_is_clean(self):
+        rep = envcheck.check_tree()
+        assert rep.ok, "\n".join(
+            f"{v.file}:{v.line}: {v.message}" for v in rep.violations
+        )
+        # the registry is real: dozens of knobs, mostly validated
+        assert len(rep.read_vars) > 30
+        assert rep.validated_reads > rep.raw_reads
+        assert all(w.reason for w in rep.waivers)
+
+    def test_main_exit_zero(self, capsys):
+        assert envcheck.main([]) == 0
+        assert "envcheck" in capsys.readouterr().out
+
+
+class TestFailLoudlyReaders:
+    """VALIDATED_READERS membership asserts "raises on malformed value,
+    naming the variable" — spot-check the utils/env.py four."""
+
+    def test_int_from_env(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_T_INT", "8O")
+        with pytest.raises(ValueError, match="CMT_TPU_T_INT"):
+            int_from_env("CMT_TPU_T_INT", 8)
+        monkeypatch.setenv("CMT_TPU_T_INT", "-1")
+        with pytest.raises(ValueError, match="CMT_TPU_T_INT"):
+            int_from_env("CMT_TPU_T_INT", 8, minimum=0)
+        monkeypatch.setenv("CMT_TPU_T_INT", "16")
+        assert int_from_env("CMT_TPU_T_INT", 8) == 16
+        monkeypatch.delenv("CMT_TPU_T_INT")
+        assert int_from_env("CMT_TPU_T_INT", 8) == 8
+
+    def test_float_from_env(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_T_FLOAT", "fast")
+        with pytest.raises(ValueError, match="CMT_TPU_T_FLOAT"):
+            float_from_env("CMT_TPU_T_FLOAT", 1.0)
+        monkeypatch.setenv("CMT_TPU_T_FLOAT", "2.5")
+        assert float_from_env("CMT_TPU_T_FLOAT", 1.0) == 2.5
+
+    def test_flag_from_env_strict(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_T_FLAG", "yes")
+        with pytest.raises(ValueError, match="CMT_TPU_T_FLAG"):
+            flag_from_env("CMT_TPU_T_FLAG")
+        monkeypatch.setenv("CMT_TPU_T_FLAG", "1")
+        assert flag_from_env("CMT_TPU_T_FLAG") is True
+        monkeypatch.setenv("CMT_TPU_T_FLAG", "0")
+        assert flag_from_env("CMT_TPU_T_FLAG", default=True) is False
+        monkeypatch.delenv("CMT_TPU_T_FLAG")
+        assert flag_from_env("CMT_TPU_T_FLAG", default=True) is True
+
+    def test_choice_from_env(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_T_CHOICE", "warp")
+        with pytest.raises(ValueError, match="CMT_TPU_T_CHOICE"):
+            choice_from_env("CMT_TPU_T_CHOICE", "a", ("a", "b"))
+        monkeypatch.setenv("CMT_TPU_T_CHOICE", "b")
+        assert choice_from_env("CMT_TPU_T_CHOICE", "a", ("a", "b")) == "b"

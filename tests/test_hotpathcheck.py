@@ -1,0 +1,157 @@
+"""Critical-path blocking lint (tools/hotpathcheck.py): fixtures for
+every site class, the stage-billing waiver grammar, the repo-tree gate,
+and the STAGES_OK ↔ critpath.STAGES lockstep check."""
+
+from __future__ import annotations
+
+import textwrap
+
+from cometbft_tpu.utils import critpath
+
+import tools.hotpathcheck as hotpathcheck
+
+
+def lint(src: str, rel: str = "cometbft_tpu/wal/__init__.py"):
+    """Fixture rel defaults to a root file so ``class WAL`` with a
+    ``write_sync`` method seeds the real root set."""
+    return hotpathcheck.check_source(textwrap.dedent(src), rel)
+
+
+ROOT = """
+class WAL:
+    def write_sync(self, rec):
+        {body}
+"""
+
+
+def root_with(body: str):
+    return lint(ROOT.format(body=body))
+
+
+class TestHotpathFixtures:
+    def test_clean_root_passes(self):
+        rep = root_with("return self.encode(rec)")
+        assert rep.ok and rep.roots == 1 and not rep.waivers
+
+    def test_sleep_flagged(self):
+        rep = root_with("import time; time.sleep(1)")
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "sleep" in v.message and "write_sync" in v.message
+
+    def test_reachable_helper_flagged_with_chain(self):
+        rep = lint(
+            """
+            class WAL:
+                def write_sync(self, rec):
+                    return stamp(rec)
+
+            def stamp(rec):
+                import subprocess
+                return subprocess.run(["sync"])
+            """
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "subprocess" in v.message and "write_sync" in v.message
+
+    def test_unreachable_blocking_not_flagged(self):
+        rep = lint(
+            """
+            class WAL:
+                def write_sync(self, rec):
+                    return rec
+
+            def bench_only():
+                import time
+                time.sleep(5)
+            """
+        )
+        assert rep.ok
+
+    def test_http_and_socket_flagged(self):
+        rep = root_with(
+            "requests.get('http://x'); self.sock.sendall(rec)"
+        )
+        msgs = " ".join(v.message for v in rep.violations)
+        assert "HTTP" in msgs and "socket" in msgs
+
+    def test_fsync_and_open_flagged(self):
+        rep = root_with("import os; os.fsync(3); open('/tmp/x')")
+        msgs = " ".join(v.message for v in rep.violations)
+        assert "disk barrier" in msgs and "open()" in msgs
+
+    def test_bounded_wait_passes_unbounded_flagged(self):
+        rep = root_with(
+            "self.ev.wait(timeout=1.0); self.ev2.wait(0.5); self.ev3.wait()"
+        )
+        assert len(rep.violations) == 1
+        assert "unbounded" in rep.violations[0].message
+
+    def test_unbounded_acquire_flagged(self):
+        rep = root_with("self.mtx.acquire()")
+        assert len(rep.violations) == 1
+        assert ".acquire()" in rep.violations[0].message
+
+    def test_waiver_with_valid_stage_passes(self):
+        rep = root_with(
+            "self.group.sync()  "
+            "# blocking ok: wal_fsync — this IS the stage"
+        )
+        assert rep.ok
+        assert len(rep.waivers) == 1
+        assert rep.waivers[0].reason.startswith("wal_fsync")
+
+    def test_waiver_with_unknown_stage_is_violation(self):
+        rep = root_with(
+            "self.group.sync()  "
+            "# blocking ok: disk_stuff — sounds plausible"
+        )
+        assert len(rep.violations) == 1
+        v = rep.violations[0]
+        assert "unknown" in v.message and "disk_stuff" in v.message
+
+    def test_stale_waiver_flagged(self):
+        rep = root_with(
+            "return rec  # blocking ok: wal_fsync — nothing here"
+        )
+        assert len(rep.violations) == 1
+        assert "stale" in rep.violations[0].message
+
+
+class TestHotpathTree:
+    def test_repo_is_clean(self):
+        rep = hotpathcheck.check_tree()
+        assert rep.ok, "\n".join(
+            f"{v.file}:{v.line}: {v.message}" for v in rep.violations
+        )
+        assert rep.roots == len(hotpathcheck.HOTPATH_ROOTS)
+        assert rep.reachable > 100
+        # every waiver is a billing record: starts with a real stage
+        for w in rep.waivers:
+            stage = w.reason.split()[0].rstrip(":—-")
+            assert stage in hotpathcheck.STAGES_OK, w
+
+    def test_main_exit_zero(self, capsys):
+        assert hotpathcheck.main([]) == 0
+        assert "hotpathcheck" in capsys.readouterr().out
+
+    def test_renamed_root_is_loud(self, monkeypatch):
+        monkeypatch.setattr(
+            hotpathcheck, "HOTPATH_ROOTS",
+            hotpathcheck.HOTPATH_ROOTS
+            + (("cometbft_tpu/wal/__init__.py", "renamed_away"),
+               ("cometbft_tpu/wal/gone.py", "whatever")),
+        )
+        rep = hotpathcheck.check_tree()
+        msgs = " ".join(v.message for v in rep.violations)
+        assert "renamed_away" in msgs
+        assert "file missing" in msgs
+
+
+class TestStagesLockstep:
+    def test_stages_ok_mirrors_critpath(self):
+        """STAGES_OK is a deliberate mirror (the lint must run on
+        broken checkouts), so this test is the coupling: edit
+        critpath.STAGES and this fails until the mirror follows."""
+        assert hotpathcheck.STAGES_OK == frozenset(critpath.STAGES)

@@ -1,0 +1,331 @@
+"""determcheck: static replay-determinism lint — the compile-time half
+of the determinism toolchain (runtime half: CMT_TPU_DETERMINISM in
+cometbft_tpu/state/determinism.py; docs/determinism.md is the manual).
+
+PR 3 gave the thread plane lockcheck, PR 4 gave the device plane
+jitcheck; this completes the trilogy for the consensus plane.  The BFT
+contract requires the state transition machine to be a pure function
+of (block, prior state): the same decided block must produce bit-equal
+results on every node, under WAL replay, handshake recovery, and
+speculative execution.  This lint walks the intra-repo call graph from
+the registered transition roots (``DETERMINISM_ROOTS``: apply_block /
+update_state / process_proposal / WAL replay / handshake / evidence
+verification / the in-repo ABCI app) and flags nondeterminism
+*sources* in everything reachable:
+
+* **wall clock** — ``time.time()``, ``now_ns()``, ``datetime.now()``…
+  (block time comes from the header / median-time, never the host);
+* **randomness** — ``random``, ``secrets``, ``uuid``, ``os.urandom``;
+* **environment reads** — ``os.environ`` / ``os.getenv`` (two nodes
+  with different env must not execute differently);
+* **set iteration** — set literals/comprehensions/``set()`` locals
+  iterated directly: element order depends on PYTHONHASHSEED, so it
+  diverges *across processes* (dict iteration is insertion-ordered in
+  the Pythons we support and is deliberately NOT flagged; ``sorted()``
+  launders a set back to determinism);
+* **float division** — ``/`` on the transition path (IEEE rounding is
+  deterministic per-op but invites drift through reordering; integer
+  consensus math uses ``//``);
+* **identity hashing** — ``id()`` / ``hash()`` (PYTHONHASHSEED again).
+
+A site is silenced by an audited trailing ``# deterministic: <reason>``
+waiver (the lockcheck grammar); a waiver on a line with no flagged
+site is a STALE-WAIVER error.  The call graph is a name-matching
+over-approximation (see tools/lintlib.py CallGraph): everything truly
+reachable is covered, at the cost of some extra reachable functions —
+bounded by ``GRAPH_STOPS`` (diagnostics planes that never feed state)
+and the package boundary (``crypto/``/``ops/`` are out of scope: their
+*results* are deterministic by the verify contract, their *routing*
+is timing-based by design and billed to the dispatch plane).
+
+Known static limits (the runtime guard covers these): sets reached
+through attributes or returned from helpers, nondeterminism behind
+``getattr`` indirection, and C-extension behavior are not seen;
+CMT_TPU_DETERMINISM=1 catches them as a transition-digest mismatch at
+the exact height and field.
+
+    python tools/determcheck.py         # exit 0 clean, 1 with a report
+    python tools/determcheck.py -v      # also list waivers
+
+Run in the tier-1 flow via tests/test_determcheck.py and standalone
+via ``make determcheck``; tools/metrics_lint.py main() gates on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    CallGraph,
+    Violation,
+    Waiver,
+    check_stale_waivers,
+    comments_by_line,
+    dotted,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
+
+#: packages whose call graph the walk covers.  crypto/ops/parallel are
+#: an audited boundary (result-deterministic by contract, timing-based
+#: inside); utils/ is host plumbing that never computes state.
+SCAN_DIRS = (
+    "cometbft_tpu/abci",
+    "cometbft_tpu/consensus",
+    "cometbft_tpu/evidence",
+    "cometbft_tpu/mempool",
+    "cometbft_tpu/state",
+    "cometbft_tpu/store",
+    "cometbft_tpu/types",
+    "cometbft_tpu/wal",
+)
+
+#: the registered transition roots: every way replayed/recovered/
+#: re-proposed state enters the machine.  check_tree errors if one of
+#: these stops resolving, so the root set cannot silently rot.
+DETERMINISM_ROOTS = (
+    ("cometbft_tpu/state/execution.py", "BlockExecutor.apply_block"),
+    ("cometbft_tpu/state/execution.py", "BlockExecutor.process_proposal"),
+    ("cometbft_tpu/state/execution.py", "update_state"),
+    ("cometbft_tpu/state/execution.py", "validate_block"),
+    ("cometbft_tpu/consensus/replay.py", "Handshaker.handshake"),
+    ("cometbft_tpu/consensus/state.py", "ConsensusState._catchup_replay"),
+    ("cometbft_tpu/evidence/pool.py", "Pool.verify"),
+    ("cometbft_tpu/evidence/pool.py", "Pool.check_evidence"),
+    ("cometbft_tpu/abci/kvstore.py", "KVStoreApp.finalize_block"),
+    ("cometbft_tpu/abci/kvstore.py", "KVStoreApp.process_proposal"),
+    ("cometbft_tpu/wal/__init__.py", "decode_records"),
+)
+
+#: callee names the walk never follows — diagnostics planes whose
+#: output never feeds state (flight/trace/metrics/log/events), plus
+#: service lifecycle.  Each entry is an audited boundary: adding one
+#: asserts "nothing behind this name computes consensus state".
+GRAPH_STOPS = frozenset(
+    {
+        # flight recorder / tracer / metrics / logger
+        "record", "format_tail", "span", "add_complete", "observe",
+        "observe_height", "inc", "dec", "set", "labels", "remove",
+        "info", "debug", "error", "warning", "with_fields",
+        # event bus + pubsub fan-out (subscribers are off-path)
+        "publish", "publish_new_block", "publish_new_block_events",
+        "publish_tx_event", "publish_validator_set_updates", "fire",
+        # service lifecycle + thread plumbing
+        "start", "stop", "is_running", "quit_event", "wait",
+        # stdlib-ish names that would wildly over-match
+        "get", "put", "append", "extend", "pop", "items", "keys",
+        "values", "join", "split", "strip", "encode_varint", "read",
+        "write", "close", "flush",
+    }
+)
+
+_WAIVER_RE = waiver_re("deterministic")
+
+#: dotted call names that read the host wall clock.  Duration clocks
+#: (perf_counter/monotonic) are deliberately absent: they can only
+#: express *intervals*, which feed metrics, not state — and if one
+#: ever did escape into state, the runtime digest guard names the
+#: height and field.
+_WALL_CLOCK = frozenset(
+    {
+        "time.time", "time.time_ns", "now_ns", "now", "utcnow",
+        "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+        "datetime.datetime.utcnow", "date.today",
+    }
+)
+
+#: dotted prefixes that produce randomness
+_RANDOM_PREFIXES = ("random.", "secrets.", "uuid.")
+
+
+@dataclass
+class Report(lintlib.Report):
+    roots: int = 0
+    reachable: int = 0
+    sites: int = 0
+
+
+def _detect_sites(fn: ast.AST) -> list[tuple[int, str]]:
+    """All nondeterminism sites in one function body (nested defs
+    included — a deferred closure still runs on the replay path)."""
+    sites: list[tuple[int, str]] = []
+
+    # one-level local taint: names assigned from set constructions
+    set_vars: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            is_set = isinstance(v, (ast.Set, ast.SetComp)) or (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id in ("set", "frozenset")
+            )
+            if is_set:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        set_vars.add(tgt.id)
+
+    def is_set_expr(e: ast.expr) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Name):
+            return e.func.id in ("set", "frozenset")
+        return isinstance(e, ast.Name) and e.id in set_vars
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            base = d.split(".")[-1] if d else ""
+            if d in _WALL_CLOCK:
+                sites.append((node.lineno, f"wall-clock read {d}()"))
+            elif d.startswith(_RANDOM_PREFIXES) or d == "os.urandom":
+                sites.append((node.lineno, f"randomness source {d}()"))
+            elif d in ("os.getenv", "os.environ.get", "getenv"):
+                sites.append((node.lineno, f"environment read {d}()"))
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("id", "hash")
+                and node.args
+            ):
+                sites.append(
+                    (node.lineno,
+                     f"identity/{node.func.id}() keying "
+                     "(PYTHONHASHSEED-dependent)")
+                )
+        elif isinstance(node, ast.Subscript):
+            if dotted(node.value) == "os.environ":
+                sites.append(
+                    (node.lineno, "environment read os.environ[...]")
+                )
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            sites.append((node.lineno, "float division '/'"))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if is_set_expr(node.iter):
+                sites.append(
+                    (node.lineno,
+                     "iteration over a set (order is "
+                     "PYTHONHASHSEED-dependent; sort first)")
+                )
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                if is_set_expr(gen.iter):
+                    sites.append(
+                        (gen.iter.lineno,
+                         "comprehension over a set (order is "
+                         "PYTHONHASHSEED-dependent; sort first)")
+                    )
+    return sites
+
+
+def _check_files(files: list[tuple[str, str]], report: Report) -> None:
+    graph = CallGraph(files)
+    roots = [r for r in DETERMINISM_ROOTS if r in graph.funcs]
+    report.roots += len(roots)
+    parents = graph.reachable(roots, stops=GRAPH_STOPS)
+    report.reachable += len(parents)
+
+    comments = {rel: comments_by_line(src) for rel, src in files}
+    flagged: dict[str, set[int]] = {rel: set() for rel, _ in files}
+    waived: dict[str, set[int]] = {rel: set() for rel, _ in files}
+
+    for key, info in graph.funcs.items():
+        sites = _detect_sites(info.node)
+        if not sites:
+            continue
+        flagged[info.rel].update(line for line, _ in sites)
+        if key not in parents:
+            continue  # pattern present but not replay-reachable
+        for line, site in sites:
+            report.sites += 1
+            m = _WAIVER_RE.search(comments[info.rel].get(line, ""))
+            if m:
+                if line not in waived[info.rel]:
+                    waived[info.rel].add(line)
+                    report.waivers.append(
+                        Waiver(info.rel, line, site, m.group(1).strip())
+                    )
+                continue
+            report.violations.append(
+                Violation(
+                    info.rel, line,
+                    f"{site} in {info.qualname}() on the replay path "
+                    f"({graph.chain(parents, key)}) — the state "
+                    "transition must be a pure function of (block, "
+                    "prior state); derive the value from the block/"
+                    "state or waive with '# deterministic: <reason>'",
+                )
+            )
+
+    for rel, _src in files:
+        check_stale_waivers(
+            comments[rel], flagged[rel], _WAIVER_RE, rel, report,
+            "deterministic",
+        )
+
+
+def check_source(source: str, rel: str) -> Report:
+    """Lint one file's source (fixtures): roots are matched against
+    ``rel``, so a fixture posing as cometbft_tpu/state/execution.py
+    with a ``def update_state`` exercises the real root set."""
+    report = Report()
+    _check_files([(rel, source)], report)
+    return report
+
+
+def check_tree(root: str | None = None) -> Report:
+    report = Report()
+    files: list[tuple[str, str]] = []
+    if root is not None:
+        files = list(iter_py_files(root))
+    else:
+        for d in SCAN_DIRS:
+            files.extend(iter_py_files(d))
+    seen = {rel for rel, _ in files}
+    for rel, qual in DETERMINISM_ROOTS:
+        if rel not in seen:
+            report.violations.append(
+                Violation(rel, 0, f"DETERMINISM_ROOTS file missing "
+                                  f"(root {qual})")
+            )
+    _check_files(files, report)
+    graph_roots = {
+        (rel, qual) for rel, qual in DETERMINISM_ROOTS if rel in seen
+    }
+    resolved = CallGraph(files).funcs.keys()
+    for key in sorted(graph_roots):
+        if key not in resolved:
+            report.violations.append(
+                Violation(
+                    key[0], 0,
+                    f"determinism root {key[1]} no longer resolves — "
+                    "update DETERMINISM_ROOTS (tools/determcheck.py) "
+                    "to the renamed transition entrypoint",
+                )
+            )
+    return report
+
+
+def _summary(report: Report) -> str:
+    return (
+        f"{report.reachable} functions reachable from {report.roots} "
+        f"transition roots; {report.sites} nondeterminism sites "
+        f"({len(report.waivers)} audited waivers)"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("determcheck", check_tree, _summary, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,247 @@
+"""envcheck: the CMT_TPU_* knob registry lint.
+
+Every environment knob in the tree must obey the one contract
+(cometbft_tpu/utils/env.py, generalizing flight.ring_size_from_env):
+a malformed value fails LOUDLY at read time, naming the variable and
+its constraint — a typo'd ``CMT_TPU_CHECKTX_BATCH=8O`` that silently
+falls back to the default is a production incident disguised as a perf
+regression.  This lint walks every ``CMT_TPU_*`` string literal in the
+package and enforces three things:
+
+1. **validated reads** — a literal used as the key of a raw
+   ``os.environ.get`` / ``os.getenv`` / ``os.environ[...]`` read is a
+   violation unless the line carries an audited ``# env ok: <reason>``
+   waiver (free-form paths/lists that have no parse to fail, or reads
+   whose validation demonstrably happens downstream).  Reads routed
+   through a registered validator (``VALIDATED_READERS``: the
+   utils/env.py helpers, ``ring_size_from_env`` and its per-module
+   aliases, the profiler's range-checked reader) pass.
+2. **documented** — every knob the code reads must have a row in
+   docs/observability.md's env table (``| `CMT_TPU_X` | ...``).
+3. **still read** (the inverse): every knob in the doc table must
+   still be read somewhere — a documented-but-unread knob is an
+   operator trap (setting it does nothing).
+
+A waiver on a line with no raw CMT_TPU_* read is a STALE-WAIVER
+error, same as the other three lints.
+
+    python tools/envcheck.py            # exit 0 clean, 1 with a report
+    python tools/envcheck.py -v         # also list waivers + knobs
+
+Run in the tier-1 flow via tests/test_envcheck.py and standalone via
+``make envcheck``; tools/metrics_lint.py main() gates on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    Violation,
+    Waiver,
+    check_stale_waivers,
+    comments_by_line,
+    dotted,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
+
+SCAN_ROOT = "cometbft_tpu"
+DOC_PATH = "docs/observability.md"
+
+_WAIVER_RE = waiver_re("env ok")
+_VAR_RE = re.compile(r"^CMT_TPU_[A-Z0-9_]+$")
+_DOC_ROW_RE = re.compile(r"^\|\s*`(CMT_TPU_[A-Z0-9_]+)`")
+
+#: call basenames that implement the fail-loudly contract.  Adding a
+#: name here asserts "this function raises on a malformed value,
+#: naming the variable" — tests/test_envcheck.py spot-checks the
+#: utils/env.py four.
+VALIDATED_READERS = frozenset(
+    {
+        "int_from_env", "float_from_env", "flag_from_env",
+        "choice_from_env",
+        # flight.ring_size_from_env, the original, and its per-module
+        # aliases (light/serve, crypto/bls_dispatch, crypto/verify_queue
+        # import it as _int_env; crypto/dispatch+health define peers)
+        "ring_size_from_env", "_int_env", "_float_env",
+        # profiler's range-checked reader (0..1000 Hz window)
+        "profile_hz_from_env",
+    }
+)
+
+def _is_raw_read(d: str) -> bool:
+    """``os.environ.get`` / ``os.getenv`` under any import alias
+    (``import os as _os`` is common in this tree)."""
+    return (
+        d.endswith("environ.get")
+        or d.endswith(".getenv")
+        or d == "getenv"
+    )
+
+
+@dataclass
+class Report(lintlib.Report):
+    read_vars: set = field(default_factory=set)
+    validated_reads: int = 0
+    raw_reads: int = 0
+
+
+def _literal_var(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if _VAR_RE.match(node.value):
+            return node.value
+    return None
+
+
+def _check_file(rel: str, source: str, report: Report) -> None:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        report.violations.append(Violation(rel, exc.lineno or 0,
+                                           f"syntax error: {exc.msg}"))
+        return
+    comments = comments_by_line(source)
+    flagged: set[int] = set()
+    waived: set[int] = set()
+
+    def raw_read(line: int, var: str, how: str) -> None:
+        report.read_vars.add(var)
+        report.raw_reads += 1
+        flagged.add(line)
+        m = _WAIVER_RE.search(comments.get(line, ""))
+        if m:
+            if line not in waived:
+                waived.add(line)
+                report.waivers.append(
+                    Waiver(rel, line, f"{how} read of {var}",
+                           m.group(1).strip())
+                )
+            return
+        report.violations.append(
+            Violation(
+                rel, line,
+                f"raw {how} read of {var} — route it through a "
+                "validated reader (cometbft_tpu/utils/env.py: "
+                "int_from_env / float_from_env / flag_from_env / "
+                "choice_from_env) so a malformed value fails loudly "
+                "naming the variable, or waive with "
+                "'# env ok: <reason>'",
+            )
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            base = d.split(".")[-1] if d else ""
+            if base in VALIDATED_READERS:
+                for arg in node.args[:1]:
+                    var = _literal_var(arg)
+                    if var:
+                        report.read_vars.add(var)
+                        report.validated_reads += 1
+            elif _is_raw_read(d):
+                for arg in node.args[:1]:
+                    var = _literal_var(arg)
+                    if var:
+                        raw_read(arg.lineno, var, d)
+        elif isinstance(node, ast.FunctionDef):
+            # a validated reader may carry its variable as a parameter
+            # default (profiler.profile_hz_from_env) — that IS a read
+            if node.name in VALIDATED_READERS:
+                for default in node.args.defaults:
+                    var = _literal_var(default)
+                    if var:
+                        report.read_vars.add(var)
+                        report.validated_reads += 1
+        elif isinstance(node, ast.Subscript):
+            if dotted(node.value).endswith("environ"):
+                var = _literal_var(
+                    node.slice if not isinstance(node.slice, ast.Tuple)
+                    else node.slice
+                )
+                if var:
+                    raw_read(node.value.lineno, var, "os.environ[...]")
+
+    check_stale_waivers(comments, flagged, _WAIVER_RE, rel, report,
+                        "env ok")
+
+
+def doc_table_vars(doc_source: str) -> set[str]:
+    """Knob names with a row in the env table (``| `CMT_TPU_X` | ...``)."""
+    out = set()
+    for line in doc_source.splitlines():
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+def check_source(source: str, rel: str) -> Report:
+    """Lint one file's source (fixtures) — code checks only; the doc
+    cross-check needs the tree and lives in check_tree."""
+    report = Report()
+    _check_file(rel, source, report)
+    return report
+
+
+def check_tree(root: str | None = None) -> Report:
+    report = Report()
+    scan = root if root is not None else SCAN_ROOT
+    for rel, source in iter_py_files(scan):
+        _check_file(rel, source, report)
+
+    doc_path = os.path.join(REPO, DOC_PATH)
+    if not os.path.exists(doc_path):
+        report.violations.append(
+            Violation(DOC_PATH, 0, "env-table doc missing")
+        )
+        return report
+    with open(doc_path, encoding="utf-8") as f:
+        doc = f.read()
+    documented = doc_table_vars(doc)
+
+    for var in sorted(report.read_vars - documented):
+        report.violations.append(
+            Violation(
+                DOC_PATH, 0,
+                f"{var} is read by the code but has no row in the "
+                "env table — document the default and constraint",
+            )
+        )
+    for var in sorted(documented - report.read_vars):
+        report.violations.append(
+            Violation(
+                DOC_PATH, 0,
+                f"{var} has an env-table row but nothing reads it — "
+                "setting it does nothing; delete the row or restore "
+                "the read",
+            )
+        )
+    return report
+
+
+def _summary(report: Report) -> str:
+    return (
+        f"{len(report.read_vars)} knobs; {report.validated_reads} "
+        f"validated reads, {report.raw_reads} raw reads "
+        f"({len(report.waivers)} audited waivers)"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("envcheck", check_tree, _summary, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,309 @@
+"""hotpathcheck: static critical-path blocking lint — determcheck's
+latency-plane sibling (same CallGraph, different question).
+
+determcheck asks "is the transition pure?"; hotpathcheck asks "does the
+commit pipeline ever stall on something it shouldn't?".  The height
+SLO (docs/observability.md, the attribution ledger) bills every
+committed height to the stage taxonomy in
+``cometbft_tpu/utils/critpath.py`` — a blocking call on a consensus
+step handler that is NOT billed to a stage is invisible latency: it
+shows up as ``residual`` in perfdiff with no owner.  This lint walks
+the intra-repo call graph from the critical-path roots
+(``HOTPATH_ROOTS``: the consensus step handlers, the reactor receive
+path, block persistence, the WAL write/fsync family) and flags
+blocking *sites* in everything reachable:
+
+* **sleep** — ``time.sleep()`` (the step loop must never nap);
+* **subprocess** — ``subprocess.*`` / ``os.system`` / ``os.popen``;
+* **HTTP** — ``requests.`` / ``urllib.`` / ``http.`` / ``httpx.``;
+* **socket I/O** — ``socket.create_connection``, ``.sendall()``,
+  ``.recv()``, ``.connect()`` (the ABCI round-trip is the one audited
+  exception — it IS the ``abci_execute`` stage);
+* **disk barriers** — ``os.fsync`` / ``.fsync()`` / ``.sync()`` and
+  ``open()`` (the WAL head fsync IS the ``wal_fsync`` stage);
+* **unbounded waits** — ``.wait()`` / ``.acquire()`` with no timeout
+  (a bounded ``wait(timeout=...)`` passes; an unbounded one can hold
+  the step mutex forever);
+* **stdin** — ``input()``.
+
+A site is silenced by ``# blocking ok: <stage> — <reason>`` where
+``<stage>`` MUST be a stage name from ``critpath.STAGES`` — the waiver
+is the billing record: it says "this block is already measured as that
+ledger column".  A waiver naming an unknown stage is a violation; a
+waiver on a line with no flagged site is a STALE-WAIVER error.  The
+call graph is the lintlib name-matching over-approximation, bounded by
+``GRAPH_STOPS`` (diagnostics planes and background-thread entrypoints
+that the step loop only *signals*, never joins).
+
+    python tools/hotpathcheck.py        # exit 0 clean, 1 with a report
+    python tools/hotpathcheck.py -v     # also list waivers
+
+Run in the tier-1 flow via tests/test_hotpathcheck.py and standalone
+via ``make hotpathcheck``; tools/metrics_lint.py main() gates on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    CallGraph,
+    Violation,
+    Waiver,
+    check_stale_waivers,
+    comments_by_line,
+    dotted,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
+
+#: mirror of cometbft_tpu/utils/critpath.py STAGES — kept in lockstep
+#: by tests/test_hotpathcheck.py (the jitcheck DTYPES_OK pattern).
+#: Mirrored rather than imported so the lint stays runnable on a
+#: checkout where the package itself fails to import.
+STAGES_OK = frozenset(
+    {
+        "proposal_wait", "gossip_hop", "verify_spec", "verify_launch",
+        "quorum_wait", "store_save", "wal_fsync", "abci_execute",
+        "index", "residual",
+    }
+)
+
+#: packages whose call graph the walk covers — same boundary as
+#: determcheck (crypto/ops/parallel route work to device queues and are
+#: billed by the dispatch plane; utils/ is host plumbing).
+SCAN_DIRS = (
+    "cometbft_tpu/abci",
+    "cometbft_tpu/consensus",
+    "cometbft_tpu/evidence",
+    "cometbft_tpu/mempool",
+    "cometbft_tpu/state",
+    "cometbft_tpu/store",
+    "cometbft_tpu/types",
+    "cometbft_tpu/wal",
+)
+
+#: the registered critical-path roots: everything the commit pipeline
+#: executes synchronously between "message arrives" and "height
+#: committed".  check_tree errors if one stops resolving.
+HOTPATH_ROOTS = (
+    ("cometbft_tpu/consensus/state.py", "ConsensusState._handle_msg"),
+    ("cometbft_tpu/consensus/state.py", "ConsensusState._handle_timeout"),
+    ("cometbft_tpu/consensus/state.py", "ConsensusState._finalize_commit"),
+    ("cometbft_tpu/consensus/reactor.py", "ConsensusReactor.receive"),
+    ("cometbft_tpu/store/__init__.py", "BlockStore.save_block"),
+    ("cometbft_tpu/wal/__init__.py", "WAL.write"),
+    ("cometbft_tpu/wal/__init__.py", "WAL.write_sync"),
+    ("cometbft_tpu/wal/__init__.py", "WAL.write_end_height"),
+)
+
+#: callee names the walk never follows.  Diagnostics planes (their
+#: sinks run on background threads), service lifecycle (the step loop
+#: signals services, never joins them), and stdlib-ish over-matchers.
+GRAPH_STOPS = frozenset(
+    {
+        # flight recorder / tracer / metrics / logger
+        "record", "format_tail", "span", "add_complete", "observe",
+        "observe_height", "inc", "dec", "set", "labels", "remove",
+        "info", "debug", "error", "warning", "with_fields",
+        # event bus + pubsub fan-out (subscribers are off-path)
+        "publish", "publish_new_block", "publish_new_block_events",
+        "publish_tx_event", "publish_validator_set_updates", "fire",
+        # service lifecycle + thread plumbing
+        "start", "stop", "is_running", "quit_event",
+        # stdlib-ish names that would wildly over-match
+        "get", "put", "append", "extend", "pop", "items", "keys",
+        "values", "join", "split", "strip", "encode_varint", "read",
+        "close", "flush",
+    }
+)
+
+_WAIVER_RE = waiver_re("blocking ok")
+
+#: dotted prefixes that make network round-trips
+_HTTP_PREFIXES = ("requests.", "urllib.", "urllib2.", "http.", "httpx.")
+
+#: attribute basenames that are socket I/O on an established connection
+_SOCKET_IO = frozenset({"sendall", "recv", "connect", "create_connection"})
+
+#: attribute basenames that are disk write barriers
+_DISK_BARRIER = frozenset({"fsync", "sync"})
+
+
+@dataclass
+class Report(lintlib.Report):
+    roots: int = 0
+    reachable: int = 0
+    sites: int = 0
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    """True when a wait/acquire call is bounded — any positional arg
+    (Event.wait(t) / Lock.acquire(True, t)) or a timeout= kwarg."""
+    if call.args:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _detect_sites(fn: ast.AST) -> list[tuple[int, str]]:
+    """All blocking sites in one function body (nested defs included —
+    a closure the handler invokes inline still blocks the step loop)."""
+    sites: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        base = d.split(".")[-1] if d else ""
+        if d in ("time.sleep", "sleep"):
+            sites.append((node.lineno, "sleep() on the step loop"))
+        elif d.startswith("subprocess.") or d in ("os.system", "os.popen"):
+            sites.append((node.lineno, f"subprocess spawn {d}()"))
+        elif d.startswith(_HTTP_PREFIXES):
+            sites.append((node.lineno, f"HTTP round-trip {d}()"))
+        elif d == "socket.create_connection" or (
+            isinstance(node.func, ast.Attribute) and base in _SOCKET_IO
+        ):
+            sites.append((node.lineno, f"socket I/O .{base}()"))
+        elif d == "os.fsync" or (
+            isinstance(node.func, ast.Attribute) and base in _DISK_BARRIER
+        ):
+            sites.append((node.lineno, f"disk barrier {d or base}()"))
+        elif isinstance(node.func, ast.Name) and node.func.id == "open":
+            sites.append((node.lineno, "file open()"))
+        elif isinstance(node.func, ast.Name) and node.func.id == "input":
+            sites.append((node.lineno, "stdin read input()"))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and base in ("wait", "acquire")
+            and not _has_timeout(node)
+        ):
+            sites.append(
+                (node.lineno,
+                 f"unbounded .{base}() — no timeout, can hold the "
+                 "step loop forever")
+            )
+    return sites
+
+
+def _check_files(files: list[tuple[str, str]], report: Report) -> None:
+    graph = CallGraph(files)
+    roots = [r for r in HOTPATH_ROOTS if r in graph.funcs]
+    report.roots += len(roots)
+    parents = graph.reachable(roots, stops=GRAPH_STOPS)
+    report.reachable += len(parents)
+
+    comments = {rel: comments_by_line(src) for rel, src in files}
+    flagged: dict[str, set[int]] = {rel: set() for rel, _ in files}
+    waived: dict[str, set[int]] = {rel: set() for rel, _ in files}
+
+    for key, info in graph.funcs.items():
+        sites = _detect_sites(info.node)
+        if not sites:
+            continue
+        flagged[info.rel].update(line for line, _ in sites)
+        if key not in parents:
+            continue  # blocking pattern present but off the hot path
+        for line, site in sites:
+            report.sites += 1
+            m = _WAIVER_RE.search(comments[info.rel].get(line, ""))
+            if m:
+                reason = m.group(1).strip()
+                stage = reason.split()[0].rstrip(":—-") if reason else ""
+                if stage not in STAGES_OK:
+                    report.violations.append(
+                        Violation(
+                            info.rel, line,
+                            f"'# blocking ok:' waiver names unknown "
+                            f"stage {stage!r} — the waiver is a billing"
+                            " record and must start with a stage from "
+                            "cometbft_tpu/utils/critpath.py STAGES "
+                            f"({', '.join(sorted(STAGES_OK))})",
+                        )
+                    )
+                elif line not in waived[info.rel]:
+                    waived[info.rel].add(line)
+                    report.waivers.append(
+                        Waiver(info.rel, line, site, reason)
+                    )
+                continue
+            report.violations.append(
+                Violation(
+                    info.rel, line,
+                    f"{site} in {info.qualname}() on the critical path "
+                    f"({graph.chain(parents, key)}) — the commit "
+                    "pipeline must not stall on unbilled work; move it "
+                    "to a background thread or waive with "
+                    "'# blocking ok: <stage> — <reason>' naming the "
+                    "critpath stage that already measures it",
+                )
+            )
+
+    for rel, _src in files:
+        check_stale_waivers(
+            comments[rel], flagged[rel], _WAIVER_RE, rel, report,
+            "blocking ok",
+        )
+
+
+def check_source(source: str, rel: str) -> Report:
+    """Lint one file's source (fixtures): roots are matched against
+    ``rel``, so a fixture posing as cometbft_tpu/wal/__init__.py with a
+    ``class WAL: def write_sync`` exercises the real root set."""
+    report = Report()
+    _check_files([(rel, source)], report)
+    return report
+
+
+def check_tree(root: str | None = None) -> Report:
+    report = Report()
+    files: list[tuple[str, str]] = []
+    if root is not None:
+        files = list(iter_py_files(root))
+    else:
+        for d in SCAN_DIRS:
+            files.extend(iter_py_files(d))
+    seen = {rel for rel, _ in files}
+    for rel, qual in HOTPATH_ROOTS:
+        if rel not in seen:
+            report.violations.append(
+                Violation(rel, 0, f"HOTPATH_ROOTS file missing "
+                                  f"(root {qual})")
+            )
+    _check_files(files, report)
+    resolved = CallGraph(files).funcs.keys()
+    for key in sorted((rel, q) for rel, q in HOTPATH_ROOTS if rel in seen):
+        if key not in resolved:
+            report.violations.append(
+                Violation(
+                    key[0], 0,
+                    f"hot-path root {key[1]} no longer resolves — "
+                    "update HOTPATH_ROOTS (tools/hotpathcheck.py) to "
+                    "the renamed critical-path entrypoint",
+                )
+            )
+    return report
+
+
+def _summary(report: Report) -> str:
+    return (
+        f"{report.reachable} functions reachable from {report.roots} "
+        f"critical-path roots; {report.sites} blocking sites "
+        f"({len(report.waivers)} stage-billed waivers)"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("hotpathcheck", check_tree, _summary, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

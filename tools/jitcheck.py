@@ -61,19 +61,26 @@ Run in the tier-1 flow via tests/test_jitcheck.py and standalone via
 from __future__ import annotations
 
 import ast
-import io
 import os
-import re
 import sys
-import tokenize
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-#: package whose jax.jit calls must go through a registered seam
-SCAN_ROOT = "cometbft_tpu"
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    SCAN_ROOT,
+    Violation,
+    Waiver,
+    check_stale_waivers,
+    comments_by_line as _comments_by_line,
+    dotted as _dotted,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
 
 #: the registered compile-cache seams: (file, function) pairs allowed
 #: to call jax.jit — everything the runtime guard's note_compile sees
@@ -138,7 +145,7 @@ REQUIRED_CONTRACTS = {
     ),
 }
 
-_WAIVER_RE = re.compile(r"#\s*host\s+sync:\s*(\S.*)")
+_WAIVER_RE = waiver_re("host sync")
 
 #: contract vocabulary — mirrored from ops/contracts.py WITHOUT
 #: importing it (the ops package import initializes jax; a lint must
@@ -171,69 +178,11 @@ def _is_leaf_spec(spec) -> bool:
 
 
 @dataclass
-class Violation:
-    file: str
-    line: int
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.file}:{self.line}: {self.message}"
-
-
-@dataclass
-class Waiver:
-    file: str
-    line: int
-    site: str
-    reason: str
-
-    def __str__(self) -> str:
-        return f"{self.file}:{self.line}: {self.site} — {self.reason}"
-
-
-@dataclass
-class Report:
-    violations: list[Violation] = field(default_factory=list)
-    waivers: list[Waiver] = field(default_factory=list)
+class Report(lintlib.Report):
     jit_calls: int = 0
     seams: int = 0
     contracts: int = 0
     sync_sites: int = 0
-
-    @property
-    def ok(self) -> bool:
-        return not self.violations
-
-    def merge(self, other: "Report") -> None:
-        self.violations.extend(other.violations)
-        self.waivers.extend(other.waivers)
-        self.jit_calls += other.jit_calls
-        self.seams += other.seams
-        self.contracts += other.contracts
-        self.sync_sites += other.sync_sites
-
-
-def _comments_by_line(source: str) -> dict[int, str]:
-    out: dict[int, str] = {}
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                out[tok.start[0]] = tok.string
-    except (tokenize.TokenError, IndentationError):
-        pass
-    return out
-
-
-def _dotted(node: ast.expr) -> str:
-    """``jax.debug.callback`` -> "jax.debug.callback"; "" otherwise."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
 
 
 def _is_jit_call(node: ast.Call) -> bool:
@@ -560,16 +509,10 @@ class _FileChecker:
         )
 
     def _check_stale_waivers(self) -> None:
-        for line, comment in self.comments.items():
-            if _WAIVER_RE.search(comment) and line not in self.flagged_lines:
-                self.report.violations.append(
-                    Violation(
-                        self.rel, line,
-                        "stale '# host sync:' waiver — no host-sync site "
-                        "on this line; delete the waiver or restore the "
-                        "audited call",
-                    )
-                )
+        check_stale_waivers(
+            self.comments, self.flagged_lines, _WAIVER_RE,
+            self.rel, self.report, "host sync",
+        )
 
     # -- contract check -------------------------------------------------
 
@@ -695,20 +638,12 @@ def check_source(source: str, rel: str) -> Report:
 
 def check_tree(root: str = SCAN_ROOT) -> Report:
     report = Report()
-    base = os.path.join(REPO, root)
-    for dirpath, dirnames, names in os.walk(base):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for n in sorted(names):
-            if not n.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, n)
-            rel = os.path.relpath(path, REPO)
-            with open(path, encoding="utf-8") as fh:
-                report.merge(check_source(fh.read(), rel))
+    seen: set[str] = set()
+    for rel, source in iter_py_files(root):
+        seen.add(rel)
+        report.merge(check_source(source, rel))
     # coverage: a REQUIRED_CONTRACTS file that vanished entirely would
     # otherwise silently pass
-    seen = {os.path.relpath(os.path.join(dp, n), REPO)
-            for dp, _, ns in os.walk(base) for n in ns}
     for rel in REQUIRED_CONTRACTS:
         if rel not in seen:
             report.violations.append(
@@ -717,29 +652,17 @@ def check_tree(root: str = SCAN_ROOT) -> Report:
     return report
 
 
-def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    verbose = "-v" in argv
-    report = check_tree()
-    for v in report.violations:
-        print(f"jitcheck: {v}", file=sys.stderr)
-    if verbose:
-        for w in report.waivers:
-            print(f"jitcheck: waiver: {w}")
-    if report.ok:
-        print(
-            f"jitcheck: {report.jit_calls} jax.jit calls through "
-            f"{report.seams} registered seams; {report.contracts} kernel "
-            f"contracts; {report.sync_sites} host-sync sites "
-            f"({len(report.waivers)} audited waivers)"
-        )
-        return 0
-    print(
-        f"jitcheck: {len(report.violations)} violations "
-        f"({len(report.waivers)} waivers)",
-        file=sys.stderr,
+def _summary(report: Report) -> str:
+    return (
+        f"{report.jit_calls} jax.jit calls through "
+        f"{report.seams} registered seams; {report.contracts} kernel "
+        f"contracts; {report.sync_sites} host-sync sites "
+        f"({len(report.waivers)} audited waivers)"
     )
-    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("jitcheck", check_tree, _summary, argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,309 @@
+"""lintlib: the shared machinery behind the repo's static lints.
+
+Four AST lints enforce the codebase's documented disciplines —
+lockcheck (guarded-by), jitcheck (device plane), determcheck
+(replay determinism), hotpathcheck (critical-path blocking) — plus
+envcheck (knob registry) and metrics_lint (series registry).  They
+all share one grammar:
+
+* **Waivers** are trailing comments of the form ``# <tag>: <reason>``
+  (``# unguarded:``, ``# host sync:``, ``# deterministic:``,
+  ``# blocking ok:``, ``# env ok:``).  A waiver silences exactly the
+  flagged site on its own line, is counted, and is listed by ``-v``
+  so the audit trail stays visible.
+
+* **Stale-waiver inverse check.**  A waiver comment on a line with no
+  flagged site is itself an error — annotations cannot outlive the
+  code they audit.
+
+* **Fixture-tree runner.**  Every lint exposes
+  ``check_source(source, rel)`` (unit-testable on fixture strings) and
+  ``check_tree(root)`` (the repo gate), built on :func:`iter_py_files`.
+
+* **Repo-gate entrypoint.**  ``main(argv)`` prints violations to
+  stderr, waivers under ``-v``, a one-line summary, and exits 0/1 —
+  uniform across tools so Makefile targets and tests/test_*.py gates
+  treat them interchangeably.
+
+This module is import-side-effect free (no jax, no cometbft_tpu): a
+lint must be able to judge the tree without executing it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field, fields
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default package scanned by every lint's repo gate
+SCAN_ROOT = "cometbft_tpu"
+
+
+@dataclass
+class Violation:
+    file: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.message}"
+
+
+@dataclass
+class Waiver:
+    file: str
+    line: int
+    site: str
+    reason: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.site} — {self.reason}"
+
+
+@dataclass
+class Report:
+    """Base report: violations + waivers + ``ok``.  Lints subclass and
+    add integer counters; :meth:`merge` folds those in generically so
+    subclasses don't hand-roll it."""
+
+    violations: list[Violation] = field(default_factory=list)
+    waivers: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def merge(self, other: "Report") -> None:
+        self.violations.extend(other.violations)
+        self.waivers.extend(other.waivers)
+        for f in fields(self):
+            if f.name in ("violations", "waivers"):
+                continue
+            mine = getattr(self, f.name)
+            if isinstance(mine, int):
+                setattr(self, f.name, mine + getattr(other, f.name))
+            elif isinstance(mine, set):
+                mine.update(getattr(other, f.name))
+
+
+def comments_by_line(source: str) -> dict[int, str]:
+    """Map line number -> comment text (tokenize survives the partial
+    trees fixtures throw at it; a tokenize error just yields fewer
+    comments, never a crash)."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def waiver_re(tag: str) -> re.Pattern:
+    """The shared waiver grammar: ``# <tag>: <reason>`` with a
+    mandatory non-empty reason.  ``tag`` may contain spaces
+    (``host sync``, ``blocking ok``); internal whitespace is matched
+    loosely so ``#host  sync:`` still counts."""
+    toks = r"\s+".join(re.escape(t) for t in tag.split())
+    return re.compile(rf"#\s*{toks}:\s*(\S.*)")
+
+
+def dotted(node: ast.expr) -> str:
+    """``jax.debug.callback`` -> "jax.debug.callback"; "" otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def check_stale_waivers(
+    comments: dict[int, str],
+    flagged_lines: set[int],
+    pattern: re.Pattern,
+    rel: str,
+    report: Report,
+    tag: str,
+) -> None:
+    """The inverse check: a waiver comment on a line where the lint
+    found nothing to waive is an error."""
+    for line, comment in comments.items():
+        if pattern.search(comment) and line not in flagged_lines:
+            report.violations.append(
+                Violation(
+                    rel, line,
+                    f"stale '# {tag}:' waiver — no flagged site on this "
+                    "line; delete the waiver or restore the audited call",
+                )
+            )
+
+
+def iter_py_files(root: str = SCAN_ROOT):
+    """Yield ``(rel, source)`` for every .py under REPO/root, sorted,
+    skipping __pycache__ — the fixture-tree runner every lint's
+    ``check_tree`` is built on."""
+    base = os.path.join(REPO, root)
+    for dirpath, dirnames, names in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for n in sorted(names):
+            if not n.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, n)
+            rel = os.path.relpath(path, REPO)
+            with open(path, encoding="utf-8") as fh:
+                yield rel, fh.read()
+
+
+# -- intra-repo call graph (determcheck / hotpathcheck) -----------------
+#
+# Name-matching over-approximation: an edge exists from function F to
+# every indexed def whose basename matches a name F calls (plain
+# ``name(...)``, ``obj.name(...)``, and ``ClassName(...)`` via
+# ``ClassName.__init__``).  Deliberately unsound-in-the-precise-sense
+# and complete-in-the-useful-sense: anything actually reachable is
+# reachable in the graph, the cost being extra reachable functions —
+# which the waiver grammar and per-lint stop sets keep bounded.
+
+
+class FuncInfo:
+    """One indexed function: where it lives and what names it calls."""
+
+    __slots__ = ("rel", "qualname", "node", "lineno", "calls")
+
+    def __init__(self, rel: str, qualname: str, node: ast.AST):
+        self.rel = rel
+        self.qualname = qualname
+        self.node = node
+        self.lineno = node.lineno
+        self.calls = _call_names(node)
+
+    @property
+    def basename(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+def _call_names(fn_node: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                names.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute):
+                names.add(n.func.attr)
+    return names
+
+
+class CallGraph:
+    """Call graph over a set of parsed files, keyed ``(rel, qualname)``
+    with qualnames ``func`` / ``Class.method``."""
+
+    def __init__(self, files):
+        self.funcs: dict[tuple[str, str], FuncInfo] = {}
+        self.by_name: dict[str, list[tuple[str, str]]] = {}
+        for rel, source in files:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add(rel, node.name, node, node.name)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            qual = f"{node.name}.{item.name}"
+                            # a ClassName(...) call reaches the ctor
+                            alias = (
+                                node.name
+                                if item.name in ("__init__", "__post_init__")
+                                else item.name
+                            )
+                            self._add(rel, qual, item, alias)
+
+    def _add(self, rel: str, qualname: str, node: ast.AST, name: str) -> None:
+        key = (rel, qualname)
+        self.funcs[key] = FuncInfo(rel, qualname, node)
+        self.by_name.setdefault(name, []).append(key)
+        base = qualname.rsplit(".", 1)[-1]
+        # ctors are reachable ONLY via their ClassName(...) alias: a
+        # bare ``super().__init__()`` call would otherwise edge into
+        # every constructor in the scan set
+        if base != name and base not in ("__init__", "__post_init__"):
+            self.by_name.setdefault(base, []).append(key)
+
+    def reachable(
+        self,
+        roots,
+        stops: frozenset[str] = frozenset(),
+    ) -> dict[tuple[str, str], tuple[str, str] | None]:
+        """BFS closure from ``roots`` (iterable of (rel, qualname)
+        keys).  Returns key -> parent key (None for roots) — the
+        parent chain is the "why is this on the path" explanation.
+        ``stops`` are callee basenames never traversed into
+        (diagnostics planes, audited boundaries)."""
+        parents: dict[tuple[str, str], tuple[str, str] | None] = {}
+        queue: list[tuple[str, str]] = []
+        for root in roots:
+            if root in self.funcs and root not in parents:
+                parents[root] = None
+                queue.append(root)
+        while queue:
+            key = queue.pop(0)
+            for name in sorted(self.funcs[key].calls):
+                if name in stops:
+                    continue
+                for tgt in self.by_name.get(name, ()):
+                    if tgt not in parents:
+                        parents[tgt] = key
+                        queue.append(tgt)
+        return parents
+
+    def chain(self, parents, key, limit: int = 6) -> str:
+        """``root → … → key`` qualname chain for violation messages."""
+        names: list[str] = []
+        cur = key
+        while cur is not None and len(names) < limit:
+            names.append(self.funcs[cur].qualname)
+            cur = parents.get(cur)
+        if cur is not None:
+            names.append("…")
+        return " ← ".join(names)
+
+
+def run_main(
+    tool: str,
+    check_tree,
+    summary,
+    argv: list[str] | None = None,
+) -> int:
+    """The shared repo-gate entrypoint: violations to stderr, waivers
+    under ``-v``, ``summary(report)`` one-liner when clean, exit 0/1."""
+    argv = sys.argv[1:] if argv is None else argv
+    verbose = "-v" in argv
+    report = check_tree()
+    for v in report.violations:
+        print(f"{tool}: {v}", file=sys.stderr)
+    if verbose:
+        for w in report.waivers:
+            print(f"{tool}: waiver: {w}")
+    if report.ok:
+        print(f"{tool}: {summary(report)}")
+        return 0
+    print(
+        f"{tool}: {len(report.violations)} violations "
+        f"({len(report.waivers)} waivers)",
+        file=sys.stderr,
+    )
+    return 1

@@ -46,17 +46,24 @@ Run in the tier-1 flow via tests/test_lockcheck.py and standalone via
 from __future__ import annotations
 
 import ast
-import io
 import os
 import re
 import sys
-import tokenize
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: packages whose lock constructions must go through cmtsync
-SCAN_ROOT = "cometbft_tpu"
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    SCAN_ROOT,
+    Violation,
+    comments_by_line as _comments_by_line,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
 
 #: audited leaf-lock files allowed to construct raw threading locks:
 #: the seam itself, plus fine-grained primitives whose locks are never
@@ -77,17 +84,7 @@ RAW_LOCK_OK = frozenset(
 
 _GUARDED_RE = re.compile(r"#\s*guarded\s+by\s+([A-Za-z_]\w*)")
 _HOLDS_RE = re.compile(r"#\s*(?:caller[\s-]holds|holds)[:\s]+([A-Za-z_]\w*)")
-_WAIVER_RE = re.compile(r"#\s*unguarded:\s*(\S.*)")
-
-
-@dataclass
-class Violation:
-    file: str
-    line: int
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.file}:{self.line}: {self.message}"
+_WAIVER_RE = waiver_re("unguarded")
 
 
 @dataclass
@@ -106,32 +103,9 @@ class Waiver:
 
 
 @dataclass
-class Report:
-    violations: list[Violation] = field(default_factory=list)
-    waivers: list[Waiver] = field(default_factory=list)
+class Report(lintlib.Report):
     guarded_fields: int = 0
     classes: int = 0
-
-    @property
-    def ok(self) -> bool:
-        return not self.violations
-
-    def merge(self, other: "Report") -> None:
-        self.violations.extend(other.violations)
-        self.waivers.extend(other.waivers)
-        self.guarded_fields += other.guarded_fields
-        self.classes += other.classes
-
-
-def _comments_by_line(source: str) -> dict[int, str]:
-    out: dict[int, str] = {}
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-            if tok.type == tokenize.COMMENT:
-                out[tok.start[0]] = tok.string
-    except (tokenize.TokenError, IndentationError):
-        pass
-    return out
 
 
 def _is_lock_ctor(node: ast.expr) -> bool:
@@ -389,41 +363,21 @@ def check_source(source: str, rel: str) -> Report:
 
 def check_tree(root: str = SCAN_ROOT) -> Report:
     report = Report()
-    base = os.path.join(REPO, root)
-    for dirpath, dirnames, names in os.walk(base):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for n in sorted(names):
-            if not n.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, n)
-            rel = os.path.relpath(path, REPO)
-            with open(path, encoding="utf-8") as fh:
-                report.merge(check_source(fh.read(), rel))
+    for rel, source in iter_py_files(root):
+        report.merge(check_source(source, rel))
     return report
 
 
-def main(argv: list[str] | None = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    verbose = "-v" in argv
-    report = check_tree()
-    for v in report.violations:
-        print(f"lockcheck: {v}", file=sys.stderr)
-    if verbose:
-        for w in report.waivers:
-            print(f"lockcheck: waiver: {w}")
-    if report.ok:
-        print(
-            f"lockcheck: {report.guarded_fields} guarded fields across "
-            f"{report.classes} classes verified; "
-            f"{len(report.waivers)} audited unguarded waivers"
-        )
-        return 0
-    print(
-        f"lockcheck: {len(report.violations)} violations "
-        f"({len(report.waivers)} waivers)",
-        file=sys.stderr,
+def _summary(report: Report) -> str:
+    return (
+        f"{report.guarded_fields} guarded fields across "
+        f"{report.classes} classes verified; "
+        f"{len(report.waivers)} audited unguarded waivers"
     )
-    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("lockcheck", check_tree, _summary, argv)
 
 
 if __name__ == "__main__":

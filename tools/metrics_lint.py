@@ -402,15 +402,23 @@ def main() -> int:
             f"({', '.join(files)}) but no struct registers it",
             file=sys.stderr,
         )
-    # one command gates all three lints: the guarded-by/lock-seam
-    # check (tools/lockcheck.py) and the device-path jit/contract
-    # check (tools/jitcheck.py) run here too, so CI needs one entry
-    from tools import jitcheck, lockcheck  # REPO is on sys.path (above)
+    # one command gates every lint: the guarded-by/lock-seam check
+    # (tools/lockcheck.py), the device-path jit/contract check
+    # (tools/jitcheck.py), the replay-determinism walk
+    # (tools/determcheck.py), the critical-path blocking walk
+    # (tools/hotpathcheck.py), and the env-knob registry
+    # (tools/envcheck.py) run here too, so CI needs one entry
+    from tools import (  # REPO is on sys.path (above)
+        determcheck,
+        envcheck,
+        hotpathcheck,
+        jitcheck,
+        lockcheck,
+    )
 
-    if lockcheck.main([]) != 0:
-        rc = 1
-    if jitcheck.main([]) != 0:
-        rc = 1
+    for lint in (lockcheck, jitcheck, determcheck, hotpathcheck, envcheck):
+        if lint.main([]) != 0:
+            rc = 1
     return rc
 
 
