@@ -4,19 +4,20 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lockcheck jitcheck determcheck hotpathcheck envcheck determinism-smoke test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lint lockcheck jitcheck determcheck hotpathcheck envcheck trustcheck determinism-smoke test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
 # randomized-manifest e2e, interpret-mode pallas trace) are skipped;
 # target <15 min single-core (reference analog: tests.mk:66-87 CI
 # package splits). The r4 default gate had grown to 48 min.
-# All five lints gate the default flow — metrics-lint runs lockcheck,
-# jitcheck, determcheck, hotpathcheck AND envcheck too, so one
+# All six lints gate the default flow — metrics-lint runs lockcheck,
+# jitcheck, determcheck, hotpathcheck, envcheck AND trustcheck too, so one
 # prerequisite covers them (and all run inside tier-1 via
 # tests/test_metrics.py + tests/test_lockcheck.py +
 # tests/test_jitcheck.py + tests/test_determcheck.py +
-# tests/test_hotpathcheck.py + tests/test_envcheck.py).
+# tests/test_hotpathcheck.py + tests/test_envcheck.py +
+# tests/test_trustcheck.py).
 test: metrics-lint determinism-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
@@ -120,6 +121,18 @@ hotpathcheck:
 # still read (inverse)
 envcheck:
 	$(PY) tools/envcheck.py
+
+# wire-ingress taint lint (docs/trust_boundary.md): network-derived
+# values reaching a consensus-state sink must pass a registered
+# validator or carry an audited '# trusted: <validator>' waiver;
+# wire-length allocations need a dominating cap or '# bounded: <cap>'
+trustcheck:
+	$(PY) tools/trustcheck.py
+
+# all six lints in one process, each file's AST parsed once
+# (tools/lint_all.py); `make test` runs the same set via metrics-lint
+lint:
+	$(PY) tools/lint_all.py
 
 # replay-determinism smoke (ISSUE 18 acceptance): a live node with
 # CMT_TPU_DETERMINISM=1 commits >= 5 heights writing per-height

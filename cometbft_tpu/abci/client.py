@@ -29,6 +29,7 @@ from cometbft_tpu.proxy import AbciClientError
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import encode_uvarint, read_uvarint_from
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
 
 
 class SocketClient:
@@ -159,6 +160,7 @@ class SocketClient:
             raise err
         return resp
 
+    @trustguard.guarded_seam("abci_response")
     def _read_response(self):
         f = self._file
 

@@ -22,6 +22,7 @@ from cometbft_tpu.types import codec
 from cometbft_tpu.types.block import BlockID
 from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
 from cometbft_tpu.types.validation import verify_commit_light
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
@@ -239,6 +240,7 @@ class BlocksyncReactor(Reactor):
 
     # -- receive ---------------------------------------------------------
 
+    @trustguard.guarded_seam("blocksync_reactor")
     def receive(self, env: Envelope) -> None:
         try:
             msg = decode_bs_message(env.message)

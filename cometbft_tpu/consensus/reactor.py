@@ -50,6 +50,7 @@ from cometbft_tpu.consensus.ticker import (
     STEP_PROPOSE,
 )
 from cometbft_tpu.p2p.base_reactor import Envelope, Reactor
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
 from cometbft_tpu.types.block import (
     BLOCK_ID_FLAG_COMMIT,
@@ -693,6 +694,7 @@ class ConsensusReactor(Reactor):
 
     # -- receive --------------------------------------------------------
 
+    @trustguard.guarded_seam("consensus_reactor")
     def receive(self, env: Envelope) -> None:
         try:
             msg, ctx = decode_message_traced(env.message)

@@ -62,6 +62,7 @@ from cometbft_tpu.types.vote_set import ConflictingVoteError, VoteSet
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.service import BaseService
 from cometbft_tpu.utils.flight import FLIGHT
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.time import now_ns
 from cometbft_tpu.utils.trace import NOP_SPAN, TRACER as _tracer
 from cometbft_tpu.wal import (
@@ -445,6 +446,7 @@ class ConsensusState(BaseService):
                 )
                 self.logger.error(FLIGHT.format_tail(20))
 
+    @trustguard.guarded_seam("consensus_state")
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
         with self._rs_mtx:
@@ -1240,7 +1242,7 @@ class ConsensusState(BaseService):
                     # block whose extensions the height+1 proposer then
                     # silently lacks (store.go SaveBlockWithExtendedCommit)
                     extended = precommits.votes()
-                self.block_store.save_block(
+                self.block_store.save_block(  # trusted: _verify — parts proof-verified at admission, precommits signature-verified by VoteSet._verify; the commit is assembled from the 2/3 majority
                     block, parts, seen_commit, extended_votes=extended
                 )
             # Height boundary: the block is durably stored; a crash after

@@ -25,6 +25,7 @@ from cometbft_tpu.types.evidence import (
     LightClientAttackEvidence,
 )
 from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.db import DB
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
@@ -138,6 +139,7 @@ class Pool:
                 f"evidence from height {ev.height} is too old "
                 f"({age_blocks} blocks, {age_ns // 1_000_000_000}s)"
             )
+        trustguard.note_validated("Pool.verify")
 
     def _verify_duplicate_vote(
         self, ev: DuplicateVoteEvidence, state: State
@@ -335,6 +337,7 @@ class Pool:
             if self._is_pending(ev) or self._is_committed(ev):
                 return
         self.verify(ev)
+        trustguard.check_sink("evidence.add")
         with self._mtx:
             self._add_pending_locked(ev)
             self._observe_pool_locked()
@@ -387,6 +390,7 @@ class Pool:
                 pending = self._is_pending(ev)
             if not pending:
                 self.verify(ev)
+        trustguard.note_validated("Pool.check_evidence")
 
     # -- post-commit update ----------------------------------------------
 

@@ -17,6 +17,7 @@ from cometbft_tpu.types import codec
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes
+from cometbft_tpu.utils import trustguard
 
 EVIDENCE_CHANNEL = 0x38
 
@@ -64,6 +65,7 @@ class EvidenceReactor(Reactor):
             daemon=True,
         ).start()
 
+    @trustguard.guarded_seam("evidence_reactor")
     def receive(self, env: Envelope) -> None:
         try:
             ev_list = decode_evidence_list(env.message)

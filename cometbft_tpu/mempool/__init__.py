@@ -13,6 +13,7 @@ import threading
 import time
 
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable
@@ -367,6 +368,9 @@ class CListMempool:
         except _ingest.MalformedSignedTx as exc:
             raise TxSignatureError(str(exc)) from None
         if parsed is None:
+            # a plain (un-enveloped) tx: the admission *policy* ran —
+            # there is simply no signature to check
+            trustguard.note_validated("CListMempool._verify_tx_signature")
             return
         pub, sig, payload = parsed
         t0 = time.perf_counter()
@@ -392,12 +396,14 @@ class CListMempool:
         )
         if not ok:
             raise TxSignatureError("invalid tx signature")
+        trustguard.note_validated("CListMempool._verify_tx_signature")
 
     def _handle_check_result(
         self, tx: bytes, res: CheckTxResponse, sender: str,
         key: bytes | None = None,
     ) -> None:
         """(clist_mempool.go:328 handleCheckTxResponse)"""
+        trustguard.check_sink("mempool.check_tx")
         post_err = None
         if self.post_check is not None:  # unguarded: callable ref, swapped atomically under lock in update()
             try:

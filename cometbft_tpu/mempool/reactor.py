@@ -19,6 +19,8 @@ from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
+from cometbft_tpu.utils.flight import FLIGHT
 
 MEMPOOL_CHANNEL = 0x30
 
@@ -87,6 +89,7 @@ class MempoolReactor(Reactor):
         with self._peer_tx_mtx:
             self._peer_tx_counts.pop(peer.id, None)
 
+    @trustguard.guarded_seam("mempool_reactor")
     def receive(self, env: Envelope) -> None:
         """CheckTx every received tx, remembering the sender so we never
         echo a tx back (reactor.go:184 Receive)."""
@@ -107,8 +110,16 @@ class MempoolReactor(Reactor):
         for tx in txs:
             try:
                 self.mempool.check_tx(tx, sender=env.src.id)
-            except Exception:  # noqa: BLE001 — invalid/duplicate txs are normal
-                pass
+            except Exception as exc:  # noqa: BLE001
+                # invalid/duplicate txs are normal at the gossip edge,
+                # but a swallowed rejection on a wire-ingress path must
+                # leave a breadcrumb (PR 9 convention), or a byzantine
+                # flood of bad txs is indistinguishable from silence
+                FLIGHT.record(
+                    "mempool_gossip_tx_rejected",
+                    peer=env.src.id,
+                    err=type(exc).__name__,
+                )
 
     def _broadcast_tx_routine(self, peer) -> None:
         """(mempool/reactor.go:209 broadcastTxRoutine)"""

@@ -49,6 +49,7 @@ class ConsensusMetrics:
             self.block_parts = self.quorum_prevote_delay = _NOP
             self.step_duration_seconds = _NOP
             self.replay_divergence_total = _NOP
+            self.trust_guard_trips_total = _NOP
             return
         s = "consensus"
         self.height = reg.gauge(s, "height", "Height of the chain.")
@@ -105,6 +106,14 @@ class ConsensusMetrics:
             "CMT_TPU_DETERMINISM replay guard, by surface "
             "(wal_replay|handshake|startup).",
             labels=("surface",),
+        )
+        self.trust_guard_trips_total = reg.counter(
+            s, "trust_guard_trips_total",
+            "Wire-derived values that reached a registered consensus "
+            "sink with no validator run in the active wire context, "
+            "caught by the CMT_TPU_TRUSTGUARD runtime guard, by sink "
+            "(utils/trustguard.py; static half tools/trustcheck.py).",
+            labels=("sink",),
         )
 
 

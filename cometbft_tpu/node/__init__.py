@@ -178,6 +178,12 @@ class Node(BaseService):
         else:
             self.metrics = NodeMetrics(None)
             self.metrics_server = None
+        # the trust-boundary guard (utils/trustguard.py) trips from
+        # sinks in types/ with no node handle — same sink pattern
+        # (the no-op NodeMetrics branch installs a _NOP counter)
+        from cometbft_tpu.utils import trustguard
+
+        trustguard.install_metrics(self.metrics.consensus)
         #: background tier prober (started with the metrics server;
         #: CMT_TPU_HEALTH_INTERVAL=0 disables)
         self.health_prober = None

@@ -20,6 +20,8 @@ from cometbft_tpu.utils.log import default_logger
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.types.codec import as_bytes as _bz, as_int as _iv
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
+from cometbft_tpu.utils.flight import FLIGHT
 
 PEX_CHANNEL = 0x00
 
@@ -142,11 +144,19 @@ class PexReactor(Reactor):
             if host in ("0.0.0.0", ""):
                 host = remote
             return NetAddress(id=ni.node_id, host=host, port=int(port))
-        except Exception:  # noqa: BLE001 — malformed listen addr
+        except Exception as exc:  # noqa: BLE001 — malformed listen addr
+            # swallowed on a wire-ingress path: breadcrumb, never
+            # silent (PR 9 convention)
+            FLIGHT.record(
+                "pex_self_addr_rejected",
+                peer=getattr(peer, "id", "?"),
+                err=type(exc).__name__,
+            )
             return None
 
     # -- receive ---------------------------------------------------------
 
+    @trustguard.guarded_seam("pex_reactor")
     def receive(self, envelope: Envelope) -> None:
         try:
             kind, addrs = decode_pex_msg(envelope.message)

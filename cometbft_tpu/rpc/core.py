@@ -11,6 +11,8 @@ from __future__ import annotations
 import base64
 import threading
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
+from cometbft_tpu.utils.flight import FLIGHT as _FLIGHT
 
 from cometbft_tpu.abci.types import CheckTxRequest, InfoRequest, QueryRequest
 from cometbft_tpu.rpc.jsonrpc import QuotedStr, RPCError
@@ -827,9 +829,15 @@ class Environment:
 
     def _check_tx_quiet(self, raw: bytes) -> None:
         try:
-            self.mempool.check_tx(raw)
-        except Exception:  # noqa: BLE001
-            pass
+            with trustguard.wire_context("rpc_tx_async"):
+                self.mempool.check_tx(raw)
+        except Exception as exc:  # noqa: BLE001
+            # async broadcast promises no admission verdict, but a
+            # swallowed rejection on the RPC ingress path must leave a
+            # breadcrumb (PR 9 convention)
+            _FLIGHT.record(
+                "rpc_async_checktx_rejected", err=type(exc).__name__
+            )
 
     def check_tx(self, tx=None) -> dict:
         """Run CheckTx against the app WITHOUT adding to the mempool
@@ -879,6 +887,7 @@ class Environment:
         )
         return {"log": "Dialing peers in progress. See /net_info for details"}
 
+    @trustguard.guarded_seam("rpc_tx")
     def broadcast_tx_sync(self, tx=None) -> dict:
         raw = _to_bytes(tx, "tx")
         try:
@@ -892,6 +901,7 @@ class Environment:
             "hash": hexb(tx_hash(raw)),
         }
 
+    @trustguard.guarded_seam("rpc_tx")
     def broadcast_tx_commit(self, tx=None, timeout=10.0) -> dict:
         """(rpc/core/mempool.go:76 BroadcastTxCommit) — subscribe to the
         tx event BEFORE CheckTx so the commit can't be missed."""
@@ -934,6 +944,7 @@ class Environment:
             except Exception:  # noqa: BLE001
                 pass
 
+    @trustguard.guarded_seam("rpc_evidence")
     def broadcast_evidence(self, evidence=None) -> dict:
         from cometbft_tpu.types import codec
 

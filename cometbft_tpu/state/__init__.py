@@ -19,6 +19,7 @@ from cometbft_tpu.types.block import Block, BlockID, Commit, Data, Header
 from cometbft_tpu.types.genesis import GenesisDoc
 from cometbft_tpu.types.params import ConsensusParams
 from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.db import DB
 from cometbft_tpu.utils.protoio import ProtoReader, ProtoWriter
 from cometbft_tpu.version import BLOCK_PROTOCOL
@@ -268,6 +269,7 @@ class Store:
     def save(self, state: State) -> None:
         """Persist the snapshot plus height-indexed validator/params
         lookups, in one atomic batch (state/store.go save)."""
+        trustguard.check_sink("state.save")
         next_height = state.last_block_height + 1
         ops: list[tuple[bytes, bytes | None]] = []
         if next_height == 1:

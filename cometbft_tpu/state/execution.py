@@ -41,6 +41,7 @@ from cometbft_tpu.types.event_bus import (
 )
 from cometbft_tpu.types.validation import verify_commit
 from cometbft_tpu.types.validator import ValidatorSet
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.fail import fail_point
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
@@ -482,6 +483,7 @@ class BlockExecutor:
     def validate_block(self, state: State, block: Block) -> None:
         validate_block(state, block, self.block_store)
         self.ev_pool.check_evidence(list(block.evidence))
+        trustguard.note_validated("validate_block")
 
     def apply_block(
         self,
@@ -507,6 +509,7 @@ class BlockExecutor:
         syncing_to_height: int = 0,
     ) -> State:
         self.validate_block(state, block)
+        trustguard.check_sink("apply_block")
 
         # duration clock, not wall clock: the measurement feeds metrics
         # only, and determcheck keeps wall-time reads off the apply path

@@ -26,6 +26,7 @@ from cometbft_tpu.statesync.messages import (
 )
 from cometbft_tpu.statesync.syncer import Snapshot, Syncer
 from cometbft_tpu.utils.log import Logger, default_logger
+from cometbft_tpu.utils import trustguard
 
 _MAX_MSG_BYTES = 16 * 1024 * 1024 + 1024
 RECENT_SNAPSHOTS = 10  # reactor.go recentSnapshots
@@ -130,6 +131,7 @@ class StatesyncReactor(Reactor):
 
     # -- receive ----------------------------------------------------------
 
+    @trustguard.guarded_seam("statesync_reactor")
     def receive(self, env: Envelope) -> None:
         try:
             msg = decode_ss_message(env.message)

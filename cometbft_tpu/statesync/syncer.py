@@ -30,6 +30,7 @@ from cometbft_tpu.statesync.stateprovider import (
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.log import Logger, default_logger
 from cometbft_tpu.utils.trace import TRACER
+from cometbft_tpu.utils import trustguard
 
 CHUNK_TIMEOUT = 10.0        # config chunk_request_timeout
 RETRIES_PER_CHUNK = 3
@@ -196,6 +197,7 @@ class Syncer:
                 fmt=snapshot.format, chunks=snapshot.chunks,
             )
 
+    @trustguard.guarded_seam("statesync_chunk")
     def add_chunk(self, height: int, fmt: int, index: int,
                   chunk: bytes) -> None:
         with self._mtx:

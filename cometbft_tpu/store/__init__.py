@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.trace import TRACER
 
@@ -146,6 +147,7 @@ class BlockStore:
         needs)."""
         if block is None or not part_set.is_complete():
             raise BlockStoreError("cannot save incomplete block")
+        trustguard.check_sink("store.save_block")
         height = block.header.height
         with self._mtx, TRACER.span(
             "store/save_block", cat="store", height=height

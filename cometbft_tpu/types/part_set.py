@@ -13,9 +13,19 @@ from dataclasses import dataclass
 
 from cometbft_tpu.crypto import merkle
 from cometbft_tpu.types.block import PartSetHeader
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.bit_array import BitArray
 
 BLOCK_PART_SIZE_BYTES = 65536  # types/params.go:23
+
+# 100MB hard cap, mirrored from types/params.py MAX_BLOCK_SIZE_BYTES
+# (params imports from this module, so importing it back would cycle)
+_MAX_BLOCK_SIZE_BYTES = 104857600
+
+#: the largest part count any valid block can need
+#: (types/params.go MaxBlockPartsCount) — PartSetHeader.total comes
+#: off the wire, so admission must cap it before allocating
+MAX_PART_SET_TOTAL = _MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES + 1
 
 
 class PartSetError(Exception):
@@ -35,12 +45,21 @@ class Part:
             raise PartSetError("part proof index mismatch")
         if len(self.bytes) > BLOCK_PART_SIZE_BYTES:
             raise PartSetError("part too large")
+        trustguard.note_validated("Part.validate_basic")
 
 
 class PartSet:
     """A complete or in-progress set of block parts."""
 
     def __init__(self, header: PartSetHeader):
+        # the header is wire-derived (proposal gossip): cap total before
+        # the allocations below, or a byzantine proposer that signs
+        # total=2**40 turns part admission into an OOM
+        if not 0 <= header.total <= MAX_PART_SET_TOTAL:
+            raise PartSetError(
+                f"part set total {header.total} out of range "
+                f"[0, {MAX_PART_SET_TOTAL}]"
+            )
         self.header = header
         self.parts: list[Part | None] = [None] * header.total
         self.parts_bit_array = BitArray(header.total)
@@ -74,6 +93,7 @@ class PartSet:
             raise PartSetError("invalid part proof")
         if part.proof.total != self.header.total:
             raise PartSetError("part proof total mismatch")
+        trustguard.check_sink("part_set.add_part")
         self.parts[part.index] = part
         self.parts_bit_array.set_index(part.index, True)
         self.count += 1

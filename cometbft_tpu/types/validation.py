@@ -23,6 +23,7 @@ from cometbft_tpu.crypto import verify_queue as _vq
 from cometbft_tpu.types.block import BlockID, Commit
 from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.utils import sync as cmtsync
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.flight import FLIGHT
 from cometbft_tpu.utils.trace import TRACER as _tracer
 
@@ -450,6 +451,7 @@ def verify_commit(
         count_all=True,
         lookup_by_address=False,
     )
+    trustguard.note_validated("verify_commit")
 
 
 def verify_commit_light(
@@ -477,6 +479,7 @@ def verify_commit_light(
         count_all=count_all,
         lookup_by_address=False,
     )
+    trustguard.note_validated("verify_commit_light")
 
 
 def verify_commit_light_trusting(
@@ -514,3 +517,4 @@ def verify_commit_light_trusting(
         lookup_by_address=True,
         signer_vals=signer_vals,
     )
+    trustguard.note_validated("verify_commit_light_trusting")

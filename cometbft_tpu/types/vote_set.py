@@ -22,6 +22,7 @@ from cometbft_tpu.types.block import (
 from cometbft_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from cometbft_tpu.types.validator import ValidatorSet
 from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.utils import trustguard
 from cometbft_tpu.utils.bit_array import BitArray
 
 
@@ -129,6 +130,7 @@ class VoteSet:
                 raise ConflictingVoteError(existing, vote)
 
         self._verify(vote, val.pub_key)
+        trustguard.check_sink("vote_set.add_vote")
 
         if existing is None:
             self._votes[val_idx] = vote
@@ -199,11 +201,13 @@ class VoteSet:
                 raise VoteSetError(
                     "vote extension on a nil vote or prevote"
                 )
+            trustguard.note_validated("VoteSet._verify")
             return
         if not vote.extension_signature:
             raise VoteSetError("missing vote extension signature")
         if not results[1]:
             raise VoteSetError("invalid vote extension signature")
+        trustguard.note_validated("VoteSet._verify")
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """A peer claims +2/3 for block_id (anti-entropy, vote_set.go:

@@ -21,6 +21,23 @@ CRASH_ROOT = os.path.join(HERE, "data", "fuzz_crashes")
 _ALLOWED = (ValueError, KeyError, IndexError, EOFError, OverflowError)
 
 
+def _hostile_envelopes(enc: bytes) -> list[bytes]:
+    """Adversarial variants of a valid encoding, seeded per wire
+    ingress root (docs/trust_boundary.md): a length-delimited field
+    claiming ~1 GiB it never supplies — decoders must size
+    allocations by the bytes actually present, the discipline
+    tools/trustcheck.py's decode-bounds pass checks statically — and
+    a truncated envelope, which must raise a typed error rather than
+    yield a half-built structure."""
+    from cometbft_tpu.utils.protoio import encode_uvarint
+
+    return [
+        # proto field 2, wire type LEN, with an absurd length claim
+        enc + b"\x12" + encode_uvarint(1 << 30),
+        enc[: max(1, len(enc) // 2)],
+    ]
+
+
 def _seed_abci() -> list[bytes]:
     from cometbft_tpu.abci import codec
     from cometbft_tpu.abci import types as T
@@ -34,7 +51,9 @@ def _seed_abci() -> list[bytes]:
         ),
         T.PrepareProposalRequest(max_tx_bytes=1024, height=2),
     ]
-    return [codec.encode_request(r) for r in reqs]
+    out = [codec.encode_request(r) for r in reqs]
+    out.extend(_hostile_envelopes(out[0]))
+    return out
 
 
 def _abci_target(data: bytes) -> None:
@@ -81,6 +100,7 @@ def _seed_mconn() -> list[bytes]:
         mc.encode_packet_msg(0x00, False, b""),
         mc.encode_packet_ping(),
         mc.encode_packet_pong(),
+        *_hostile_envelopes(mc.encode_packet_msg(0x20, True, b"payload")),
     ]
 
 
@@ -163,6 +183,20 @@ def _seed_reactor_msgs() -> list[bytes]:
         seeds.append(encode_pex_request())
     except ImportError:
         pass
+    # forged stx: admission claims riding mempool gossip
+    # (docs/trust_boundary.md): an all-zero pub/sig envelope (which
+    # ZIP-215 deliberately ACCEPTS — zero pub decodes to a small-order
+    # point and the zero sig satisfies the cofactored equation; the
+    # decoder must stay deterministic about it), a prefix with no
+    # envelope behind it, and non-hex where fixed-width hex is
+    # promised — a tx that CLAIMS to be signed must parse-or-reject
+    # loudly, never admit as plain
+    seeds.append(encode_txs([
+        b"stx:" + b"0" * 64 + b"0" * 128 + b":k=v",
+        b"stx:liar",
+        b"stx:" + b"zz" * 32 + b"0" * 128 + b":k=v",
+    ]))
+    seeds.extend(_hostile_envelopes(seeds[1]))
     return seeds
 
 
@@ -288,6 +322,46 @@ def _rlc_target(data: bytes) -> None:
             )
 
 
+def _seed_signed_tx() -> list[bytes]:
+    """A genuinely signed admission envelope plus forged claims
+    (docs/trust_boundary.md): sig bit-flipped, envelope truncated
+    mid-header, and an all-zero claim — mutation explores the
+    parse/verify reject space from the RPC broadcast_tx ingress."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.mempool import ingest
+
+    priv = ed.priv_key_from_secret(b"fuzz-stx-seed")
+    good = ingest.make_signed_tx(priv, b"k=v")
+    forged = bytearray(good)
+    forged[len(ingest.SIGNED_TX_PREFIX) + 64 + 5] ^= 1  # hex digit flip
+    return [
+        good,
+        bytes(forged),
+        good[:20],
+        b"stx:" + b"0" * 192 + b":k=v",
+    ]
+
+
+def _signed_tx_target(data: bytes) -> None:
+    """The stx: admission claim surface: parse must either return a
+    well-formed (pub, sig, payload) triple, return None for plain
+    txs, or raise MalformedSignedTx — and a parsed forgery must fail
+    signature verification, never admit."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.mempool import ingest
+
+    parsed = ingest.parse_signed_tx(bytes(data))
+    if parsed is None:
+        return
+    pub, sig, payload = parsed
+    if len(pub) != ed.PUB_KEY_SIZE or len(sig) != ed.SIGNATURE_SIZE:
+        raise AssertionError(
+            f"parse_signed_tx returned malformed triple "
+            f"(pub {len(pub)}B, sig {len(sig)}B)"
+        )
+    ed.Ed25519PubKey(pub).verify_signature(ingest.sign_bytes(payload), sig)
+
+
 def make_fuzzers(names: list[str] | None = None):
     """Instantiate GuidedFuzzer objects for the named targets."""
     from cometbft_tpu.utils.fuzzing import GuidedFuzzer
@@ -305,6 +379,7 @@ def make_fuzzers(names: list[str] | None = None):
             lambda: [b"\x00" * 32, os.urandom(64)],
         ),
         "ed25519_rlc": (_rlc_target, _ALLOWED, _seed_rlc),
+        "signed_tx": (_signed_tx_target, _ALLOWED, _seed_signed_tx),
     }
     out = []
     for name, (fn, allowed, seeds) in registry.items():

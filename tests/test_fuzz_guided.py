@@ -27,6 +27,7 @@ _FAST = [
     "ws_frame",
     "reactor_msgs",
     "ed25519_rlc",
+    "signed_tx",
 ]
 
 

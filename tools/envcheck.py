@@ -106,7 +106,7 @@ def _literal_var(node: ast.expr) -> str | None:
 
 def _check_file(rel: str, source: str, report: Report) -> None:
     try:
-        tree = ast.parse(source)
+        tree = lintlib.parse_cached(source)
     except SyntaxError as exc:
         report.violations.append(Violation(rel, exc.lineno or 0,
                                            f"syntax error: {exc.msg}"))

@@ -249,7 +249,7 @@ class _FileChecker:
 
     def run(self) -> None:
         try:
-            tree = ast.parse(self.source)
+            tree = lintlib.parse_cached(self.source)
         except SyntaxError as exc:
             self.report.violations.append(
                 Violation(self.rel, exc.lineno or 0,

@@ -92,10 +92,37 @@ class Report:
                 mine.update(getattr(other, f.name))
 
 
+# -- memoized parse layer (tools/lint_all.py) ---------------------------
+#
+# Both caches key on source CONTENT: ast.parse and the comment map are
+# pure functions of it, and no lint mutates the returned tree/dict —
+# so when all six lints run in one process (tools/lint_all.py, `make
+# lint`, the metrics_lint gate) each file is parsed and tokenized
+# once instead of once per lint.  SyntaxError is deliberately NOT
+# cached: every caller handles it per-file and failures are rare.
+
+_PARSE_CACHE: dict[str, ast.Module] = {}
+_COMMENT_CACHE: dict[str, dict[int, str]] = {}
+
+
+def parse_cached(source: str) -> ast.Module:
+    """``ast.parse`` memoized on source content (raises SyntaxError
+    like the original).  Treat the returned tree as read-only."""
+    tree = _PARSE_CACHE.get(source)
+    if tree is None:
+        tree = ast.parse(source)
+        _PARSE_CACHE[source] = tree
+    return tree
+
+
 def comments_by_line(source: str) -> dict[int, str]:
     """Map line number -> comment text (tokenize survives the partial
     trees fixtures throw at it; a tokenize error just yields fewer
-    comments, never a crash)."""
+    comments, never a crash).  Memoized on content — treat the
+    returned dict as read-only."""
+    cached = _COMMENT_CACHE.get(source)
+    if cached is not None:
+        return cached
     out: dict[int, str] = {}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
@@ -103,6 +130,7 @@ def comments_by_line(source: str) -> dict[int, str]:
                 out[tok.start[0]] = tok.string
     except (tokenize.TokenError, IndentationError):
         pass
+    _COMMENT_CACHE[source] = out
     return out
 
 
@@ -212,7 +240,7 @@ class CallGraph:
         self.by_name: dict[str, list[tuple[str, str]]] = {}
         for rel, source in files:
             try:
-                tree = ast.parse(source)
+                tree = parse_cached(source)
             except SyntaxError:
                 continue
             for node in tree.body:

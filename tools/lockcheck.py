@@ -332,7 +332,7 @@ def check_source(source: str, rel: str) -> Report:
     """Lint one file's source; ``rel`` is the path used in reports."""
     report = Report()
     try:
-        tree = ast.parse(source)
+        tree = lintlib.parse_cached(source)
     except SyntaxError as exc:
         report.violations.append(
             Violation(rel, exc.lineno or 0, f"syntax error: {exc.msg}")

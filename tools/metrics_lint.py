@@ -406,17 +406,21 @@ def main() -> int:
     # (tools/lockcheck.py), the device-path jit/contract check
     # (tools/jitcheck.py), the replay-determinism walk
     # (tools/determcheck.py), the critical-path blocking walk
-    # (tools/hotpathcheck.py), and the env-knob registry
-    # (tools/envcheck.py) run here too, so CI needs one entry
+    # (tools/hotpathcheck.py), the env-knob registry
+    # (tools/envcheck.py), and the wire-ingress taint walk
+    # (tools/trustcheck.py) run here too, so CI needs one entry
     from tools import (  # REPO is on sys.path (above)
         determcheck,
         envcheck,
         hotpathcheck,
         jitcheck,
         lockcheck,
+        trustcheck,
     )
 
-    for lint in (lockcheck, jitcheck, determcheck, hotpathcheck, envcheck):
+    for lint in (
+        lockcheck, jitcheck, determcheck, hotpathcheck, envcheck, trustcheck
+    ):
         if lint.main([]) != 0:
             rc = 1
     return rc

@@ -1,0 +1,494 @@
+"""trustcheck: wire-ingress taint lint — the compile-time half of the
+byzantine trust boundary (runtime half: CMT_TPU_TRUSTGUARD in
+cometbft_tpu/utils/trustguard.py; docs/trust_boundary.md is the
+manual).
+
+Every byzantine byte enters the node through a small set of seams: the
+seven ``Reactor.receive`` implementations, the consensus message
+decoder, the secret-connection frame decode, the statesync chunk
+apply, the RPC tx ingress, and the remote-ABCI response read.  The BFT
+contract requires that network-derived values pass a *validator*
+(``validate_basic``, signature verify, commit verify) before they
+touch consensus state.  Nothing enforced that mechanically until now —
+this is the sixth lint in the lintlib family (lockcheck, jitcheck,
+determcheck, hotpathcheck, envcheck) and it closes the last un-linted
+plane: the wire.
+
+**Pass 1 — taint walk.**  BFS the intra-repo call graph from the
+registered ``INGRESS_ROOTS``; every reachable function is *tainted*
+(may be holding attacker-controlled values).  Inside tainted
+functions, flag each call whose basename matches a registered sink
+(``SINKS``: vote admission, part admission, mempool entry, evidence
+add, block/state store writes, apply_block).  A flagged site passes
+when:
+
+* the sink **self-validates** — a registered validator is reachable
+  from the sink's own definition (``VoteSet.add_vote`` reaches
+  ``VoteSet._verify`` through ``_add_vote_locked``); or
+* the **caller validates** — the tainted function's own body calls a
+  registered validator (blocksync verifies the commit light before
+  applying); or
+* the line carries an audited ``# trusted: <validator> — <reason>``
+  waiver whose first token names a registered validator (the
+  hotpathcheck mirrored-registry convention — a waiver cannot cite a
+  validator that does not exist).
+
+**Pass 2 — decode-bounds discipline.**  Inside tainted functions, a
+sequence-repeat allocation whose size comes from a bare
+name/attribute (``[None] * total``, ``b"\\x00" * n``) is the classic
+pre-consensus DoS when the size is a hostile length prefix.  The site
+passes when the function dominates it with a cap — an upper-bound
+comparison on the size, a ``min(size, CAP)`` clamp, or a
+``read_uvarint_from(..., max_value=...)`` producer — or carries a
+``# bounded: <cap> — <reason>`` waiver whose first token names a cap
+in ``KNOWN_CAPS``.  (``bytes(x)``/``bytearray(x)`` calls are NOT
+flagged: statically they are overwhelmingly buffer *copies* of data
+already in memory, not length-prefix preallocations.)
+
+Registries are pure literals; an entry that stops resolving fails the
+gate loudly (determcheck's root-set convention) so the boundary cannot
+silently rot.  Both waiver tags get the stale-waiver inverse check.
+
+The taint walk STOPS at registered validators: a validator is the
+audited boundary — everything behind ``verify_signature`` /
+``Pool.verify`` is the crypto plane, designed for hostile input and
+out of scope here (determcheck draws the same line for its plane).
+
+Known static limits (the runtime guard covers these): taint through
+queues is modeled by registering both seam ends as roots
+(``ConsensusReactor.receive`` enqueues, ``ConsensusState._handle_msg``
+dequeues); dynamic dispatch behind ``getattr`` is not seen.
+CMT_TPU_TRUSTGUARD=1 stamps provenance on decoded envelopes at the
+reactor seam and asserts at each registered sink that validation ran,
+tripping ``consensus_trust_guard_trips_total{sink}`` plus a flight
+event before raising.
+
+    python tools/trustcheck.py         # exit 0 clean, 1 with a report
+    python tools/trustcheck.py -v      # also list waivers
+
+Run in the tier-1 flow via tests/test_trustcheck.py and standalone via
+``make trustcheck``; tools/metrics_lint.py main() gates on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lintlib import (  # noqa: E402 — path bootstrap above
+    CallGraph,
+    Violation,
+    Waiver,
+    check_stale_waivers,
+    comments_by_line,
+    dotted,
+    iter_py_files,
+    run_main,
+    waiver_re,
+)
+from tools import lintlib  # noqa: E402
+
+#: packages the taint walk covers — everything a wire byte can reach.
+#: crypto/ is IN scope here (unlike determcheck): signature
+#: verification is the validator plane this lint pivots on.
+SCAN_DIRS = (
+    "cometbft_tpu/abci",
+    "cometbft_tpu/blocksync",
+    "cometbft_tpu/consensus",
+    "cometbft_tpu/crypto",
+    "cometbft_tpu/evidence",
+    "cometbft_tpu/mempool",
+    "cometbft_tpu/p2p",
+    "cometbft_tpu/rpc",
+    "cometbft_tpu/state",
+    "cometbft_tpu/statesync",
+    "cometbft_tpu/store",
+    "cometbft_tpu/types",
+    "cometbft_tpu/wal",
+)
+
+#: every seam where attacker-controlled bytes enter the process.  The
+#: consensus seam is registered at BOTH ends of its queue (receive
+#: enqueues MsgInfo, _handle_msg dequeues it) because the name-matching
+#: graph cannot follow values through a queue.  check_tree errors if
+#: an entry stops resolving.
+INGRESS_ROOTS = (
+    ("cometbft_tpu/consensus/reactor.py", "ConsensusReactor.receive"),
+    ("cometbft_tpu/consensus/state.py", "ConsensusState._handle_msg"),
+    ("cometbft_tpu/blocksync/reactor.py", "BlocksyncReactor.receive"),
+    ("cometbft_tpu/mempool/reactor.py", "MempoolReactor.receive"),
+    ("cometbft_tpu/statesync/reactor.py", "StatesyncReactor.receive"),
+    ("cometbft_tpu/statesync/syncer.py", "Syncer.add_chunk"),
+    ("cometbft_tpu/evidence/reactor.py", "EvidenceReactor.receive"),
+    ("cometbft_tpu/p2p/pex/reactor.py", "PexReactor.receive"),
+    ("cometbft_tpu/p2p/base_reactor.py", "Reactor.receive"),
+    ("cometbft_tpu/consensus/messages.py", "decode_message_traced"),
+    ("cometbft_tpu/p2p/conn/secret_connection.py", "SecretConnection.read"),
+    ("cometbft_tpu/rpc/core.py", "Environment.broadcast_tx_async"),
+    ("cometbft_tpu/rpc/core.py", "Environment.broadcast_tx_sync"),
+    ("cometbft_tpu/rpc/core.py", "Environment.broadcast_tx_commit"),
+    ("cometbft_tpu/rpc/core.py", "Environment.broadcast_evidence"),
+    ("cometbft_tpu/abci/client.py", "SocketClient._read_response"),
+)
+
+#: the validation plane: a flagged sink call passes when one of these
+#: is reachable from the sink def, called by the flagged caller, or
+#: named by a ``# trusted:`` waiver.  check_tree errors if an entry
+#: stops resolving.
+VALIDATORS = (
+    ("cometbft_tpu/types/vote_set.py", "VoteSet._verify"),
+    ("cometbft_tpu/types/part_set.py", "Part.validate_basic"),
+    ("cometbft_tpu/types/validation.py", "verify_commit"),
+    ("cometbft_tpu/types/validation.py", "verify_commit_light"),
+    ("cometbft_tpu/types/validation.py", "verify_commit_light_trusting"),
+    ("cometbft_tpu/state/execution.py", "validate_block"),
+    ("cometbft_tpu/evidence/pool.py", "Pool.verify"),
+    ("cometbft_tpu/evidence/pool.py", "Pool.check_evidence"),
+    ("cometbft_tpu/mempool/__init__.py", "CListMempool._verify_tx_signature"),
+    ("cometbft_tpu/crypto/verify_queue.py", "verify_or_fallback"),
+    ("cometbft_tpu/crypto/verify_queue.py", "checktx_verify_or_fallback"),
+    ("cometbft_tpu/crypto/ed25519.py", "Ed25519PubKey.verify_signature"),
+)
+
+#: consensus-state mutation points a tainted value must not reach
+#: unvalidated.  check_tree errors if an entry stops resolving.
+SINKS = (
+    ("cometbft_tpu/types/vote_set.py", "VoteSet.add_vote"),
+    ("cometbft_tpu/types/part_set.py", "PartSet.add_part"),
+    ("cometbft_tpu/mempool/__init__.py", "CListMempool.check_tx"),
+    ("cometbft_tpu/evidence/pool.py", "Pool.add_evidence"),
+    ("cometbft_tpu/store/__init__.py", "BlockStore.save_block"),
+    ("cometbft_tpu/state/__init__.py", "Store.save"),
+    ("cometbft_tpu/state/execution.py", "BlockExecutor.apply_block"),
+)
+
+#: size-cap names a ``# bounded: <cap>`` waiver may cite — the
+#: mirrored-registry convention: a waiver cannot invent a cap.
+KNOWN_CAPS = frozenset(
+    {
+        "MAX_MSG_SIZE",
+        "DATA_MAX_SIZE",
+        "TOTAL_FRAME_SIZE",
+        "_MAX_BIT_ARRAY_BITS",
+        "BLOCK_PART_SIZE_BYTES",
+        "MAX_PART_SET_TOTAL",
+        "MAX_RANGE",
+        "_MAX_MSG_BYTES",
+        "max_packet_msg_payload_size",
+        "recv_message_capacity",
+        "_MAX_ADDRS_PER_MSG",
+        "MAX_PACKET_PAYLOAD",
+        "MAX_CHUNK_SIZE",
+        "read_uvarint_from",
+    }
+)
+
+#: callee names the walk never follows — diagnostics planes whose
+#: output never feeds state, service lifecycle, and stdlib-ish names
+#: that would wildly over-match (the determcheck convention; each
+#: entry asserts "nothing behind this name admits wire data to
+#: consensus state").
+GRAPH_STOPS = frozenset(
+    {
+        # flight recorder / tracer / metrics / logger
+        "record", "format_tail", "span", "add_complete", "observe",
+        "observe_height", "inc", "dec", "set", "labels", "remove",
+        "info", "debug", "error", "warning", "with_fields",
+        # event bus + pubsub fan-out (subscribers are off-path)
+        "publish", "publish_new_block", "publish_new_block_events",
+        "publish_tx_event", "publish_validator_set_updates", "fire",
+        # service lifecycle + thread plumbing
+        "start", "stop", "is_running", "quit_event", "wait",
+        # stdlib-ish names that would wildly over-match
+        "get", "put", "append", "extend", "pop", "items", "keys",
+        "values", "join", "split", "strip", "encode_varint",
+        "write", "close", "flush", "add",
+    }
+)
+
+_TRUSTED_RE = waiver_re("trusted")
+_BOUNDED_RE = waiver_re("bounded")
+
+
+@dataclass
+class Report(lintlib.Report):
+    roots: int = 0
+    validators: int = 0
+    sinks: int = 0
+    tainted: int = 0
+    sink_sites: int = 0
+    alloc_sites: int = 0
+
+
+def _sink_calls(fn: ast.AST, sink_names: set[str]) -> list[tuple[int, str]]:
+    """Call sites in ``fn`` whose basename matches a registered sink."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        else:
+            continue
+        if name in sink_names:
+            out.append((node.lineno, name))
+    return out
+
+
+def _size_token(e: ast.expr) -> str:
+    """The textual identity of a size operand when it is a bare
+    name/attribute ("" otherwise — constants and len() results are
+    not attacker-controlled lengths)."""
+    if isinstance(e, (ast.Name, ast.Attribute)):
+        return dotted(e)
+    return ""
+
+
+def _alloc_sites(fn: ast.AST) -> list[tuple[int, str, str]]:
+    """(line, size-token, description) for each sequence-repeat
+    allocation sized by a bare name/attribute: ``[x] * n``,
+    ``b".." * n``."""
+    sites: list[tuple[int, str, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            for seq, size in ((node.left, node.right),
+                              (node.right, node.left)):
+                is_seq = isinstance(seq, (ast.List, ast.Tuple)) or (
+                    isinstance(seq, ast.Constant)
+                    and isinstance(seq.value, (bytes, str))
+                )
+                tok = _size_token(size)
+                if is_seq and tok:
+                    sites.append(
+                        (node.lineno, tok,
+                         f"sequence allocation sized by '{tok}'")
+                    )
+    return sites
+
+
+def _capped_tokens(fn: ast.AST) -> set[str]:
+    """Size tokens the function dominates with a cap: an upper-bound
+    comparison mentioning the token, a ``min(...)`` assignment, or a
+    ``read_uvarint_from(...)`` producer (which rejects past
+    ``max_value`` before allocating)."""
+    capped: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare):
+            if any(
+                isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                for op in node.ops
+            ):
+                for e in [node.left, *node.comparators]:
+                    tok = _size_token(e)
+                    if tok:
+                        capped.add(tok)
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            callee = dotted(node.value.func).split(".")[-1]
+            # len() of an in-memory collection is already materialized
+            # — it cannot be a hostile length *prefix*
+            if callee in ("min", "len", "read_uvarint_from"):
+                for tgt in node.targets:
+                    tok = _size_token(tgt)
+                    if tok:
+                        capped.add(tok)
+    return capped
+
+
+def _check_files(files: list[tuple[str, str]], report: Report) -> None:
+    graph = CallGraph(files)
+
+    roots = [r for r in INGRESS_ROOTS if r in graph.funcs]
+    validators = [v for v in VALIDATORS if v in graph.funcs]
+    sinks = [s for s in SINKS if s in graph.funcs]
+    report.roots += len(roots)
+    report.validators += len(validators)
+    report.sinks += len(sinks)
+
+    validator_names = {q.rsplit(".", 1)[-1] for _, q in VALIDATORS}
+    sink_names = {q.rsplit(".", 1)[-1] for _, q in SINKS}
+
+    # a sink self-validates when a registered validator is reachable
+    # from the sink's own definition (add_vote reaches _verify through
+    # _add_vote_locked — function granularity would miss it)
+    validator_keys = set(validators)
+    self_validating: set[str] = set()
+    for key in sinks:
+        closure = graph.reachable([key], stops=GRAPH_STOPS)
+        if validator_keys & set(closure):
+            self_validating.add(key[1].rsplit(".", 1)[-1])
+
+    # the taint walk stops AT validators: they are the audited
+    # boundary, their internals are the crypto plane
+    taint_stops = frozenset(GRAPH_STOPS | validator_names)
+    parents = graph.reachable(roots, stops=taint_stops)
+    report.tainted += len(parents)
+
+    comments = {rel: comments_by_line(src) for rel, src in files}
+    flagged: dict[str, set[int]] = {rel: set() for rel, _ in files}
+    bflagged: dict[str, set[int]] = {rel: set() for rel, _ in files}
+
+    for key, info in graph.funcs.items():
+        scalls = _sink_calls(info.node, sink_names)
+        allocs = _alloc_sites(info.node)
+        if not scalls and not allocs:
+            continue
+        flagged[info.rel].update(line for line, _ in scalls)
+        bflagged[info.rel].update(line for line, _, _ in allocs)
+        if key not in parents:
+            continue  # pattern present but not wire-reachable
+
+        caller_validates = bool(info.calls & validator_names)
+        for line, sname in scalls:
+            report.sink_sites += 1
+            if sname in self_validating or caller_validates:
+                continue
+            m = _TRUSTED_RE.search(comments[info.rel].get(line, ""))
+            if m:
+                reason = m.group(1).strip()
+                cited = reason.split()[0].rstrip(":—-") if reason else ""
+                if cited not in validator_names:
+                    report.violations.append(
+                        Violation(
+                            info.rel, line,
+                            f"'# trusted: {cited}' does not name a "
+                            "registered validator "
+                            f"({', '.join(sorted(validator_names))})",
+                        )
+                    )
+                else:
+                    report.waivers.append(
+                        Waiver(info.rel, line, f"sink {sname}", reason)
+                    )
+                continue
+            report.violations.append(
+                Violation(
+                    info.rel, line,
+                    f"wire-tainted call to sink {sname}() in "
+                    f"{info.qualname}() "
+                    f"({graph.chain(parents, key)}) with no validator "
+                    "on the path — route through a registered "
+                    "validator or waive with "
+                    "'# trusted: <validator> — <reason>'",
+                )
+            )
+
+        capped = _capped_tokens(info.node)
+        for line, tok, desc in allocs:
+            report.alloc_sites += 1
+            if tok in capped:
+                continue
+            m = _BOUNDED_RE.search(comments[info.rel].get(line, ""))
+            if m:
+                reason = m.group(1).strip()
+                cited = reason.split()[0].rstrip(":—-") if reason else ""
+                if cited not in KNOWN_CAPS:
+                    report.violations.append(
+                        Violation(
+                            info.rel, line,
+                            f"'# bounded: {cited}' does not name a "
+                            "registered cap (KNOWN_CAPS in "
+                            "tools/trustcheck.py)",
+                        )
+                    )
+                else:
+                    report.waivers.append(
+                        Waiver(info.rel, line, desc, reason)
+                    )
+                continue
+            report.violations.append(
+                Violation(
+                    info.rel, line,
+                    f"{desc} in wire-tainted {info.qualname}() "
+                    f"({graph.chain(parents, key)}) with no dominating "
+                    "size cap — a hostile length prefix is an "
+                    "unbounded-allocation DoS; cap the size or waive "
+                    "with '# bounded: <cap> — <reason>'",
+                )
+            )
+
+    for rel, _src in files:
+        check_stale_waivers(
+            comments[rel], flagged[rel], _TRUSTED_RE, rel, report,
+            "trusted",
+        )
+        check_stale_waivers(
+            comments[rel], bflagged[rel], _BOUNDED_RE, rel, report,
+            "bounded",
+        )
+
+
+def check_source(source: str, rel: str) -> Report:
+    """Lint one file's source (fixtures): registries are matched
+    against ``rel``, so a fixture posing as
+    cometbft_tpu/mempool/reactor.py with a ``def receive`` exercises
+    the real root set."""
+    report = Report()
+    _check_files([(rel, source)], report)
+    return report
+
+
+def check_tree(root: str | None = None) -> Report:
+    report = Report()
+    files: list[tuple[str, str]] = []
+    if root is not None:
+        files = list(iter_py_files(root))
+    else:
+        for d in SCAN_DIRS:
+            files.extend(iter_py_files(d))
+    seen = {rel for rel, _ in files}
+    registries = (
+        ("INGRESS_ROOTS", "ingress root", INGRESS_ROOTS),
+        ("VALIDATORS", "validator", VALIDATORS),
+        ("SINKS", "sink", SINKS),
+    )
+    for regname, kind, entries in registries:
+        for rel, qual in entries:
+            if rel not in seen:
+                report.violations.append(
+                    Violation(
+                        rel, 0,
+                        f"{regname} file missing ({kind} {qual})",
+                    )
+                )
+    _check_files(files, report)
+    resolved = CallGraph(files).funcs.keys()
+    for regname, kind, entries in registries:
+        for key in sorted(set(entries)):
+            if key[0] in seen and key not in resolved:
+                report.violations.append(
+                    Violation(
+                        key[0], 0,
+                        f"{kind} {key[1]} no longer resolves — update "
+                        f"{regname} (tools/trustcheck.py) to the "
+                        "renamed boundary entrypoint",
+                    )
+                )
+    return report
+
+
+def _summary(report: Report) -> str:
+    return (
+        f"{report.tainted} functions tainted from {report.roots} "
+        f"ingress roots; {report.sink_sites} sink sites checked "
+        f"against {report.validators} validators / {report.sinks} "
+        f"sinks, {report.alloc_sites} wire allocation sites "
+        f"({len(report.waivers)} audited waivers)"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_main("trustcheck", check_tree, _summary, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
