@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lint lockcheck jitcheck determcheck hotpathcheck envcheck trustcheck determinism-smoke test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate perf-ledger
+.PHONY: test test-slow test-deadlock test-race test-e2e bench bench-all bench-micro native metrics-lint lint lockcheck jitcheck determcheck hotpathcheck envcheck trustcheck determinism-smoke test-jitguard wire-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke wan-smoke byz-smoke churn-smoke perf-gate perf-ledger
 
 # default gate: soak-tier tests (@pytest.mark.slow — the 10k-sig mesh
 # torture, chunk-variant compile matrix, 150-key rotation build,
@@ -18,7 +18,7 @@ PY ?= python
 # tests/test_jitcheck.py + tests/test_determcheck.py +
 # tests/test_hotpathcheck.py + tests/test_envcheck.py +
 # tests/test_trustcheck.py).
-test: metrics-lint determinism-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke perf-gate
+test: metrics-lint determinism-smoke flight-smoke mesh-smoke health-smoke pipeline-smoke chaos-smoke ingest-smoke light-smoke route-smoke fleet-smoke attr-smoke wan-smoke byz-smoke churn-smoke perf-gate
 	$(PY) -m pytest tests/ -x -q
 
 # everything, including the soak tier (~1 h single-core)
@@ -257,6 +257,24 @@ route-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu CMT_TPU_FLEET_LEDGER=1 $(PY) -m pytest \
 		tests/test_fleet.py -k "FleetSmoke" -q
+
+# scenario fleet (ISSUE 20): the hostile-condition drives, each
+# landing its perfdiff-gated ledger row (CMT_TPU_FLEET_LEDGER=1 so
+# the real ledger gets the point; bare tier-1 runs write a scratch
+# copy).  Tier-1 itself keeps only the lite 4-node wan drive; these
+# targets run the full 8-node matrix under the slow tier.
+wan-smoke:
+	JAX_PLATFORMS=cpu CMT_TPU_SLOW_TESTS=1 CMT_TPU_FLEET_LEDGER=1 \
+		$(PY) -m pytest tests/test_scenarios.py -k "wan_8node" -q
+
+byz-smoke:
+	JAX_PLATFORMS=cpu CMT_TPU_SLOW_TESTS=1 CMT_TPU_FLEET_LEDGER=1 \
+		$(PY) -m pytest tests/test_scenarios.py \
+		-k "Byzantine" -q
+
+churn-smoke:
+	JAX_PLATFORMS=cpu CMT_TPU_SLOW_TESTS=1 CMT_TPU_FLEET_LEDGER=1 \
+		$(PY) -m pytest tests/test_scenarios.py -k "Churn" -q
 
 # attribution smoke: the critical-path proof (ISSUE 16) — a
 # single-validator node under the always-on sampling profiler must

@@ -576,6 +576,15 @@ class ConsensusReactor(Reactor):
 
     def on_start(self) -> None:
         self._subscribe_to_broadcast_events()
+        if self.switch is not None:
+            # scenario-fleet adversary: the equivocator's raw vote-
+            # channel broadcast (its conflicting vote never enters its
+            # own vote set, so normal gossip cannot carry it)
+            from cometbft_tpu.consensus import byz as _byz
+
+            _byz.BYZ.register_broadcast(
+                lambda raw: self.switch.broadcast(VOTE_CHANNEL, raw)
+            )
         if not self.wait_sync():
             if not self.consensus.is_running():
                 self.consensus.start()
@@ -835,8 +844,11 @@ class ConsensusReactor(Reactor):
             if ok:
                 part = rs_parts.get_part(index)
                 if part is not None:
+                    from cometbft_tpu.consensus import byz as _byz
+
                     msg = BlockPartMessage(
-                        height=rs["height"], round=rs["round"], part=part
+                        height=rs["height"], round=rs["round"],
+                        part=_byz.BYZ.maybe_corrupt_part(part),
                     )
                     if peer.send(
                         DATA_CHANNEL,

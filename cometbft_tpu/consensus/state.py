@@ -22,6 +22,7 @@ from cometbft_tpu.utils import sync as cmtsync
 from dataclasses import dataclass, replace
 
 from cometbft_tpu.config import ConsensusConfig
+from cometbft_tpu.consensus import byz as _byz
 from cometbft_tpu.consensus.height_vote_set import HeightVoteSet
 from cometbft_tpu.consensus.messages import (
     BlockPartMessage,
@@ -1542,3 +1543,9 @@ class ConsensusState(BaseService):
         vote = self._sign_vote(vote_type, block)
         if vote is not None:
             self._send_internal(VoteMessage(vote))
+            # scenario-fleet adversary (consensus/byz.py): a no-op
+            # attribute test unless CMT_TPU_BYZ=equivocate armed this
+            # node at assembly
+            _byz.BYZ.maybe_equivocate(
+                vote, self.priv_validator, self.state.chain_id
+            )

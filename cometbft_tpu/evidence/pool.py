@@ -52,6 +52,13 @@ def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
     return prefix + height.to_bytes(8, "big") + ev_hash
 
 
+def _ev_type(ev) -> str:
+    """The ``{type}`` label of evidence_pool_detected_total."""
+    if isinstance(ev, LightClientAttackEvidence):
+        return "light_client_attack"
+    return "duplicate_vote"
+
+
 @cmtsync.guarded
 class Pool:
     """(internal/evidence/pool.go:24 Pool)"""
@@ -342,6 +349,7 @@ class Pool:
             self._add_pending_locked(ev)
             self._observe_pool_locked()
             self._new_evidence_cond.notify_all()
+        self.metrics.pool_detected_total.labels(type=_ev_type(ev)).inc()
         FLIGHT.record(
             "evidence_added", height=ev.height,
             hash=ev.hash().hex()[:12],
@@ -399,6 +407,8 @@ class Pool:
         conflicts, prune expired."""
         with self._mtx:
             for ev in ev_list:
+                if not self._is_committed(ev):
+                    self.metrics.committed_total.inc()
                 self._mark_committed_locked(ev)
         self._process_consensus_buffer(state)
         self._prune_expired(state)
@@ -437,6 +447,9 @@ class Pool:
                 # _observe_pool_locked once after the buffer drains
                 self._add_pending_locked(ev)
                 self._new_evidence_cond.notify_all()
+            self.metrics.pool_detected_total.labels(
+                type="duplicate_vote"
+            ).inc()
             self.logger.info(
                 "duplicate vote evidence created",
                 height=ev.height,

@@ -389,6 +389,7 @@ class StateMetrics:
             self.consensus_param_updates = _NOP
             self.validator_set_updates = _NOP
             self.pruned_blocks = _NOP
+            self.process_proposal_total = _NOP
             return
         s = "state"
         self.block_processing_time = reg.histogram(
@@ -406,6 +407,14 @@ class StateMetrics:
         self.validator_set_updates = reg.counter(
             s, "validator_set_updates",
             "Number of validator set updates by the app.",
+        )
+        self.process_proposal_total = reg.counter(
+            s, "process_proposal_total",
+            "ProcessProposal verdicts by result (accept, reject) — a "
+            "nonzero reject count on an honest node is the observable "
+            "proof that a forged proposal was refused before any "
+            "prevote endorsed it.",
+            labels=("result",),
         )
 
 
@@ -589,6 +598,8 @@ class EvidenceMetrics:
         if reg is None:
             self.pool_size = _NOP
             self.oldest_age_seconds = _NOP
+            self.pool_detected_total = _NOP
+            self.committed_total = _NOP
             return
         s = "evidence"
         self.pool_size = reg.gauge(
@@ -599,6 +610,20 @@ class EvidenceMetrics:
             "Age of the oldest pending evidence (0 when the pool is "
             "empty) — evidence aging toward the expiry window without "
             "being committed means proposers are not reaping it.",
+        )
+        self.pool_detected_total = reg.counter(
+            s, "pool_detected_total",
+            "Evidence items admitted to the pending pool, by type "
+            "(duplicate_vote, light_client_attack) — DETECTION; "
+            "pool_size alone cannot distinguish detection from "
+            "commitment.",
+            labels=("type",),
+        )
+        self.committed_total = reg.counter(
+            s, "committed_total",
+            "Evidence items marked committed because a block carrying "
+            "them was applied — the byzantine drive's proof that "
+            "detected misbehavior actually landed on chain.",
         )
 
 
@@ -1099,6 +1124,61 @@ def install_fleet_metrics(metrics: FleetMetrics | None) -> None:
     _FLEET = metrics if metrics is not None else FleetMetrics(None)
 
 
+class NetemMetrics:
+    """WAN-emulation plane (p2p/conn/netem.py) — what the injected
+    link is doing to each peer, per frame.  No metricsgen analog: the
+    reference delegates hostile-network testing to external tooling
+    (tc/netem, docker compose e2e); here the emulation runs inside
+    the frame pump, so its cost is a first-class metrics family with
+    per-peer child retirement like P2PMetrics."""
+
+    def __init__(self, reg: Registry | None = None):
+        if reg is None:
+            self.injected_delay_seconds = _NOP
+            self.dropped_frames_total = _NOP
+            self.active_profile = _NOP
+            return
+        s = "netem"
+        self.injected_delay_seconds = reg.histogram(
+            s, "injected_delay_seconds",
+            "Wall injected into one send frame (delay + jitter + loss "
+            "penalty + rate reservation) — the emulated-WAN share of "
+            "gossip wall; compare against p2p_gossip_hop_seconds for "
+            "the intrinsic share.",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.2, 0.5,
+                     1.0, 2.5),
+            labels=("peer_id",),
+        )
+        self.dropped_frames_total = reg.counter(
+            s, "dropped_frames_total",
+            "Frames the loss draw hit; each paid a TCP retransmit "
+            "penalty instead of vanishing (the transport is a "
+            "reliable stream — see p2p/conn/netem.py).",
+            labels=("peer_id",),
+        )
+        self.active_profile = reg.gauge(
+            s, "active_profile",
+            "Plan entries active on this peer's emulated link at the "
+            "last frame send (0 = inside no window, passthrough).",
+            labels=("peer_id",),
+        )
+
+
+_NETEM_SINK = NetemMetrics(None)
+
+
+def netem_metrics() -> NetemMetrics:
+    """The currently installed netem-plane sink (never None)."""
+    return _NETEM_SINK
+
+
+def install_netem_metrics(metrics: NetemMetrics | None) -> None:
+    """Install ``metrics`` as the process-wide netem sink (None
+    resets to the no-op)."""
+    global _NETEM_SINK
+    _NETEM_SINK = metrics if metrics is not None else NetemMetrics(None)
+
+
 class AttributionMetrics:
     """Attribution plane (utils/critpath.py) — a committed height's
     wall decomposed into the fixed stage taxonomy.  No metricsgen
@@ -1160,6 +1240,7 @@ class NodeMetrics:
         self.consensus = ConsensusMetrics(reg)
         self.mempool = MempoolMetrics(reg)
         self.p2p = P2PMetrics(reg)
+        self.netem = NetemMetrics(reg)
         self.state = StateMetrics(reg)
         self.crypto = CryptoMetrics(reg)
         self.health = HealthMetrics(reg)
@@ -1187,6 +1268,7 @@ __all__ = [
     "HealthMetrics",
     "LightMetrics",
     "MempoolMetrics",
+    "NetemMetrics",
     "NodeMetrics",
     "P2PMetrics",
     "ProxyMetrics",
@@ -1204,7 +1286,9 @@ __all__ = [
     "install_fleet_metrics",
     "install_health_metrics",
     "install_light_metrics",
+    "install_netem_metrics",
     "install_p2p_metrics",
     "light_metrics",
+    "netem_metrics",
     "p2p_metrics",
 ]

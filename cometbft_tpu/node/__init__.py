@@ -143,6 +143,7 @@ class Node(BaseService):
             install_fleet_metrics,
             install_health_metrics,
             install_light_metrics,
+            install_netem_metrics,
             install_p2p_metrics,
         )
         from cometbft_tpu.utils.metrics import MetricsServer, Registry
@@ -163,6 +164,9 @@ class Node(BaseService):
             # analogous p2p sink.
             install_crypto_metrics(self.metrics.crypto)
             install_p2p_metrics(self.metrics.p2p)
+            # the WAN-emulation plane (p2p/conn/netem.py stages are
+            # constructed per peer with no node handle) — same sink
+            install_netem_metrics(self.metrics.netem)
             # the device-health plane (watchdog, prober, utilization —
             # crypto/health.py) shares the singleton-sink pattern
             install_health_metrics(self.metrics.health)
@@ -674,6 +678,40 @@ class Node(BaseService):
                 "CHAOS MODE ARMED — seeded faults will be injected "
                 "at the crypto dispatch seam (CMT_TPU_CHAOS_PLAN)",
                 plan=_dispatch.CHAOS.snapshot()["windows"],
+            )
+        # WAN emulation (CMT_TPU_NETEM): parse fail-loudly at assembly
+        # and pin the window epoch — a node emulating a hostile link
+        # must SAY so before the first injected hold
+        from cometbft_tpu.p2p.conn import netem as _netem
+
+        _netem.NETEM.reload()
+        if _netem.NETEM.enabled():
+            _netem.NETEM.start()
+            self.logger.error(
+                "NETEM ARMED — WAN conditions will be injected on "
+                "every send frame (CMT_TPU_NETEM)",
+                plan=_netem.NETEM.plan().describe(),
+            )
+        # byzantine adversary (CMT_TPU_BYZ): validated at assembly,
+        # armed loudly — a node about to misbehave must confess first
+        from cometbft_tpu.consensus import byz as _byzmod
+
+        _byzmod.BYZ.reload()
+        if _byzmod.BYZ.mode is not None:
+            self.logger.error(
+                "BYZANTINE MODE ARMED — this node will misbehave "
+                "(CMT_TPU_BYZ)",
+                mode=_byzmod.BYZ.mode,
+            )
+        # scenario label (CMT_TPU_SCENARIO): validated here so a bad
+        # label fails the node, not the first /debug/fleet request
+        from cometbft_tpu.utils.env import name_from_env as _name_env
+
+        _scenario = _name_env("CMT_TPU_SCENARIO", None)
+        if _scenario:
+            self.logger.info(
+                "scenario labeled — /debug/fleet will carry it",
+                scenario=_scenario,
             )
         # verify-ahead queue FIRST: the reactors that feed it
         # (consensus add_vote, blocksync prefetch) start below, and

@@ -279,6 +279,12 @@ class MConnection(BaseService):
             peer_id=peer_id
         )
         self.last_error: str | None = None
+        # WAN emulation stage (p2p/conn/netem.py) — None when
+        # CMT_TPU_NETEM is unset, and then _flush pays exactly one
+        # `is None` test per frame (the zero-cost-off contract)
+        from cometbft_tpu.p2p.conn import netem as _netem
+
+        self._netem = _netem.NETEM.stage_for(peer_id)
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
         self._send_thread: threading.Thread | None = None
@@ -312,6 +318,8 @@ class MConnection(BaseService):
         for ch in self.channels.values():
             ch.m_send_queue_size.set(0)
             ch.m_send_queue_bytes.set(0)
+        if self._netem is not None:
+            self._netem.retire()
         self.conn.close()
 
     def _stop_for_error(self, err: Exception) -> None:
@@ -434,6 +442,8 @@ class MConnection(BaseService):
 
     def _flush(self, buf: bytearray) -> None:
         if buf:
+            if self._netem is not None:
+                self._netem.hold(len(buf))
             with TRACER.span("frame_pump", cat="p2p", bytes=len(buf)):
                 self.conn.write(bytes(buf))
 

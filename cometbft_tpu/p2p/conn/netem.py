@@ -1,0 +1,378 @@
+"""In-process WAN emulation at the MConnection frame pump (ISSUE 20).
+
+Every fleet number the ledger keeps was measured on a loopback
+localnet — the friendliest network that exists.  This module injects
+hostile-link conditions (latency, jitter, loss, bandwidth cap) into
+the SEND side of every MConnection, at the exact seam where framed
+packets hit the socket (``connection._flush``), with no root, no
+``tc``, and no extra threads: the send routine itself sleeps the
+injected wall inside a ``p2p/netem_hold`` span, so stitched
+cross-node traces separate *injected* wall from *intrinsic* wall and
+the PR 2 send-queue/flowrate telemetry measures the backpressure the
+emulated link creates.
+
+Plan grammar (``CMT_TPU_NETEM``), mirroring the seeded chaos-plan
+grammar of crypto/dispatch.py — entries split on ``;``, each entry an
+optionally windowed profile::
+
+    delay=BASE~JITTER[@START-END]   propagation delay ms, +/- jitter ms
+    delay=BASE[@START-END]          no jitter
+    loss=P[@START-END]              loss probability in [0, 1)
+    rate=BYTES[@START-END]          bandwidth cap, bytes/second
+    seed=N                          RNG seed (jitter + loss draws)
+
+Windows are seconds relative to the epoch pinned when the plan is
+armed (``NETEM.start()``, node ``_start_services``); an entry with no
+window is always active.  Example — a 100 ms +/- 20 ms link with 1 %
+loss for the first ten minutes::
+
+    CMT_TPU_NETEM="delay=100~20;loss=0.01;seed=7@0-600"
+
+Semantics, stated honestly:
+
+- **Delay/jitter** hold the send routine before the socket write.
+  Because MConnection frames are FIFO on one TCP stream, jitter never
+  reorders (real netem can); the jitter draw is per-frame.
+- **Loss** is TCP-faithful: the transport is a *reliable stream*, so
+  a vanished frame would corrupt channel reassembly — something real
+  TCP never shows an application.  A loss draw instead charges the
+  frame a retransmit penalty (one RTO: ``max(0.2 s, 2 x base
+  delay)``) and increments ``netem_dropped_frames_total`` — the
+  frames that "dropped" on the emulated wire and were re-sent.
+- **Rate** is a leaky bucket: each frame reserves ``bytes/rate``
+  seconds of link time behind the previous frame's reservation.
+- The hold serializes on the send routine, emulating a link whose
+  in-flight window is one frame; per-connection throughput is
+  bounded at one frame per injected delay.  That is the hostile
+  regime the wan scenario *wants* to measure.
+
+Zero-cost off: with ``CMT_TPU_NETEM`` unset, MConnection caches
+``_netem = None`` at construction and ``_flush`` pays exactly one
+``is None`` test per flush — byte-identical output, no per-frame
+allocations (tests/test_netem.py proves both).
+
+Same seed => identical injected schedule: every stage draws from
+``random.Random(f"{seed}:{peer_id}")``, so a reproduction run with
+the same plan, peers, and frame sequence injects the same holds.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from cometbft_tpu.utils import sync as cmtsync
+
+__all__ = [
+    "NETEM",
+    "NetemError",
+    "NetemPlan",
+    "NetemStage",
+    "netem_enabled",
+]
+
+_ENV = "CMT_TPU_NETEM"
+
+#: TCP retransmit-timeout floor charged to a "lost" frame (RFC 6298
+#: minimum RTO is 1 s; Linux's effective floor is 200 ms — we use the
+#: observable Linux behaviour)
+_RTO_MIN_S = 0.2
+
+
+class NetemError(ValueError):
+    """Malformed ``CMT_TPU_NETEM`` — always names the variable."""
+
+
+@dataclass(frozen=True)
+class _Entry:
+    kind: str  # delay | loss | rate
+    p1: float  # delay: base ms | loss: probability | rate: bytes/sec
+    p2: float  # delay: jitter ms | otherwise 0.0
+    start: float  # window start, seconds from epoch
+    end: float  # window end (inf = forever)
+
+    def active(self, t: float) -> bool:
+        return self.start <= t < self.end
+
+
+def _parse_window(spec: str, raw: str) -> tuple[str, float, float]:
+    """Split ``value[@A-B]`` -> (value, start, end)."""
+    if "@" not in spec:
+        return spec, 0.0, float("inf")
+    val, _, win = spec.partition("@")
+    a, sep, b = win.partition("-")
+    if not sep:
+        raise NetemError(
+            f"{_ENV}: window {win!r} in {raw!r} must be START-END seconds"
+        )
+    try:
+        lo, hi = float(a), float(b)
+    except ValueError:
+        raise NetemError(
+            f"{_ENV}: non-numeric window {win!r} in {raw!r}"
+        ) from None
+    if lo < 0 or hi <= lo:
+        raise NetemError(
+            f"{_ENV}: window {win!r} in {raw!r} needs 0 <= START < END"
+        )
+    return val, lo, hi
+
+
+@dataclass(frozen=True)
+class NetemPlan:
+    """Parsed, validated emulation plan (immutable after parse)."""
+
+    entries: tuple[_Entry, ...]
+    seed: int
+
+    @classmethod
+    def parse(cls, text: str) -> "NetemPlan":
+        entries: list[_Entry] = []
+        seed = 0
+        for raw in text.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            kind, sep, spec = raw.partition("=")
+            kind = kind.strip()
+            if not sep or not spec.strip():
+                raise NetemError(
+                    f"{_ENV}: entry {raw!r} must be kind=value"
+                )
+            spec = spec.strip()
+            if kind == "seed":
+                try:
+                    seed = int(spec)
+                except ValueError:
+                    raise NetemError(
+                        f"{_ENV}: seed {spec!r} must be an integer"
+                    ) from None
+                continue
+            if kind not in ("delay", "loss", "rate"):
+                raise NetemError(
+                    f"{_ENV}: unknown kind {kind!r} in {raw!r} "
+                    "(want delay|loss|rate|seed)"
+                )
+            val, lo, hi = _parse_window(spec, raw)
+            if kind == "delay":
+                base_s, _, jit_s = val.partition("~")
+                try:
+                    base = float(base_s)
+                    jitter = float(jit_s) if jit_s else 0.0
+                except ValueError:
+                    raise NetemError(
+                        f"{_ENV}: delay {val!r} must be BASE[~JITTER] ms"
+                    ) from None
+                if base < 0 or jitter < 0:
+                    raise NetemError(
+                        f"{_ENV}: delay {val!r} must be >= 0 ms"
+                    )
+                entries.append(_Entry("delay", base, jitter, lo, hi))
+            elif kind == "loss":
+                try:
+                    p = float(val)
+                except ValueError:
+                    raise NetemError(
+                        f"{_ENV}: loss {val!r} must be a probability"
+                    ) from None
+                if not 0.0 <= p < 1.0:
+                    raise NetemError(
+                        f"{_ENV}: loss {val!r} must be in [0, 1)"
+                    )
+                entries.append(_Entry("loss", p, 0.0, lo, hi))
+            else:  # rate
+                try:
+                    r = float(val)
+                except ValueError:
+                    raise NetemError(
+                        f"{_ENV}: rate {val!r} must be bytes/second"
+                    ) from None
+                if r <= 0:
+                    raise NetemError(
+                        f"{_ENV}: rate {val!r} must be > 0 bytes/second"
+                    )
+                entries.append(_Entry("rate", r, 0.0, lo, hi))
+        if not entries:
+            raise NetemError(
+                f"{_ENV}: plan {text!r} has no delay/loss/rate entries"
+            )
+        return cls(entries=tuple(entries), seed=seed)
+
+    def params_at(
+        self, t: float
+    ) -> tuple[float, float, float, float, int]:
+        """(delay_ms, jitter_ms, loss_p, rate_bps, active_count) at
+        plan-relative time ``t`` (later entries of a kind win, like
+        the chaos grammar's fault windows)."""
+        delay = jitter = loss = 0.0
+        rate = 0.0  # 0 = uncapped
+        n = 0
+        for e in self.entries:
+            if not e.active(t):
+                continue
+            n += 1
+            if e.kind == "delay":
+                delay, jitter = e.p1, e.p2
+            elif e.kind == "loss":
+                loss = e.p1
+            else:
+                rate = e.p1
+        return delay, jitter, loss, rate, n
+
+    def describe(self) -> str:
+        parts = [f"seed={self.seed}"]
+        for e in self.entries:
+            win = (
+                ""
+                if e.end == float("inf") and e.start == 0.0
+                else f"@{e.start:g}-{e.end:g}"
+            )
+            if e.kind == "delay":
+                parts.append(f"delay={e.p1:g}~{e.p2:g}ms{win}")
+            elif e.kind == "loss":
+                parts.append(f"loss={e.p1:g}{win}")
+            else:
+                parts.append(f"rate={e.p1:g}B/s{win}")
+        return ";".join(parts)
+
+
+class NetemStage:
+    """Per-peer send-side emulation stage.  Owned by exactly one
+    MConnection send routine — ``hold()`` runs in (and sleeps) that
+    thread, which is the whole point: the hold IS the link."""
+
+    def __init__(self, plan: NetemPlan, peer_id: str, epoch: float):
+        import random
+
+        self._plan = plan
+        self._peer = peer_id or "?"
+        self._epoch = epoch
+        # seeded per (plan seed, peer): same seed => same schedule
+        self._rng = random.Random(f"{plan.seed}:{self._peer}")
+        self._link_free_at = 0.0  # leaky-bucket reservation (monotonic)
+        from cometbft_tpu.metrics import netem_metrics
+
+        m = netem_metrics()
+        self._m_delay = m.injected_delay_seconds.labels(
+            peer_id=self._peer
+        )
+        self._m_dropped = m.dropped_frames_total.labels(
+            peer_id=self._peer
+        )
+        self._m_profile = m.active_profile.labels(peer_id=self._peer)
+
+    def hold_s(self, nbytes: int, now: float) -> tuple[float, bool]:
+        """Pure schedule: injected seconds for an ``nbytes`` frame
+        sent at monotonic ``now``, plus whether the loss draw fired.
+        Split from :meth:`hold` so determinism is testable without
+        sleeping."""
+        t = now - self._epoch
+        delay_ms, jitter_ms, loss_p, rate, n = self._plan.params_at(t)
+        self._m_profile.set(float(n))
+        if n == 0:
+            return 0.0, False
+        h = delay_ms / 1e3
+        if jitter_ms:
+            h += self._rng.uniform(-jitter_ms, jitter_ms) / 1e3
+        lost = loss_p > 0.0 and self._rng.random() < loss_p
+        if lost:
+            # retransmit penalty, not a vanished frame (module doc)
+            h += max(_RTO_MIN_S, 2.0 * delay_ms / 1e3)
+        if rate > 0.0:
+            busy_until = max(self._link_free_at, now)
+            self._link_free_at = busy_until + nbytes / rate
+            h += self._link_free_at - now
+        return max(h, 0.0), lost
+
+    def hold(self, nbytes: int) -> None:
+        """Sleep the injected wall for one frame, inside the
+        ``p2p/netem_hold`` span the stitched trace separates from
+        intrinsic gossip wall."""
+        h, lost = self.hold_s(nbytes, time.monotonic())
+        if lost:
+            self._m_dropped.inc()
+        if h <= 0.0:
+            return
+        from cometbft_tpu.utils.trace import TRACER
+
+        with TRACER.span(
+            "p2p/netem_hold", cat="p2p", peer=self._peer,
+            bytes=nbytes, lost=int(lost),
+        ):
+            time.sleep(h)
+        self._m_delay.observe(h)
+
+    def retire(self) -> None:
+        """Peer departed: drop the per-peer metric children so the
+        exposition stops carrying a dead link (P2PMetrics idiom)."""
+        from cometbft_tpu.metrics import netem_metrics
+
+        m = netem_metrics()
+        m.injected_delay_seconds.remove(peer_id=self._peer)
+        m.dropped_frames_total.remove(peer_id=self._peer)
+        m.active_profile.remove(peer_id=self._peer)
+
+
+class _Netem:
+    """Process-wide plan singleton (crypto/dispatch.Chaos shape):
+    ``reload()`` re-reads the env fail-loudly, ``enabled()`` lazily
+    parses once, ``start()`` pins the window epoch at arming."""
+
+    def __init__(self):
+        self._mtx = cmtsync.Mutex()
+        self._loaded = False
+        self._plan: NetemPlan | None = None
+        self._epoch: float | None = None
+
+    def reload(self) -> None:
+        raw = os.environ.get("CMT_TPU_NETEM", "").strip()  # env ok: free-form plan — NetemPlan.parse validates fail-loudly naming the var
+
+        with self._mtx:
+            self._loaded = True
+            self._plan = NetemPlan.parse(raw) if raw else None
+
+    def enabled(self) -> bool:
+        with self._mtx:
+            loaded = self._loaded
+        if not loaded:
+            self.reload()
+        with self._mtx:
+            return self._plan is not None
+
+    def start(self) -> None:
+        """Pin the window epoch (node ``_start_services`` arming)."""
+        with self._mtx:
+            if self._epoch is None:
+                self._epoch = time.monotonic()
+
+    def plan(self) -> NetemPlan | None:
+        with self._mtx:
+            return self._plan
+
+    def stage_for(self, peer_id: str) -> NetemStage | None:
+        """A fresh per-peer stage, or None when emulation is off —
+        MConnection caches the None and pays one ``is`` test per
+        flush forever after."""
+        if not self.enabled():
+            return None
+        with self._mtx:
+            plan = self._plan
+            if self._epoch is None:
+                self._epoch = time.monotonic()
+            epoch = self._epoch
+        return NetemStage(plan, peer_id, epoch)
+
+    def _reset_for_tests(self) -> None:
+        with self._mtx:
+            self._loaded = False
+            self._plan = None
+            self._epoch = None
+
+
+NETEM = _Netem()
+
+
+def netem_enabled() -> bool:
+    """Convenience for assembly-time arming checks."""
+    return NETEM.enabled()

@@ -451,9 +451,16 @@ class BlockExecutor:
             raise BlockExecutionError(
                 f"PrepareProposal returned {total} tx bytes > limit {data_limit}"
             )
+        # scenario-fleet adversary (consensus/byz.py): identity unless
+        # CMT_TPU_BYZ=forge_stx armed this node — then the block is
+        # built (and hashed) over a forged envelope honest
+        # process_proposal must refuse
+        from cometbft_tpu.consensus import byz as _byz
+
+        block_txs = _byz.BYZ.maybe_forge_stx(tuple(resp.txs))
         return state.make_block(
             height,
-            tuple(resp.txs),
+            block_txs,
             last_commit if last_commit is not None else Commit(),
             tuple(evidence),
             proposer_address,
@@ -476,7 +483,11 @@ class BlockExecutor:
             next_validators_hash=block.header.next_validators_hash,
             proposer_address=block.header.proposer_address,
         )
-        return self.proxy_app.process_proposal(req).is_accepted
+        accepted = self.proxy_app.process_proposal(req).is_accepted
+        self.metrics.process_proposal_total.labels(
+            result="accept" if accepted else "reject"
+        ).inc()
+        return accepted
 
     # -- apply path ------------------------------------------------------
 

@@ -79,6 +79,9 @@ _SPAN_QUORUM_PREVOTE = "height/quorum_prevote"
 _SPAN_QUORUM_PRECOMMIT = "height/quorum_precommit"
 _SPAN_VERIFY_PREP = "verify_queue/prepare"
 _SPAN_VERIFY_LAUNCH = "verify_queue/launch"
+#: WAN-emulation hold (p2p/conn/netem.py) — injected, not intrinsic,
+#: wall; frames are multiplexed so the span carries no height tag
+_SPAN_NETEM = "p2p/netem_hold"
 
 
 def _clip(start: float, end: float, lo: float, hi: float) -> float:
@@ -293,6 +296,7 @@ def decompose_stitched(
     gating = None  # (scrape, local t0..t1 seconds, shift to wall)
     prop_latest = None
     qpc_latest = None
+    netem_holds: list[tuple[float, float]] = []
     for s in scrapes:
         epoch = s.wall_epoch
         if epoch is None:
@@ -302,6 +306,9 @@ def decompose_stitched(
             name = ev.get("name")
             ts = float(ev.get("ts", 0.0)) / 1e6
             dur = float(ev.get("dur", 0.0)) / 1e6
+            if name == _SPAN_NETEM:
+                netem_holds.append((shift + ts, shift + ts + dur))
+                continue
             if name in (_SPAN_ORIGIN_WALL, _SPAN_HOP, _SPAN_PROPOSAL):
                 if not _is_height(ev, height):
                     continue
@@ -366,11 +373,18 @@ def decompose_stitched(
         t0, t1, first_send, prop_latest, qpc_latest,
         commit_durs, verify_prep, verify_launch,
     )
+    # injected (netem) wall overlapping this height's window: the
+    # seconds during which at least one emulated link was holding a
+    # frame — read gossip_hop minus this for the INTRINSIC hop wall
+    # (docs/observability.md "Scenario plane").  Kept beside, not
+    # inside, the stage taxonomy: stages must keep summing to wall_s.
+    injected = _union_len(netem_holds, t0, t1)
     return {
         "height": int(height),
         "wall_s": round(max(t1 - t0, 0.0), 6),
         "gating_node": g.name,
         "stages": {s: round(v, 6) for s, v in stages.items()},
+        "injected_s": round(injected, 6),
     }
 
 

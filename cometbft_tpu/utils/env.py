@@ -25,6 +25,7 @@ __all__ = [
     "float_from_env",
     "flag_from_env",
     "choice_from_env",
+    "name_from_env",
 ]
 
 
@@ -86,5 +87,24 @@ def choice_from_env(var: str, default: str, choices: tuple[str, ...]) -> str:
     if raw not in choices:
         raise ValueError(
             f"{var} must be one of {sorted(choices)}, got {raw!r}"
+        )
+    return raw
+
+
+def name_from_env(var: str, default: str | None = None) -> str | None:
+    """A validated free-form label knob (e.g. ``CMT_TPU_SCENARIO``):
+    unset/empty -> default; otherwise a short ``[a-z0-9_-]`` token —
+    the value rides metrics labels and JSON payloads, so an arbitrary
+    string is an injection surface, not a name."""
+    raw = os.environ.get(var)
+    if raw is None or raw.strip() == "":
+        return default
+    raw = raw.strip()
+    if len(raw) > 64 or not all(
+        c.isascii() and (c.isalnum() or c in "_-") for c in raw
+    ):
+        raise ValueError(
+            f"{var} must be a short [A-Za-z0-9_-] label (<= 64 chars), "
+            f"got {raw!r}"
         )
     return raw

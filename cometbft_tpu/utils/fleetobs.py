@@ -204,7 +204,13 @@ def scrape_fleet(
 ) -> list[NodeScrape]:
     """Scrape every target CONCURRENTLY (one dead peer's connect
     timeout must cost the fleet view max(timeout), not N x timeout —
-    /debug/fleet serves from a request handler)."""
+    /debug/fleet serves from a request handler).
+
+    Concurrency is BOUNDED by ``CMT_TPU_FLEET_SCRAPE_POOL`` (default
+    8): one thread per target was fine at 4 nodes and is a thread
+    burst at 32 — the scenario fleet scales node-count, the pool does
+    not.  Workers are named ``fleet-scrape*`` and joined before
+    return, so the thread-leak gate can hold this seam to zero."""
     out: list[NodeScrape] = []
     if include_self:
         out.append(self_scrape(self_name, self_registry))
@@ -212,13 +218,18 @@ def scrape_fleet(
         return out
     from concurrent.futures import ThreadPoolExecutor
 
+    from cometbft_tpu.utils.env import int_from_env
+
+    bound = int_from_env("CMT_TPU_FLEET_SCRAPE_POOL", 8, minimum=1)
+
     def one(i_t):
         i, t = i_t
         n = names[i] if names and i < len(names) else None
         return scrape_node(t, name=n, timeout=timeout)
 
     with ThreadPoolExecutor(
-        max_workers=min(8, len(targets)), thread_name_prefix="fleet-scrape"
+        max_workers=min(bound, len(targets)),
+        thread_name_prefix="fleet-scrape",
     ) as pool:
         out.extend(pool.map(one, enumerate(targets)))
     return out
@@ -619,6 +630,12 @@ def fleet_payload(
         ),
     }
     payload["clock_corrections"] = corrections
+    # scenario plane: the active scenario this node was launched
+    # under (wan/byzantine/churn runner sets CMT_TPU_SCENARIO), so a
+    # /debug/fleet reader knows WHICH conditions produced the numbers
+    from cometbft_tpu.utils.env import name_from_env
+
+    payload["scenario"] = name_from_env("CMT_TPU_SCENARIO", None)
     # attribution plane: each committed height's wall decomposed into
     # the critpath stage taxonomy on the same corrected axis (the
     # stage budget an operator reads AFTER the p95 row says "slow")

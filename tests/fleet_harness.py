@@ -1,0 +1,185 @@
+"""Shared subprocess-localnet harness (ISSUE 15 fleet smoke, ISSUE 20
+scenario fleet).
+
+``FleetNet`` spins N real ``python -m cometbft_tpu start`` node
+processes from one ``testnet`` CLI init, with per-node Prometheus
+metrics servers — the machinery the 4-node fleet smoke proved out,
+parameterized so the scenario runner can scale node-count (8 today,
+a parameter toward 32), move port ranges (scenarios must not collide
+with the fleet smoke's 27470/27490 block), inject per-node env
+(CMT_TPU_NETEM / CMT_TPU_BYZ / CMT_TPU_SCENARIO), and rewrite
+per-node config (WAN runs need WAN consensus timeouts) — all without
+a second copy of the subprocess plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# deadlock-lane scaling, same contract as test_e2e_perturb
+DEADLINE_SCALE = 5.0 if os.environ.get("CMT_TPU_DEADLOCK") else 1.0
+
+
+def rpc(port: int, method: str, timeout: float = 3.0, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read())
+    if body.get("error"):
+        raise RuntimeError(body["error"])
+    return body["result"]
+
+
+def node_height(port: int) -> int:
+    return int(rpc(port, "status")["sync_info"]["latest_block_height"])
+
+
+def wait_heights(ports, target: int, timeout: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout * DEADLINE_SCALE
+    pending = set(ports)
+    while pending:
+        for p in list(pending):
+            try:
+                if node_height(p) >= target:
+                    pending.discard(p)
+            except Exception:
+                pass
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"nodes on ports {sorted(pending)} never reached "
+                f"height {target}"
+            )
+        time.sleep(0.3)
+
+
+class FleetNet:
+    """N-node subprocess localnet with per-node metrics servers.
+
+    ``node_env(i) -> dict`` adds per-node environment at start (the
+    scenario runner's netem/byz/scenario knobs); ``config_hook(i,
+    cfg)`` mutates each node's loaded Config after ``testnet`` init
+    and before the first start (WAN timeouts, pex pinning).
+    """
+
+    def __init__(
+        self,
+        root: str,
+        n_nodes: int = 4,
+        base_port: int = 27470,
+        metrics_port: int = 27490,
+        chain_id: str = "fleet-chain",
+        node_env=None,
+        config_hook=None,
+    ):
+        self.root = root
+        self.n_nodes = n_nodes
+        self.base_port = base_port
+        self.metrics_port = metrics_port
+        self.chain_id = chain_id
+        self.node_env = node_env
+        self.config_hook = config_hook
+        self.procs: dict[int, subprocess.Popen] = {}
+        self.env = dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+        )
+
+    # -- addressing ------------------------------------------------------
+
+    def rpc_port(self, i: int) -> int:
+        return self.base_port + 2 * i + 1
+
+    def rpc_ports(self) -> list[int]:
+        return [self.rpc_port(i) for i in range(self.n_nodes)]
+
+    def metrics_addr(self, i: int) -> str:
+        return f"127.0.0.1:{self.metrics_port + i}"
+
+    def metrics_addrs(self) -> list[str]:
+        return [self.metrics_addr(i) for i in range(self.n_nodes)]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def init(self) -> None:
+        subprocess.run(
+            [
+                sys.executable, "-m", "cometbft_tpu", "testnet",
+                "--v", str(self.n_nodes), "--o", self.root,
+                "--chain-id", self.chain_id,
+                "--starting-port", str(self.base_port),
+            ],
+            env=self.env, check=True, capture_output=True, cwd=REPO,
+        )
+        from cometbft_tpu.config import Config
+
+        for i in range(self.n_nodes):
+            cfg = Config.load(os.path.join(self.root, f"node{i}"))
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = (
+                self.metrics_addr(i)
+            )
+            if self.config_hook is not None:
+                self.config_hook(i, cfg)
+            cfg.save()
+
+    def start(self, i: int, extra_env: dict | None = None) -> None:
+        env = dict(self.env)
+        if self.node_env is not None:
+            env.update(self.node_env(i) or {})
+        if extra_env:
+            env.update(extra_env)
+        with open(
+            os.path.join(self.root, f"node{i}.log"), "ab", buffering=0
+        ) as log:
+            self.procs[i] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "cometbft_tpu",
+                    "--home", os.path.join(self.root, f"node{i}"),
+                    "start",
+                ],
+                env=env, stdout=subprocess.DEVNULL, stderr=log, cwd=REPO,
+            )
+
+    def kill(self, i: int) -> None:
+        """SIGKILL one node (the churn scenario's failure injection —
+        no graceful shutdown, exactly like a crashed host)."""
+        import signal as _signal
+
+        p = self.procs.get(i)
+        if p is None:
+            return
+        try:
+            p.send_signal(_signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        p.wait(timeout=10)
+
+    def stop_all(self) -> None:
+        import signal as _signal
+
+        for p in self.procs.values():
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

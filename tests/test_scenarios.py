@@ -1,0 +1,574 @@
+"""Scenario fleet (ISSUE 20): WAN / byzantine / churn drives as a
+declarative matrix over the shared subprocess harness
+(tests/fleet_harness.py), every hostile condition ending in a
+perfdiff-gated ledger row.
+
+- ``wan``: CMT_TPU_NETEM injects 100 ms +/- jitter and 1% loss at the
+  MConnection frame pump; the stitched attribution plane separates
+  injected hold time from intrinsic work (``injected_s``) and the run
+  lands ``height_latency_p95_wan`` + per-stage ``_wan`` rows.
+- ``byzantine``: CMT_TPU_BYZ arms one node as the adversary —
+  equivocation must end as COMMITTED evidence (both counters move and
+  the block scan finds it), forged ``stx:`` envelopes must be refused
+  by honest process_proposal, corrupted block parts must not dent
+  liveness; the liveness row is ``byzantine_liveness_8node``.
+- ``churn``: SIGKILL + restart under sustained load; recovery is read
+  off the offset-corrected stitched timeline as
+  ``churn_recovery_seconds``.
+
+Only the lite 4-node wan drive runs in tier-1; the 8-node drives are
+``slow`` (make wan-smoke / byz-smoke / churn-smoke).  Ledger rows
+follow the fleet-smoke convention: scratch copy unless
+CMT_TPU_FLEET_LEDGER=1.  Port blocks here (27560+) must not collide
+with the fleet smoke's 27470/27490.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.utils import critpath, fleetobs  # noqa: E402
+from tests.fleet_harness import (  # noqa: E402
+    REPO,
+    FleetNet,
+    node_height,
+    rpc,
+    wait_heights,
+)
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _wan_config(_i, cfg):
+    """WAN-sized consensus timeouts (the test_e2e_wan precedent): the
+    default timeouts are shorter than an emulated 100 ms RTT and would
+    livelock rounds; pex stays off so topology is pinned."""
+    cfg.consensus.timeout_propose_ns = 1_000_000_000
+    cfg.consensus.timeout_propose_delta_ns = 200_000_000
+    cfg.consensus.timeout_vote_ns = 400_000_000
+    cfg.consensus.timeout_vote_delta_ns = 100_000_000
+    cfg.consensus.timeout_commit_ns = 200_000_000
+    cfg.p2p.pex = False
+
+
+def _scenario_env(net: FleetNet, scenario: str, netem: str | None = None,
+                  byz: str | None = None, byz_node: int | None = None):
+    """The declarative per-node env matrix: every node carries the
+    scenario label, node 0 aggregates /debug/fleet, netem applies
+    fleet-wide, the byzantine mode arms exactly one node."""
+
+    def env(i: int) -> dict:
+        e = {"CMT_TPU_SCENARIO": scenario}
+        if i == 0:
+            e["CMT_TPU_FLEET_PEERS"] = ",".join(
+                net.metrics_addr(j) for j in range(net.n_nodes) if j != 0
+            )
+        if netem is not None:
+            e["CMT_TPU_NETEM"] = netem
+        if byz is not None and i == byz_node:
+            e["CMT_TPU_BYZ"] = byz
+        return e
+
+    return env
+
+
+def _rpc_retry(port: int, method: str, tries: int = 5,
+               timeout: float = 10.0, **params):
+    """Busy subprocess nodes (pure-Python signing under load) can
+    blow a short RPC socket timeout; height reads and block scans
+    must ride through that, not flake."""
+    for k in range(tries):
+        try:
+            return rpc(port, method, timeout=timeout, **params)
+        except Exception:
+            if k == tries - 1:
+                raise
+            time.sleep(1.0)
+
+
+def _max_height(ports) -> int:
+    return max(
+        int(_rpc_retry(p, "status")["sync_info"]["latest_block_height"])
+        for p in ports
+    )
+
+
+def _boot(net: FleetNet, first_height: int = 2,
+          timeout: float = 120.0) -> None:
+    net.init()
+    for i in range(net.n_nodes):
+        net.start(i)
+    wait_heights(net.rpc_ports(), first_height, timeout=timeout)
+
+
+def _commit_strictly_increasing(net: FleetNet, n_new: int,
+                                timeout: float = 120.0) -> tuple[int, int]:
+    """Drive every node through n_new consecutive heights — waiting
+    for h0+1, h0+2, ... in order is the strictly-increasing proof."""
+    h0 = _max_height(net.rpc_ports())
+    for k in range(1, n_new + 1):
+        wait_heights(net.rpc_ports(), h0 + k, timeout=timeout)
+    return h0, h0 + n_new
+
+
+def _load(net: FleetNet, rate: int, seconds: float, ports=None) -> dict:
+    from cometbft_tpu.loadtime import SustainedLoader
+
+    loader = SustainedLoader(
+        endpoints=[
+            f"http://127.0.0.1:{p}" for p in (ports or net.rpc_ports())
+        ],
+        workers=4, tx_size=64,
+    )
+    return loader.run([(rate, seconds)])
+
+
+def _scrapes(net: FleetNet):
+    scrapes = fleetobs.scrape_fleet(
+        net.metrics_addrs(),
+        names=[f"node{i}" for i in range(net.n_nodes)],
+    )
+    errs = {s.name: s.error for s in scrapes if s.error}
+    assert not errs, errs
+    return scrapes
+
+
+def _ledger_path(tmp_path) -> str:
+    import perfledger
+
+    if os.environ.get("CMT_TPU_FLEET_LEDGER"):
+        return perfledger.default_path()
+    return str(tmp_path / "perf_ledger.json")
+
+
+def _append_latency_rows(tmp_path, suffix: str, source: str, scrapes,
+                         n_nodes: int, with_stages: bool = True) -> dict:
+    """The fleet-smoke ledger convention for a scenario: the p95
+    cross-node height latency plus (optionally) the per-stage rows
+    that explain it, all in perfdiff's lower-better units."""
+    import perfdiff
+    import perfledger
+
+    stitched = fleetobs.stitch_heights(scrapes)
+    lat = fleetobs.height_latencies_ms(stitched)
+    assert lat, "no cross-node height latencies measurable"
+    p95 = fleetobs.percentile(list(lat.values()), 95.0)
+    assert p95 > 0.0
+    measured = time.strftime("%Y-%m-%dT%H:%M:%S")
+    budgets = critpath.stage_budgets(scrapes)
+    assert budgets, "no height decomposed into stage budgets"
+    p95_budget = critpath.budget_at_percentile(budgets, 95.0)
+    rows = [
+        perfledger.make_entry(
+            f"height_latency_p95_{suffix}", round(p95, 3), "ms",
+            source, measured=measured, heights=len(lat), nodes=n_nodes,
+            injected_p95_ms=round(
+                (p95_budget.get("injected_s") or 0.0) * 1e3, 3
+            ),
+        ),
+    ]
+    if with_stages:
+        rows += [
+            perfledger.make_entry(
+                f"height_stage_p95_{stage}_{suffix}",
+                round(p95_budget["stages"][stage] * 1e3, 3), "ms",
+                source, measured=measured, height=p95_budget["height"],
+                gating_node=p95_budget["gating_node"],
+            )
+            for stage in critpath.STAGES
+        ]
+    path = _ledger_path(tmp_path)
+    perfledger.append(rows, path=path)
+    doc = perfledger.load(path)
+    got = {
+        e["config"]: e for e in doc["entries"]
+        if e.get("source") == source
+    }
+    assert f"height_latency_p95_{suffix}" in got
+    for e in got.values():
+        assert e["unit"] in perfdiff.LOWER_BETTER_UNITS
+    return {"p95_ms": p95, "budgets": budgets, "p95_budget": p95_budget,
+            "stitched": stitched}
+
+
+def _debug_fleet(net: FleetNet, tries: int = 3) -> dict:
+    """The aggregator fans out to every peer inside the handler, so
+    under load the round trip can exceed one scrape interval — retry
+    with a generous timeout rather than flake."""
+    for k in range(tries):
+        try:
+            with urllib.request.urlopen(
+                f"http://{net.metrics_addr(0)}/debug/fleet", timeout=30
+            ) as resp:
+                return json.loads(resp.read())
+        except Exception:
+            if k == tries - 1:
+                raise
+            time.sleep(2.0)
+
+
+def _counter_total(scrape, suffix: str, labels=None) -> float:
+    return sum(
+        v for _, v in fleetobs.series(scrape, suffix, labels=labels)
+    )
+
+
+# -- wan ------------------------------------------------------------------
+
+
+class TestWanScenario:
+    def test_wan_lite_tier1(self, tmp_path):
+        """Tier-1 keeps a lite wan drive alive: 4 nodes, mild netem,
+        committed heights, netem holds visible as injected_s, the
+        scenario label live on /debug/fleet, and the (scratch unless
+        CMT_TPU_FLEET_LEDGER) wan_lite latency row."""
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=4,
+            base_port=27560, metrics_port=27590,
+            chain_id="wan-lite-chain",
+        )
+        net.node_env = _scenario_env(
+            net, "wan", netem="delay=15~5;seed=11"
+        )
+        _boot(net)
+        try:
+            _load(net, 30, 3.0)
+            _commit_strictly_increasing(net, 2)
+            scrapes = _scrapes(net)
+            # netem holds landed in the rings...
+            holds = [
+                e for s in scrapes for e in s.span_events()
+                if e.get("name") == "p2p/netem_hold"
+            ]
+            assert holds, "armed netem produced no p2p/netem_hold spans"
+            # ...and the attribution plane separates injected wall
+            res = _append_latency_rows(
+                tmp_path, "wan_lite", "wan_lite", scrapes, 4,
+                with_stages=False,
+            )
+            assert any(
+                d.get("injected_s", 0.0) > 0.0
+                for d in res["budgets"].values()
+            ), res["budgets"]
+            payload = _debug_fleet(net)
+            assert payload["scenario"] == "wan"
+        finally:
+            net.stop_all()
+
+    @pytest.mark.slow
+    def test_wan_8node(self, tmp_path):
+        """The full wan drive: 8 nodes, 100 ms +/- 20 ms and 1% loss
+        on every send frame, WAN consensus timeouts, >= +3 strictly
+        increasing committed heights, injected-vs-intrinsic separation
+        in the stitched decomposition, and the height_latency_p95_wan
+        + per-stage _wan ledger rows."""
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=8,
+            base_port=27620, metrics_port=27660,
+            chain_id="wan-chain", config_hook=_wan_config,
+        )
+        net.node_env = _scenario_env(
+            net, "wan", netem="delay=100~20;loss=0.01;seed=42"
+        )
+        _boot(net, timeout=240.0)
+        try:
+            _load(net, 20, 5.0)
+            _commit_strictly_increasing(net, 3, timeout=240.0)
+            scrapes = _scrapes(net)
+            res = _append_latency_rows(
+                tmp_path, "wan", "wan_smoke", scrapes, 8,
+            )
+            # injected hold time is visible AND separable: it never
+            # exceeds the wall it sits inside, and under 100 ms holds
+            # at least one height carries a macroscopic injection
+            injected = {
+                h: d["injected_s"] for h, d in res["budgets"].items()
+            }
+            assert any(v > 0.02 for v in injected.values()), injected
+            for h, d in res["budgets"].items():
+                assert d["injected_s"] <= d["wall_s"] + 1e-6, (h, d)
+                # stages still account for the full wall — injection
+                # rides BESIDE the taxonomy, not inside it
+                assert abs(
+                    sum(d["stages"].values()) - d["wall_s"]
+                ) < 1e-5, (h, d)
+            # loss=1% charged retransmit penalties somewhere
+            dropped = sum(
+                _counter_total(s, "netem_dropped_frames_total")
+                for s in scrapes
+            )
+            assert dropped >= 0.0  # counter exists and parses
+            payload = _debug_fleet(net)
+            assert payload["scenario"] == "wan"
+        finally:
+            net.stop_all()
+
+
+# -- byzantine ------------------------------------------------------------
+
+
+class TestByzantineScenario:
+    @pytest.mark.slow
+    def test_equivocation_detected_and_committed_8node(self, tmp_path):
+        """One equivocating validator among 8: honest vote sets report
+        the conflict, the evidence pool DETECTS it (counter + type),
+        a proposer scoops it and the chain COMMITS it (counter + block
+        scan) — and liveness holds, landing byzantine_liveness_8node
+        in heights/min (higher-better, so perfdiff gates a drop)."""
+        import perfledger
+
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=8,
+            base_port=27700, metrics_port=27740,
+            chain_id="byz-chain",
+        )
+        net.node_env = _scenario_env(
+            net, "byzantine", byz="equivocate", byz_node=1
+        )
+        _boot(net, timeout=240.0)
+        try:
+            t0 = time.monotonic()
+            h0 = _max_height(net.rpc_ports())
+            honest = [f"node{i}" for i in range(8) if i != 1]
+            deadline = time.monotonic() + 180.0
+            detected = committed = 0.0
+            while time.monotonic() < deadline:
+                scrapes = fleetobs.scrape_fleet(
+                    net.metrics_addrs(),
+                    names=[f"node{i}" for i in range(8)],
+                )
+                by_name = {s.name: s for s in scrapes if not s.error}
+                detected = max(
+                    (_counter_total(
+                        by_name[n], "evidence_pool_detected_total",
+                        labels={"type": "duplicate_vote"},
+                    ) for n in honest if n in by_name),
+                    default=0.0,
+                )
+                committed = max(
+                    (_counter_total(
+                        by_name[n], "evidence_committed_total"
+                    ) for n in honest if n in by_name),
+                    default=0.0,
+                )
+                if detected > 0 and committed > 0:
+                    break
+                time.sleep(2.0)
+            assert detected > 0, "no honest node detected equivocation"
+            assert committed > 0, "detected evidence never committed"
+
+            # block scan: the committed evidence is IN a block
+            port = net.rpc_port(0)
+            top = _max_height([port])
+            found = []
+            for h in range(1, top + 1):
+                evs = _rpc_retry(port, "block", height=str(h))["block"][
+                    "evidence"]["evidence"]
+                if evs:
+                    found.append((h, len(evs)))
+            assert found, "no block carries the committed evidence"
+
+            # liveness under the attack, as a gated ledger row
+            h1, _ = _commit_strictly_increasing(net, 2, timeout=180.0)
+            span_min = (time.monotonic() - t0) / 60.0
+            rate = (h1 + 2 - h0) / span_min
+            assert rate > 0.0
+            perfledger.append(
+                [perfledger.make_entry(
+                    "byzantine_liveness_8node", round(rate, 2),
+                    "heights/min", "byz_smoke",
+                    measured=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    evidence_blocks=len(found), nodes=8,
+                )],
+                path=_ledger_path(tmp_path),
+            )
+            payload = _debug_fleet(net)
+            assert payload["scenario"] == "byzantine"
+        finally:
+            net.stop_all()
+
+    @pytest.mark.slow
+    def test_forged_stx_refused(self, tmp_path):
+        """The armed proposer appends a forged ``stx:`` envelope (real
+        pubkey, wrong signer) to its own proposals; honest
+        process_proposal refuses — the reject shows up on
+        state_process_proposal_total{result="reject"} — and the chain
+        keeps committing through honest proposers."""
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=4,
+            base_port=27780, metrics_port=27800,
+            chain_id="byz-forge-chain",
+        )
+        net.node_env = _scenario_env(
+            net, "byzantine", byz="forge_stx", byz_node=1
+        )
+        _boot(net, timeout=180.0)
+        try:
+            honest = [f"node{i}" for i in range(4) if i != 1]
+            deadline = time.monotonic() + 150.0
+            rejects = 0.0
+            while time.monotonic() < deadline:
+                scrapes = fleetobs.scrape_fleet(
+                    net.metrics_addrs(),
+                    names=[f"node{i}" for i in range(4)],
+                )
+                by_name = {s.name: s for s in scrapes if not s.error}
+                rejects = max(
+                    (_counter_total(
+                        by_name[n], "state_process_proposal_total",
+                        labels={"result": "reject"},
+                    ) for n in honest if n in by_name),
+                    default=0.0,
+                )
+                if rejects > 0:
+                    break
+                time.sleep(1.0)
+            assert rejects > 0, (
+                "no honest node ever refused the forged proposal"
+            )
+            # liveness: honest rounds still commit
+            _commit_strictly_increasing(net, 2, timeout=120.0)
+        finally:
+            net.stop_all()
+
+    @pytest.mark.slow
+    def test_corrupt_parts_liveness(self, tmp_path):
+        """The armed node flips a byte in every 4th block part it
+        gossips; receivers' merkle proofs reject the bad copies and
+        re-fetch from honest peers — liveness holds and every node
+        agrees on the committed hashes."""
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=4,
+            base_port=27820, metrics_port=27840,
+            chain_id="byz-part-chain",
+        )
+        net.node_env = _scenario_env(
+            net, "byzantine", byz="corrupt_parts", byz_node=1
+        )
+        _boot(net, timeout=180.0)
+        try:
+            _load(net, 20, 3.0)
+            _, h_end = _commit_strictly_increasing(net, 3, timeout=180.0)
+            # agreement: one hash per height across the fleet
+            for h in (h_end - 1, h_end):
+                hashes = {
+                    _rpc_retry(p, "block", height=str(h))["block_id"]["hash"]
+                    for p in net.rpc_ports()
+                }
+                assert len(hashes) == 1, (h, hashes)
+        finally:
+            net.stop_all()
+
+
+# -- churn ----------------------------------------------------------------
+
+
+class TestChurnScenario:
+    @pytest.mark.slow
+    def test_kill_restart_rejoin_under_load(self, tmp_path):
+        """SIGKILL one of 8 nodes under sustained load, keep the fleet
+        committing without it, restart it, and read the rejoin off the
+        offset-corrected stitched timeline: churn_recovery_seconds is
+        restart -> the node's first own committed height, as stamped
+        by its commit spans on the corrected wall axis."""
+        import perfledger
+
+        net = FleetNet(
+            str(tmp_path / "net"), n_nodes=8,
+            base_port=27860, metrics_port=27900,
+            chain_id="churn-chain",
+        )
+        net.node_env = _scenario_env(net, "churn")
+        _boot(net, timeout=240.0)
+        victim = 7
+        honest_ports = [
+            net.rpc_port(i) for i in range(8) if i != victim
+        ]
+        stop_load = threading.Event()
+
+        def _pump():
+            while not stop_load.is_set():
+                try:
+                    _load(net, 15, 3.0, ports=honest_ports)
+                except Exception:
+                    time.sleep(0.5)
+
+        pump = threading.Thread(target=_pump, daemon=True)
+        pump.start()
+        try:
+            net.kill(victim)
+            h_kill = _max_height(honest_ports)
+            # the 7-node fleet keeps committing without the victim
+            wait_heights(honest_ports, h_kill + 2, timeout=180.0)
+
+            restart_wall = time.time()
+            net.start(victim)
+            # rejoin is proven by the victim's OWN commit spans on
+            # the corrected wall axis — catching up via replay moves
+            # its RPC height first, so poll the stitched timeline
+            # until a post-restart height is committed_on the victim
+            deadline = time.monotonic() + 240.0
+            rejoin_commits: list[float] = []
+            while time.monotonic() < deadline:
+                try:
+                    if node_height(net.rpc_port(victim)) <= h_kill:
+                        time.sleep(1.0)
+                        continue
+                    scrapes = _scrapes(net)
+                except Exception:
+                    time.sleep(1.0)
+                    continue
+                corrections = fleetobs.clock_corrections(scrapes)
+                stitched = fleetobs.stitch_heights(
+                    scrapes, corrections=corrections
+                )
+                rejoin_commits = [
+                    ent["commit_end_wall"]
+                    for h, ent in stitched.items()
+                    if f"node{victim}" in ent["committed_on"]
+                    and ent["commit_end_wall"] is not None
+                    and ent["commit_end_wall"] >= restart_wall
+                ]
+                if rejoin_commits:
+                    break
+                time.sleep(2.0)
+            assert rejoin_commits, (
+                "victim's post-restart commits never reached the "
+                "stitched timeline"
+            )
+            recovery = min(rejoin_commits) - restart_wall
+            assert 0.0 <= recovery < 240.0, recovery
+            perfledger.append(
+                [perfledger.make_entry(
+                    "churn_recovery_seconds", round(recovery, 3), "s",
+                    "churn_smoke",
+                    measured=time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    nodes=8, killed=victim,
+                    heights_while_down=2,
+                )],
+                path=_ledger_path(tmp_path),
+            )
+            import perfdiff
+
+            doc = perfledger.load(_ledger_path(tmp_path))
+            row = [
+                e for e in doc["entries"]
+                if e["config"] == "churn_recovery_seconds"
+            ][-1]
+            assert row["unit"] in perfdiff.LOWER_BETTER_UNITS
+            # quiesce the load pump before the live fan-out check
+            stop_load.set()
+            pump.join(timeout=15)
+            payload = _debug_fleet(net)
+            assert payload["scenario"] == "churn"
+        finally:
+            stop_load.set()
+            pump.join(timeout=15)
+            net.stop_all()

@@ -77,6 +77,8 @@ VALIDATED_READERS = frozenset(
         "ring_size_from_env", "_int_env", "_float_env",
         # profiler's range-checked reader (0..1000 Hz window)
         "profile_hz_from_env",
+        # scenario-label reader ([A-Za-z0-9_-], <= 64 chars)
+        "name_from_env",
     }
 )
 

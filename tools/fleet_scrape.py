@@ -119,6 +119,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     print(_fmt_rollup(payload["rollup"]))
+    if payload.get("scenario"):
+        print(f"active scenario: {payload['scenario']}")
     if args.heights:
         lat = {
             h: ent["latency_ms"]
@@ -158,9 +160,11 @@ def main(argv: list[str] | None = None) -> int:
                 tops = " ".join(
                     f"{s}={v * 1e3:.1f}ms" for s, v in top if v > 0
                 )
+                inj = d.get("injected_s") or 0.0
+                inj_s = f" injected={inj * 1e3:.1f}ms" if inj else ""
                 print(
                     f"  h={h} wall={d['wall_s'] * 1e3:.1f}ms "
-                    f"gate={d.get('gating_node')} {tops}"
+                    f"gate={d.get('gating_node')} {tops}{inj_s}"
                 )
             p95b = payload.get("stage_budget_p95")
             if p95b:

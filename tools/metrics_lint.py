@@ -71,6 +71,7 @@ SUBSYSTEMS = {
     "LightMetrics": "light",
     "FleetMetrics": "fleet",
     "AttributionMetrics": "attribution",
+    "NetemMetrics": "netem",
 }
 
 #: structs whose every field must ALSO be documented in
@@ -109,6 +110,9 @@ DOC_CHECKED = (
     # thing read after a latency regression — every series must be
     # interpretable from the docs
     "AttributionMetrics",
+    # the scenario plane (ISSUE 20): injected-vs-intrinsic is read
+    # straight off the netem family, so it must be interpretable
+    "NetemMetrics",
 )
 
 DOC_FILES = (
@@ -123,6 +127,9 @@ DOC_FILES = (
 DOC_NON_SERIES = frozenset((
     "light_client",
     "light_serve_sustained",
+    # evidence-type label VALUE (evidence_pool_detected_total{type}),
+    # not a series — it parses as light_<field> but names an attack
+    "light_client_attack",
     # critpath stage names in the observability.md taxonomy table:
     # they parse as <subsystem>_<field> under the abci/store/wal
     # prefixes but denote attribution stages, not series
